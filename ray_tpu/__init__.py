@@ -1,0 +1,161 @@
+"""ray_tpu: a TPU-native distributed compute framework.
+
+Capability-parity redesign of the reference (Ray v2.38-class: tasks, actors,
+objects, placement groups, collectives, Data/Train/Tune/Serve) built
+TPU-first: device objects are jax.Arrays, collectives compile to XLA ICI
+operations via shard_map/pjit, the scheduler is TPU-pod-topology aware, and
+DP/FSDP/TP/PP/EP/SP parallelism is first-class.
+
+Public core API mirrors the reference's (reference:
+python/ray/_private/worker.py — init :1260, get :2617, put :2785,
+wait :2850, remote :3239).
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ._private import common as _common
+from ._private.api import (ActorClass, ActorHandle, RemoteFunction, get_actor,
+                           kill, remote)
+from ._private.common import (ActorDiedError, GetTimeoutError, ObjectLostError,
+                              RayTpuError, TaskError, WorkerCrashedError)
+from ._private.core import CoreWorker, ObjectRef
+
+__version__ = "0.1.0"
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.RLock()
+_core: Optional[CoreWorker] = None
+_owned_cluster = None
+
+
+def is_initialized() -> bool:
+    return _core is not None
+
+
+def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
+         num_tpus: Optional[float] = None,
+         resources: Optional[Dict[str, float]] = None,
+         ignore_reinit_error: bool = False,
+         logging_level: int = logging.INFO) -> Dict[str, Any]:
+    """Start (or connect to) a ray_tpu cluster and connect this driver.
+
+    With no address, boots a local single-node cluster: a control-plane
+    process and one raylet (reference: ray.init starting a head node,
+    worker.py:1260).
+    """
+    global _core, _owned_cluster
+    with _lock:
+        if _core is not None:
+            if ignore_reinit_error:
+                return connection_info()
+            raise RuntimeError("ray_tpu.init() called twice; use "
+                               "ignore_reinit_error=True to allow")
+        if address is None and os.environ.get("RAY_TPU_ADDRESS"):
+            address = os.environ["RAY_TPU_ADDRESS"]
+        if address is None:
+            from ._private import bootstrap
+
+            cluster, node = bootstrap.start_local(num_cpus=num_cpus,
+                                                  num_tpus=num_tpus,
+                                                  resources=resources)
+            _owned_cluster = cluster
+            control_addr = cluster.control_addr
+            raylet_addr = node.addr
+        else:
+            host, port = address.rsplit(":", 1)
+            control_addr = (host, int(port))
+            raylet_addr = None
+        # find the local raylet & its store
+        from ._private.protocol import Client
+
+        node_id = None
+        store_root = None
+        if raylet_addr is None:
+            probe = Client(control_addr, name="init-probe")
+            nodes = probe.call("get_nodes", timeout=30.0)
+            probe.close()
+            alive = [n for n in nodes if n["state"] == "ALIVE"]
+            if alive:
+                raylet_addr = tuple(alive[0]["addr"])
+        if raylet_addr is not None:
+            probe = Client(raylet_addr, name="init-probe-raylet")
+            info = probe.call("node_info", timeout=30.0)
+            probe.close()
+            node_id = info["node_id"]
+            if os.path.isdir(info["store_root"]):
+                store_root = info["store_root"]
+        _core = CoreWorker(control_addr, raylet_addr, mode="driver",
+                           node_id=node_id, store_root=store_root)
+        atexit.register(shutdown)
+        return connection_info()
+
+
+def connection_info() -> Dict[str, Any]:
+    core = _require()
+    return {
+        "control_address": f"{core.control.addr[0]}:{core.control.addr[1]}",
+        "node_id": core.node_id,
+        "job_id": core.job_id,
+    }
+
+
+def shutdown() -> None:
+    global _core, _owned_cluster
+    with _lock:
+        core, _core = _core, None
+        cluster, _owned_cluster = _owned_cluster, None
+    if core is not None:
+        core.shutdown()
+    if cluster is not None:
+        cluster.shutdown()
+
+
+def _require() -> CoreWorker:
+    if _core is not None:
+        return _core
+    # inside a worker process the CoreWorker registers itself globally
+    from ._private.core import current_core
+
+    return current_core()
+
+
+def put(value: Any) -> ObjectRef:
+    return _require().put(value)
+
+
+def get(refs, timeout: Optional[float] = None):
+    return _require().get(refs, timeout=timeout)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None):
+    return _require().wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _require().control.call("cluster_resources", {})["total"]
+
+
+def available_resources() -> Dict[str, float]:
+    return _require().control.call("cluster_resources", {})["available"]
+
+
+def nodes() -> List[Dict[str, Any]]:
+    return _require().control.call("get_nodes", {})
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "put", "get", "wait", "remote",
+    "kill", "get_actor", "cluster_resources", "available_resources", "nodes",
+    "ObjectRef", "ActorHandle", "ActorClass", "RemoteFunction",
+    "RayTpuError", "TaskError", "ActorDiedError", "WorkerCrashedError",
+    "ObjectLostError", "GetTimeoutError",
+]

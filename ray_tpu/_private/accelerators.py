@@ -1,0 +1,60 @@
+"""TPU accelerator detection & topology labels.
+
+Mirror of the reference's accelerator-manager layer
+(reference: python/ray/_private/accelerators/tpu.py:71 TPUAcceleratorManager
+— chip detection via GCE metadata :48, TPU_VISIBLE_CHIPS env :155-195).
+We detect chips from /dev/accel* (TPU VMs expose one per chip), or the
+GCE metadata env mirrors, or RAY_TPU_NUM_CHIPS; topology labels
+(slice name, worker id, accelerator type) come from the standard TPU env
+vars so gang placement can keep bundles on one ICI-connected slice.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Optional, Tuple
+
+
+def num_tpu_chips() -> int:
+    env = os.environ.get("RAY_TPU_NUM_CHIPS")
+    if env:
+        return int(env)
+    chips = glob.glob("/dev/accel*")
+    if chips:
+        return len(chips)
+    bounds = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS")  # e.g. "2,2,1"
+    if bounds:
+        n = 1
+        for p in bounds.split(","):
+            n *= int(p)
+        return n
+    return 0
+
+
+def tpu_labels() -> Dict[str, str]:
+    labels = {}
+    slice_name = os.environ.get("TPU_NAME") or os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if slice_name:
+        labels["tpu_slice"] = slice_name.split(",")[0]
+    wid = os.environ.get("TPU_WORKER_ID")
+    if wid is not None:
+        labels["tpu_worker_id"] = wid
+    acc = os.environ.get("TPU_ACCELERATOR_TYPE")
+    if acc:
+        labels["tpu_accelerator_type"] = acc
+    return labels
+
+
+def default_resources() -> Dict[str, float]:
+    res: Dict[str, float] = {"CPU": float(os.cpu_count() or 1)}
+    chips = num_tpu_chips()
+    if chips:
+        res["TPU"] = float(chips)
+    return res
+
+
+def visible_chip_env(assigned: Tuple[int, ...]) -> Dict[str, str]:
+    """Env vars confining a worker to its assigned chips
+    (reference: tpu.py:155-195 set_current_process_visible_accelerator_ids)."""
+    return {"TPU_VISIBLE_CHIPS": ",".join(str(c) for c in assigned)}

@@ -1,0 +1,190 @@
+"""Public API objects: @remote functions, actor classes, handles, options.
+
+Mirrors the reference's decorator machinery (reference:
+python/ray/remote_function.py:266 RemoteFunction._remote,
+python/ray/actor.py ActorClass/ActorHandle) with TPU-native resource names
+(num_tpus instead of num_gpus).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from . import common
+from .core import ObjectRef, current_core
+
+
+def _build_resources(num_cpus=None, num_tpus=None, resources=None,
+                     default_cpus: float = 1.0) -> Dict[str, float]:
+    out: Dict[str, float] = dict(resources or {})
+    out[common.CPU] = float(default_cpus if num_cpus is None else num_cpus)
+    if num_tpus is not None and num_tpus > 0:
+        out[common.TPU] = float(num_tpus)
+    if out.get(common.CPU) == 0:
+        out.pop(common.CPU, None)
+    return out
+
+
+def _strategy_to_wire(scheduling_strategy) -> tuple:
+    """Returns (strategy_dict, pg_id, bundle_index)."""
+    if scheduling_strategy is None or scheduling_strategy == "DEFAULT":
+        return None, None, -1
+    if scheduling_strategy == "SPREAD":
+        return {"kind": "spread"}, None, -1
+    kind = type(scheduling_strategy).__name__
+    if kind == "PlacementGroupSchedulingStrategy":
+        pg = scheduling_strategy.placement_group
+        return None, pg.id, scheduling_strategy.placement_group_bundle_index
+    if kind == "NodeAffinitySchedulingStrategy":
+        return {"kind": "node_affinity",
+                "node_id": scheduling_strategy.node_id,
+                "soft": scheduling_strategy.soft}, None, -1
+    raise ValueError(f"unknown scheduling strategy: {scheduling_strategy!r}")
+
+
+class RemoteFunction:
+    def __init__(self, fn, **opts):
+        self._fn = fn
+        self._opts = opts
+        functools.update_wrapper(self, fn)
+
+    def options(self, **opts):
+        merged = {**self._opts, **opts}
+        return RemoteFunction(self._fn, **merged)
+
+    def remote(self, *args, **kwargs):
+        core = current_core()
+        o = self._opts
+        strategy, pg, bidx = _strategy_to_wire(o.get("scheduling_strategy"))
+        if pg is None and o.get("placement_group") is not None:
+            pg = o["placement_group"].id
+            bidx = o.get("placement_group_bundle_index", -1)
+        refs = core.submit_task(
+            self._fn, args, kwargs,
+            num_returns=o.get("num_returns", 1),
+            resources=_build_resources(o.get("num_cpus"), o.get("num_tpus"),
+                                       o.get("resources")),
+            max_retries=o.get("max_retries", 3),
+            strategy=strategy, pg=pg, bundle_index=bidx,
+            name=o.get("name", ""),
+        )
+        return refs[0] if o.get("num_returns", 1) == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._fn.__name__} cannot be called directly; "
+            f"use .remote()")
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1):
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        core = current_core()
+        refs = core.submit_actor_task(self._handle._actor_id, self._name,
+                                      args, kwargs,
+                                      num_returns=self._num_returns)
+        return refs[0] if self._num_returns == 1 else refs
+
+
+class ActorHandle:
+    def __init__(self, actor_id: str, class_name: str = "Actor",
+                 is_owner: bool = False):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._is_owner = is_owner
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id})"
+
+    def __reduce__(self):
+        # deserialized handles are borrowed: they never own the lifetime
+        return (ActorHandle, (self._actor_id, self._class_name))
+
+    def __del__(self):
+        # the owner handle going out of scope terminates the actor
+        # (reference semantics: actors are GC'd with their original handle
+        # unless detached)
+        if getattr(self, "_is_owner", False):
+            try:
+                core = current_core()
+                if not core._shutdown:
+                    core.control.call_async(
+                        "kill_actor", {"actor_id": self._actor_id,
+                                       "no_restart": True})
+            except Exception:
+                pass
+
+
+class ActorClass:
+    def __init__(self, cls, **opts):
+        self._cls = cls
+        self._opts = opts
+
+    def options(self, **opts):
+        return ActorClass(self._cls, **{**self._opts, **opts})
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        core = current_core()
+        o = self._opts
+        strategy, pg, bidx = _strategy_to_wire(o.get("scheduling_strategy"))
+        if pg is None and o.get("placement_group") is not None:
+            pg = o["placement_group"].id
+            bidx = o.get("placement_group_bundle_index", -1)
+        aid = core.create_actor(
+            self._cls, args, kwargs,
+            resources=_build_resources(o.get("num_cpus"), o.get("num_tpus"),
+                                       o.get("resources")),
+            name=o.get("name"),
+            max_restarts=o.get("max_restarts", 0),
+            max_task_retries=o.get("max_task_retries", 0),
+            max_concurrency=o.get("max_concurrency", 1),
+            pg=pg, bundle_index=bidx,
+            detached=o.get("lifetime") == "detached",
+            runtime_env=o.get("runtime_env"),
+        )
+        return ActorHandle(aid, self._cls.__name__,
+                           is_owner=o.get("lifetime") != "detached")
+
+    def __call__(self, *a, **k):
+        raise TypeError(f"actor class {self._cls.__name__} cannot be "
+                        f"instantiated directly; use .remote()")
+
+
+def remote(*args, **opts):
+    """@ray_tpu.remote decorator for functions and classes."""
+
+    def wrap(obj):
+        if isinstance(obj, type):
+            return ActorClass(obj, **opts)
+        return RemoteFunction(obj, **opts)
+
+    if len(args) == 1 and not opts and (callable(args[0]) or isinstance(args[0], type)):
+        return wrap(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only")
+    return wrap
+
+
+def get_actor(name: str) -> ActorHandle:
+    core = current_core()
+    view = core.get_actor_by_name(name)
+    if view is None or view["state"] == "DEAD":
+        raise ValueError(f"no alive actor named {name!r}")
+    return ActorHandle(view["actor_id"], view.get("class_name") or "Actor")
+
+
+def kill(handle: ActorHandle, no_restart: bool = True):
+    current_core().kill_actor(handle._actor_id, no_restart=no_restart)

@@ -1,0 +1,177 @@
+"""Cluster bootstrap: start/stop control plane + raylet processes.
+
+Analog of the reference's node bootstrap (reference:
+python/ray/_private/node.py:1354 start_head_processes,
+services.py:1442 start_gcs_server, :1507 start_raylet).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+from . import common
+from .protocol import Client, free_port
+
+_SESSION_ROOT = "/dev/shm/ray_tpu"
+
+
+def _wait_ping(addr: Tuple[str, int], timeout: float = 30.0, what: str = "daemon"):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            cli = Client(addr, connect_timeout=2.0)
+            cli.call("ping", timeout=5.0)
+            cli.close()
+            return
+        except Exception as e:
+            last = e
+            time.sleep(0.05)
+    raise RuntimeError(f"{what} at {addr} did not come up: {last}")
+
+
+class NodeHandle:
+    def __init__(self, proc: subprocess.Popen, addr, node_id, session_dir):
+        self.proc = proc
+        self.addr = addr
+        self.node_id = node_id
+        self.session_dir = session_dir
+
+    def kill(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+
+    def terminate(self):
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+def _package_pythonpath() -> str:
+    """PYTHONPATH entry that makes ray_tpu importable in child processes
+    even when the driver found it via sys.path manipulation."""
+    import ray_tpu
+
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+    existing = os.environ.get("PYTHONPATH", "")
+    parts = [pkg_parent] + ([existing] if existing else [])
+    return os.pathsep.join(parts)
+
+
+def _spawn(cmd: List[str], log_path: str, env: Optional[Dict[str, str]] = None):
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    out = open(log_path, "ab")
+    proc = subprocess.Popen(
+        cmd, stdout=out, stderr=out,
+        env={**os.environ, "PYTHONPATH": _package_pythonpath(), **(env or {})},
+        start_new_session=True)
+    out.close()
+    return proc
+
+
+class Cluster:
+    """A local cluster: one control server + N raylets (each its own
+    process).  The workhorse for tests, like the reference's
+    ray.cluster_utils.Cluster (reference: python/ray/cluster_utils.py:135)."""
+
+    def __init__(self, session_name: Optional[str] = None):
+        self.session_name = session_name or f"session-{int(time.time()*1000)}-{os.getpid()}"
+        self.session_dir = os.path.join(_SESSION_ROOT, self.session_name)
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.log_dir = os.path.join(self.session_dir, "logs")
+        self.control_proc: Optional[subprocess.Popen] = None
+        self.control_addr: Optional[Tuple[str, int]] = None
+        self.nodes: List[NodeHandle] = []
+        self._n = 0
+
+    def start_control(self) -> Tuple[str, int]:
+        port = free_port()
+        self.control_proc = _spawn(
+            [sys.executable, "-m", "ray_tpu._private.control",
+             "--host", "127.0.0.1", "--port", str(port)],
+            os.path.join(self.log_dir, "control.log"))
+        self.control_addr = ("127.0.0.1", port)
+        _wait_ping(self.control_addr, what="control plane")
+        return self.control_addr
+
+    def add_node(self, resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 wait: bool = True) -> NodeHandle:
+        assert self.control_addr is not None, "start_control() first"
+        self._n += 1
+        nid = common.node_id()
+        port = free_port()
+        node_session = os.path.join(self.session_dir, f"node-{self._n}")
+        cmd = [sys.executable, "-m", "ray_tpu._private.node",
+               "--control", f"{self.control_addr[0]}:{self.control_addr[1]}",
+               "--host", "127.0.0.1", "--port", str(port),
+               "--node-id", nid, "--session-dir", node_session]
+        if resources is not None:
+            cmd += ["--resources", json.dumps(resources)]
+        env = {}
+        if labels:
+            env["RAY_TPU_NODE_LABELS"] = json.dumps(labels)
+        proc = _spawn(cmd, os.path.join(self.log_dir, f"raylet-{self._n}.log"), env)
+        h = NodeHandle(proc, ("127.0.0.1", port), nid, node_session)
+        self.nodes.append(h)
+        if wait:
+            _wait_ping(h.addr, what="raylet")
+        return h
+
+    def remove_node(self, h: NodeHandle, graceful: bool = False):
+        if graceful:
+            h.terminate()
+        else:
+            h.kill()
+        if h in self.nodes:
+            self.nodes.remove(h)
+
+    def shutdown(self):
+        for h in list(self.nodes):
+            h.terminate()
+        self.nodes.clear()
+        if self.control_proc is not None and self.control_proc.poll() is None:
+            self.control_proc.terminate()
+            try:
+                self.control_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.control_proc.kill()
+        self.control_proc = None
+        import shutil
+
+        shutil.rmtree(self.session_dir, ignore_errors=True)
+
+
+# global session for ray_tpu.init()
+_cluster: Optional[Cluster] = None
+
+
+def start_local(num_cpus=None, num_tpus=None, resources=None) -> Tuple[Cluster, NodeHandle]:
+    global _cluster
+    c = Cluster()
+    c.start_control()
+    res = None
+    if num_cpus is not None or num_tpus is not None or resources is not None:
+        from . import accelerators
+
+        res = dict(resources or {})
+        res.setdefault("CPU", float(num_cpus if num_cpus is not None
+                                    else (os.cpu_count() or 1)))
+        tpus = num_tpus if num_tpus is not None else accelerators.num_tpu_chips()
+        if tpus:
+            res.setdefault("TPU", float(tpus))
+    node = c.add_node(resources=res)
+    _cluster = c
+    return c, node

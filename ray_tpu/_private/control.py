@@ -1,0 +1,771 @@
+"""Control plane server — the cluster-singleton GCS equivalent.
+
+The reference's GcsServer composes per-concern managers (node, resource,
+job, actor, placement group, worker, KV, pubsub, health
+— reference: src/ray/gcs/gcs_server/gcs_server.h:128-179).  This module is the
+TPU-native analog: one process owning
+
+  * node table + resource view (fed by raylet heartbeats, the ray_syncer
+    equivalent),
+  * internal KV store (function table, collective rendezvous, named objects),
+  * pubsub (long-push channels over server->client push frames),
+  * actor manager with restart-on-failure (GcsActorManager::RestartActor,
+    reference: gcs_actor_manager.cc:1361),
+  * placement group manager with 2-phase PREPARE/COMMIT bundle reservation
+    (reference: gcs_placement_group_manager.h:230,
+    placement_group_resource_manager.h:54-61),
+  * health checks via heartbeat timeout
+    (reference: gcs_health_check_manager.h).
+
+Scheduling policy: hybrid pack-then-spread over the resource view (reference:
+hybrid_scheduling_policy.h:61) extended with TPU topology labels — nodes carry
+`tpu_slice`/`tpu_worker_id` labels so gang placement can keep bundles on one
+ICI-connected slice.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import pickle
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from . import common
+from .common import add, fits, normalize_resources, subtract
+from .protocol import Client, DaemonPool, Deferred, Server, ServerConn
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_INTERVAL_S = 0.5
+NODE_DEATH_TIMEOUT_S = 5.0
+
+ALIVE, RESTARTING, DEAD, PENDING = "ALIVE", "RESTARTING", "DEAD", "PENDING"
+
+
+class NodeRecord:
+    def __init__(self, nid: str, addr, resources, labels):
+        self.node_id = nid
+        self.addr = tuple(addr)
+        self.total = dict(resources)
+        self.available = dict(resources)
+        self.labels = dict(labels or {})
+        self.last_heartbeat = time.monotonic()
+        self.state = ALIVE
+
+    def view(self):
+        return {
+            "node_id": self.node_id,
+            "addr": self.addr,
+            "total": common.denormalize_resources(self.total),
+            "available": common.denormalize_resources(self.available),
+            "labels": self.labels,
+            "state": self.state,
+        }
+
+
+class ActorRecord:
+    def __init__(self, aid: str, spec_blob: bytes, name, resources, max_restarts,
+                 owner_id, pg_id=None, bundle_index=-1, detached=False):
+        self.actor_id = aid
+        self.spec_blob = spec_blob
+        self.name = name
+        self.resources = resources
+        self.max_restarts = max_restarts
+        self.restarts = 0
+        self.owner_id = owner_id
+        self.pg_id = pg_id
+        self.bundle_index = bundle_index
+        self.detached = detached
+        self.state = PENDING
+        self.node_id: Optional[str] = None
+        self.worker_addr: Optional[Tuple[str, int]] = None
+        self.incarnation = 0
+        self.error: Optional[str] = None
+        self.class_name = ""
+
+    def view(self):
+        return {
+            "actor_id": self.actor_id,
+            "name": self.name,
+            "state": self.state,
+            "node_id": self.node_id,
+            "worker_addr": self.worker_addr,
+            "incarnation": self.incarnation,
+            "restarts": self.restarts,
+            "max_restarts": self.max_restarts,
+            "error": self.error,
+            "class_name": self.class_name,
+            "pg_id": self.pg_id,
+        }
+
+
+class PlacementGroupRecord:
+    def __init__(self, pgid: str, bundles: List[Dict[str, int]], strategy: str, name: str):
+        self.pg_id = pgid
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.state = PENDING
+        # bundle index -> node_id
+        self.assignments: Dict[int, str] = {}
+
+    def view(self):
+        return {
+            "pg_id": self.pg_id,
+            "strategy": self.strategy,
+            "name": self.name,
+            "state": self.state,
+            "bundles": [common.denormalize_resources(b) for b in self.bundles],
+            "assignments": dict(self.assignments),
+        }
+
+
+class ControlServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.server = Server(host, port, name="control")
+        self.lock = threading.RLock()
+        self.kv: Dict[str, Dict[str, bytes]] = {}  # namespace -> key -> value
+        self.nodes: Dict[str, NodeRecord] = {}
+        self.actors: Dict[str, ActorRecord] = {}
+        self.named_actors: Dict[str, str] = {}
+        self.pgs: Dict[str, PlacementGroupRecord] = {}
+        self.functions: Dict[str, bytes] = {}
+        self.jobs: Dict[str, Dict[str, Any]] = {}
+        self.subs: Dict[str, Set[ServerConn]] = {}  # topic -> conns
+        self.node_clients: Dict[str, Client] = {}  # node_id -> raylet client
+        self.pool = DaemonPool(max_workers=16, name="control")
+        self._stop = threading.Event()
+        self.start_time = time.time()
+
+        s = self.server
+        s.handle("ping", lambda c, p: "pong")
+        s.handle("kv_put", self.h_kv_put)
+        s.handle("kv_get", self.h_kv_get)
+        s.handle("kv_del", self.h_kv_del)
+        s.handle("kv_keys", self.h_kv_keys)
+        s.handle("kv_exists", self.h_kv_exists)
+        s.handle("register_node", self.h_register_node)
+        s.handle("heartbeat", self.h_heartbeat)
+        s.handle("get_nodes", self.h_get_nodes)
+        s.handle("pick_node", self.h_pick_node)
+        s.handle("register_function", self.h_register_function)
+        s.handle("get_function", self.h_get_function)
+        s.handle("register_job", self.h_register_job)
+        s.handle("create_actor", self.h_create_actor, deferred=True)
+        s.handle("get_actor", self.h_get_actor)
+        s.handle("get_actor_spec", lambda c, p: (
+            self.actors[p["actor_id"]].spec_blob
+            if p["actor_id"] in self.actors else None))
+        s.handle("wait_actor_alive", self.h_wait_actor_alive, deferred=True)
+        s.handle("list_actors", self.h_list_actors)
+        s.handle("actor_ready", self.h_actor_ready)
+        s.handle("actor_failed", self.h_actor_failed)
+        s.handle("kill_actor", self.h_kill_actor, deferred=True)
+        s.handle("subscribe", self.h_subscribe)
+        s.handle("publish", self.h_publish)
+        s.handle("create_pg", self.h_create_pg, deferred=True)
+        s.handle("remove_pg", self.h_remove_pg, deferred=True)
+        s.handle("get_pg", self.h_get_pg)
+        s.handle("list_pgs", lambda c, p: [pg.view() for pg in self.pgs.values()])
+        s.handle("cluster_resources", self.h_cluster_resources)
+        s.handle("state_dump", self.h_state_dump)
+        s.on_disconnect(self.h_disconnect)
+
+        self.health_thread = threading.Thread(
+            target=self._health_loop, name="control-health", daemon=True
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, block: bool = False):
+        self.health_thread.start()
+        self.server.start(thread=not block)
+
+    def stop(self):
+        self._stop.set()
+        self.server.stop()
+        self.pool.shutdown(wait=False)
+
+    @property
+    def addr(self):
+        return self.server.addr
+
+    # -- kv ----------------------------------------------------------------
+
+    def h_kv_put(self, conn, p):
+        ns, k, v, overwrite = p["ns"], p["key"], p["val"], p.get("overwrite", True)
+        with self.lock:
+            space = self.kv.setdefault(ns, {})
+            if not overwrite and k in space:
+                return False
+            space[k] = v
+            return True
+
+    def h_kv_get(self, conn, p):
+        with self.lock:
+            return self.kv.get(p["ns"], {}).get(p["key"])
+
+    def h_kv_del(self, conn, p):
+        with self.lock:
+            return self.kv.get(p["ns"], {}).pop(p["key"], None) is not None
+
+    def h_kv_keys(self, conn, p):
+        prefix = p.get("prefix", "")
+        with self.lock:
+            return [k for k in self.kv.get(p["ns"], {}) if k.startswith(prefix)]
+
+    def h_kv_exists(self, conn, p):
+        with self.lock:
+            return p["key"] in self.kv.get(p["ns"], {})
+
+    # -- nodes -------------------------------------------------------------
+
+    def h_register_node(self, conn, p):
+        rec = NodeRecord(p["node_id"], p["addr"], normalize_resources(p["resources"]),
+                         p.get("labels"))
+        with self.lock:
+            self.nodes[rec.node_id] = rec
+        conn.meta["node_id"] = rec.node_id
+        logger.info("node %s registered at %s: %s", rec.node_id[:12], rec.addr, p["resources"])
+        self.publish("node", {"event": "added", "node": rec.view()})
+        return {"ok": True, "cluster_start_time": self.start_time}
+
+    def h_heartbeat(self, conn, p):
+        with self.lock:
+            rec = self.nodes.get(p["node_id"])
+            if rec is None or rec.state == DEAD:
+                return {"ok": False}
+            rec.last_heartbeat = time.monotonic()
+            if "available" in p:
+                rec.available = normalize_resources(p["available"])
+            return {"ok": True}
+
+    def h_get_nodes(self, conn, p):
+        with self.lock:
+            return [n.view() for n in self.nodes.values()]
+
+    def _alive_nodes(self) -> List[NodeRecord]:
+        return [n for n in self.nodes.values() if n.state == ALIVE]
+
+    def _pick_node_locked(self, demand: Dict[str, int], strategy=None) -> Optional[NodeRecord]:
+        """Hybrid policy: pack onto the busiest node that fits (reference
+        defaults to pack-then-spread, hybrid_scheduling_policy.h:61); honors
+        node-affinity / pg strategies."""
+        nodes = self._alive_nodes()
+        if strategy is not None:
+            kind = strategy.get("kind")
+            if kind == "node_affinity":
+                n = self.nodes.get(strategy["node_id"])
+                if n is not None and n.state == ALIVE and (strategy.get("soft") or fits(n.available, demand)):
+                    return n
+                if not strategy.get("soft"):
+                    return None
+            elif kind == "placement_group":
+                pg = self.pgs.get(strategy["pg_id"])
+                if pg is None or pg.state != ALIVE:
+                    return None
+                idx = strategy.get("bundle_index", -1)
+                indices = [idx] if idx >= 0 else list(pg.assignments)
+                for i in indices:
+                    nid = pg.assignments.get(i)
+                    n = self.nodes.get(nid)
+                    if n is not None and n.state == ALIVE:
+                        return n
+                return None
+            elif kind == "spread":
+                cands = [n for n in nodes if fits(n.available, demand)]
+                if not cands:
+                    return None
+                # least-loaded first
+                return min(cands, key=lambda n: sum(v / max(t, 1) for v, t in
+                                                    ((n.total.get(k, 0) - n.available.get(k, 0), n.total.get(k, 1))
+                                                     for k in n.total)))
+        cands = [n for n in nodes if fits(n.available, demand)]
+        if not cands:
+            return None
+        # pack: most-utilized node that still fits
+        def util(n: NodeRecord) -> float:
+            tot = sum(n.total.values()) or 1
+            return 1.0 - sum(n.available.values()) / tot
+        return max(cands, key=util)
+
+    def h_pick_node(self, conn, p):
+        demand = normalize_resources(p.get("resources"))
+        with self.lock:
+            n = self._pick_node_locked(demand, p.get("strategy"))
+            if n is None:
+                return None
+            # optimistic reservation so concurrent picks spread; the next
+            # heartbeat overwrites with the raylet's ground truth
+            subtract(n.available, demand)
+            return {"node_id": n.node_id, "addr": n.addr}
+
+    def h_cluster_resources(self, conn, p):
+        with self.lock:
+            total: Dict[str, int] = {}
+            avail: Dict[str, int] = {}
+            for n in self._alive_nodes():
+                add(total, n.total)
+                add(avail, n.available)
+            return {
+                "total": common.denormalize_resources(total),
+                "available": common.denormalize_resources(avail),
+            }
+
+    # -- functions / jobs --------------------------------------------------
+
+    def h_register_function(self, conn, p):
+        with self.lock:
+            self.functions[p["function_id"]] = p["blob"]
+        return True
+
+    def h_get_function(self, conn, p):
+        with self.lock:
+            return self.functions.get(p["function_id"])
+
+    def h_register_job(self, conn, p):
+        with self.lock:
+            self.jobs[p["job_id"]] = {"start_time": time.time(), **p}
+        conn.meta["job_id"] = p["job_id"]
+        return True
+
+    # -- pubsub ------------------------------------------------------------
+
+    def h_subscribe(self, conn, p):
+        with self.lock:
+            for t in p["topics"]:
+                self.subs.setdefault(t, set()).add(conn)
+        return True
+
+    def h_publish(self, conn, p):
+        self.publish(p["topic"], p["payload"])
+        return True
+
+    def publish(self, topic: str, payload: Any):
+        with self.lock:
+            conns = list(self.subs.get(topic, ()))
+        dead = [c for c in conns if not c.push(f"pub:{topic}", payload)]
+        if dead:
+            with self.lock:
+                for c in dead:
+                    for s in self.subs.values():
+                        s.discard(c)
+
+    # -- raylet client cache ----------------------------------------------
+
+    def _node_client(self, nid: str) -> Optional[Client]:
+        with self.lock:
+            rec = self.nodes.get(nid)
+            if rec is None or rec.state != ALIVE:
+                return None
+            cli = self.node_clients.get(nid)
+            if cli is not None and not cli.closed:
+                return cli
+            addr = rec.addr
+        try:
+            cli = Client(addr, name=f"control->raylet-{nid[:8]}")
+        except Exception:
+            return None
+        with self.lock:
+            self.node_clients[nid] = cli
+        return cli
+
+    # -- actors ------------------------------------------------------------
+
+    def h_create_actor(self, conn, p, d: Deferred):
+        rec = ActorRecord(
+            p["actor_id"], p["spec_blob"], p.get("name"),
+            normalize_resources(p.get("resources")), p.get("max_restarts", 0),
+            p.get("owner_id", ""), p.get("pg_id"), p.get("bundle_index", -1),
+            p.get("detached", False),
+        )
+        rec.class_name = p.get("class_name", "")
+        with self.lock:
+            if rec.name:
+                if rec.name in self.named_actors:
+                    d.reject(f"actor name {rec.name!r} already taken")
+                    return
+                self.named_actors[rec.name] = rec.actor_id
+            self.actors[rec.actor_id] = rec
+        self.pool.submit(self._schedule_actor, rec, d)
+
+    def _schedule_actor(self, rec: ActorRecord, d: Optional[Deferred]):
+        """Lease a worker for the actor on a chosen node and hand it the
+        creation spec (reference: GcsActorScheduler::Schedule,
+        gcs_actor_scheduler.h:146)."""
+        strategy = None
+        if rec.pg_id:
+            strategy = {"kind": "placement_group", "pg_id": rec.pg_id,
+                        "bundle_index": rec.bundle_index}
+        deadline = time.monotonic() + 60.0
+        while not self._stop.is_set():
+            with self.lock:
+                if rec.state == DEAD:
+                    if d:
+                        d.resolve(rec.view())
+                    return
+                node = self._pick_node_locked(rec.resources, strategy)
+            if node is not None:
+                cli = self._node_client(node.node_id)
+                if cli is not None:
+                    try:
+                        r = cli.call("start_actor_worker", {
+                            "actor_id": rec.actor_id,
+                            "resources": common.denormalize_resources(rec.resources),
+                            "pg_id": rec.pg_id,
+                            "bundle_index": rec.bundle_index,
+                            "incarnation": rec.incarnation,
+                        }, timeout=60.0)
+                        if r and r.get("ok"):
+                            with self.lock:
+                                rec.node_id = node.node_id
+                                rec.worker_addr = tuple(r["worker_addr"])
+                                # stays PENDING until worker reports ready
+                            if d:
+                                d.resolve(rec.view())
+                            return
+                    except Exception as e:
+                        logger.warning("actor %s placement on %s failed: %s",
+                                       rec.actor_id[:12], node.node_id[:12], e)
+            if time.monotonic() > deadline:
+                with self.lock:
+                    rec.state = DEAD
+                    rec.error = "actor scheduling timed out: no node with resources " + str(
+                        common.denormalize_resources(rec.resources))
+                self.publish("actor", {"event": "dead", "actor": rec.view()})
+                if d:
+                    d.resolve(rec.view())
+                return
+            time.sleep(0.2)
+
+    def h_actor_ready(self, conn, p):
+        """Worker finished running the creation task."""
+        with self.lock:
+            rec = self.actors.get(p["actor_id"])
+            if rec is None:
+                return False
+            if p.get("error"):
+                rec.state = DEAD
+                rec.error = p["error"]
+            else:
+                rec.state = ALIVE
+                rec.worker_addr = tuple(p["worker_addr"])
+                rec.incarnation = p.get("incarnation", rec.incarnation)
+            view = rec.view()
+        self.publish("actor", {"event": "alive" if not p.get("error") else "dead",
+                               "actor": view})
+        return True
+
+    def h_actor_failed(self, conn, p):
+        """Worker/raylet reports actor process death -> maybe restart
+        (reference: GcsActorManager::RestartActor gcs_actor_manager.cc:1361)."""
+        self._on_actor_failure(p["actor_id"], p.get("error", "actor process died"))
+        return True
+
+    def _on_actor_failure(self, aid: str, error: str):
+        with self.lock:
+            rec = self.actors.get(aid)
+            if rec is None or rec.state == DEAD:
+                return
+            if rec.max_restarts != 0 and (
+                rec.max_restarts < 0 or rec.restarts < rec.max_restarts
+            ):
+                rec.restarts += 1
+                rec.incarnation += 1
+                rec.state = RESTARTING
+                rec.worker_addr = None
+                view = rec.view()
+                restart = True
+            else:
+                rec.state = DEAD
+                rec.error = error
+                view = rec.view()
+                restart = False
+        self.publish("actor", {"event": "restarting" if restart else "dead", "actor": view})
+        if restart:
+            self.pool.submit(self._schedule_actor, self.actors[aid], None)
+
+    def h_get_actor(self, conn, p):
+        with self.lock:
+            aid = p.get("actor_id")
+            if aid is None and p.get("name"):
+                aid = self.named_actors.get(p["name"])
+            rec = self.actors.get(aid) if aid else None
+            return None if rec is None else rec.view()
+
+    def h_wait_actor_alive(self, conn, p, d: Deferred):
+        aid, timeout = p["actor_id"], p.get("timeout", 60.0)
+
+        def waiter():
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline and not self._stop.is_set():
+                with self.lock:
+                    rec = self.actors.get(aid)
+                    if rec is None:
+                        d.resolve(None)
+                        return
+                    if rec.state in (ALIVE, DEAD):
+                        d.resolve(rec.view())
+                        return
+                time.sleep(0.05)
+            with self.lock:
+                rec = self.actors.get(aid)
+                d.resolve(rec.view() if rec else None)
+
+        self.pool.submit(waiter)
+
+    def h_list_actors(self, conn, p):
+        with self.lock:
+            return [a.view() for a in self.actors.values()]
+
+    def h_kill_actor(self, conn, p, d: Deferred):
+        aid, no_restart = p["actor_id"], p.get("no_restart", True)
+
+        def do():
+            with self.lock:
+                rec = self.actors.get(aid)
+                if rec is None:
+                    d.resolve(False)
+                    return
+                if no_restart:
+                    rec.max_restarts = 0
+                nid, addr = rec.node_id, rec.worker_addr
+            if nid:
+                cli = self._node_client(nid)
+                if cli is not None:
+                    try:
+                        cli.call("kill_actor_worker", {"actor_id": aid}, timeout=10.0)
+                    except Exception:
+                        pass
+            if no_restart:
+                with self.lock:
+                    rec = self.actors.get(aid)
+                    if rec is not None:
+                        rec.state = DEAD
+                        rec.error = "killed via kill_actor"
+                        if rec.name:
+                            self.named_actors.pop(rec.name, None)
+                        view = rec.view()
+                self.publish("actor", {"event": "dead", "actor": view})
+            d.resolve(True)
+
+        self.pool.submit(do)
+
+    # -- placement groups --------------------------------------------------
+
+    def h_create_pg(self, conn, p, d: Deferred):
+        bundles = [normalize_resources(b) for b in p["bundles"]]
+        rec = PlacementGroupRecord(p["pg_id"], bundles, p.get("strategy", "PACK"),
+                                   p.get("name", ""))
+        with self.lock:
+            self.pgs[rec.pg_id] = rec
+        self.pool.submit(self._schedule_pg, rec, d)
+
+    def _schedule_pg(self, rec: PlacementGroupRecord, d: Deferred):
+        """2-phase bundle reservation: PREPARE on every chosen node, then
+        COMMIT; release everything on any failure (reference:
+        placement_group_resource_manager.h:54-61)."""
+        deadline = time.monotonic() + 60.0
+        while not self._stop.is_set():
+            plan_result = self._plan_pg(rec)
+            if plan_result is not None:
+                prepared: List[Tuple[str, int]] = []
+                ok = True
+                for idx, nid in plan_result.items():
+                    cli = self._node_client(nid)
+                    try:
+                        r = cli.call("prepare_bundle", {
+                            "pg_id": rec.pg_id, "bundle_index": idx,
+                            "resources": common.denormalize_resources(rec.bundles[idx]),
+                        }, timeout=15.0) if cli else None
+                        if not (r and r.get("ok")):
+                            ok = False
+                            break
+                        prepared.append((nid, idx))
+                    except Exception:
+                        ok = False
+                        break
+                if ok:
+                    for nid, idx in prepared:
+                        cli = self._node_client(nid)
+                        if cli:
+                            try:
+                                cli.call("commit_bundle",
+                                         {"pg_id": rec.pg_id, "bundle_index": idx},
+                                         timeout=15.0)
+                            except Exception:
+                                pass
+                    with self.lock:
+                        rec.assignments = dict(plan_result)
+                        rec.state = ALIVE
+                    self.publish("pg", {"event": "alive", "pg": rec.view()})
+                    d.resolve(rec.view())
+                    return
+                for nid, idx in prepared:
+                    cli = self._node_client(nid)
+                    if cli:
+                        try:
+                            cli.call("release_bundle",
+                                     {"pg_id": rec.pg_id, "bundle_index": idx},
+                                     timeout=15.0)
+                        except Exception:
+                            pass
+            if time.monotonic() > deadline:
+                with self.lock:
+                    rec.state = DEAD
+                d.resolve(rec.view())
+                return
+            time.sleep(0.2)
+
+    def _plan_pg(self, rec: PlacementGroupRecord) -> Optional[Dict[int, str]]:
+        with self.lock:
+            nodes = self._alive_nodes()
+            # simulate availability
+            sim = {n.node_id: dict(n.available) for n in nodes}
+            # TPU slice affinity: prefer nodes sharing a tpu_slice label
+            order = sorted(nodes, key=lambda n: n.labels.get("tpu_slice", ""))
+            out: Dict[int, str] = {}
+            if rec.strategy == "STRICT_PACK":
+                for n in order:
+                    s = dict(sim[n.node_id])
+                    if all(fits(s, b) and (subtract(s, b) or True)
+                           for b in rec.bundles):
+                        return {i: n.node_id for i in range(len(rec.bundles))}
+                return None
+            if rec.strategy == "STRICT_SPREAD":
+                used: Set[str] = set()
+                for i, b in enumerate(rec.bundles):
+                    got = next((n.node_id for n in order
+                                if n.node_id not in used
+                                and fits(sim[n.node_id], b)), None)
+                    if got is None:
+                        return None
+                    subtract(sim[got], b)
+                    used.add(got)
+                    out[i] = got
+                return out
+            # PACK / SPREAD: soft preferences
+            prefer_spread = rec.strategy == "SPREAD"
+            last = None
+            for i, b in enumerate(rec.bundles):
+                cands = [n for n in order if fits(sim[n.node_id], b)]
+                if not cands:
+                    return None
+                if prefer_spread:
+                    fresh = [n for n in cands if n.node_id != last]
+                    n = (fresh or cands)[0]
+                else:
+                    n = cands[0] if last is None else next(
+                        (c for c in cands if c.node_id == last), cands[0])
+                subtract(sim[n.node_id], b)
+                out[i] = n.node_id
+                last = n.node_id
+            return out
+
+    def h_remove_pg(self, conn, p, d: Deferred):
+        pgid = p["pg_id"]
+
+        def do():
+            with self.lock:
+                rec = self.pgs.get(pgid)
+                if rec is None:
+                    d.resolve(False)
+                    return
+                rec.state = DEAD
+                assignments = dict(rec.assignments)
+            for idx, nid in assignments.items():
+                cli = self._node_client(nid)
+                if cli:
+                    try:
+                        cli.call("release_bundle", {"pg_id": pgid, "bundle_index": idx},
+                                 timeout=15.0)
+                    except Exception:
+                        pass
+            self.publish("pg", {"event": "removed", "pg_id": pgid})
+            d.resolve(True)
+
+        self.pool.submit(do)
+
+    def h_get_pg(self, conn, p):
+        with self.lock:
+            rec = self.pgs.get(p["pg_id"]) or (
+                self.pgs.get(self._pg_by_name(p["name"])) if p.get("name") else None)
+            return None if rec is None else rec.view()
+
+    def _pg_by_name(self, name):
+        for pg in self.pgs.values():
+            if pg.name == name:
+                return pg.pg_id
+        return None
+
+    # -- health / failure detection ---------------------------------------
+
+    def _health_loop(self):
+        while not self._stop.is_set():
+            time.sleep(HEARTBEAT_INTERVAL_S)
+            now = time.monotonic()
+            dead_nodes: List[NodeRecord] = []
+            with self.lock:
+                for rec in self.nodes.values():
+                    if rec.state == ALIVE and now - rec.last_heartbeat > NODE_DEATH_TIMEOUT_S:
+                        rec.state = DEAD
+                        dead_nodes.append(rec)
+            for rec in dead_nodes:
+                logger.warning("node %s declared dead (heartbeat timeout)", rec.node_id[:12])
+                self.publish("node", {"event": "removed", "node": rec.view()})
+                self._on_node_death(rec.node_id)
+
+    def _on_node_death(self, nid: str):
+        with self.lock:
+            cli = self.node_clients.pop(nid, None)
+            affected = [a for a in self.actors.values()
+                        if a.node_id == nid and a.state in (ALIVE, PENDING, RESTARTING)]
+        if cli:
+            cli.close()
+        for rec in affected:
+            self._on_actor_failure(rec.actor_id, f"node {nid} died")
+
+    def h_disconnect(self, conn: ServerConn):
+        with self.lock:
+            for s in self.subs.values():
+                s.discard(conn)
+        nid = conn.meta.get("node_id")
+        if nid:
+            with self.lock:
+                rec = self.nodes.get(nid)
+                if rec is not None and rec.state == ALIVE:
+                    rec.state = DEAD
+                    view = rec.view()
+                else:
+                    return
+            self.publish("node", {"event": "removed", "node": view})
+            self._on_node_death(nid)
+
+    # -- state dump (state API source of truth) ---------------------------
+
+    def h_state_dump(self, conn, p):
+        with self.lock:
+            return {
+                "nodes": [n.view() for n in self.nodes.values()],
+                "actors": [a.view() for a in self.actors.values()],
+                "pgs": [g.view() for g in self.pgs.values()],
+                "jobs": dict(self.jobs),
+                "start_time": self.start_time,
+            }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s control %(levelname)s %(message)s")
+    srv = ControlServer(args.host, args.port)
+    srv.start(block=True)
+
+
+if __name__ == "__main__":
+    main()

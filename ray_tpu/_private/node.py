@@ -1,0 +1,614 @@
+"""Raylet: the per-node daemon.
+
+TPU-native analog of the reference's NodeManager
+(reference: src/ray/raylet/node_manager.cc:101): owns the worker pool
+(worker_pool.h:366 PopWorker + startup-token protocol), lease-based local
+scheduling (local_task_manager.h:58), placement-group bundle 2-phase commit
+(placement_group_resource_manager.h:54-61), the node object store (shm_store),
+and node-to-node object transfer (object_manager.proto:61 Push/Pull).
+
+Deadlock avoidance for nested tasks: a worker blocked in `get` notifies the
+raylet (task_blocked), which releases its CPUs so queued leases can be granted
+— possibly by spawning extra workers (the reference does the same when
+workers block in ray.get).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Set, Tuple
+
+from . import accelerators, common
+from .common import add, fits, normalize_resources, subtract
+from .protocol import Client, Deferred, Server, ServerConn
+from .shm_store import ShmObjectStore
+
+logger = logging.getLogger(__name__)
+
+LEASE_GRANT_TICK_S = 0.01
+WORKER_SPAWN_HARD_CAP_FACTOR = 10
+
+
+class WorkerRecord:
+    def __init__(self, worker_id: str, proc: Optional[subprocess.Popen], token: int):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.token = token
+        self.addr: Optional[Tuple[str, int]] = None
+        self.conn: Optional[ServerConn] = None
+        self.state = "starting"  # starting | idle | leased | actor | dead
+        self.actor_id: Optional[str] = None
+        self.lease_id: Optional[str] = None
+        self.blocked = False
+        self.lease_resources: Dict[str, int] = {}
+        self.bundle_key: Optional[Tuple[str, int]] = None
+
+
+class PendingLease:
+    def __init__(self, demand: Dict[str, int], deferred: Deferred, client_id: str,
+                 bundle: Optional[Tuple[str, int]] = None):
+        self.demand = demand
+        self.deferred = deferred
+        self.client_id = client_id
+        self.bundle = bundle
+        self.ts = time.monotonic()
+
+
+class Raylet:
+    def __init__(self, control_addr: Tuple[str, int], host: str = "127.0.0.1",
+                 port: int = 0, resources: Optional[Dict[str, float]] = None,
+                 session_dir: Optional[str] = None, labels: Optional[Dict[str, str]] = None,
+                 node_id: Optional[str] = None):
+        self.node_id = node_id or common.node_id()
+        self.control_addr = tuple(control_addr)
+        self.server = Server(host, port, name="raylet")
+        self.session_dir = session_dir or f"/dev/shm/ray_tpu/{self.node_id}"
+        self.store = ShmObjectStore(os.path.join(self.session_dir, "objects"))
+        res = resources if resources is not None else accelerators.default_resources()
+        self.total = normalize_resources(res)
+        self.available = dict(self.total)
+        self.labels = {**accelerators.tpu_labels(), **(labels or {})}
+        self.lock = threading.RLock()
+        self.workers: Dict[str, WorkerRecord] = {}
+        self.workers_by_token: Dict[int, WorkerRecord] = {}
+        self.idle: Deque[WorkerRecord] = deque()
+        self.pending_leases: Deque[PendingLease] = deque()
+        self.bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}  # (pg,idx)->{resources,state}
+        self._next_token = 0
+        self._stop = threading.Event()
+        self.control: Optional[Client] = None
+        self.peer_clients: Dict[Tuple[str, int], Client] = {}
+        self.max_workers = max(
+            1, int(sum(v for k, v in self.total.items() if k == common.CPU) / common._GRAN)
+        ) * WORKER_SPAWN_HARD_CAP_FACTOR
+
+        s = self.server
+        s.handle("ping", lambda c, p: "pong")
+        s.handle("register_worker", self.h_register_worker)
+        s.handle("request_lease", self.h_request_lease, deferred=True)
+        s.handle("return_lease", self.h_return_lease)
+        s.handle("cancel_lease_requests", self.h_cancel_lease_requests)
+        s.handle("task_blocked", self.h_task_blocked)
+        s.handle("task_unblocked", self.h_task_unblocked)
+        s.handle("start_actor_worker", self.h_start_actor_worker, deferred=True)
+        s.handle("kill_actor_worker", self.h_kill_actor_worker)
+        s.handle("prepare_bundle", self.h_prepare_bundle)
+        s.handle("commit_bundle", self.h_commit_bundle)
+        s.handle("release_bundle", self.h_release_bundle)
+        s.handle("fetch_object", self.h_fetch_object)
+        s.handle("pull_object", self.h_pull_object, deferred=True)
+        s.handle("delete_objects", self.h_delete_objects)
+        s.handle("store_stats", self.h_store_stats)
+        s.handle("node_info", self.h_node_info)
+        s.on_disconnect(self.h_disconnect)
+
+        self._grant_thread = threading.Thread(target=self._grant_loop,
+                                              name="raylet-grant", daemon=True)
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           name="raylet-heartbeat", daemon=True)
+        self._reap_thread = threading.Thread(target=self._reap_loop,
+                                             name="raylet-reap", daemon=True)
+        self._pull_pool: Dict[str, threading.Event] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, block: bool = False):
+        self.server.start()
+        self.control = Client(self.control_addr, name="raylet->control",
+                              on_disconnect=self._on_control_lost)
+        self.control.call("register_node", {
+            "node_id": self.node_id,
+            "addr": self.server.addr,
+            "resources": common.denormalize_resources(self.total),
+            "labels": self.labels,
+        }, timeout=30.0)
+        self._grant_thread.start()
+        self._hb_thread.start()
+        self._reap_thread.start()
+        logger.info("raylet %s up at %s resources=%s", self.node_id[:12],
+                    self.server.addr, common.denormalize_resources(self.total))
+        if block:
+            try:
+                while not self._stop.is_set():
+                    time.sleep(0.5)
+            except KeyboardInterrupt:
+                pass
+            self.shutdown()
+
+    def _on_control_lost(self):
+        if not self._stop.is_set():
+            logger.warning("control plane connection lost; shutting down raylet")
+            self.shutdown()
+
+    def shutdown(self):
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        with self.lock:
+            workers = list(self.workers.values())
+        for w in workers:
+            self._kill_worker(w)
+        self.server.stop()
+        self.store.destroy()
+        try:
+            shutil.rmtree(self.session_dir, ignore_errors=True)
+        except OSError:
+            pass
+
+    # -- worker pool -------------------------------------------------------
+
+    def _spawn_worker(self, actor_id: Optional[str] = None,
+                      env_extra: Optional[Dict[str, str]] = None) -> WorkerRecord:
+        with self.lock:
+            self._next_token += 1
+            token = self._next_token
+        wid = common.worker_id()
+        rec = WorkerRecord(wid, None, token)
+        rec.actor_id = actor_id
+        with self.lock:
+            self.workers[wid] = rec
+            self.workers_by_token[token] = rec
+        env = dict(os.environ)
+        from .bootstrap import _package_pythonpath
+
+        env["PYTHONPATH"] = _package_pythonpath()
+        env["RAY_TPU_STARTUP_TOKEN"] = str(token)
+        env["RAY_TPU_WORKER_ID"] = wid
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        if actor_id:
+            env["RAY_TPU_ACTOR_ID"] = actor_id
+        if env_extra:
+            env.update(env_extra)
+        cmd = [sys.executable, "-m", "ray_tpu._private.worker_proc",
+               "--raylet", f"{self.server.addr[0]}:{self.server.addr[1]}",
+               "--control", f"{self.control_addr[0]}:{self.control_addr[1]}"]
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{wid[:12]}.log"), "ab")
+        rec.proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=out,
+                                    start_new_session=True)
+        out.close()
+        return rec
+
+    def h_register_worker(self, conn: ServerConn, p):
+        token = p["token"]
+        with self.lock:
+            rec = self.workers_by_token.get(token)
+            if rec is None:
+                return {"ok": False, "error": "unknown startup token"}
+            rec.addr = tuple(p["addr"])
+            rec.conn = conn
+            conn.meta["worker_id"] = rec.worker_id
+            if rec.actor_id is None:
+                rec.state = "idle"
+                self.idle.append(rec)
+            else:
+                rec.state = "actor"
+        return {"ok": True, "worker_id": rec.worker_id, "node_id": self.node_id,
+                "actor_id": rec.actor_id}
+
+    def _kill_worker(self, rec: WorkerRecord):
+        rec.state = "dead"
+        if rec.proc is not None and rec.proc.poll() is None:
+            try:
+                rec.proc.terminate()
+            except OSError:
+                pass
+
+    def h_disconnect(self, conn: ServerConn):
+        wid = conn.meta.get("worker_id")
+        if not wid:
+            return
+        with self.lock:
+            rec = self.workers.get(wid)
+            if rec is None or rec.state == "dead":
+                return
+            was = rec.state
+            actor_id = rec.actor_id
+            if rec.lease_resources:
+                self._free_lease_resources(rec)
+            if rec in self.idle:
+                try:
+                    self.idle.remove(rec)
+                except ValueError:
+                    pass
+            rec.state = "dead"
+            self.workers.pop(wid, None)
+            self.workers_by_token.pop(rec.token, None)
+        if actor_id and self.control is not None and not self._stop.is_set():
+            try:
+                self.control.notify("actor_failed", {
+                    "actor_id": actor_id,
+                    "error": f"actor worker process exited (state={was})",
+                })
+            except OSError:
+                pass
+
+    def _reap_loop(self):
+        while not self._stop.is_set():
+            time.sleep(1.0)
+            with self.lock:
+                for rec in list(self.workers.values()):
+                    if rec.proc is not None and rec.proc.poll() is not None \
+                            and rec.state == "starting":
+                        # died before registering
+                        logger.warning("worker %s died during startup", rec.worker_id[:12])
+                        self.workers.pop(rec.worker_id, None)
+                        self.workers_by_token.pop(rec.token, None)
+
+    # -- leases ------------------------------------------------------------
+
+    def h_request_lease(self, conn, p, d: Deferred):
+        demand = normalize_resources(p.get("resources") or {common.CPU: 1})
+        bundle = p.get("bundle")  # (pg_id, index) -> draw from bundle reservation
+        if bundle is not None:
+            bundle = (bundle[0], bundle[1])
+            with self.lock:
+                b = self.bundles.get(bundle)
+                if b is None or b["state"] != "committed":
+                    d.reject(f"bundle {bundle} not committed on this node")
+                    return
+        with self.lock:
+            self.pending_leases.append(
+                PendingLease(demand, d, p.get("client_id", ""), bundle))
+        self._try_grant()
+
+    def _lease_fits(self, pl: PendingLease) -> bool:
+        """Bundle leases draw from the bundle's reservation, not general
+        availability (the reservation was subtracted at PREPARE)."""
+        if pl.bundle is not None:
+            b = self.bundles.get(pl.bundle)
+            if b is None or b["state"] != "committed":
+                return True  # grant path will reject; don't wedge the queue
+            free = dict(b["resources"])
+            subtract(free, b.setdefault("used", {}))
+            return fits(free, pl.demand)
+        return fits(self.available, pl.demand)
+
+    def _grant_loop(self):
+        while not self._stop.is_set():
+            time.sleep(LEASE_GRANT_TICK_S)
+            self._try_grant()
+
+    def _try_grant(self):
+        grants: List[Tuple[PendingLease, WorkerRecord]] = []
+        spawn = 0
+        with self.lock:
+            while self.pending_leases:
+                pl = self.pending_leases[0]
+                if not self._lease_fits(pl):
+                    break
+                w = None
+                while self.idle:
+                    cand = self.idle.popleft()
+                    if cand.state == "idle":
+                        w = cand
+                        break
+                if w is None:
+                    n_starting = sum(1 for r in self.workers.values()
+                                     if r.state == "starting" and r.actor_id is None)
+                    if n_starting == 0 and len(self.workers) < self.max_workers:
+                        spawn += 1
+                    break
+                self.pending_leases.popleft()
+                if pl.bundle is not None:
+                    b = self.bundles.get(pl.bundle)
+                    if b is None or b["state"] != "committed":
+                        pl.deferred.reject(f"bundle {pl.bundle} no longer committed")
+                        self.idle.append(w)
+                        continue
+                    add(b.setdefault("used", {}), pl.demand)
+                    w.bundle_key = pl.bundle
+                else:
+                    subtract(self.available, pl.demand)
+                w.state = "leased"
+                w.lease_id = common.new_id("lease-")
+                w.lease_resources = pl.demand
+                grants.append((pl, w))
+        for _ in range(spawn):
+            self._spawn_worker()
+        for pl, w in grants:
+            pl.deferred.resolve({
+                "ok": True, "lease_id": w.lease_id, "worker_id": w.worker_id,
+                "worker_addr": w.addr, "node_id": self.node_id,
+            })
+
+    def _free_lease_resources(self, rec: WorkerRecord):
+        """Return a worker's held resources to the right pool (general
+        availability or its PG bundle's reservation).  Caller holds lock."""
+        if rec.bundle_key is not None:
+            if not rec.blocked:  # blocked leases already gave resources back
+                b = self.bundles.get(rec.bundle_key)
+                if b is not None:
+                    subtract(b.setdefault("used", {}), rec.lease_resources)
+            rec.bundle_key = None
+        elif not rec.blocked:
+            add(self.available, rec.lease_resources)
+        rec.lease_resources = {}
+
+    def h_return_lease(self, conn, p):
+        wid = p.get("worker_id")
+        with self.lock:
+            rec = self.workers.get(wid)
+            if rec is None or rec.state != "leased":
+                return False
+            self._free_lease_resources(rec)
+            rec.blocked = False
+            rec.state = "idle"
+            rec.lease_id = None
+            self.idle.append(rec)
+        self._try_grant()
+        return True
+
+    def h_cancel_lease_requests(self, conn, p):
+        cid = p.get("client_id")
+        canceled = []
+        with self.lock:
+            keep = deque()
+            for pl in self.pending_leases:
+                if pl.client_id == cid:
+                    canceled.append(pl)
+                else:
+                    keep.append(pl)
+            self.pending_leases = keep
+        for pl in canceled:
+            pl.deferred.resolve({"ok": False, "canceled": True})
+        return len(canceled)
+
+    def h_task_blocked(self, conn, p):
+        wid = p.get("worker_id")
+        with self.lock:
+            rec = self.workers.get(wid)
+            if rec is not None and rec.state in ("leased", "actor") \
+                    and not rec.blocked:
+                rec.blocked = True
+                if rec.bundle_key is not None:
+                    b = self.bundles.get(rec.bundle_key)
+                    if b is not None:
+                        subtract(b.setdefault("used", {}), rec.lease_resources)
+                else:
+                    add(self.available, rec.lease_resources)
+        self._try_grant()
+        return True
+
+    def h_task_unblocked(self, conn, p):
+        wid = p.get("worker_id")
+        with self.lock:
+            rec = self.workers.get(wid)
+            if rec is not None and rec.blocked:
+                rec.blocked = False
+                if rec.bundle_key is not None:
+                    b = self.bundles.get(rec.bundle_key)
+                    if b is not None:
+                        add(b.setdefault("used", {}), rec.lease_resources)
+                else:
+                    # may go negative transiently: oversubscription by design
+                    subtract(self.available, rec.lease_resources)
+        return True
+
+    # -- actors ------------------------------------------------------------
+
+    def h_start_actor_worker(self, conn, p, d: Deferred):
+        demand = normalize_resources(p.get("resources"))
+        with self.lock:
+            bundle_key = (p.get("pg_id"), p.get("bundle_index", -1))
+            from_bundle = p.get("pg_id") and self.bundles.get(bundle_key, {}).get("state") == "committed"
+            if not from_bundle:
+                if not fits(self.available, demand):
+                    d.resolve({"ok": False, "error": "insufficient resources"})
+                    return
+                subtract(self.available, demand)
+        env = {}
+        if p.get("incarnation") is not None:
+            env["RAY_TPU_ACTOR_INCARNATION"] = str(p["incarnation"])
+        rec = self._spawn_worker(actor_id=p["actor_id"], env_extra=env)
+        rec.lease_resources = demand if not from_bundle else {}
+
+        def waiter():
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and not self._stop.is_set():
+                with self.lock:
+                    if rec.addr is not None:
+                        d.resolve({"ok": True, "worker_addr": rec.addr,
+                                   "worker_id": rec.worker_id})
+                        return
+                    if rec.state == "dead" or rec.worker_id not in self.workers:
+                        break
+                time.sleep(0.02)
+            with self.lock:
+                if not from_bundle:
+                    add(self.available, rec.lease_resources)
+            d.resolve({"ok": False, "error": "actor worker failed to start"})
+
+        threading.Thread(target=waiter, daemon=True).start()
+
+    def h_kill_actor_worker(self, conn, p):
+        aid = p["actor_id"]
+        with self.lock:
+            rec = next((r for r in self.workers.values() if r.actor_id == aid), None)
+        if rec is None:
+            return False
+
+        def do_kill():
+            # ask politely first so the worker can run atexit handlers
+            if rec.conn is not None:
+                rec.conn.push("shutdown", {})
+            time.sleep(0.05)
+            self._kill_worker(rec)
+            with self.lock:
+                if rec.lease_resources:
+                    self._free_lease_resources(rec)
+
+        threading.Thread(target=do_kill, daemon=True).start()
+        return True
+
+    # -- placement group bundles (2-phase commit) -------------------------
+
+    def h_prepare_bundle(self, conn, p):
+        key = (p["pg_id"], p["bundle_index"])
+        demand = normalize_resources(p["resources"])
+        with self.lock:
+            if key in self.bundles:
+                return {"ok": True}
+            if not fits(self.available, demand):
+                return {"ok": False, "error": "insufficient resources"}
+            subtract(self.available, demand)
+            self.bundles[key] = {"resources": demand, "state": "prepared",
+                                 "ts": time.monotonic()}
+        return {"ok": True}
+
+    def h_commit_bundle(self, conn, p):
+        key = (p["pg_id"], p["bundle_index"])
+        with self.lock:
+            b = self.bundles.get(key)
+            if b is None:
+                return {"ok": False}
+            b["state"] = "committed"
+        return {"ok": True}
+
+    def h_release_bundle(self, conn, p):
+        key = (p["pg_id"], p["bundle_index"])
+        with self.lock:
+            b = self.bundles.pop(key, None)
+            if b is not None:
+                add(self.available, b["resources"])
+        return {"ok": True}
+
+    # -- object plane ------------------------------------------------------
+
+    def h_fetch_object(self, conn, p):
+        """Serve raw object bytes to a remote raylet (chunking: the frame
+        layer handles large payloads; reference streams 1MiB chunks,
+        object_manager.proto:61)."""
+        return self.store.read_bytes(p["object_id"])
+
+    def h_pull_object(self, conn, p, d: Deferred):
+        oid, from_addr = p["object_id"], tuple(p["from_addr"])
+
+        def do():
+            if self.store.contains(oid):
+                d.resolve(True)
+                return
+            try:
+                cli = self._peer(from_addr)
+                data = cli.call("fetch_object", {"object_id": oid}, timeout=120.0)
+                if data is None:
+                    d.resolve(False)
+                    return
+                self.store.write_bytes(oid, data)
+                d.resolve(True)
+            except Exception as e:
+                d.reject(f"pull {oid} from {from_addr} failed: {e}")
+
+        threading.Thread(target=do, daemon=True).start()
+
+    def _peer(self, addr: Tuple[str, int]) -> Client:
+        with self.lock:
+            cli = self.peer_clients.get(addr)
+            if cli is not None and not cli.closed:
+                return cli
+        cli = Client(addr, name="raylet-peer")
+        with self.lock:
+            self.peer_clients[addr] = cli
+        return cli
+
+    def h_delete_objects(self, conn, p):
+        n = 0
+        for oid in p["object_ids"]:
+            if self.store.delete(oid):
+                n += 1
+        return n
+
+    def h_store_stats(self, conn, p):
+        objs = self.store.list_objects()
+        return {"num_objects": len(objs),
+                "bytes": sum(self.store.size(o) or 0 for o in objs)}
+
+    def h_node_info(self, conn, p):
+        with self.lock:
+            return {
+                "node_id": self.node_id,
+                "store_root": self.store.root,
+                "control_addr": self.control_addr,
+                "total": common.denormalize_resources(self.total),
+                "available": common.denormalize_resources(self.available),
+                "labels": self.labels,
+                "num_workers": len(self.workers),
+                "idle": len(self.idle),
+                "pending_leases": len(self.pending_leases),
+            }
+
+    # -- heartbeats --------------------------------------------------------
+
+    def _heartbeat_loop(self):
+        from .control import HEARTBEAT_INTERVAL_S
+
+        while not self._stop.is_set():
+            try:
+                with self.lock:
+                    avail = common.denormalize_resources(
+                        {k: max(v, 0) for k, v in self.available.items()})
+                self.control.call("heartbeat", {
+                    "node_id": self.node_id, "available": avail,
+                }, timeout=5.0)
+            except Exception:
+                if not self._stop.is_set():
+                    logger.warning("heartbeat to control failed")
+            time.sleep(HEARTBEAT_INTERVAL_S)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--control", required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--resources", default=None, help="JSON resource dict")
+    ap.add_argument("--node-id", default=None)
+    ap.add_argument("--session-dir", default=None)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s raylet %(levelname)s %(message)s")
+    host, port = args.control.rsplit(":", 1)
+    import json
+
+    resources = json.loads(args.resources) if args.resources else None
+    labels = None
+    if os.environ.get("RAY_TPU_NODE_LABELS"):
+        labels = json.loads(os.environ["RAY_TPU_NODE_LABELS"])
+    r = Raylet((host, int(port)), host=args.host, port=args.port,
+               resources=resources, session_dir=args.session_dir,
+               node_id=args.node_id, labels=labels)
+    r.start(block=True)
+
+
+if __name__ == "__main__":
+    main()

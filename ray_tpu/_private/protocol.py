@@ -1,0 +1,473 @@
+"""Framed RPC layer: the TPU-native equivalent of the reference's gRPC wrappers.
+
+The reference wraps async gRPC in `GrpcServer`/`GrpcClient`/`ClientCallManager`
+(reference: src/ray/rpc/grpc_server.h:85, grpc_client.h:93, client_call.h:189).
+We provide the same capability — async request/reply with correlation ids,
+server push (for pubsub), connection-death notification — over plain TCP
+sockets with pickle framing.  This keeps the control plane dependency-free and
+fast enough for the control path; the data plane (tensors) never moves through
+this layer: device arrays travel via compiled XLA collectives (ICI) and large
+host objects via the shared-memory store.
+
+Wire format: 8-byte header (<II: payload length, flags) + pickled
+(msg_id, kind, method, payload).  kind: 0=request 1=reply 2=error 3=push.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import selectors
+import socket
+import struct
+import threading
+import time
+import traceback
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_HEADER = struct.Struct("<I")
+REQUEST, REPLY, ERROR, PUSH = 0, 1, 2, 3
+
+# Big frames allowed (object transfer fallback path), but the data plane
+# should use the shm store instead.
+MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    """Remote handler raised; carries the remote traceback string."""
+
+
+class ConnectionLost(Exception):
+    """Peer went away before replying."""
+
+
+def _dumps(obj: Any) -> bytes:
+    return pickle.dumps(obj, protocol=5)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionLost("socket closed")
+        got += r
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_HEADER.pack(len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    (n,) = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    if n > MAX_FRAME:
+        raise RpcError(f"frame too large: {n}")
+    return _recv_exact(sock, n)
+
+
+def free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class DaemonPool:
+    """Minimal thread pool with daemon threads (so a wedged handler can
+    never block interpreter exit, unlike concurrent.futures)."""
+
+    def __init__(self, max_workers: int, name: str = "pool"):
+        import queue as _q
+
+        self._q: "_q.Queue" = _q.Queue()
+        self._name = name
+        self._threads = []
+        for i in range(max_workers):
+            t = threading.Thread(target=self._run, name=f"{name}-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def submit(self, fn, *args, **kwargs) -> Future:
+        fut: Future = Future()
+        self._q.put((fut, fn, args, kwargs))
+        return fut
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fut, fn, args, kwargs = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args, **kwargs))
+            except BaseException as e:
+                fut.set_exception(e)
+
+    def shutdown(self, wait: bool = False):
+        for _ in self._threads:
+            self._q.put(None)
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+
+class Client:
+    """Thread-safe RPC client: concurrent in-flight calls over one socket.
+
+    A single reader thread demultiplexes replies to per-call futures and
+    dispatches server pushes to `on_push`.  Mirrors the role of the
+    reference's ClientCallManager (client_call.h:189).
+    """
+
+    def __init__(
+        self,
+        addr: Tuple[str, int],
+        on_push: Optional[Callable[[str, Any], None]] = None,
+        on_disconnect: Optional[Callable[[], None]] = None,
+        connect_timeout: float = 30.0,
+        name: str = "",
+    ):
+        self.addr = tuple(addr)
+        self.name = name
+        self._on_push = on_push
+        self._on_disconnect = on_disconnect
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._inflight: Dict[int, Future] = {}
+        self._closed = False
+        deadline = time.monotonic() + connect_timeout
+        last_err: Optional[Exception] = None
+        while True:
+            try:
+                self._sock = socket.create_connection(self.addr, timeout=5.0)
+                break
+            except OSError as e:  # daemon may still be booting
+                last_err = e
+                if time.monotonic() > deadline:
+                    raise ConnectionLost(
+                        f"cannot connect to {self.addr}: {last_err}"
+                    ) from e
+                time.sleep(0.05)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+        self._send_lock = threading.Lock()
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"rpc-client-reader-{name}", daemon=True
+        )
+        self._reader.start()
+
+    # -- public ------------------------------------------------------------
+
+    def call(self, method: str, payload: Any = None, timeout: Optional[float] = None) -> Any:
+        fut = self.call_async(method, payload)
+        return fut.result(timeout=timeout)
+
+    def call_async(self, method: str, payload: Any = None) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            if self._closed:
+                fut.set_exception(ConnectionLost(f"client to {self.addr} closed"))
+                return fut
+            self._next_id += 1
+            msg_id = self._next_id
+            self._inflight[msg_id] = fut
+        try:
+            data = _dumps((msg_id, REQUEST, method, payload))
+            with self._send_lock:
+                send_frame(self._sock, data)
+        except OSError as e:
+            with self._lock:
+                self._inflight.pop(msg_id, None)
+            fut.set_exception(ConnectionLost(str(e)))
+        return fut
+
+    def notify(self, method: str, payload: Any = None) -> None:
+        """One-way message; no reply expected (msg_id 0)."""
+        data = _dumps((0, REQUEST, method, payload))
+        with self._send_lock:
+            send_frame(self._sock, data)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -- internals ---------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = recv_frame(self._sock)
+                msg_id, kind, method, payload = pickle.loads(frame)
+                if kind == REPLY:
+                    fut = self._inflight.pop(msg_id, None)
+                    if fut is not None:
+                        fut.set_result(payload)
+                elif kind == ERROR:
+                    fut = self._inflight.pop(msg_id, None)
+                    if fut is not None:
+                        fut.set_exception(RpcError(payload))
+                elif kind == PUSH:
+                    if self._on_push is not None:
+                        try:
+                            self._on_push(method, payload)
+                        except Exception:
+                            logger.exception("push handler failed for %s", method)
+        except (ConnectionLost, OSError, EOFError, pickle.UnpicklingError):
+            pass
+        finally:
+            with self._lock:
+                self._closed = True
+                inflight, self._inflight = self._inflight, {}
+            for fut in inflight.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionLost(f"connection to {self.addr} lost"))
+            if self._on_disconnect is not None:
+                try:
+                    self._on_disconnect()
+                except Exception:
+                    logger.exception("disconnect handler failed")
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+
+class ServerConn:
+    """Per-connection server-side handle; used to push messages (pubsub)."""
+
+    def __init__(self, server: "Server", sock: socket.socket, peer: Tuple[str, int]):
+        self.server = server
+        self.sock = sock
+        self.peer = peer
+        self.send_lock = threading.Lock()
+        self.meta: Dict[str, Any] = {}  # handlers stash identity here
+        self.alive = True
+        self._buf = bytearray()
+        self._want = -1  # payload size being assembled, -1 = reading header
+
+    def push(self, topic: str, payload: Any) -> bool:
+        try:
+            data = _dumps((0, PUSH, topic, payload))
+            with self.send_lock:
+                send_frame(self.sock, data)
+            return True
+        except OSError:
+            return False
+
+    def reply(self, msg_id: int, payload: Any) -> None:
+        if msg_id == 0:
+            return
+        data = _dumps((msg_id, REPLY, "", payload))
+        with self.send_lock:
+            send_frame(self.sock, data)
+
+    def reply_error(self, msg_id: int, err: str) -> None:
+        if msg_id == 0:
+            return
+        data = _dumps((msg_id, ERROR, "", err))
+        with self.send_lock:
+            send_frame(self.sock, data)
+
+
+class Deferred:
+    """Return from a handler to defer the reply; call resolve/reject later."""
+
+    def __init__(self, conn: ServerConn, msg_id: int):
+        self._conn = conn
+        self._msg_id = msg_id
+
+    def resolve(self, payload: Any = None) -> None:
+        try:
+            self._conn.reply(self._msg_id, payload)
+        except OSError:
+            pass
+
+    def reject(self, err: str) -> None:
+        try:
+            self._conn.reply_error(self._msg_id, err)
+        except OSError:
+            pass
+
+
+class Server:
+    """Selector-based RPC server.
+
+    Handlers: fn(conn: ServerConn, payload) -> result | Deferred-sentinel.
+    A handler that needs to reply later returns `server.DEFER`; it then gets
+    a `Deferred` via `conn.meta['_deferred']`... simpler: handlers may accept
+    a third positional arg `deferred` by declaring `needs_deferred=True` at
+    registration.  Runs its event loop in a dedicated thread.  Handler
+    execution happens on the event-loop thread — handlers must not block; long
+    work goes to executor threads owned by the embedding daemon.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, name: str = "rpc"):
+        self.name = name
+        self._sel = selectors.DefaultSelector()
+        self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind((host, port))
+        self._listen.listen(512)
+        self._listen.setblocking(False)
+        self.addr: Tuple[str, int] = self._listen.getsockname()
+        self._handlers: Dict[str, Tuple[Callable, bool]] = {}
+        self._on_disconnect: Optional[Callable[[ServerConn], None]] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._conns: Dict[socket.socket, ServerConn] = {}
+
+    def handle(self, method: str, fn: Callable, deferred: bool = False) -> None:
+        self._handlers[method] = (fn, deferred)
+
+    def on_disconnect(self, fn: Callable[[ServerConn], None]) -> None:
+        self._on_disconnect = fn
+
+    def start(self, thread: bool = True) -> None:
+        self._sel.register(self._listen, selectors.EVENT_READ, None)
+        if thread:
+            self._thread = threading.Thread(
+                target=self._loop, name=f"rpc-server-{self.name}", daemon=True
+            )
+            self._thread.start()
+        else:
+            self._loop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            # poke the selector awake
+            s = socket.create_connection(self.addr, timeout=1.0)
+            s.close()
+        except OSError:
+            pass
+        if self._thread is not None and self._thread is not threading.current_thread():
+            self._thread.join(timeout=5.0)
+
+    # -- loop --------------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            events = self._sel.select(timeout=0.5)
+            for key, _ in events:
+                if key.fileobj is self._listen:
+                    self._accept()
+                else:
+                    self._read(key.fileobj)
+        for sock in list(self._conns):
+            self._drop(sock)
+        self._sel.close()
+        self._listen.close()
+
+    def _accept(self) -> None:
+        try:
+            sock, peer = self._listen.accept()
+        except OSError:
+            return
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Socket stays BLOCKING: the selector only fires _read when data is
+        # available (recv returns what's there without blocking), and writes
+        # (replies/pushes, possibly multi-MB, possibly from worker threads)
+        # need sendall semantics — a non-blocking sendall can partial-write
+        # and desync the frame stream.
+        conn = ServerConn(self, sock, peer)
+        self._conns[sock] = conn
+        self._sel.register(sock, selectors.EVENT_READ, conn)
+
+    def _read(self, sock: socket.socket) -> None:
+        conn = self._conns.get(sock)
+        if conn is None:
+            return
+        try:
+            data = sock.recv(1 << 20)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            data = b""
+        if not data:
+            self._drop(sock)
+            return
+        conn._buf += data
+        self._drain(conn)
+
+    def _drain(self, conn: ServerConn) -> None:
+        buf = conn._buf
+        while True:
+            if conn._want < 0:
+                if len(buf) < _HEADER.size:
+                    return
+                (conn._want,) = _HEADER.unpack(bytes(buf[: _HEADER.size]))
+                del buf[: _HEADER.size]
+            if len(buf) < conn._want:
+                return
+            frame = bytes(buf[: conn._want])
+            del buf[: conn._want]
+            conn._want = -1
+            self._dispatch(conn, frame)
+
+    def _dispatch(self, conn: ServerConn, frame: bytes) -> None:
+        try:
+            msg_id, kind, method, payload = pickle.loads(frame)
+        except Exception:
+            logger.exception("%s: bad frame from %s", self.name, conn.peer)
+            return
+        if kind != REQUEST:
+            return
+        entry = self._handlers.get(method)
+        if entry is None:
+            conn.reply_error(msg_id, f"no handler for {method!r}")
+            return
+        fn, wants_deferred = entry
+        try:
+            if wants_deferred:
+                fn(conn, payload, Deferred(conn, msg_id))
+            else:
+                result = fn(conn, payload)
+                conn.reply(msg_id, result)
+        except Exception as e:
+            tb = traceback.format_exc()
+            logger.debug("%s: handler %s raised: %s", self.name, method, e)
+            try:
+                conn.reply_error(msg_id, f"{type(e).__name__}: {e}\n{tb}")
+            except OSError:
+                self._drop(conn.sock)
+
+    def _drop(self, sock: socket.socket) -> None:
+        conn = self._conns.pop(sock, None)
+        try:
+            self._sel.unregister(sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+        if conn is not None:
+            conn.alive = False
+            if self._on_disconnect is not None:
+                try:
+                    self._on_disconnect(conn)
+                except Exception:
+                    logger.exception("%s: disconnect callback failed", self.name)

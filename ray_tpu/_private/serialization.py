@@ -1,0 +1,116 @@
+"""Serialization: pickle-5 out-of-band buffers + device-array awareness.
+
+Equivalent of the reference's serialization layer (cloudpickle + zero-copy
+numpy via plasma buffers, reference: python/ray/_private/serialization.py).
+TPU-native twist: `jax.Array` values are first-class.  Inside one process they
+stay device-resident in the in-process store; when they must cross a process
+boundary through the object plane they are staged to host (device_get) and the
+sharding is recorded so the receiver can restore placement.  Large device-to-
+device movement should use the collective plane (compiled ICI collectives),
+not the object store — this path is correctness, not the fast path.
+
+ObjectRefs inside values are swapped for SerializedRef markers; the
+deserializing side re-wraps them via a context hook so borrower ref-counting
+works (reference: reference_count.h borrower protocol).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import sys
+from typing import Any, Callable, List, Optional, Tuple
+
+import cloudpickle
+
+from .common import SerializedRef
+
+# Hooks installed by core.py: map ObjectRef -> SerializedRef and back.
+_ref_to_marker: Optional[Callable[[Any], Any]] = None
+_marker_to_ref: Optional[Callable[[SerializedRef], Any]] = None
+_ref_type: Optional[type] = None
+
+
+def install_ref_hooks(ref_type: type, to_marker, from_marker) -> None:
+    global _ref_type, _ref_to_marker, _marker_to_ref
+    _ref_type = ref_type
+    _ref_to_marker = to_marker
+    _marker_to_ref = from_marker
+
+
+def _jax_types():
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    return jax
+
+
+class _DeviceArrayStandIn:
+    """Host-staged stand-in for a jax.Array crossing the object plane."""
+
+    def __init__(self, np_value, sharding_desc):
+        self.np_value = np_value
+        self.sharding_desc = sharding_desc  # (mesh axes, spec) description or None
+
+
+def _restore_device_array(stand_in: _DeviceArrayStandIn):
+    jax = _jax_types()
+    if jax is None:
+        return stand_in.np_value
+    # Restore to default device; callers that need a specific sharding
+    # re-place explicitly (device placement is process-local).
+    return jax.numpy.asarray(stand_in.np_value)
+
+
+class _Pickler(cloudpickle.Pickler):
+    def __init__(self, file, buffer_callback=None):
+        super().__init__(file, protocol=5, buffer_callback=buffer_callback)
+
+    def reducer_override(self, obj):
+        if _ref_type is not None and type(obj) is _ref_type:
+            return (_deserialize_marker, (_ref_to_marker(obj),))
+        jax = _jax_types()
+        if jax is not None and isinstance(obj, jax.Array):
+            import numpy as np
+
+            try:
+                desc = str(obj.sharding)
+            except Exception:
+                desc = None
+            host = np.asarray(obj)
+            return (_restore_device_array, (_DeviceArrayStandIn(host, desc),))
+        return NotImplemented
+
+
+def _deserialize_marker(marker: SerializedRef):
+    if _marker_to_ref is None:
+        return marker
+    return _marker_to_ref(marker)
+
+
+def dumps_oob(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
+    """Serialize with out-of-band buffers (zero-copy for numpy/bytes)."""
+    buffers: List[pickle.PickleBuffer] = []
+    f = io.BytesIO()
+    p = _Pickler(f, buffer_callback=buffers.append)
+    p.dump(value)
+    return f.getvalue(), buffers
+
+
+def loads_oob(meta: bytes, buffers: List[memoryview]) -> Any:
+    return pickle.loads(meta, buffers=buffers)
+
+
+def dumps_inline(value: Any) -> bytes:
+    """Serialize fully in-band (for RPC messages)."""
+    f = io.BytesIO()
+    _Pickler(f).dump(value)
+    return f.getvalue()
+
+
+def loads_inline(blob: bytes) -> Any:
+    return pickle.loads(blob)
+
+
+def value_nbytes_estimate(meta: bytes, buffers) -> int:
+    return len(meta) + sum(len(b.raw() if hasattr(b, "raw") else b) for b in buffers)

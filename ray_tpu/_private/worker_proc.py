@@ -1,0 +1,209 @@
+"""Worker process main loop.
+
+Analog of the reference worker: registers with its raylet using the startup
+token (reference: worker_pool.h startup token protocol), then serves
+push_task / actor_task RPCs (reference: CoreWorker::HandlePushTask
+core_worker.cc:3489 -> scheduling queues -> ExecuteTask :2914).  Normal tasks
+run sequentially on one executor thread; actor tasks run FIFO in arrival
+order (TCP preserves per-caller order, giving the reference's per-caller
+sequence semantics); max_concurrency>1 uses a thread pool like the
+reference's concurrency groups.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import queue
+import sys
+import threading
+import time
+import traceback
+
+import cloudpickle
+
+from . import common, serialization
+from .common import TaskError, TaskSpec
+from .core import CoreWorker, ObjectRef
+from .protocol import Deferred, ServerConn
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerMain:
+    def __init__(self, control_addr, raylet_addr):
+        self.token = int(os.environ["RAY_TPU_STARTUP_TOKEN"])
+        wid = os.environ.get("RAY_TPU_WORKER_ID")
+        nid = os.environ.get("RAY_TPU_NODE_ID")
+        session_dir = os.environ.get("RAY_TPU_SESSION_DIR")
+        self.actor_id = os.environ.get("RAY_TPU_ACTOR_ID")
+        self.incarnation = int(os.environ.get("RAY_TPU_ACTOR_INCARNATION", "0"))
+        store_root = os.path.join(session_dir, "objects") if session_dir else None
+        self.core = CoreWorker(control_addr, raylet_addr, mode="worker",
+                               worker_id=wid, node_id=nid, store_root=store_root)
+        self.core.server.handle("push_task", self.h_push_task, deferred=True)
+        self.core.server.handle("actor_task", self.h_actor_task, deferred=True)
+        self.core.server.handle("exit", lambda c, p: self._exit_soon())
+
+        self.task_queue: "queue.Queue" = queue.Queue()
+        self.actor_instance = None
+        self.actor_concurrency = 1
+        self._stop = threading.Event()
+
+        # raylet client push handling (shutdown) + death of raylet kills us
+        self.core.raylet._on_push = self._on_raylet_push
+        self.core.raylet._on_disconnect = self._exit_soon
+
+        r = self.core.raylet.call("register_worker", {
+            "token": self.token, "addr": self.core.addr,
+        }, timeout=30.0)
+        if not r.get("ok"):
+            raise RuntimeError(f"worker registration rejected: {r}")
+
+        n_threads = 1
+        self.exec_threads = [
+            threading.Thread(target=self._exec_loop, name=f"exec-{i}", daemon=True)
+            for i in range(n_threads)
+        ]
+        for t in self.exec_threads:
+            t.start()
+
+        if self.actor_id:
+            threading.Thread(target=self._init_actor, daemon=True).start()
+
+    # -- actor bootstrap ---------------------------------------------------
+
+    def _init_actor(self):
+        err = None
+        try:
+            blob = self.core.control.call("get_actor_spec",
+                                          {"actor_id": self.actor_id}, timeout=30.0)
+            if blob is None:
+                raise RuntimeError("actor spec missing in control plane")
+            spec = cloudpickle.loads(blob)
+            cls = cloudpickle.loads(spec["class_blob"])
+            args, kwargs = serialization.loads_inline(spec["args_blob"])
+            args = [self.core.get(a) if isinstance(a, ObjectRef) else a
+                    for a in args]
+            kwargs = {k: self.core.get(v) if isinstance(v, ObjectRef) else v
+                      for k, v in kwargs.items()}
+            env = (spec.get("runtime_env") or {}).get("env_vars") or {}
+            os.environ.update(env)
+            self.actor_instance = cls(*args, **kwargs)
+            self.actor_concurrency = spec.get("max_concurrency", 1) or 1
+            if self.actor_concurrency > 1:
+                for i in range(self.actor_concurrency - 1):
+                    t = threading.Thread(target=self._exec_loop,
+                                         name=f"exec-actor-{i}", daemon=True)
+                    t.start()
+                    self.exec_threads.append(t)
+        except BaseException as e:
+            err = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+            logger.error("actor creation failed: %s", err)
+        try:
+            self.core.control.call("actor_ready", {
+                "actor_id": self.actor_id,
+                "worker_addr": self.core.addr,
+                "incarnation": self.incarnation,
+                "error": err,
+            }, timeout=30.0)
+        except Exception:
+            logger.exception("failed to report actor_ready")
+        if err is not None:
+            self._exit_soon()
+
+    # -- rpc handlers ------------------------------------------------------
+
+    def h_push_task(self, conn: ServerConn, spec: TaskSpec, d: Deferred):
+        self.task_queue.put(("normal", spec, d))
+
+    def h_actor_task(self, conn: ServerConn, spec: TaskSpec, d: Deferred):
+        self.task_queue.put(("actor", spec, d))
+
+    def _on_raylet_push(self, topic, payload):
+        if topic == "shutdown":
+            self._exit_soon()
+
+    def _exit_soon(self):
+        self._stop.set()
+        threading.Thread(target=self._do_exit, daemon=True).start()
+        return True
+
+    def _do_exit(self):
+        time.sleep(0.05)
+        os._exit(0)
+
+    # -- execution ---------------------------------------------------------
+
+    def _exec_loop(self):
+        while not self._stop.is_set():
+            try:
+                kind, spec, d = self.task_queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            reply = self._execute(kind, spec)
+            d.resolve(reply)
+
+    def _execute(self, kind: str, spec: TaskSpec):
+        self.core._executing.active = True
+        t0 = time.monotonic()
+        try:
+            if kind == "actor":
+                # wait for actor init to finish (creation runs async)
+                deadline = time.monotonic() + 120.0
+                while self.actor_instance is None and time.monotonic() < deadline \
+                        and not self._stop.is_set():
+                    time.sleep(0.005)
+                if self.actor_instance is None:
+                    raise common.ActorDiedError("actor instance not initialized")
+                fn = getattr(self.actor_instance, spec.function_name)
+            else:
+                fn = self.core.get_function(spec.function_id)
+            args, kwargs = self.core.resolve_args(spec)
+            out = fn(*args, **kwargs)
+            if spec.num_returns > 1:
+                values = list(out)
+                if len(values) != spec.num_returns:
+                    raise ValueError(
+                        f"task {spec.function_name} declared num_returns="
+                        f"{spec.num_returns} but returned {len(values)} values")
+            else:
+                values = [out]
+            reply = self.core.store_task_results(spec, values)
+            reply["exec_ms"] = (time.monotonic() - t0) * 1000.0
+            return reply
+        except BaseException as e:
+            tb = traceback.format_exc()
+            try:
+                err_blob = serialization.dumps_inline(
+                    TaskError(e, tb, spec.function_name))
+            except BaseException:
+                err_blob = serialization.dumps_inline(
+                    TaskError(RuntimeError(f"{type(e).__name__}: {e}"), tb,
+                              spec.function_name))
+            return {"status": "error", "error": err_blob}
+        finally:
+            self.core._executing.active = False
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--raylet", required=True)
+    ap.add_argument("--control", required=True)
+    args = ap.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"%(asctime)s worker[{os.getpid()}] %(levelname)s %(message)s")
+    rh, rp = args.raylet.rsplit(":", 1)
+    ch, cp = args.control.rsplit(":", 1)
+    w = WorkerMain((ch, int(cp)), (rh, int(rp)))
+    try:
+        while not w._stop.is_set():
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

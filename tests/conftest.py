@@ -1,0 +1,56 @@
+"""Test configuration.
+
+Mirrors the reference's fixture strategy (reference:
+python/ray/tests/conftest.py — ray_start_regular :419, ray_start_cluster
+:500): a shared local cluster fixture plus a multi-node Cluster builder.
+
+JAX tests run on a virtual 8-device CPU mesh
+(XLA_FLAGS=--xla_force_host_platform_device_count=8) so multi-chip sharding
+logic is exercised without TPU hardware, as SURVEY.md §4 prescribes.
+The env vars MUST be set before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+# force-set: the axon sitecustomize pins JAX_PLATFORMS=axon at interpreter
+# start, so setdefault would lose
+if not os.environ.get("RAY_TPU_TEST_REAL_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def ray_cluster():
+    """A started local cluster with 4 (virtual) CPUs, shared per session."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def multi_node_cluster():
+    """Builder for multi-raylet clusters (the reference's
+    cluster_utils.Cluster pattern)."""
+    from ray_tpu._private.bootstrap import Cluster
+
+    clusters = []
+
+    def make():
+        c = Cluster()
+        c.start_control()
+        clusters.append(c)
+        return c
+
+    yield make
+    for c in clusters:
+        c.shutdown()

@@ -1,0 +1,156 @@
+"""Multi-node and fault-tolerance tests.
+
+Reference model: ray.cluster_utils.Cluster based scheduling/failover tests
+(reference: python/ray/cluster_utils.py:135, tests/test_multi_node*.py,
+test_reconstruction*.py) — multiple raylets against one control plane,
+killing a raylet to simulate node failure.
+"""
+
+import time
+
+import pytest
+
+from ray_tpu._private import common
+from ray_tpu._private.core import CoreWorker
+from ray_tpu._private.protocol import Client
+
+
+def _driver(cluster, node=None):
+    """Connect a CoreWorker driver to the cluster."""
+    raylet_addr = node.addr if node is not None else None
+    store_root = None
+    node_id = None
+    if node is not None:
+        probe = Client(node.addr)
+        info = probe.call("node_info", timeout=30.0)
+        probe.close()
+        node_id = info["node_id"]
+        store_root = info["store_root"]
+    return CoreWorker(cluster.control_addr, raylet_addr, mode="driver",
+                      node_id=node_id, store_root=store_root)
+
+
+def _fn_ret_node():
+    import os
+    import time
+
+    time.sleep(1.0)  # long enough that one node can't serve all tasks
+    return os.environ.get("RAY_TPU_NODE_ID")
+
+
+def test_two_nodes_spread(multi_node_cluster):
+    c = multi_node_cluster()
+    n1 = c.add_node(resources={"CPU": 1})
+    n2 = c.add_node(resources={"CPU": 1})
+    core = _driver(c, n1)
+    try:
+        refs = []
+        for _ in range(4):
+            refs += core.submit_task(_fn_ret_node, (), {}, resources={"CPU": 1})
+        nodes = set(core.get(refs, timeout=120))
+        assert len(nodes) == 2, f"tasks did not spread: {nodes}"
+    finally:
+        core.shutdown()
+
+
+def test_custom_resource_routing(multi_node_cluster):
+    c = multi_node_cluster()
+    c.add_node(resources={"CPU": 1})
+    special = c.add_node(resources={"CPU": 1, "special": 1})
+    core = _driver(c, None)
+    try:
+        refs = core.submit_task(_fn_ret_node, (), {},
+                                resources={"CPU": 1, "special": 0.1})
+        out = core.get(refs[0], timeout=120)
+        assert out == special.node_id
+    finally:
+        core.shutdown()
+
+
+def test_node_death_detected(multi_node_cluster):
+    c = multi_node_cluster()
+    n1 = c.add_node(resources={"CPU": 1})
+    n2 = c.add_node(resources={"CPU": 1})
+    core = _driver(c, n1)
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            nodes = core.control.call("get_nodes", {})
+            if sum(1 for n in nodes if n["state"] == "ALIVE") == 2:
+                break
+            time.sleep(0.2)
+        c.remove_node(n2)  # hard kill
+        deadline = time.time() + 30
+        dead_seen = False
+        while time.time() < deadline:
+            nodes = core.control.call("get_nodes", {})
+            states = {n["node_id"]: n["state"] for n in nodes}
+            if states.get(n2.node_id) == "DEAD":
+                dead_seen = True
+                break
+            time.sleep(0.5)
+        assert dead_seen, "control plane never declared the killed node dead"
+    finally:
+        core.shutdown()
+
+
+def test_actor_restart_after_node_death(multi_node_cluster):
+    c = multi_node_cluster()
+    n1 = c.add_node(resources={"CPU": 1})
+    core = _driver(c, n1)
+
+    class Pinger:
+        def node(self):
+            import os
+
+            return os.environ.get("RAY_TPU_NODE_ID")
+
+    try:
+        n2 = c.add_node(resources={"CPU": 1, "target": 1})
+        aid = core.create_actor(Pinger, (), {},
+                                resources={"CPU": 1, "target": 0.1},
+                                max_restarts=-1)
+        ref = core.submit_actor_task(aid, "node", (), {})[0]
+        first_node = core.get(ref, timeout=120)
+        assert first_node == n2.node_id
+        # kill the node hosting the actor; add a replacement with the same
+        # custom resource; actor should restart there
+        c.remove_node(n2)
+        n3 = c.add_node(resources={"CPU": 1, "target": 1})
+        deadline = time.time() + 60
+        moved = None
+        while time.time() < deadline:
+            try:
+                ref = core.submit_actor_task(aid, "node", (), {})[0]
+                moved = core.get(ref, timeout=30)
+                if moved == n3.node_id:
+                    break
+            except common.RayTpuError:
+                time.sleep(0.5)
+        assert moved == n3.node_id
+    finally:
+        core.shutdown()
+
+
+def test_object_pull_across_nodes(multi_node_cluster):
+    c = multi_node_cluster()
+    n1 = c.add_node(resources={"CPU": 1, "a": 1})
+    n2 = c.add_node(resources={"CPU": 1, "b": 1})
+    core = _driver(c, n1)
+
+    def make_big():
+        import numpy as np
+
+        return np.full(300_000, 7.0)
+
+    def consume(x):
+        return float(x.sum())
+
+    try:
+        big_ref = core.submit_task(make_big, (), {},
+                                   resources={"CPU": 1, "a": 0.1})[0]
+        out_ref = core.submit_task(consume, (big_ref,), {},
+                                   resources={"CPU": 1, "b": 0.1})[0]
+        assert core.get(out_ref, timeout=120) == 300_000 * 7.0
+    finally:
+        core.shutdown()

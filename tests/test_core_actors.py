@@ -1,0 +1,136 @@
+"""Actor API tests (reference model: python/ray/tests/test_actor*.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.v = start
+
+    def inc(self, n=1):
+        self.v += n
+        return self.v
+
+    def value(self):
+        return self.v
+
+    def fail(self):
+        raise RuntimeError("method-error-marker")
+
+
+def test_actor_basic(ray_cluster):
+    c = Counter.remote(5)
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 6
+    assert ray_tpu.get(c.value.remote(), timeout=60) == 6
+
+
+def test_actor_ordering(ray_cluster):
+    c = Counter.remote(0)
+    refs = [c.inc.remote() for _ in range(20)]
+    assert ray_tpu.get(refs, timeout=60) == list(range(1, 21))
+
+
+def test_actor_method_error_keeps_actor_alive(ray_cluster):
+    c = Counter.remote(0)
+    with pytest.raises(ray_tpu.TaskError, match="method-error-marker"):
+        ray_tpu.get(c.fail.remote(), timeout=60)
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+
+
+def test_named_actor(ray_cluster):
+    original = Counter.options(name="test-named-counter").remote(42)
+    h = ray_tpu.get_actor("test-named-counter")
+    assert ray_tpu.get(h.value.remote(), timeout=60) == 42
+    del original
+
+
+def test_named_actor_collision(ray_cluster):
+    keep = Counter.options(name="collide").remote(0)
+    time.sleep(0.2)
+    with pytest.raises(Exception):
+        h2 = Counter.options(name="collide").remote(0)
+        ray_tpu.get(h2.value.remote(), timeout=30)
+
+
+def test_actor_constructor_error(ray_cluster):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise ValueError("ctor-error")
+
+        def m(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(b.m.remote(), timeout=60)
+
+
+def test_actor_restart(ray_cluster):
+    @ray_tpu.remote
+    class Dier:
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    d = Dier.options(max_restarts=2).remote()
+    pid1 = ray_tpu.get(d.pid.remote(), timeout=60)
+    try:
+        ray_tpu.get(d.die.remote(), timeout=30)
+    except Exception:
+        pass
+    deadline = time.time() + 60
+    pid2 = None
+    while time.time() < deadline:
+        try:
+            pid2 = ray_tpu.get(d.pid.remote(), timeout=30)
+            break
+        except ray_tpu.RayTpuError:
+            time.sleep(0.5)
+    assert pid2 is not None and pid2 != pid1
+
+
+def test_actor_kill(ray_cluster):
+    c = Counter.remote(0)
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+    ray_tpu.kill(c)
+    time.sleep(0.5)
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(c.inc.remote(), timeout=30)
+
+
+def test_actor_handle_in_task(ray_cluster):
+    c = Counter.remote(0)
+    ray_tpu.get(c.inc.remote(), timeout=60)
+
+    @ray_tpu.remote
+    def use_handle(h):
+        return ray_tpu.get(h.inc.remote())
+
+    assert ray_tpu.get(use_handle.remote(c), timeout=120) == 2
+
+
+def test_actor_concurrency(ray_cluster):
+    @ray_tpu.remote
+    class Slow:
+        def work(self):
+            time.sleep(0.5)
+            return 1
+
+    s = Slow.options(max_concurrency=4).remote()
+    t0 = time.time()
+    refs = [s.work.remote() for _ in range(4)]
+    assert sum(ray_tpu.get(refs, timeout=60)) == 4
+    # 4 overlapping 0.5 s sleeps should take well under 2 s
+    assert time.time() - t0 < 1.9

@@ -1,0 +1,82 @@
+"""Object store tests (reference model: python/ray/tests/test_object_*.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_put_get_small(ray_cluster):
+    ref = ray_tpu.put({"a": 1, "b": [1, 2, 3]})
+    assert ray_tpu.get(ref, timeout=30) == {"a": 1, "b": [1, 2, 3]}
+
+
+def test_put_get_large_zero_copy(ray_cluster):
+    arr = np.arange(500_000, dtype=np.float64)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref, timeout=30)
+    assert np.array_equal(arr, out)
+
+
+def test_put_ref_as_task_arg(ray_cluster):
+    arr = np.ones(200_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+
+    @ray_tpu.remote
+    def total(a):
+        return float(a.sum())
+
+    assert ray_tpu.get(total.remote(ref), timeout=60) == 200_000.0
+
+
+def test_ref_inside_container_not_materialized(ray_cluster):
+    ref = ray_tpu.put(123)
+
+    @ray_tpu.remote
+    def check(d):
+        # nested refs are NOT auto-materialized (reference semantics)
+        inner = d["ref"]
+        assert isinstance(inner, ray_tpu.ObjectRef)
+        return ray_tpu.get(inner)
+
+    assert ray_tpu.get(check.remote({"ref": ref}), timeout=60) == 123
+
+
+def test_shared_object_many_consumers(ray_cluster):
+    data = np.random.rand(100_000)
+    ref = ray_tpu.put(data)
+
+    @ray_tpu.remote
+    def s(a):
+        return float(a.sum())
+
+    outs = ray_tpu.get([s.remote(ref) for _ in range(4)], timeout=60)
+    assert all(abs(o - data.sum()) < 1e-6 for o in outs)
+
+
+def test_jax_array_put_get(ray_cluster):
+    import jax.numpy as jnp
+
+    x = jnp.arange(16.0)
+    ref = ray_tpu.put(x)
+    out = ray_tpu.get(ref, timeout=30)
+    assert np.allclose(np.asarray(out), np.arange(16.0))
+
+
+def test_jax_array_task_return(ray_cluster):
+    @ray_tpu.remote
+    def make():
+        import jax.numpy as jnp
+
+        return jnp.ones((8, 8)) * 3.0
+
+    out = ray_tpu.get(make.remote(), timeout=120)
+    assert np.allclose(np.asarray(out), 3.0)
+
+
+def test_plain_pickle_of_ref_forbidden(ray_cluster):
+    import pickle
+
+    ref = ray_tpu.put(1)
+    with pytest.raises(TypeError):
+        pickle.dumps(ref)

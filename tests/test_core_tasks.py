@@ -1,0 +1,170 @@
+"""Task API tests (reference model: python/ray/tests/test_basic*.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def echo(x):
+    return x
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+def test_simple_task(ray_cluster):
+    assert ray_tpu.get(echo.remote(41), timeout=60) == 41
+
+
+def test_many_tasks(ray_cluster):
+    refs = [add.remote(i, 1) for i in range(50)]
+    assert ray_tpu.get(refs, timeout=60) == [i + 1 for i in range(50)]
+
+
+def test_task_kwargs(ray_cluster):
+    @ray_tpu.remote
+    def f(a, b=10, *, c=0):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1, c=2), timeout=60) == 13
+
+
+def test_chained_refs(ray_cluster):
+    r1 = add.remote(1, 2)
+    r2 = add.remote(r1, 10)
+    r3 = add.remote(r2, r1)
+    assert ray_tpu.get(r3, timeout=60) == 16
+
+
+def test_nested_submission(ray_cluster):
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(add.remote(x, 1)) * 2
+
+    assert ray_tpu.get(outer.remote(10), timeout=120) == 22
+
+
+def test_error_propagation(ray_cluster):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom-marker")
+
+    with pytest.raises(ray_tpu.TaskError, match="kaboom-marker"):
+        ray_tpu.get(boom.remote(), timeout=60)
+
+
+def test_error_through_dependency(ray_cluster):
+    @ray_tpu.remote
+    def boom():
+        raise RuntimeError("upstream-dead")
+
+    # a task consuming a failed ref fails too
+    r = add.remote(boom.remote(), 1)
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(r, timeout=60)
+
+
+def test_num_returns(ray_cluster):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c], timeout=60) == [1, 2, 3]
+
+
+def test_options_override(ray_cluster):
+    f2 = echo.options(num_returns=2)
+
+    @ray_tpu.remote
+    def pair():
+        return "x", "y"
+
+    a, b = pair.options(num_returns=2).remote()
+    assert ray_tpu.get([a, b], timeout=60) == ["x", "y"]
+
+
+def test_wait(ray_cluster):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    fast = slow.remote(0.0)
+    slower = slow.remote(5.0)
+    ready, not_ready = ray_tpu.wait([fast, slower], num_returns=1, timeout=30)
+    assert ready and ready[0].id == fast.id
+    assert not_ready and not_ready[0].id == slower.id
+
+
+def test_wait_timeout(ray_cluster):
+    @ray_tpu.remote
+    def hang():
+        time.sleep(30)
+
+    r = hang.remote()
+    ready, not_ready = ray_tpu.wait([r], num_returns=1, timeout=0.5)
+    assert not ready and len(not_ready) == 1
+
+
+def test_get_timeout(ray_cluster):
+    @ray_tpu.remote
+    def hang():
+        time.sleep(30)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(hang.remote(), timeout=0.5)
+
+
+def test_large_args_and_returns(ray_cluster):
+    arr = np.random.rand(512, 512)
+
+    @ray_tpu.remote
+    def double(a):
+        return a * 2
+
+    out = ray_tpu.get(double.remote(arr), timeout=60)
+    assert np.allclose(out, arr * 2)
+
+
+def test_closure_capture(ray_cluster):
+    captured = {"k": 7}
+
+    @ray_tpu.remote
+    def use_closure():
+        return captured["k"]
+
+    assert ray_tpu.get(use_closure.remote(), timeout=60) == 7
+
+
+def test_retries_on_worker_death(ray_cluster):
+    @ray_tpu.remote(max_retries=2)
+    def die_once(path):
+        import os
+
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os._exit(1)
+        return "survived"
+
+    import tempfile
+
+    path = tempfile.mktemp()
+    assert ray_tpu.get(die_once.remote(path), timeout=120) == "survived"
+
+
+def test_no_retries_raises(ray_cluster):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        import os
+
+        os._exit(1)
+
+    with pytest.raises(ray_tpu.WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=120)
