@@ -1,0 +1,13 @@
+from .collective import (allgather, allreduce, barrier, broadcast,
+                         destroy_collective_group, get_group_handle,
+                         init_collective_group, recv, reducescatter, send)
+from .xla_group import (mesh_allgather, mesh_allreduce, mesh_all_to_all,
+                        mesh_broadcast, mesh_ppermute, mesh_reducescatter)
+
+__all__ = [
+    "init_collective_group", "destroy_collective_group", "get_group_handle",
+    "allreduce", "allgather", "reducescatter", "broadcast", "barrier",
+    "send", "recv",
+    "mesh_allreduce", "mesh_allgather", "mesh_reducescatter",
+    "mesh_broadcast", "mesh_ppermute", "mesh_all_to_all",
+]

@@ -1,0 +1,188 @@
+"""Cross-process collective API (reference:
+python/ray/util/collective/collective.py — init_collective_group :120,
+allreduce :258, barrier :298, broadcast :373, allgather :423,
+reducescatter :472, send :531, recv :594, GroupManager :40).
+
+Two backends:
+
+  * "xla" — the declared intent for the data plane: the group's members are
+    expected to be inside one SPMD program (jax.distributed multi-host or a
+    local mesh); this module then only provides rendezvous/barrier, and the
+    collectives themselves are the compiled helpers in xla_group.py.
+  * "kv" — the Gloo-equivalent control-plane backend: CPU tensors move
+    through the control-plane KV store with a rendezvous protocol (the
+    reference's Gloo group bootstraps exactly this way through the Ray
+    internal KV, reference: gloo_util.py:271 RayInternalKvStore).  Built for
+    correctness of small control-plane syncs (init barriers, metric merges),
+    not bandwidth.
+
+The KV protocol is epoch-numbered: every op on a group bumps a local op
+counter, keys are f"{group}/{op_idx}/{rank}"; readers poll-and-delete.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_NS = "collective"
+
+
+def _kv():
+    from ray_tpu._private.core import current_core
+
+    return current_core().control
+
+
+def _kv_put(key: str, val: bytes):
+    _kv().call("kv_put", {"ns": _NS, "key": key, "val": val})
+
+
+def _kv_get(key: str, timeout: float = 120.0) -> bytes:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = _kv().call("kv_get", {"ns": _NS, "key": key})
+        if v is not None:
+            return v
+        time.sleep(0.005)
+    raise TimeoutError(f"collective rendezvous timed out on {key}")
+
+
+def _kv_del(key: str):
+    _kv().call("kv_del", {"ns": _NS, "key": key})
+
+
+class GroupHandle:
+    def __init__(self, name: str, world_size: int, rank: int, backend: str):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.backend = backend
+        self.op_idx = 0
+
+    def _key(self, op: str, rank: int) -> str:
+        return f"{self.name}/{self.op_idx}/{op}/{rank}"
+
+
+_groups: Dict[str, GroupHandle] = {}
+
+
+def init_collective_group(world_size: int, rank: int, backend: str = "kv",
+                          group_name: str = "default") -> GroupHandle:
+    """Register this process as `rank` of `group_name` and barrier until all
+    members arrive (reference: collective.py:120)."""
+    if backend not in ("kv", "xla"):
+        raise ValueError(f"unknown backend {backend!r}")
+    g = GroupHandle(group_name, world_size, rank, backend)
+    _groups[group_name] = g
+    _kv_put(f"{group_name}/init/{rank}", b"1")
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline:
+        n = sum(1 for r in range(world_size)
+                if _kv().call("kv_exists",
+                              {"ns": _NS, "key": f"{group_name}/init/{r}"}))
+        if n == world_size:
+            return g
+        time.sleep(0.01)
+    raise TimeoutError(
+        f"collective group {group_name} init: only {n}/{world_size} arrived")
+
+
+def get_group_handle(group_name: str = "default") -> GroupHandle:
+    if group_name not in _groups:
+        raise ValueError(f"collective group {group_name!r} not initialized "
+                         f"in this process")
+    return _groups[group_name]
+
+
+def destroy_collective_group(group_name: str = "default"):
+    g = _groups.pop(group_name, None)
+    if g is not None:
+        _kv_del(f"{g.name}/init/{g.rank}")
+
+
+def _as_numpy(t) -> np.ndarray:
+    return np.asarray(t)
+
+
+def barrier(group_name: str = "default"):
+    """All members rendezvous (reference: collective.py:298)."""
+    g = get_group_handle(group_name)
+    g.op_idx += 1
+    _kv_put(g._key("bar", g.rank), b"1")
+    for r in range(g.world_size):
+        _kv_get(g._key("bar", r))
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    """CPU allreduce through the KV plane; returns the reduced array
+    (reference: collective.py:258).  Rank 0 reduces, others fetch."""
+    g = get_group_handle(group_name)
+    g.op_idx += 1
+    x = _as_numpy(tensor)
+    _kv_put(g._key("ar", g.rank), pickle.dumps(x, protocol=5))
+    if g.rank == 0:
+        acc = x.copy()
+        for r in range(1, g.world_size):
+            other = pickle.loads(_kv_get(g._key("ar", r)))
+            if op == "sum" or op == "mean":
+                acc = acc + other
+            elif op == "max":
+                acc = np.maximum(acc, other)
+            elif op == "min":
+                acc = np.minimum(acc, other)
+            else:
+                raise ValueError(f"unknown op {op}")
+        if op == "mean":
+            acc = acc / g.world_size
+        _kv_put(g._key("ar", -1), pickle.dumps(acc, protocol=5))
+        return acc
+    return pickle.loads(_kv_get(g._key("ar", -1)))
+
+
+def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
+    """Every member receives every member's tensor, rank-ordered
+    (reference: collective.py:423)."""
+    g = get_group_handle(group_name)
+    g.op_idx += 1
+    _kv_put(g._key("ag", g.rank), pickle.dumps(_as_numpy(tensor), protocol=5))
+    return [pickle.loads(_kv_get(g._key("ag", r))) for r in range(g.world_size)]
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
+    """Reduce then scatter equal chunks; returns this rank's chunk
+    (reference: collective.py:472)."""
+    g = get_group_handle(group_name)
+    full = allreduce(tensor, group_name, op=op)
+    chunks = np.array_split(full, g.world_size, axis=0)
+    return chunks[g.rank]
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    """Root's tensor to everyone (reference: collective.py:373)."""
+    g = get_group_handle(group_name)
+    g.op_idx += 1
+    if g.rank == src_rank:
+        _kv_put(g._key("bc", src_rank), pickle.dumps(_as_numpy(tensor),
+                                                     protocol=5))
+        return _as_numpy(tensor)
+    return pickle.loads(_kv_get(g._key("bc", src_rank)))
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    """P2P send via KV mailbox (reference: collective.py:531)."""
+    g = get_group_handle(group_name)
+    g.op_idx += 1
+    _kv_put(g._key(f"p2p-{g.rank}-{dst_rank}", g.rank),
+            pickle.dumps(_as_numpy(tensor), protocol=5))
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    """P2P recv (reference: collective.py:594).  The sender and receiver
+    must issue matching op sequences (same as NCCL send/recv pairing)."""
+    g = get_group_handle(group_name)
+    g.op_idx += 1
+    return pickle.loads(_kv_get(g._key(f"p2p-{src_rank}-{g.rank}", src_rank)))

@@ -1,0 +1,158 @@
+"""Compiled (XLA) collectives over a device mesh — the NCCL-group analog.
+
+The reference's NCCL collective group issues runtime library calls per
+operation (reference: nccl_collective_group.py:830 LoC of stream/comm
+management).  On TPU the idiomatic equivalent is *compiled* collectives:
+`shard_map` over a `jax.sharding.Mesh` lowers `lax.psum`/`all_gather`/
+`psum_scatter`/`ppermute`/`all_to_all` to ICI/DCN programs fused into the
+surrounding computation.  These helpers give that capability the shape of a
+collective API for code that isn't already inside a pjit program; inside
+one, use `jax.lax` primitives directly.
+
+All helpers are single-controller: they operate on (possibly sharded) global
+arrays over the local mesh.  The multi-process story is the Train backend
+(jax.distributed + the same compiled collectives across hosts).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+
+def _axis(mesh: Mesh, axis_name: Optional[str]) -> str:
+    if axis_name is None:
+        if len(mesh.axis_names) != 1:
+            raise ValueError(f"specify axis_name for multi-axis mesh "
+                             f"{mesh.axis_names}")
+        return mesh.axis_names[0]
+    return axis_name
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "op"))
+def _allreduce_impl(x, mesh: Mesh, axis: str, op: str):
+    spec = P(axis)
+
+    def f(shard):
+        if op == "sum":
+            return jax.lax.psum(shard, axis)
+        if op == "max":
+            return jax.lax.pmax(shard, axis)
+        if op == "min":
+            return jax.lax.pmin(shard, axis)
+        if op == "mean":
+            return jax.lax.pmean(shard, axis)
+        raise ValueError(f"unknown reduce op {op}")
+
+    return shard_map(f, check_vma=False, mesh=mesh, in_specs=spec, out_specs=spec)(x)
+
+
+def mesh_allreduce(x, mesh: Mesh, axis_name: Optional[str] = None,
+                   op: str = "sum"):
+    """Allreduce a leading-axis-sharded array across a mesh axis.
+
+    x has a per-device leading chunk layout [n_dev * k, ...]; each device's
+    chunk is reduced with its peers' — the allreduce of the NCCL API, but
+    compiled (reference API: collective.py:258 allreduce)."""
+    axis = _axis(mesh, axis_name)
+    return _allreduce_impl(x, mesh, axis, op)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "tiled"))
+def _allgather_impl(x, mesh: Mesh, axis: str, tiled: bool):
+    def f(shard):
+        return jax.lax.all_gather(shard, axis, tiled=tiled)
+
+    return shard_map(f, check_vma=False, mesh=mesh, in_specs=P(axis), out_specs=P())(x)
+
+
+def mesh_allgather(x, mesh: Mesh, axis_name: Optional[str] = None):
+    """Each device contributes its shard; all get the concatenation
+    (reference API: collective.py:423 allgather)."""
+    axis = _axis(mesh, axis_name)
+    return _allgather_impl(x, mesh, axis, True)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def _reducescatter_impl(x, mesh: Mesh, axis: str):
+    def f(shard):
+        # shard is [1, N] (this device's contribution row); NCCL semantics:
+        # reduce all rows, each device keeps its N/world chunk
+        y = jax.lax.psum_scatter(shard[0], axis, scatter_dimension=0,
+                                 tiled=True)
+        return y[None]
+
+    return shard_map(f, check_vma=False, mesh=mesh, in_specs=P(axis),
+                     out_specs=P(axis))(x)
+
+
+def mesh_reducescatter(x, mesh: Mesh, axis_name: Optional[str] = None):
+    """Reduce across the axis, leave each device its scattered chunk
+    (reference API: collective.py:472 reducescatter).  Input is the stacked
+    per-device contributions [world, N]; output [world, N/world] where row r
+    is the reduced chunk owned by device r."""
+    axis = _axis(mesh, axis_name)
+    return _reducescatter_impl(x, mesh, axis)
+
+
+def mesh_broadcast(x, mesh: Mesh, axis_name: Optional[str] = None,
+                   root: int = 0):
+    """Every device receives root's shard (reference API: collective.py:373)."""
+    axis = _axis(mesh, axis_name)
+    n = mesh.shape[axis]
+
+    @functools.partial(jax.jit, static_argnames=())
+    def run(v):
+        def f(shard):
+            # rotate root's shard to everyone: gather then index is simplest
+            # and XLA turns the gather+slice into a broadcast from root
+            full = jax.lax.all_gather(shard, axis)
+            return full[root]
+
+        return shard_map(f, check_vma=False, mesh=mesh, in_specs=P(axis), out_specs=P(axis))(v)
+
+    return run(x)
+
+
+def mesh_ppermute(x, mesh: Mesh, perm: Sequence[tuple],
+                  axis_name: Optional[str] = None):
+    """Point-to-point shard rotation — the send/recv of the compiled world
+    (reference API: collective.py:531/:594 send/recv); the building block of
+    ring attention and pipeline microbatching."""
+    axis = _axis(mesh, axis_name)
+    perm = tuple((int(a), int(b)) for a, b in perm)
+
+    @functools.partial(jax.jit)
+    def run(v):
+        def f(shard):
+            return jax.lax.ppermute(shard, axis, perm)
+
+        return shard_map(f, check_vma=False, mesh=mesh, in_specs=P(axis), out_specs=P(axis))(v)
+
+    return run(x)
+
+
+def mesh_all_to_all(x, mesh: Mesh, axis_name: Optional[str] = None,
+                    split_axis: int = 1, concat_axis: int = 0):
+    """All-to-all reshard — the Ulysses/MoE-dispatch primitive.
+
+    With the array sharded on dim 0 over the mesh axis, each device splits
+    its shard along `split_axis` and exchanges pieces, concatenating along
+    `concat_axis` (maps to lax.all_to_all; EP token dispatch and
+    sequence<->head resharding are this one op)."""
+    axis = _axis(mesh, axis_name)
+
+    @functools.partial(jax.jit)
+    def run(v):
+        def f(shard):
+            return jax.lax.all_to_all(shard, axis, split_axis, concat_axis,
+                                      tiled=True)
+
+        return shard_map(f, check_vma=False, mesh=mesh, in_specs=P(axis), out_specs=P(axis))(v)
+
+    return run(x)

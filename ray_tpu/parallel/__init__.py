@@ -1,0 +1,11 @@
+from .mesh import (AXIS_ORDER, MeshSpec, batch_sharding, data_axes,
+                   local_mesh, make_mesh, replicated)
+from .sharding import (DEFAULT_RULES, Logical, shard_tree, spec_from_logical,
+                       tree_shardings, with_constraint)
+
+__all__ = [
+    "AXIS_ORDER", "MeshSpec", "make_mesh", "local_mesh", "batch_sharding",
+    "data_axes", "replicated",
+    "DEFAULT_RULES", "Logical", "spec_from_logical", "tree_shardings",
+    "shard_tree", "with_constraint",
+]

@@ -1,0 +1,101 @@
+"""Device mesh management: the TPU-native communication substrate.
+
+Where the reference wires collectives at runtime (NCCL groups over cupy,
+reference: python/ray/util/collective/collective_group/nccl_collective_group.py),
+the TPU-native design makes the *mesh* the primitive: a
+`jax.sharding.Mesh` over ICI (intra-slice) and DCN (cross-slice) axes.
+Collectives are compiled into XLA programs via shard_map/pjit over this mesh
+— there are no runtime collective calls to manage.
+
+Standard axis names (outer-to-inner, DCN-friendly axes first):
+
+    pp    pipeline stages          (cross-slice OK: p2p only)
+    dp    pure data parallel       (cross-slice OK: one allreduce per step)
+    fsdp  data parallel + param sharding (ZeRO-3; wants ICI)
+    sp    sequence/context parallel (ring attention; wants ICI ring)
+    tp    tensor parallel          (wants fastest ICI axis, innermost)
+    ep    expert parallel          (aliased onto fsdp/sp axes in MoE layers)
+
+jax device order for TPU meshes follows the physical torus, so keeping `tp`
+innermost places it on the fastest ICI loop — the layout recipe of the
+scaling playbook (jax-ml.github.io/scaling-book).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape; -1 on at most one axis means 'fill'."""
+
+    pp: int = 1
+    dp: int = 1
+    fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        sizes = self.sizes()
+        fill = [a for a, s in sizes.items() if s == -1]
+        if len(fill) > 1:
+            raise ValueError(f"at most one -1 axis allowed: {sizes}")
+        known = math.prod(s for s in sizes.values() if s != -1)
+        if fill:
+            if n_devices % known:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by {known} for {sizes}")
+            sizes[fill[0]] = n_devices // known
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {math.prod(sizes.values())} devices, "
+                f"got {n_devices}")
+        return MeshSpec(**sizes)
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        devices = list(devices if devices is not None else jax.devices())
+        spec = self.resolve(len(devices))
+        shape = tuple(spec.sizes()[a] for a in AXIS_ORDER)
+        arr = np.array(devices).reshape(shape)
+        return Mesh(arr, AXIS_ORDER)
+
+
+def make_mesh(*, pp: int = 1, dp: int = 1, fsdp: int = 1, sp: int = 1,
+              tp: int = 1, devices: Optional[Sequence] = None) -> Mesh:
+    return MeshSpec(pp=pp, dp=dp, fsdp=fsdp, sp=sp, tp=tp).build(devices)
+
+
+def data_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Axes a per-example batch is sharded over."""
+    return tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) >= 1)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Canonical input-batch sharding: batch over (dp, fsdp), seq over sp."""
+    return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_mesh(n: Optional[int] = None, **axes) -> Mesh:
+    """Mesh over this process's local devices (single-controller use)."""
+    devs = jax.local_devices()
+    if n is not None:
+        devs = devs[:n]
+    if not axes:
+        axes = {"dp": len(devs)}
+    return make_mesh(devices=devs, **axes)

@@ -1,0 +1,103 @@
+"""Logical-axis sharding rules: the FSDP/TP/SP story as partition specs.
+
+The reference delegates sharded training to integrations (torch FSDP /
+DeepSpeed via Train, reference: python/ray/train/ — §2.3 of SURVEY.md);
+here parameter/optimizer sharding is first-class: parameters carry *logical*
+axis names and a rule table maps them to mesh axes, GSPMD-style.  ZeRO-3 ≡
+sharding every parameter's largest axis over `fsdp`; TP ≡ sharding
+attention-head / mlp axes over `tp`; SP ≡ sharding the sequence axis of
+activations over `sp`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis -> mesh axis (or tuple of mesh axes, or None = replicate)
+DEFAULT_RULES: Dict[str, Any] = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed": "fsdp",       # ZeRO-3: shard params' embed dim over fsdp
+    "heads": "tp",
+    "kv_heads": "tp",
+    "head_dim": None,
+    "mlp": "tp",
+    "vocab": "tp",
+    "layers": None,        # stacked-layer leading axis (scanned); pp handles stages
+    "stages": "pp",
+    "experts": "ep",
+    "conv_in": None,
+    "conv_out": "fsdp",
+    "norm": None,
+}
+
+
+def spec_from_logical(logical: Sequence[Optional[str]],
+                      rules: Optional[Dict[str, Any]] = None,
+                      mesh: Optional[Mesh] = None) -> P:
+    """Map a tuple of logical axis names to a PartitionSpec.
+
+    Axes whose mesh axis has size 1 (or is absent) become None so the same
+    model code runs on any mesh shape.
+    """
+    rules = {**DEFAULT_RULES, **(rules or {})}
+    out = []
+    for name in logical:
+        mesh_axes = rules.get(name) if name is not None else None
+        if mesh_axes is None:
+            out.append(None)
+            continue
+        if isinstance(mesh_axes, str):
+            mesh_axes = (mesh_axes,)
+        if mesh is not None:
+            mesh_axes = tuple(a for a in mesh_axes
+                              if mesh.shape.get(a, 1) > 1)
+        if not mesh_axes:
+            out.append(None)
+        elif len(mesh_axes) == 1:
+            out.append(mesh_axes[0])
+        else:
+            out.append(tuple(mesh_axes))
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+class Logical:
+    """Annotation carried on parameter pytree leaves at init time."""
+
+    __slots__ = ("axes",)
+
+    def __init__(self, *axes: Optional[str]):
+        self.axes = axes
+
+
+def tree_shardings(logical_tree, mesh: Mesh,
+                   rules: Optional[Dict[str, Any]] = None):
+    """Map a pytree of Logical annotations to NamedShardings."""
+    return jax.tree.map(
+        lambda l: NamedSharding(mesh, spec_from_logical(l.axes, rules, mesh)),
+        logical_tree, is_leaf=lambda x: isinstance(x, Logical))
+
+
+def shard_tree(tree, logical_tree, mesh: Mesh,
+               rules: Optional[Dict[str, Any]] = None):
+    """Place a concrete pytree on the mesh per its logical annotations."""
+    sh = tree_shardings(logical_tree, mesh, rules)
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), tree, sh)
+
+
+def with_constraint(x, logical: Tuple[Optional[str], ...],
+                    rules: Optional[Dict[str, Any]] = None):
+    """In-jit sharding constraint by logical axes (uses the ambient mesh)."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()  # jax>=0.4.35 in-jit mesh
+        concrete = None if mesh is None or mesh.empty else mesh
+    except Exception:
+        concrete = None
+    spec = spec_from_logical(logical, rules, None)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(concrete, spec) if concrete is not None else spec)

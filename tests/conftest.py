@@ -13,14 +13,18 @@ The env vars MUST be set before jax is imported anywhere.
 import os
 import sys
 
-# force-set: the axon sitecustomize pins JAX_PLATFORMS=axon at interpreter
-# start, so setdefault would lose
-if not os.environ.get("RAY_TPU_TEST_REAL_TPU"):
-    os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+# The TPU-tunnel sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS pinned to the hardware plugin, so env edits here are too
+# late for the config default — update the already-imported config too.
+if not os.environ.get("RAY_TPU_TEST_REAL_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

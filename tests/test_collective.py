@@ -1,0 +1,70 @@
+"""Cross-process collective API tests (reference model:
+python/ray/util/collective/tests/single_node_cpu_tests/)."""
+
+import numpy as np
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class CollectiveWorker:
+    def __init__(self, rank, world):
+        self.rank = rank
+        self.world = world
+
+    def setup(self, group):
+        from ray_tpu import collective as col
+
+        col.init_collective_group(self.world, self.rank, backend="kv",
+                                  group_name=group)
+        return True
+
+    def do_allreduce(self, group):
+        from ray_tpu import collective as col
+
+        x = np.full(8, float(self.rank + 1))
+        out = col.allreduce(x, group)
+        return out
+
+    def do_allgather(self, group):
+        from ray_tpu import collective as col
+
+        return col.allgather(np.array([self.rank]), group)
+
+    def do_broadcast(self, group):
+        from ray_tpu import collective as col
+
+        x = np.array([42.0]) if self.rank == 1 else np.zeros(1)
+        return col.broadcast(x, src_rank=1, group_name=group)
+
+    def do_sendrecv(self, group):
+        from ray_tpu import collective as col
+
+        if self.rank == 0:
+            col.send(np.array([7.0]), dst_rank=1, group_name=group)
+            return None
+        return col.recv(src_rank=0, group_name=group)
+
+
+def test_kv_collectives(ray_cluster):
+    world = 2
+    workers = [CollectiveWorker.remote(r, world) for r in range(world)]
+    assert all(ray_tpu.get([w.setup.remote("g1") for w in workers], timeout=120))
+
+    outs = ray_tpu.get([w.do_allreduce.remote("g1") for w in workers],
+                       timeout=120)
+    for o in outs:
+        assert np.allclose(o, 3.0)  # 1 + 2
+
+    gathers = ray_tpu.get([w.do_allgather.remote("g1") for w in workers],
+                          timeout=120)
+    for g in gathers:
+        assert [int(a[0]) for a in g] == [0, 1]
+
+    bcasts = ray_tpu.get([w.do_broadcast.remote("g1") for w in workers],
+                         timeout=120)
+    for b in bcasts:
+        assert np.allclose(b, 42.0)
+
+    sr = ray_tpu.get([w.do_sendrecv.remote("g1") for w in workers], timeout=120)
+    assert sr[0] is None and np.allclose(sr[1], 7.0)
