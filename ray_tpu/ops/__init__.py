@@ -1,0 +1,14 @@
+from .attention import (attention, blockwise_attention, flash_attention,
+                        mha_reference)
+from .layers import (apply_rope, gelu_mlp, layer_norm, rms_norm, rope_table,
+                     softmax_cross_entropy, swiglu)
+from .ring_attention import ring_attention, ring_attention_sharded
+from .ulysses import ulysses_attention, ulysses_attention_sharded
+
+__all__ = [
+    "attention", "flash_attention", "blockwise_attention", "mha_reference",
+    "ring_attention", "ring_attention_sharded",
+    "ulysses_attention", "ulysses_attention_sharded",
+    "rms_norm", "layer_norm", "rope_table", "apply_rope", "swiglu",
+    "gelu_mlp", "softmax_cross_entropy",
+]

@@ -1,0 +1,243 @@
+"""Attention ops: Pallas TPU flash attention + blockwise XLA fallback.
+
+The reference has no attention kernels at all (it delegates compute to
+torch); for a TPU-native framework the attention kernel IS the hot op, so it
+lives here as a first-class component (SURVEY.md §2.3: ring attention must be
+built natively).
+
+Layouts: all functions take [batch, heads, seq, head_dim] (BHSD).
+
+Three tiers:
+  * mha_reference     — O(S^2) naive, the correctness oracle.
+  * blockwise_attention — flash-style streaming softmax as a lax.scan; runs
+    anywhere XLA runs, differentiable, memory O(S·block).
+  * flash_attention   — Pallas TPU kernel (MXU-tiled, VMEM-resident blocks,
+    causal block skipping); custom VJP falls back to the blockwise XLA
+    backward (recompute) so the op is differentiable end-to-end.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+
+
+def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """Naive O(S^2) attention; the oracle for kernel tests."""
+    *_, sq, d = q.shape
+    sk = k.shape[-2]
+    scale = (d ** -0.5) if scale is None else scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = np.tril(np.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Blockwise (flash-style) attention in pure XLA — runs on CPU/TPU, grads OK
+# ---------------------------------------------------------------------------
+
+
+def _block_stats_update(carry, s_blk, v_blk):
+    """One online-softmax accumulation step (the flash recurrence)."""
+    acc, m_prev, l_prev = carry
+    m_cur = jnp.max(s_blk, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s_blk - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_blk.dtype),
+                                       v_blk).astype(acc.dtype)
+    return acc_new, m_new, l_new
+
+
+def blockwise_attention(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None, block_k: int = 512,
+                        kv_offset: int = 0, q_offset: int = 0):
+    """Streaming-softmax attention scanning KV blocks.
+
+    kv_offset/q_offset give the *global* positions of the local q/k chunks —
+    that's what lets ring attention reuse this with rotated KV blocks.
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[-2]
+    scale = (d ** -0.5) if scale is None else scale
+    block_k = min(block_k, sk)
+    nblocks = (sk + block_k - 1) // block_k
+    pad = nblocks * block_k - sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(b, h, nblocks, block_k, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nblocks, block_k, d).transpose(2, 0, 1, 3, 4)
+
+    q32 = q.astype(jnp.float32)
+    row_ids = q_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, 1), 0)
+
+    def step(carry, inputs):
+        idx, k_blk, v_blk = inputs
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                       k_blk.astype(jnp.float32)) * scale
+        col_start = kv_offset + idx * block_k
+        col_ids = col_start + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        mask = col_ids < (kv_offset + sk)  # padding mask
+        if causal:
+            mask = mask & (row_ids >= col_ids)
+        s = jnp.where(mask[None, None], s, DEFAULT_MASK_VALUE)
+        return _block_stats_update(carry, s, v_blk), None
+
+    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq, 1), DEFAULT_MASK_VALUE, jnp.float32)
+    l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
+    (acc, m, l), _ = jax.lax.scan(
+        step, (acc0, m0, l0),
+        (jnp.arange(nblocks), kb, vb))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU flash attention
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  scale: float, causal: bool, block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(2)  # q block
+    j = pl.program_id(3)  # k block
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, DEFAULT_MASK_VALUE)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = True
+    if causal:
+        # whole block above the diagonal contributes nothing
+        run = (j * block_k) <= (i * block_q + block_q - 1)
+
+    @pl.when(run if causal else True)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)           # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)           # [bk, d]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, DEFAULT_MASK_VALUE)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_ref[:] /
+                       jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
+                   block_k: int, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[-2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(f"seq lengths ({sq},{sk}) must divide blocks "
+                         f"({block_q},{block_k})")
+    grid = (b, h, sq // block_q, sk // block_k)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b_, h_, i, j: (b_, h_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 256,
+                    block_k: int = 256, interpret: bool = False):
+    """Pallas TPU flash attention (forward); backward recomputes via the
+    blockwise XLA path (flash-style memory there too)."""
+    scale = (q.shape[-1] ** -0.5) if scale is None else scale
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
+                                               scale=scale, block_k=block_k),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
+              impl: str = "auto", block_q: int = 256, block_k: int = 256):
+    """Dispatching attention: Pallas kernel on TPU, blockwise XLA elsewhere.
+
+    q,k,v: [batch, heads, seq, head_dim]
+    """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        return flash_attention(q, k, v, causal, scale, block_q, block_k, False)
+    if impl == "pallas_interpret":
+        return flash_attention(q, k, v, causal, scale, block_q, block_k, True)
+    if impl == "xla":
+        return blockwise_attention(q, k, v, causal=causal, scale=scale,
+                                   block_k=block_k)
+    if impl == "reference":
+        return mha_reference(q, k, v, causal=causal, scale=scale)
+    raise ValueError(f"unknown attention impl {impl!r}")

@@ -1,0 +1,83 @@
+"""Core layer math: rmsnorm, rope, activations — XLA-fusable building blocks.
+
+XLA fuses these elementwise chains into surrounding matmuls (the HBM-
+bandwidth recipe); they are written shape-polymorphic so the same code runs
+under any sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-6):
+    """RMSNorm in f32 accumulation, cast back to input dtype."""
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def layer_norm(x, weight, bias, eps: float = 1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_table(seq_len: int, head_dim: int, base: float = 10000.0,
+               dtype=jnp.float32) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Precomputed cos/sin tables [seq, head_dim/2]."""
+    half = head_dim // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = jnp.arange(seq_len, dtype=jnp.float32)
+    angles = jnp.outer(pos, freqs)
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rope(x, cos, sin, positions: Optional[jnp.ndarray] = None):
+    """Rotary embedding for [B, H, S, D] with tables [S_max, D/2].
+
+    positions: optional [S] global positions (sequence-parallel chunks pass
+    their offsets); defaults to arange(S).
+    """
+    b, h, s, d = x.shape
+    if positions is None:
+        c = cos[:s][None, None]
+        sn = sin[:s][None, None]
+    else:
+        c = cos[positions][None, None]
+        sn = sin[positions][None, None]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    y1 = x1 * c - x2 * sn
+    y2 = x2 * c + x1 * sn
+    return jnp.concatenate([y1, y2], axis=-1).astype(x.dtype)
+
+
+def swiglu(x, w_gate, w_up, w_down):
+    """SwiGLU MLP: silu(x@Wg) * (x@Wu) @ Wd, bf16-friendly."""
+    g = jnp.einsum("...d,df->...f", x, w_gate)
+    u = jnp.einsum("...d,df->...f", x, w_up)
+    return jnp.einsum("...f,fd->...d", jax.nn.silu(g) * u, w_down)
+
+
+def gelu_mlp(x, w_in, b_in, w_out, b_out):
+    h = jnp.einsum("...d,df->...f", x, w_in) + b_in
+    return jnp.einsum("...f,fd->...d", jax.nn.gelu(h), w_out) + b_out
+
+
+def softmax_cross_entropy(logits, labels, z_loss: float = 0.0):
+    """Token-level CE in f32 with optional z-loss (stabilizes large-vocab
+    training); logits [..., V], labels [...] int."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    loss = lse - ll
+    if z_loss:
+        loss = loss + z_loss * jnp.square(lse)
+    return loss
