@@ -1,0 +1,304 @@
+"""GPT-class transformer LM — the flagship model, TPU-first.
+
+Pure-function + pytree design (no module framework): params are nested dicts
+of jax arrays with a parallel tree of Logical axis annotations, so any mesh
+shape (dp/fsdp/tp/sp/pp) shards the same code.  Layers are *stacked* on a
+leading axis and scanned (`lax.scan` + `jax.checkpoint`), which keeps compile
+time O(1) in depth and gives PP a natural stage axis.
+
+Capability target: the reference runs GPT-2 via Train integrations
+(reference: release/air_tests/air_benchmarks, train/examples/deepspeed/
+deepspeed_torch_trainer.py fine-tunes GPT-2-class models); here the model is
+in-tree and sharding-native.  BASELINE.md north star: GPT-2-medium
+throughput on pods.
+
+Supports both the GPT-2 recipe (learned positions, LayerNorm, GELU) and the
+modern recipe (RoPE, RMSNorm, SwiGLU) via config flags.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops import (apply_rope, attention, blockwise_attention,
+                         gelu_mlp, layer_norm, rms_norm, rope_table,
+                         softmax_cross_entropy, swiglu)
+from ray_tpu.ops.ring_attention import ring_attention_sharded
+from ray_tpu.parallel.sharding import Logical, spec_from_logical
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304
+    n_layers: int = 12
+    d_model: int = 768
+    n_heads: int = 12
+    d_head: int = 64
+    d_ff: int = 3072
+    max_seq: int = 1024
+    norm: str = "ln"          # "ln" | "rms"
+    act: str = "gelu"         # "gelu" | "swiglu"
+    pos: str = "learned"      # "learned" | "rope"
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    attention_impl: str = "auto"
+    sp_mode: str = "ring"     # how to handle a >1 sp axis: "ring" | "none"
+    z_loss: float = 1e-4
+    tie_embeddings: bool = True
+    num_microbatches: Optional[int] = None  # pp microbatches; default = pp
+
+    @classmethod
+    def gpt2_small(cls, **kw):
+        return cls(n_layers=12, d_model=768, n_heads=12, d_head=64,
+                   d_ff=3072, **kw)
+
+    @classmethod
+    def gpt2_medium(cls, **kw):
+        return cls(n_layers=24, d_model=1024, n_heads=16, d_head=64,
+                   d_ff=4096, **kw)
+
+    @classmethod
+    def gpt2_large(cls, **kw):
+        return cls(n_layers=36, d_model=1280, n_heads=20, d_head=64,
+                   d_ff=5120, **kw)
+
+    @classmethod
+    def gpt2_xl(cls, **kw):
+        return cls(n_layers=48, d_model=1600, n_heads=25, d_head=64,
+                   d_ff=6400, **kw)
+
+    @classmethod
+    def nano(cls, **kw):
+        """Tiny config for tests: runs on an 8-device CPU mesh."""
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("max_seq", 128)
+        return cls(n_layers=4, d_model=64, n_heads=4, d_head=16, d_ff=128,
+                   **kw)
+
+
+def logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
+    """Logical sharding annotations mirroring init()'s param tree."""
+    lp = {
+        "attn_norm": Logical("layers", None),
+        "wq": Logical("layers", "embed", "heads", "head_dim"),
+        "wk": Logical("layers", "embed", "heads", "head_dim"),
+        "wv": Logical("layers", "embed", "heads", "head_dim"),
+        "wo": Logical("layers", "heads", "head_dim", "embed"),
+        "mlp_norm": Logical("layers", None),
+        "mlp_out": Logical("layers", "mlp", "embed"),
+    }
+    if cfg.act == "swiglu":
+        lp["mlp_gate"] = Logical("layers", "embed", "mlp")
+        lp["mlp_up"] = Logical("layers", "embed", "mlp")
+    else:
+        lp["mlp_in"] = Logical("layers", "embed", "mlp")
+        lp["mlp_in_b"] = Logical("layers", "mlp")
+        lp["mlp_out_b"] = Logical("layers", None)
+    if cfg.norm == "ln":
+        lp["attn_norm_b"] = Logical("layers", None)
+        lp["mlp_norm_b"] = Logical("layers", None)
+    out = {
+        "embed": Logical("vocab", "embed"),
+        "layers": lp,
+        "final_norm": Logical(None),
+    }
+    if cfg.norm == "ln":
+        out["final_norm_b"] = Logical(None)
+    if cfg.pos == "learned":
+        out["pos_embed"] = Logical(None, "embed")
+    if not cfg.tie_embeddings:
+        out["unembed"] = Logical("embed", "vocab")
+    return out
+
+
+def init(key, cfg: GPTConfig) -> Dict[str, Any]:
+    """Initialize the (host or sharded — see training.init_sharded) params."""
+    L, D, H, dh, F, V = (cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.d_head,
+                         cfg.d_ff, cfg.vocab_size)
+    pd = cfg.param_dtype
+    k = iter(jax.random.split(key, 16))
+
+    def norm_init(shape):
+        return jnp.ones(shape, pd)
+
+    def dense(rng, shape, fan_in):
+        return (jax.random.normal(rng, shape, pd)
+                * (1.0 / math.sqrt(fan_in)))
+
+    lp = {
+        "attn_norm": norm_init((L, D)),
+        "wq": dense(next(k), (L, D, H, dh), D),
+        "wk": dense(next(k), (L, D, H, dh), D),
+        "wv": dense(next(k), (L, D, H, dh), D),
+        # residual-branch scaling a la GPT-2 (1/sqrt(2L))
+        "wo": dense(next(k), (L, H, dh, D), H * dh) / math.sqrt(2 * L),
+        "mlp_norm": norm_init((L, D)),
+        "mlp_out": dense(next(k), (L, F, D), F) / math.sqrt(2 * L),
+    }
+    if cfg.act == "swiglu":
+        lp["mlp_gate"] = dense(next(k), (L, D, F), D)
+        lp["mlp_up"] = dense(next(k), (L, D, F), D)
+    else:
+        lp["mlp_in"] = dense(next(k), (L, D, F), D)
+        lp["mlp_in_b"] = jnp.zeros((L, F), pd)
+        lp["mlp_out_b"] = jnp.zeros((L, D), pd)
+    if cfg.norm == "ln":
+        lp["attn_norm_b"] = jnp.zeros((L, D), pd)
+        lp["mlp_norm_b"] = jnp.zeros((L, D), pd)
+    params = {
+        "embed": jax.random.normal(next(k), (V, D), pd) * 0.02,
+        "layers": lp,
+        "final_norm": norm_init((D,)),
+    }
+    if cfg.norm == "ln":
+        params["final_norm_b"] = jnp.zeros((D,), pd)
+    if cfg.pos == "learned":
+        params["pos_embed"] = jax.random.normal(next(k), (cfg.max_seq, D),
+                                                pd) * 0.01
+    if not cfg.tie_embeddings:
+        params["unembed"] = dense(next(k), (D, V), D)
+    return params
+
+
+def _norm(x, w, b, kind):
+    if kind == "rms":
+        return rms_norm(x, w)
+    return layer_norm(x, w, b)
+
+
+def _constrain(x, *axes):
+    from ray_tpu.parallel.sharding import spec_from_logical
+
+    try:
+        return jax.lax.with_sharding_constraint(x, spec_from_logical(axes))
+    except Exception:
+        return x  # outside jit / no mesh context
+
+
+def _attention_op(q, k, v, cfg: GPTConfig, mesh, allow_manual: bool = True):
+    """Pick the attention path: ring over sp when the mesh has an sp axis,
+    otherwise flash/blockwise on the whole (possibly tp-sharded) arrays.
+
+    The sp region is *partial-manual* shard_map (axis_names={'sp'}): dp/tp
+    stay automatic.  Inside the pp pipeline region (allow_manual=False)
+    shardy cannot nest another manual region, so attention falls back to
+    GSPMD partitioning there (exact, all-gathers KV over sp)."""
+    if (allow_manual and mesh is not None and mesh.shape.get("sp", 1) > 1
+            and cfg.sp_mode == "ring"):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        spec = P(None, None, "sp", None)
+        fn = lambda q_, k_, v_: ring_attention_sharded(
+            q_, k_, v_, "sp", causal=True)
+        # mesh=None -> ambient context mesh, so this nests inside the pp
+        # pipeline's manual region (whose context mesh has pp already Manual)
+        return shard_map(fn, check_vma=False,
+                         in_specs=(spec, spec, spec), out_specs=spec,
+                         axis_names=frozenset({"sp"}))(q, k, v)
+    return attention(q, k, v, causal=True, impl=cfg.attention_impl)
+
+
+def apply(params, tokens, cfg: GPTConfig, mesh=None):
+    """Forward pass: tokens [B, S] int32 -> logits [B, S, V]."""
+    B, S = tokens.shape
+    x = params["embed"][tokens].astype(cfg.dtype)
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"][:S][None].astype(cfg.dtype)
+        rope = None
+    else:
+        rope = rope_table(S, cfg.d_head, dtype=jnp.float32)
+    x = _constrain(x, "batch", "seq", "embed")
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+
+    def block(x, layer):
+        h = _norm(x, layer["attn_norm"], layer.get("attn_norm_b"), cfg.norm)
+        h = h.astype(cfg.dtype)
+        q = jnp.einsum("bsd,dhk->bhsk", h, layer["wq"].astype(cfg.dtype))
+        k = jnp.einsum("bsd,dhk->bhsk", h, layer["wk"].astype(cfg.dtype))
+        v = jnp.einsum("bsd,dhk->bhsk", h, layer["wv"].astype(cfg.dtype))
+        if rope is not None:
+            q = apply_rope(q, *rope)
+            k = apply_rope(k, *rope)
+        q = _constrain(q, "batch", "heads", "seq", "head_dim")
+        k = _constrain(k, "batch", "heads", "seq", "head_dim")
+        v = _constrain(v, "batch", "heads", "seq", "head_dim")
+        o = _attention_op(q, k, v, cfg, mesh, allow_manual=(pp == 1))
+        att = jnp.einsum("bhsk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
+        x = x + att
+        h2 = _norm(x, layer["mlp_norm"], layer.get("mlp_norm_b"), cfg.norm)
+        h2 = h2.astype(cfg.dtype)
+        if cfg.act == "swiglu":
+            m = swiglu(h2, layer["mlp_gate"].astype(cfg.dtype),
+                       layer["mlp_up"].astype(cfg.dtype),
+                       layer["mlp_out"].astype(cfg.dtype))
+        else:
+            m = gelu_mlp(h2, layer["mlp_in"].astype(cfg.dtype),
+                         layer["mlp_in_b"].astype(cfg.dtype),
+                         layer["mlp_out"].astype(cfg.dtype),
+                         layer["mlp_out_b"].astype(cfg.dtype))
+        x = x + m
+        return _constrain(x, "batch", "seq", "embed")
+
+    def scan_body(x, layer):
+        if cfg.remat:
+            x = jax.checkpoint(block)(x, layer)
+        else:
+            x = block(x, layer)
+        return x, None
+
+    if pp > 1:
+        from ray_tpu.parallel.pipeline import (merge_microbatches,
+                                               pipeline_apply,
+                                               split_microbatches)
+
+        if cfg.n_layers % pp:
+            raise ValueError(f"n_layers {cfg.n_layers} not divisible by "
+                             f"pp {pp}")
+        M = cfg.num_microbatches or pp
+
+        def stage_fn(stage_layers, xm):
+            out, _ = jax.lax.scan(scan_body, xm, stage_layers)
+            return out
+
+        stacked = jax.tree.map(
+            lambda p: p.reshape(pp, cfg.n_layers // pp, *p.shape[1:]),
+            params["layers"])
+        x = merge_microbatches(
+            pipeline_apply(stage_fn, stacked, split_microbatches(x, M), mesh))
+    else:
+        x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"), cfg.norm)
+    unembed = (params["embed"].T if cfg.tie_embeddings
+               else params["unembed"]).astype(cfg.dtype)
+    logits = jnp.einsum("bsd,dv->bsv", x.astype(cfg.dtype), unembed)
+    return _constrain(logits, "batch", "seq", "vocab")
+
+
+def loss_fn(params, batch, cfg: GPTConfig, mesh=None):
+    """Next-token LM loss.  batch: {"tokens": [B, S+1]} or
+    {"inputs","targets"}."""
+    if "inputs" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+    else:
+        toks = batch["tokens"]
+        inputs, targets = toks[:, :-1], toks[:, 1:]
+    logits = apply(params, inputs, cfg, mesh)
+    loss = softmax_cross_entropy(logits, targets, z_loss=cfg.z_loss)
+    if "mask" in batch:
+        mask = batch["mask"].astype(jnp.float32)
+        return jnp.sum(loss * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(loss)
+
+
+def num_params(cfg: GPTConfig) -> int:
+    p = init(jax.random.PRNGKey(0), dataclasses.replace(cfg, n_layers=1))
+    base = sum(x.size for x in jax.tree.leaves(p))
+    per_layer = sum(x.size for x in jax.tree.leaves(p["layers"]))
+    return base + per_layer * (cfg.n_layers - 1)

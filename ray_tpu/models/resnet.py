@@ -1,0 +1,194 @@
+"""ResNet-50 — the vision e2e model (BASELINE.md north star: Ray Train
+ResNet-50 images/sec/chip on pods).
+
+Pure-function pytree design like gpt.py.  BatchNorm statistics are computed
+over the *global* batch: with the batch sharded over dp/fsdp, `jnp.mean`
+reductions become cross-device psums under GSPMD — synchronized BN with no
+extra code.  Running statistics live in a separate `state` pytree
+(params, state) -> (out, new_state).
+
+Channels-last NHWC layout (TPU-native conv layout).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.parallel.sharding import Logical
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)   # ResNet-50
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+
+    @classmethod
+    def resnet18(cls, **kw):
+        return cls(stage_sizes=(2, 2, 2, 2), **kw)
+
+    @classmethod
+    def resnet50(cls, **kw):
+        return cls(stage_sizes=(3, 4, 6, 3), **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        """For tests: 2 stages, narrow."""
+        kw.setdefault("num_classes", 10)
+        return cls(stage_sizes=(1, 1), width=8, **kw)
+
+
+def _conv_init(key, kh, kw_, cin, cout, dtype):
+    fan_in = kh * kw_ * cin
+    return jax.random.normal(key, (kh, kw_, cin, cout), dtype) * math.sqrt(
+        2.0 / fan_in)
+
+
+def _bn_params(c, dtype):
+    return {"scale": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)}
+
+
+def _bn_state(c):
+    return {"mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def init(key, cfg: ResNetConfig):
+    """Returns (params, state)."""
+    pd = cfg.param_dtype
+    keys = iter(jax.random.split(key, 256))
+    params: Dict[str, Any] = {
+        "stem_conv": _conv_init(next(keys), 7, 7, 3, cfg.width, pd),
+        "stem_bn": _bn_params(cfg.width, pd),
+    }
+    state: Dict[str, Any] = {"stem_bn": _bn_state(cfg.width)}
+    cin = cfg.width
+    for si, nblocks in enumerate(cfg.stage_sizes):
+        cmid = cfg.width * (2 ** si)
+        cout = cmid * 4
+        for bi in range(nblocks):
+            name = f"s{si}b{bi}"
+            stride = 2 if (si > 0 and bi == 0) else 1
+            blk = {
+                "conv1": _conv_init(next(keys), 1, 1, cin, cmid, pd),
+                "bn1": _bn_params(cmid, pd),
+                "conv2": _conv_init(next(keys), 3, 3, cmid, cmid, pd),
+                "bn2": _bn_params(cmid, pd),
+                "conv3": _conv_init(next(keys), 1, 1, cmid, cout, pd),
+                "bn3": _bn_params(cout, pd),
+            }
+            st = {"bn1": _bn_state(cmid), "bn2": _bn_state(cmid),
+                  "bn3": _bn_state(cout)}
+            if cin != cout or stride != 1:
+                blk["proj"] = _conv_init(next(keys), 1, 1, cin, cout, pd)
+                blk["proj_bn"] = _bn_params(cout, pd)
+                st["proj_bn"] = _bn_state(cout)
+            params[name] = blk
+            state[name] = st
+            cin = cout
+    params["head"] = {
+        "w": jax.random.normal(next(keys), (cin, cfg.num_classes), pd) * 0.01,
+        "b": jnp.zeros((cfg.num_classes,), pd),
+    }
+    return params, state
+
+
+def logical_axes(cfg: ResNetConfig, params) -> Any:
+    """Conv kernels shard their output channels over fsdp (ZeRO); head over
+    tp.  BN/bias replicate."""
+
+    def annotate(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        nd = leaf.ndim
+        if nd == 4:
+            return Logical(None, None, None, "conv_out")
+        if nd == 2:
+            return Logical("embed", "vocab")  # head: classes over tp
+        return Logical(*([None] * nd))
+
+    return jax.tree_util.tree_map_with_path(annotate, params)
+
+
+def _batch_norm(x, p, s, training: bool, momentum: float, eps: float):
+    x32 = x.astype(jnp.float32)
+    if training:
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x32, axis=axes)          # global batch: sync BN
+        var = jnp.var(x32, axis=axes)
+        new_s = {"mean": momentum * s["mean"] + (1 - momentum) * mean,
+                 "var": momentum * s["var"] + (1 - momentum) * var}
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    y = y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return y.astype(x.dtype), new_s
+
+
+def _conv(x, w, stride: int = 1, padding="SAME"):
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def apply(params, state, images, cfg: ResNetConfig, training: bool = False):
+    """images [B, H, W, 3] -> (logits [B, classes], new_state)."""
+    x = images.astype(cfg.dtype)
+    new_state: Dict[str, Any] = {}
+    x = _conv(x, params["stem_conv"], stride=2)
+    x, new_state["stem_bn"] = _batch_norm(
+        x, params["stem_bn"], state["stem_bn"], training, cfg.bn_momentum,
+        cfg.bn_eps)
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+    cin = cfg.width
+    for si, nblocks in enumerate(cfg.stage_sizes):
+        for bi in range(nblocks):
+            name = f"s{si}b{bi}"
+            blk, st = params[name], state[name]
+            stride = 2 if (si > 0 and bi == 0) else 1
+            ns: Dict[str, Any] = {}
+            residual = x
+            y = _conv(x, blk["conv1"])
+            y, ns["bn1"] = _batch_norm(y, blk["bn1"], st["bn1"], training,
+                                       cfg.bn_momentum, cfg.bn_eps)
+            y = jax.nn.relu(y)
+            y = _conv(y, blk["conv2"], stride=stride)
+            y, ns["bn2"] = _batch_norm(y, blk["bn2"], st["bn2"], training,
+                                       cfg.bn_momentum, cfg.bn_eps)
+            y = jax.nn.relu(y)
+            y = _conv(y, blk["conv3"])
+            y, ns["bn3"] = _batch_norm(y, blk["bn3"], st["bn3"], training,
+                                       cfg.bn_momentum, cfg.bn_eps)
+            if "proj" in blk:
+                residual = _conv(x, blk["proj"], stride=stride)
+                residual, ns["proj_bn"] = _batch_norm(
+                    residual, blk["proj_bn"], st["proj_bn"], training,
+                    cfg.bn_momentum, cfg.bn_eps)
+            x = jax.nn.relu(y + residual)
+            new_state[name] = ns
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    head = params["head"]
+    logits = x @ head["w"].astype(jnp.float32) + head["b"].astype(jnp.float32)
+    return logits, new_state
+
+
+def loss_fn(params, state, batch, cfg: ResNetConfig, training: bool = True):
+    logits, new_state = apply(params, state, batch["image"], cfg, training)
+    labels = batch["label"]
+    loss = jnp.mean(
+        -jax.nn.log_softmax(logits)[jnp.arange(labels.shape[0]), labels])
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, (new_state, {"loss": loss, "accuracy": acc})

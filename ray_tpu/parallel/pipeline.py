@@ -1,0 +1,103 @@
+"""SPMD pipeline parallelism: GPipe-style microbatching over the `pp` axis.
+
+The reference has no in-tree PP; its substrate is compiled multi-actor
+graphs with NCCL p2p channels (reference: dag/compiled_dag_node.py:664,
+experimental/channel/torch_tensor_nccl_channel.py — SURVEY.md §2.3).  The
+TPU-native equivalent is collective pipelining *inside one compiled
+program*: every `pp` rank holds one stage's layers; microbatch activations
+rotate stage-to-stage via `lax.ppermute` in a `lax.scan` steady-state loop,
+and reverse-mode AD differentiates straight through the rotation (the
+transpose of ppermute is the reverse ppermute — backward pipelining for
+free).
+
+Schedule: plain GPipe fill-drain over T = M + n - 1 ticks (bubble fraction
+(n-1)/T); the scan body is one tick.  Deeper schedules (1F1B, interleaved)
+are compiler-level refinements of the same loop.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x_mb, mesh: Mesh,
+                   axis_name: str = "pp"):
+    """Run microbatches through the pipeline.
+
+    stage_fn(local_params, x) -> x  : one stage's computation
+    stage_params: pytree with a leading *stage* axis sized pp on every leaf
+                  (sharded P(axis_name) outside)
+    x_mb: [M, mb, ...] microbatched input (replicated over pp)
+    returns: [M, mb, ...] outputs of the final stage (replicated over pp)
+
+    Only `axis_name` goes manual; dp/fsdp/tp/sp stay automatic inside, so
+    the stage_fn's own sharding constraints keep working.
+    """
+    n = mesh.shape[axis_name]
+    if n == 1:
+        params_local = jax.tree.map(lambda p: p[0], stage_params)
+        return jax.lax.map(lambda mb: stage_fn(params_local, mb), x_mb)
+
+    M = x_mb.shape[0]
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(params_local, x_local):
+        r = jax.lax.axis_index(axis_name)
+        params_sq = jax.tree.map(lambda p: p[0], params_local)
+        state = jnp.zeros_like(x_local[0])
+        out_buf = jnp.zeros_like(x_local)
+
+        def tick(carry, t):
+            state, out_buf = carry
+            # stage 0 picks up a fresh microbatch while the fill lasts
+            mb_idx = jnp.minimum(t, M - 1)
+            fresh = jax.lax.dynamic_index_in_dim(x_local, mb_idx, 0,
+                                                 keepdims=False)
+            inp = jnp.where(r == 0, fresh, state)
+            out = stage_fn(params_sq, inp)
+            # last stage banks its result for microbatch t-(n-1)
+            done_idx = jnp.clip(t - (n - 1), 0, M - 1)
+            banked = jax.lax.dynamic_update_index_in_dim(
+                out_buf, out.astype(out_buf.dtype), done_idx, 0)
+            take = jnp.logical_and(r == n - 1, t >= n - 1)
+            out_buf = jnp.where(take, banked, out_buf)
+            state = jax.lax.ppermute(out, axis_name, fwd)
+            return (state, out_buf), None
+
+        (state, out_buf), _ = jax.lax.scan(tick, (state, out_buf),
+                                           jnp.arange(M + n - 1))
+        # replicate final-stage outputs to all pp ranks; psum in f32 (XLA:CPU
+        # miscompiles sub-f32 all-reduce in partial-manual regions, and on
+        # TPU the f32 cast fuses into the collective anyway)
+        mask = (jax.lax.axis_index(axis_name) == n - 1).astype(jnp.float32)
+        out = jax.lax.psum(out_buf.astype(jnp.float32) * mask, axis_name)
+        return out.astype(out_buf.dtype)
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+        axis_names=frozenset({axis_name}),
+        check_vma=False,
+    )(stage_params, x_mb)
+
+
+def split_microbatches(x, num_microbatches: int):
+    """[B, ...] -> [M, B/M, ...]"""
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} not divisible by microbatches "
+                         f"{num_microbatches}")
+    return x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+
+
+def merge_microbatches(x):
+    """[M, mb, ...] -> [B, ...]"""
+    return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])

@@ -26,7 +26,7 @@ DEFAULT_RULES: Dict[str, Any] = {
     "head_dim": None,
     "mlp": "tp",
     "vocab": "tp",
-    "layers": None,        # stacked-layer leading axis (scanned); pp handles stages
+    "layers": "pp",        # stacked-layer leading axis: stage-sharded when pp>1
     "stages": "pp",
     "experts": "ep",
     "conv_in": None,
