@@ -70,7 +70,7 @@ def bench_gpt_step():
     on_tpu = jax.default_backend() == "tpu"
     cfg = gpt.GPTConfig.gpt2_small(
         vocab_size=50304, max_seq=512,
-        dtype=(None or (jax.numpy.bfloat16 if on_tpu else jax.numpy.float32)))
+        dtype=(jax.numpy.bfloat16 if on_tpu else jax.numpy.float32))
     n_dev = jax.device_count()
     mesh = make_mesh(dp=n_dev)
     batch_size = 8 * n_dev

@@ -79,7 +79,8 @@ class _Pickler(cloudpickle.Pickler):
                 desc = None
             host = np.asarray(obj)
             return (_restore_device_array, (_DeviceArrayStandIn(host, desc),))
-        return NotImplemented
+        # delegate to cloudpickle's own override (functions/classes by value)
+        return super().reducer_override(obj)
 
 
 def _deserialize_marker(marker: SerializedRef):

@@ -1,0 +1,29 @@
+"""ray_tpu.train: distributed training orchestration for JAX on TPU.
+
+Mirrors the reference's Ray Train surface (reference: python/ray/train/):
+JaxTrainer + JaxConfig replace TorchTrainer + TorchConfig; report/
+get_context/get_checkpoint/get_dataset_shard match the reference's
+module-level session API (train/_internal/session.py:667-790).
+"""
+
+from .backend import Backend, BackendConfig, JaxConfig, TPUConfig
+from .backend_executor import (BackendExecutor, TrainingFailedError,
+                               TrainingWorkerError)
+from .checkpoint import Checkpoint
+from .checkpoint_manager import CheckpointManager
+from .config import (CheckpointConfig, FailureConfig, RunConfig,
+                     ScalingConfig)
+from .result import Result
+from .session import (TrainContext, get_checkpoint, get_context,
+                      get_dataset_shard, report)
+from .trainer import DataParallelTrainer, JaxTrainer
+from .worker_group import WorkerGroup
+
+__all__ = [
+    "Backend", "BackendConfig", "BackendExecutor", "Checkpoint",
+    "CheckpointConfig", "CheckpointManager", "DataParallelTrainer",
+    "FailureConfig", "JaxConfig", "JaxTrainer", "Result", "RunConfig",
+    "ScalingConfig", "TPUConfig", "TrainContext", "TrainingFailedError",
+    "TrainingWorkerError", "WorkerGroup", "get_checkpoint", "get_context",
+    "get_dataset_shard", "report",
+]

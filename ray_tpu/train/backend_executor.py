@@ -1,0 +1,164 @@
+"""BackendExecutor: drives the training worker group from the driver side.
+
+Mirrors the reference (reference: python/ray/train/_internal/
+backend_executor.py — start :135, _create_placement_group :219,
+start_training :451, get_next_results :578, _restart :759): create the gang
+placement group + WorkerGroup, run backend setup hooks, start per-worker
+sessions, and poll results in lockstep.  Worker death surfaces as
+TrainingWorkerError so the trainer can tear down and restart the whole
+group from the latest checkpoint (elastic recovery; a jax SPMD program
+cannot survive losing a participant mid-step, so whole-group restart is
+the only sound recovery unit on TPU).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu import ActorDiedError, WorkerCrashedError
+
+from .backend import BackendConfig, JaxConfig
+from .checkpoint import Checkpoint
+from .config import ScalingConfig
+from .session import TrainContext
+from .worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingWorkerError(Exception):
+    """A worker actor died mid-training (restartable condition)."""
+
+
+class TrainingFailedError(Exception):
+    """User train code raised; not restartable."""
+
+
+class BackendExecutor:
+    def __init__(self, backend_config: Optional[BackendConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None):
+        self._backend_config = backend_config or JaxConfig()
+        self._scaling = scaling_config or ScalingConfig()
+        self._backend = self._backend_config.backend_cls()()
+        self.worker_group: Optional[WorkerGroup] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        s = self._scaling
+        self.worker_group = WorkerGroup(
+            s.num_workers, s.as_placement_group_bundles(),
+            placement_strategy=s.placement_strategy)
+        self._backend.on_start(self.worker_group, self._backend_config)
+
+    def _contexts(self, experiment_name: str, trial_name: str,
+                  trial_dir: str) -> List[TrainContext]:
+        """Compute world/local/node ranks from worker placement (the
+        reference groups by node ip, backend_executor.py:388)."""
+        ips = []
+        for w in self.worker_group.workers:
+            ip = w.metadata.get("node_ip", "?")
+            if ip not in ips:
+                ips.append(ip)
+        ctxs = []
+        local_rank_counter: Dict[str, int] = defaultdict(int)
+        for i, w in enumerate(self.worker_group.workers):
+            ip = w.metadata.get("node_ip", "?")
+            lr = local_rank_counter[ip]
+            local_rank_counter[ip] += 1
+            ctxs.append(TrainContext(
+                world_size=self.worker_group.num_workers,
+                world_rank=i,
+                local_rank=lr,
+                node_rank=ips.index(ip),
+                experiment_name=experiment_name,
+                trial_name=trial_name,
+                trial_id=trial_name,
+                trial_dir=trial_dir,
+            ))
+        for ctx in ctxs:
+            ip = self.worker_group.workers[ctx.world_rank].metadata.get(
+                "node_ip", "?")
+            ctx.local_world_size = local_rank_counter[ip]
+        return ctxs
+
+    def start_training(self, train_fn: Callable, config: Dict[str, Any],
+                       experiment_name: str, trial_name: str, trial_dir: str,
+                       checkpoint: Optional[Checkpoint] = None,
+                       dataset_shards_per_worker: Optional[List[Dict[str, Any]]] = None,
+                       start_iteration: int = 0):
+        os.makedirs(trial_dir, exist_ok=True)
+        from ray_tpu._private import common as _common
+
+        _common._ensure_picklable_by_value(train_fn)
+        self._backend.on_training_start(self.worker_group,
+                                        self._backend_config)
+        ctxs = self._contexts(experiment_name, trial_name, trial_dir)
+        shards = dataset_shards_per_worker or [None] * len(ctxs)
+        refs = [
+            w.actor.start_session.remote(ctxs[i], train_fn, config,
+                                         checkpoint, trial_dir, shards[i],
+                                         start_iteration)
+            for i, w in enumerate(self.worker_group.workers)
+        ]
+        self._get_with_failure_handling(refs)
+
+    def get_next_results(self) -> Optional[List[tuple]]:
+        """One lockstep round of next_result() from every worker.
+
+        Returns None when all workers finished; raises TrainingFailedError
+        on a user exception; TrainingWorkerError on actor death.
+        """
+        refs = [w.actor.next_result.remote()
+                for w in self.worker_group.workers]
+        results = self._get_with_failure_handling(refs)
+        kinds = {r[0] for r in results}
+        if kinds == {"finished"}:
+            return None
+        if "finished" in kinds:
+            # some workers returned while others still report: the loop is
+            # mis-specified (unequal iteration counts); fail loudly.
+            raise TrainingFailedError(
+                "training workers returned out of sync: some finished while "
+                "others are still reporting; ensure every worker runs the "
+                "same number of report() calls")
+        return results
+
+    def _get_with_failure_handling(self, refs):
+        try:
+            return ray_tpu.get(refs)
+        except (ActorDiedError, WorkerCrashedError) as e:
+            raise TrainingWorkerError(str(e)) from e
+        except (TrainingWorkerError, TrainingFailedError):
+            raise
+        except ray_tpu.TaskError as e:
+            raise TrainingFailedError(str(e)) from e
+
+    def finish_training(self):
+        if self.worker_group is None:
+            return
+        try:
+            ray_tpu.get([w.actor.end_session.remote()
+                         for w in self.worker_group.workers])
+        except Exception:
+            pass
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            try:
+                self._backend.on_shutdown(self.worker_group,
+                                          self._backend_config)
+            except Exception:
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None
+
+    def restart(self):
+        """Tear down and respawn the whole group (reference:
+        backend_executor.py:759 _restart)."""
+        self.shutdown()
+        self.start()

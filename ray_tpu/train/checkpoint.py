@@ -1,0 +1,72 @@
+"""Checkpoint: a directory handle on persistent storage.
+
+Mirrors the reference (reference: python/ray/train/_checkpoint.py:56
+Checkpoint — "a reference to data persisted as a directory"): create from a
+local directory, materialize to a local directory, read/write metadata.
+Model state inside the directory is the user's format — for JAX models the
+idiomatic content is an orbax/flax serialized pytree (msgpack) written by
+the training loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+_METADATA_FILE = ".metadata.json"
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(os.path.expanduser(path))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        if not os.path.isdir(path):
+            raise ValueError(f"not a directory: {path}")
+        return cls(path)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        """Copy checkpoint contents into `path` (default: temp dir)."""
+        dest = path or tempfile.mkdtemp(prefix="ckpt-")
+        os.makedirs(dest, exist_ok=True)
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextmanager
+    def as_directory(self):
+        """Yield a local directory view without copying when already local."""
+        yield self.path
+
+    def get_metadata(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, _METADATA_FILE)
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    def set_metadata(self, metadata: Dict[str, Any]) -> None:
+        with open(os.path.join(self.path, _METADATA_FILE), "w") as f:
+            json.dump(metadata, f)
+
+    def update_metadata(self, metadata: Dict[str, Any]) -> None:
+        m = self.get_metadata()
+        m.update(metadata)
+        self.set_metadata(m)
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path})"
+
+    def __eq__(self, other):
+        return isinstance(other, Checkpoint) and other.path == self.path
+
+    def __hash__(self):
+        return hash(self.path)

@@ -1,0 +1,93 @@
+"""Train run configuration dataclasses.
+
+Mirrors the reference's air configs (reference: python/ray/air/config.py —
+ScalingConfig :102, FailureConfig :394, CheckpointConfig :444, RunConfig
+:593) with TPU-native resource naming: workers request `num_tpus` (chips)
+instead of GPUs, and `topology` describes the per-worker mesh axes.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many training workers and what each needs.
+
+    num_workers: actor count (one per TPU host in multi-host runs).
+    use_tpu: give each worker `tpus_per_worker` TPU chips.
+    resources_per_worker: extra custom resources per worker.
+    placement_strategy: bundle strategy for the gang placement group —
+        PACK keeps workers on one ICI slice when possible.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: float = 0.0
+    cpus_per_worker: float = 1.0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    trainer_resources: Optional[Dict[str, float]] = None
+
+    def bundle(self) -> Dict[str, float]:
+        b: Dict[str, float] = dict(self.resources_per_worker or {})
+        if self.cpus_per_worker:
+            b["CPU"] = float(self.cpus_per_worker)
+        if self.use_tpu and self.tpus_per_worker:
+            b["TPU"] = float(self.tpus_per_worker)
+        return b
+
+    def as_placement_group_bundles(self):
+        return [self.bundle() for _ in range(self.num_workers)]
+
+
+@dataclass
+class FailureConfig:
+    """max_failures: worker-group restarts tolerated before the run fails;
+    -1 means unlimited (reference: air/config.py:394)."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Checkpoint retention policy (reference: air/config.py:444).
+
+    num_to_keep: keep at most N checkpoints (None = all).
+    checkpoint_score_attribute/order: which metric ranks checkpoints for
+    retention and `Result.best_checkpoints`.
+    """
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+    def __post_init__(self):
+        if self.num_to_keep is not None and self.num_to_keep <= 0:
+            raise ValueError("num_to_keep must be >= 1 or None")
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclass
+class RunConfig:
+    """Run-level config (reference: air/config.py:593).
+
+    storage_path: where checkpoints/results persist (local dir; a
+    gs://-style URI is accepted and treated as a mounted path).
+    """
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    stop: Optional[Dict[str, Any]] = None  # e.g. {"training_iteration": 10}
+    verbose: int = 1
+
+    def resolved_storage_path(self) -> str:
+        return os.path.expanduser(
+            self.storage_path
+            or os.environ.get("RAY_TPU_STORAGE", "~/ray_tpu_results"))

@@ -1,0 +1,31 @@
+"""ray_tpu.tune: hyperparameter search and experiment orchestration.
+
+Mirrors the reference's Ray Tune surface (reference: python/ray/tune/):
+Tuner/TuneConfig/ResultGrid, search domains (uniform/loguniform/randint/
+choice/grid_search/sample_from), schedulers (ASHA, median stopping, PBT),
+and `tune.report` via the shared train session.
+"""
+
+from ray_tpu.train.session import get_checkpoint, get_context, report
+
+from .schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
+                         MedianStoppingRule, PopulationBasedTraining,
+                         TrialScheduler)
+from .search import (BasicVariantGenerator, Categorical, Domain, Float,
+                     Integer, Searcher, choice, generate_variants,
+                     grid_search, loguniform, randint, sample_from, uniform)
+from .trial import Trial
+from .tune_controller import Callback, JsonLoggerCallback, TuneController
+from .tuner import ResultGrid, TuneConfig, Tuner, run
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+__all__ = [
+    "ASHAScheduler", "AsyncHyperBandScheduler", "BasicVariantGenerator",
+    "Callback", "Categorical", "Domain", "FIFOScheduler", "Float",
+    "Integer", "JsonLoggerCallback", "MedianStoppingRule",
+    "PopulationBasedTraining", "ResultGrid", "Searcher", "Trial",
+    "TrialScheduler", "TuneConfig", "TuneController", "Tuner", "choice",
+    "generate_variants", "get_checkpoint", "get_context", "grid_search",
+    "loguniform", "randint", "report", "run", "sample_from", "uniform",
+]

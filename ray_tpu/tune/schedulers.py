@@ -1,0 +1,227 @@
+"""Trial schedulers: early stopping and population-based training.
+
+Mirrors the reference (reference: python/ray/tune/schedulers/ —
+trial_scheduler.py TrialScheduler, async_hyperband.py ASHA,
+median_stopping_rule.py, pbt.py PopulationBasedTraining): the controller
+feeds every reported result to the scheduler, which answers
+CONTINUE / PAUSE / STOP; PBT additionally mutates paused trials' configs
+and restarts them from a donor's checkpoint (exploit + explore).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+from .trial import Trial
+
+logger = logging.getLogger(__name__)
+
+CONTINUE = "CONTINUE"
+PAUSE = "PAUSE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        if mode not in ("max", "min"):
+            raise ValueError("mode must be 'max' or 'min'")
+        self.metric = metric
+        self.mode = mode
+
+    def _score(self, result: Dict[str, Any]) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_add(self, trial: Trial):
+        pass
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial: Trial):
+        pass
+
+    def on_trial_error(self, trial: Trial):
+        pass
+
+    def choose_trial_to_run(self, trials: List[Trial]) -> Optional[Trial]:
+        """Default: any PENDING trial (FIFO)."""
+        from .trial import PENDING
+
+        for t in trials:
+            if t.status == PENDING:
+                return t
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference: tune/schedulers/async_hyperband.py): rungs at
+    grace_period * reduction_factor^k; a trial reaching a rung is stopped
+    unless it is in the top 1/reduction_factor of results recorded there."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: float = 3,
+                 max_t: int = 100):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        # rung levels: grace, grace*rf, grace*rf^2, ... < max_t
+        self.rungs: List[int] = []
+        r = grace_period
+        while r < max_t:
+            self.rungs.append(int(r))
+            r *= reduction_factor
+        self.rung_results: Dict[int, List[float]] = {r: [] for r in self.rungs}
+        self._recorded: Dict[str, set] = {}  # trial_id -> rungs recorded
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return STOP
+        score = self._score(result)
+        decision = CONTINUE
+        seen = self._recorded.setdefault(trial.trial_id, set())
+        for rung in reversed(self.rungs):
+            if t < rung or rung in seen:
+                continue
+            # each trial contributes to a rung exactly once
+            seen.add(rung)
+            recorded = self.rung_results[rung]
+            recorded.append(score)
+            if len(recorded) >= self.rf:
+                cutoff_idx = max(0, int(len(recorded) / self.rf) - 1)
+                cutoff = sorted(recorded, reverse=True)[cutoff_idx]
+                if score < cutoff:
+                    decision = STOP
+            break  # only the highest new rung reached this round
+        return decision
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result so far is worse than the median of
+    other trials' running averages at the same step (reference:
+    tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._trial_scores: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        score = self._score(result)
+        self._trial_scores.setdefault(trial.trial_id, []).append(score)
+        if t < self.grace_period:
+            return CONTINUE
+        others = [sum(v) / len(v) for k, v in self._trial_scores.items()
+                  if k != trial.trial_id and v]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        best = max(self._trial_scores[trial.trial_id])
+        return STOP if best < median else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: tune/schedulers/pbt.py): every
+    perturbation_interval, bottom-quantile trials PAUSE; the controller
+    clones the config of a top-quantile donor, perturbs it via
+    hyperparam_mutations, and restarts the trial from the donor's
+    checkpoint."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = {}
+        # controller reads + clears this: trial_id -> (new_config, donor)
+        self.pending_exploits: Dict[str, tuple] = {}
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        new = dict(config)
+        for k, domain in self.mutations.items():
+            if isinstance(domain, list):
+                if self.rng.random() < self.resample_p or k not in new:
+                    new[k] = self.rng.choice(domain)
+                else:
+                    i = domain.index(new[k]) if new[k] in domain else 0
+                    j = min(max(i + self.rng.choice([-1, 1]), 0),
+                            len(domain) - 1)
+                    new[k] = domain[j]
+            elif callable(domain):
+                if self.rng.random() < self.resample_p or k not in new:
+                    new[k] = domain()
+                else:
+                    new[k] = new[k] * self.rng.choice([0.8, 1.2])
+            else:
+                from .search import Domain
+
+                if isinstance(domain, Domain):
+                    if self.rng.random() < self.resample_p or k not in new:
+                        new[k] = domain.sample(self.rng)
+                    else:
+                        new[k] = new[k] * self.rng.choice([0.8, 1.2])
+        return new
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        # rank current population by last seen score
+        peers = [(tid, scores[-1])
+                 for tid, scores in self._population().items() if scores]
+        self._record(trial.trial_id, self._score(result))
+        peers = [(tid, s) for tid, s in peers if tid != trial.trial_id]
+        peers.append((trial.trial_id, self._score(result)))
+        if len(peers) < 2:
+            return CONTINUE
+        peers.sort(key=lambda p: p[1], reverse=True)
+        n = len(peers)
+        k = max(1, int(math.ceil(n * self.quantile)))
+        top = [tid for tid, _ in peers[:k]]
+        bottom = [tid for tid, _ in peers[-k:]]
+        if trial.trial_id in bottom and trial.trial_id not in top:
+            donor_id = self.rng.choice(top)
+            self.pending_exploits[trial.trial_id] = (donor_id,)
+            return PAUSE
+        return CONTINUE
+
+    _scores: Dict[str, List[float]] = None
+
+    def _population(self) -> Dict[str, List[float]]:
+        if self._scores is None:
+            self._scores = {}
+        return self._scores
+
+    def _record(self, tid: str, score: float):
+        self._population().setdefault(tid, []).append(score)
+
+    def make_exploit_config(self, donor: Trial) -> Dict[str, Any]:
+        return self._explore(donor.config)

@@ -1,0 +1,172 @@
+"""Search spaces and search algorithms.
+
+Mirrors the reference (reference: python/ray/tune/search/ — sample.py
+domains, basic_variant.py BasicVariantGenerator, searcher.py Searcher ABC):
+grid_search + random sampling domains expand into per-trial configs; a
+Searcher proposes configs and learns from completed trials.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+
+# ---------------------------------------------------------------------------
+# Domains (reference: tune/search/sample.py)
+# ---------------------------------------------------------------------------
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False):
+        if log and lower <= 0:
+            raise ValueError("loguniform requires lower > 0")
+        self.lower, self.upper, self.log = lower, upper, log
+
+    def sample(self, rng):
+        if self.log:
+            import math
+
+            return math.exp(rng.uniform(math.log(self.lower),
+                                        math.log(self.upper)))
+        return rng.uniform(self.lower, self.upper)
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.randrange(self.lower, self.upper)
+
+
+class Categorical(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Function(Domain):
+    def __init__(self, fn: Callable[[], Any]):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn()
+
+
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def choice(categories: List[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def sample_from(fn: Callable[[], Any]) -> Function:
+    return Function(fn)
+
+
+def grid_search(values: List[Any]) -> Dict[str, List[Any]]:
+    return {"grid_search": list(values)}
+
+
+def _is_grid(v) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+# ---------------------------------------------------------------------------
+# Variant expansion (reference: tune/search/basic_variant.py)
+# ---------------------------------------------------------------------------
+
+def _walk(space: Dict[str, Any], path=()):
+    """Yield (path, value) leaves of a nested dict."""
+    for k, v in space.items():
+        if isinstance(v, dict) and not _is_grid(v):
+            yield from _walk(v, path + (k,))
+        else:
+            yield path + (k,), v
+
+
+def _set_path(d: Dict[str, Any], path, value):
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def generate_variants(param_space: Dict[str, Any], num_samples: int = 1,
+                      seed: Optional[int] = None):
+    """Expand grid axes (cross product) × num_samples random draws."""
+    rng = random.Random(seed)
+    leaves = list(_walk(param_space))
+    grid_axes = [(p, v["grid_search"]) for p, v in leaves if _is_grid(v)]
+    grids = itertools.product(*[vals for _, vals in grid_axes]) \
+        if grid_axes else [()]
+    for combo in grids:
+        for _ in range(num_samples):
+            cfg: Dict[str, Any] = {}
+            for (p, v) in leaves:
+                if _is_grid(v):
+                    continue
+                _set_path(cfg, p, v.sample(rng) if isinstance(v, Domain) else v)
+            for (p, _), val in zip(grid_axes, combo):
+                _set_path(cfg, p, val)
+            yield cfg
+
+
+# ---------------------------------------------------------------------------
+# Searcher interface (reference: tune/search/searcher.py)
+# ---------------------------------------------------------------------------
+
+class Searcher:
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        """Next config, or None when exhausted."""
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]):
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False):
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid/random search over a param_space."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None, **kw):
+        super().__init__(**kw)
+        self._variants = list(generate_variants(param_space, num_samples,
+                                                seed))
+        self._i = 0
+
+    @property
+    def total_trials(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._i >= len(self._variants):
+            return None
+        cfg = self._variants[self._i]
+        self._i += 1
+        return cfg

@@ -1,0 +1,375 @@
+"""TuneController: the experiment event loop.
+
+Mirrors the reference (reference: python/ray/tune/execution/
+tune_controller.py:68 TuneController, step :666): start trial actors up to
+the concurrency/resource budget, consume reported results, apply scheduler
+decisions (CONTINUE/PAUSE/STOP), retry failed trials from their last
+checkpoint, snapshot experiment state for resume, and run PBT
+exploit/explore by restarting paused trials from a donor checkpoint.
+
+Each trial runs in one actor (`_TrialRunnerActor`) which hosts the user
+trainable inside a TrainSession — the same report/lockstep machinery Train
+uses, which is exactly how the reference unifies the two (Train runs *on*
+Tune; tune session == train session).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu import ActorDiedError, WorkerCrashedError
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import TrainContext, TrainSession
+
+from . import schedulers as sched_mod
+from .schedulers import CONTINUE, PAUSE, STOP, FIFOScheduler, TrialScheduler
+from .search import Searcher
+from .trial import (ERROR, PAUSED, PENDING, RUNNING, TERMINATED, Trial)
+
+logger = logging.getLogger(__name__)
+
+
+class _TuneSessionShim:
+    """What a trainer-adapter trainable sees as `tune_session`."""
+
+    def __init__(self, trial_dir: str, experiment_name: str, trial_name: str):
+        self.trial_dir = trial_dir
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+
+    def report(self, metrics: Dict[str, Any]):
+        from ray_tpu.train.session import report
+
+        report(metrics)
+
+    def get_checkpoint(self):
+        from ray_tpu.train.session import get_checkpoint
+
+        return get_checkpoint()
+
+
+class _TrialRunnerActor:
+    """Actor hosting one trial's trainable."""
+
+    def __init__(self):
+        self._session: Optional[TrainSession] = None
+        self._iteration = 0
+
+    def start(self, trainable: Callable, config: Dict[str, Any],
+              trial_dir: str, experiment_name: str, trial_id: str,
+              checkpoint_path: Optional[str], start_iteration: int):
+        ckpt = Checkpoint(checkpoint_path) if checkpoint_path else None
+        ctx = TrainContext(world_size=1, world_rank=0,
+                           experiment_name=experiment_name,
+                           trial_name=trial_id, trial_id=trial_id,
+                           trial_dir=trial_dir)
+        self._iteration = start_iteration
+        if getattr(trainable, "_is_trainer_adapter", False):
+            shim = _TuneSessionShim(trial_dir, experiment_name, trial_id)
+            fn = lambda: trainable(config, shim)  # noqa: E731
+        else:
+            import inspect
+
+            params = list(inspect.signature(trainable).parameters)
+            fn = (lambda: trainable(config)) if params else trainable
+        self._session = TrainSession(ctx, fn, checkpoint=ckpt,
+                                     checkpoint_upload_dir=trial_dir,
+                                     start_iteration=start_iteration)
+        self._session.start()
+        return True
+
+    def next_result(self):
+        kind, metrics, ckpt_path = self._session.next_result()
+        if kind == "result":
+            self._iteration += 1
+            metrics = dict(metrics or {})
+            metrics.setdefault("training_iteration", self._iteration)
+            metrics.setdefault("timestamp", time.time())
+        return (kind, metrics, ckpt_path)
+
+
+class Callback:
+    """Experiment callbacks (reference: tune/callback.py)."""
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]):
+        pass
+
+    def on_trial_complete(self, trial: Trial):
+        pass
+
+    def on_trial_error(self, trial: Trial):
+        pass
+
+
+class JsonLoggerCallback(Callback):
+    """Append each result as a JSON line in the trial dir (reference:
+    tune/logger/json.py)."""
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]):
+        try:
+            with open(os.path.join(trial.trial_dir, "result.json"), "a") as f:
+                f.write(json.dumps(result, default=str) + "\n")
+        except OSError:
+            pass
+
+
+class TuneController:
+    def __init__(self, trainable: Callable, *, searcher: Searcher,
+                 scheduler: Optional[TrialScheduler] = None,
+                 experiment_dir: str, experiment_name: str,
+                 max_concurrent: int = 0,
+                 stop: Optional[Dict[str, Any]] = None,
+                 max_failures: int = 0,
+                 callbacks: Optional[List[Callback]] = None,
+                 trial_resources: Optional[Dict[str, float]] = None,
+                 resumed_trials: Optional[List[Trial]] = None):
+        self._trainable = trainable
+        self._searcher = searcher
+        self._scheduler = scheduler or FIFOScheduler()
+        self._experiment_dir = experiment_dir
+        self._experiment_name = experiment_name
+        self._max_concurrent = max_concurrent
+        self._stop_criteria = stop or {}
+        self._max_failures = max_failures
+        self._callbacks = callbacks if callbacks is not None else [
+            JsonLoggerCallback()]
+        if getattr(trainable, "_is_trainer_adapter", False):
+            self._trial_resources = {"CPU": 0}
+        else:
+            self._trial_resources = dict(trial_resources or {"CPU": 1})
+        self.trials: List[Trial] = list(resumed_trials or [])
+        self._actors: Dict[str, Any] = {}          # trial_id -> actor handle
+        self._inflight: Dict[Any, Trial] = {}      # next_result ref -> trial
+        self._searcher_done = False
+        self._runner_cls = ray_tpu.remote(_TrialRunnerActor)
+        from ray_tpu._private import common as _common
+
+        _common._ensure_picklable_by_value(trainable)
+
+    # -- trial lifecycle ---------------------------------------------------
+
+    def _new_trial(self) -> Optional[Trial]:
+        if self._searcher_done:
+            return None
+        tid = f"{self._experiment_name}_{len(self.trials):05d}"
+        cfg = self._searcher.suggest(tid)
+        if cfg is None:
+            self._searcher_done = True
+            return None
+        t = Trial(tid, cfg, self._experiment_dir, self._experiment_name)
+        self.trials.append(t)
+        self._scheduler.on_trial_add(t)
+        return t
+
+    def _running_count(self) -> int:
+        return sum(1 for t in self.trials if t.status == RUNNING)
+
+    def _start_trial(self, trial: Trial):
+        opts = {"num_cpus": self._trial_resources.get("CPU", 1),
+                "max_concurrency": 2}
+        extra = {k: v for k, v in self._trial_resources.items() if k != "CPU"}
+        if extra:
+            opts["resources"] = extra
+        actor = self._runner_cls.options(**opts).remote()
+        ref = actor.start.remote(self._trainable, trial.config,
+                                 trial.trial_dir, self._experiment_name,
+                                 trial.trial_id, trial.checkpoint_path,
+                                 trial.iteration)
+        trial.status = RUNNING
+        self._actors[trial.trial_id] = actor
+        # chain: once start acks, poll for the first result
+        ray_tpu.get(ref)
+        self._poll(trial)
+
+    def _poll(self, trial: Trial):
+        actor = self._actors[trial.trial_id]
+        ref = actor.next_result.remote()
+        self._inflight[ref] = trial
+
+    def _teardown_actor(self, trial: Trial):
+        actor = self._actors.pop(trial.trial_id, None)
+        if actor is not None:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+        self._inflight = {r: t for r, t in self._inflight.items()
+                          if t.trial_id != trial.trial_id}
+
+    # -- result handling ---------------------------------------------------
+
+    def _should_stop_by_criteria(self, result: Dict[str, Any]) -> bool:
+        for k, v in self._stop_criteria.items():
+            if k in result and result[k] >= v:
+                return True
+        return False
+
+    def _handle_result(self, trial: Trial, kind: str,
+                       metrics: Optional[Dict[str, Any]],
+                       ckpt_path: Optional[str]):
+        if kind == "finished":
+            if metrics:
+                trial.last_result = {**(trial.last_result or {}), **metrics}
+            trial.status = TERMINATED
+            self._teardown_actor(trial)
+            self._searcher.on_trial_complete(trial.trial_id,
+                                             trial.last_result)
+            self._scheduler.on_trial_complete(trial)
+            for cb in self._callbacks:
+                cb.on_trial_complete(trial)
+            return
+        trial.iteration = metrics["training_iteration"]
+        trial.last_result = metrics
+        trial.results.append(metrics)
+        if ckpt_path:
+            trial.checkpoint_path = ckpt_path
+        self._searcher.on_trial_result(trial.trial_id, metrics)
+        for cb in self._callbacks:
+            cb.on_trial_result(trial, metrics)
+        decision = CONTINUE
+        if self._should_stop_by_criteria(metrics):
+            decision = STOP
+        elif self._scheduler.metric and self._scheduler.metric in metrics:
+            decision = self._scheduler.on_trial_result(trial, metrics)
+        if decision == CONTINUE:
+            self._poll(trial)
+        elif decision == STOP:
+            trial.status = TERMINATED
+            self._teardown_actor(trial)
+            self._searcher.on_trial_complete(trial.trial_id, metrics)
+            self._scheduler.on_trial_complete(trial)
+            for cb in self._callbacks:
+                cb.on_trial_complete(trial)
+        elif decision == PAUSE:
+            trial.status = PAUSED
+            self._teardown_actor(trial)
+            self._maybe_exploit(trial)
+
+    def _maybe_exploit(self, trial: Trial):
+        """PBT exploit/explore: clone a donor's config+checkpoint."""
+        pbt = self._scheduler
+        if not isinstance(pbt, sched_mod.PopulationBasedTraining):
+            return
+        pending = pbt.pending_exploits.pop(trial.trial_id, None)
+        if not pending:
+            return
+        donor = next((t for t in self.trials if t.trial_id == pending[0]),
+                     None)
+        if donor is None:
+            trial.status = PENDING
+            return
+        trial.config = pbt.make_exploit_config(donor)
+        if donor.checkpoint_path:
+            trial.checkpoint_path = donor.checkpoint_path
+        trial.status = PENDING
+        logger.info("PBT exploit: %s <- %s config=%s", trial.trial_id,
+                    donor.trial_id, trial.config)
+
+    def _handle_failure(self, trial: Trial, err: BaseException):
+        if isinstance(err, (ActorDiedError, WorkerCrashedError)):
+            trial.num_failures += 1
+            self._teardown_actor(trial)
+            if (self._max_failures == -1
+                    or trial.num_failures <= self._max_failures):
+                logger.warning("trial %s failed (%d); restarting from %s",
+                               trial.trial_id, trial.num_failures,
+                               trial.checkpoint_path)
+                trial.status = PENDING
+                return
+        trial.status = ERROR
+        trial.error_msg = str(err)
+        self._teardown_actor(trial)
+        self._searcher.on_trial_complete(trial.trial_id, error=True)
+        self._scheduler.on_trial_error(trial)
+        for cb in self._callbacks:
+            cb.on_trial_error(trial)
+
+    # -- the loop ----------------------------------------------------------
+
+    def _fill(self):
+        while True:
+            if (self._max_concurrent
+                    and self._running_count() >= self._max_concurrent):
+                return
+            nxt = self._scheduler.choose_trial_to_run(
+                [t for t in self.trials if t.status == PENDING])
+            if nxt is None:
+                nxt = self._new_trial()
+            if nxt is None:
+                return
+            try:
+                self._start_trial(nxt)
+            except ray_tpu.TaskError as e:
+                self._handle_failure(nxt, e)
+            except (ActorDiedError, WorkerCrashedError) as e:
+                self._handle_failure(nxt, e)
+
+    def step(self) -> bool:
+        """One controller iteration; returns False when the experiment is
+        done (reference: tune_controller.py:666)."""
+        self._fill()
+        if not self._inflight:
+            live = any(t.status in (PENDING, RUNNING) for t in self.trials)
+            if not live and self._searcher_done:
+                return False
+            if not live and not self._searcher_done:
+                # searcher has more but nothing running: loop to fill again
+                return True
+            return bool(self._inflight)
+        refs = list(self._inflight.keys())
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=5.0)
+        for ref in ready:
+            trial = self._inflight.pop(ref)
+            try:
+                kind, metrics, ckpt = ray_tpu.get(ref)
+            except (ActorDiedError, WorkerCrashedError,
+                    ray_tpu.TaskError) as e:
+                self._handle_failure(trial, e)
+                continue
+            self._handle_result(trial, kind, metrics, ckpt)
+        self.save_state()
+        return True
+
+    def run(self):
+        while self.step():
+            pass
+        self.save_state()
+        self.cleanup()
+
+    def cleanup(self):
+        for t in list(self.trials):
+            if t.trial_id in self._actors:
+                self._teardown_actor(t)
+
+    # -- experiment state --------------------------------------------------
+
+    def save_state(self):
+        state = {
+            "experiment_name": self._experiment_name,
+            "timestamp": time.time(),
+            "trials": [t.to_json() for t in self.trials],
+        }
+        path = os.path.join(self._experiment_dir, "experiment_state.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f, default=str)
+        os.replace(tmp, path)
+
+    @staticmethod
+    def load_trials(experiment_dir: str) -> List[Trial]:
+        path = os.path.join(experiment_dir, "experiment_state.json")
+        with open(path) as f:
+            state = json.load(f)
+        name = state["experiment_name"]
+        trials = []
+        for d in state["trials"]:
+            t = Trial.from_json(d, experiment_dir, name)
+            if t.status in (RUNNING, PAUSED):
+                t.status = PENDING  # interrupted: rerun from checkpoint
+            trials.append(t)
+        return trials

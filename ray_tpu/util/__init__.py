@@ -1,0 +1,29 @@
+"""ray_tpu.util: scheduling and cluster utilities.
+
+Mirrors the reference's `ray.util` namespace (reference: python/ray/util/):
+placement groups (util/placement_group.py:41,145), scheduling strategies
+(util/scheduling_strategies.py), ActorPool (util/actor_pool.py), Queue
+(util/queue.py).
+"""
+
+from .actor_pool import ActorPool
+from .placement_group import (PlacementGroup, get_placement_group,
+                              placement_group, placement_group_table,
+                              remove_placement_group)
+from .queue import Empty, Full, Queue
+from .scheduling_strategies import (NodeAffinitySchedulingStrategy,
+                                    PlacementGroupSchedulingStrategy)
+
+__all__ = [
+    "ActorPool",
+    "Empty",
+    "Full",
+    "NodeAffinitySchedulingStrategy",
+    "PlacementGroup",
+    "PlacementGroupSchedulingStrategy",
+    "Queue",
+    "get_placement_group",
+    "placement_group",
+    "placement_group_table",
+    "remove_placement_group",
+]

@@ -1,0 +1,128 @@
+"""Distributed FIFO queue backed by a single queue actor.
+
+Mirrors the reference's ray.util.queue.Queue (reference:
+python/ray/util/queue.py): put/get with block+timeout, put/get_nowait,
+batch variants, qsize/empty/full, shutdown.
+"""
+
+from __future__ import annotations
+
+import queue as _stdlib_queue
+import time
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self._q = _stdlib_queue.Queue(maxsize=maxsize)
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def empty(self) -> bool:
+        return self._q.empty()
+
+    def full(self) -> bool:
+        return self._q.full()
+
+    def put(self, item) -> bool:
+        try:
+            self._q.put_nowait(item)
+            return True
+        except _stdlib_queue.Full:
+            return False
+
+    def put_batch(self, items: List[Any]) -> bool:
+        """All-or-nothing: insert only if every item fits (matching the
+        reference's put_nowait_batch, which raises Full without inserting)."""
+        if self._q.maxsize and self._q.qsize() + len(items) > self._q.maxsize:
+            return False
+        for it in items:
+            self._q.put_nowait(it)
+        return True
+
+    def get(self):
+        try:
+            return True, self._q.get_nowait()
+        except _stdlib_queue.Empty:
+            return False, None
+
+    def get_batch(self, num_items: int):
+        """All-or-nothing: dequeue only if num_items are present (matching
+        the reference's get_nowait_batch, which raises Empty without
+        removing anything)."""
+        if self._q.qsize() < num_items:
+            return None
+        return [self._q.get_nowait() for _ in range(num_items)]
+
+
+class Queue:
+    """Actor-backed queue usable from any worker or driver."""
+
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        self.maxsize = maxsize
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0)
+        self.actor = ray_tpu.remote(_QueueActor).options(**opts).remote(maxsize)
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self.actor.empty.remote())
+
+    def full(self) -> bool:
+        return ray_tpu.get(self.actor.full.remote())
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if ray_tpu.get(self.actor.put.remote(item)):
+                return
+            if not block:
+                raise Full
+            if deadline is not None and time.monotonic() > deadline:
+                raise Full
+            time.sleep(0.01)
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        if not ray_tpu.get(self.actor.put_batch.remote(list(items))):
+            raise Full(f"batch of {len(items)} does not fit")
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self.actor.get.remote())
+            if ok:
+                return item
+            if not block:
+                raise Empty
+            if deadline is not None and time.monotonic() > deadline:
+                raise Empty
+            time.sleep(0.01)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        items = ray_tpu.get(self.actor.get_batch.remote(num_items))
+        if items is None:
+            raise Empty(f"queue has fewer than {num_items} items")
+        return items
+
+    def shutdown(self) -> None:
+        ray_tpu.kill(self.actor)

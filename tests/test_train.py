@@ -1,0 +1,194 @@
+"""Tests for ray_tpu.train (mirrors reference test strategy:
+python/ray/train/tests/test_backend.py, test_data_parallel_trainer.py)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (Checkpoint, CheckpointConfig, CheckpointManager,
+                           FailureConfig, JaxConfig, JaxTrainer, RunConfig,
+                           ScalingConfig)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager (no cluster)
+# ---------------------------------------------------------------------------
+
+def _mk_ckpt(tmp_path, i):
+    d = tmp_path / f"src_{i}"
+    d.mkdir()
+    (d / "w.txt").write_text(str(i))
+    return Checkpoint(str(d))
+
+
+def test_checkpoint_manager_retention(tmp_path):
+    mgr = CheckpointManager(CheckpointConfig(
+        num_to_keep=2, checkpoint_score_attribute="acc"))
+    cks = [_mk_ckpt(tmp_path, i) for i in range(4)]
+    for c, acc in zip(cks, [0.1, 0.9, 0.5, 0.2]):
+        mgr.register_checkpoint(c, {"acc": acc})
+    kept = [c for c, _ in mgr.best_checkpoints()]
+    assert len(kept) == 2
+    assert mgr.best_checkpoint == cks[1]          # acc=0.9
+    assert mgr.latest_checkpoint == cks[3]        # newest survives retention
+    assert not os.path.exists(cks[0].path)        # worst was deleted
+
+
+def test_checkpoint_metadata(tmp_path):
+    c = _mk_ckpt(tmp_path, 0)
+    c.set_metadata({"step": 3})
+    c.update_metadata({"loss": 1.5})
+    assert c.get_metadata() == {"step": 3, "loss": 1.5}
+    out = c.to_directory(str(tmp_path / "out"))
+    assert (tmp_path / "out" / "w.txt").read_text() == "0"
+
+
+# ---------------------------------------------------------------------------
+# End-to-end training runs (shared local cluster)
+# ---------------------------------------------------------------------------
+
+def _loop_basic(config):
+    ctx = train.get_context()
+    for step in range(config["steps"]):
+        train.report({"step": step, "rank": ctx.get_world_rank(),
+                      "world_size": ctx.get_world_size()})
+
+
+def test_trainer_two_workers(ray_cluster, tmp_path):
+    seen = []
+    trainer = JaxTrainer(
+        _loop_basic, train_loop_config={"steps": 3},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t2w", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert result.metrics["world_size"] == 2
+    assert os.path.isdir(result.path)
+
+
+def _loop_ckpt(config):
+    import tempfile
+
+    ctx = train.get_context()
+    restored = train.get_checkpoint()
+    start = 0
+    if restored:
+        with restored.as_directory() as d:
+            sub = os.path.join(d, f"rank_{ctx.get_world_rank()}")
+            src = sub if os.path.isdir(sub) else d
+            start = int(open(os.path.join(src, "step.txt")).read()) + 1
+    for step in range(start, config["steps"]):
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, "step.txt"), "w") as f:
+                f.write(str(step))
+            train.report({"step": step}, checkpoint=Checkpoint(d))
+
+
+def test_trainer_checkpoints_and_resume(ray_cluster, tmp_path):
+    trainer = JaxTrainer(
+        _loop_ckpt, train_loop_config={"steps": 3},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="ck", storage_path=str(tmp_path),
+                             checkpoint_config=CheckpointConfig(num_to_keep=2)),
+    )
+    result = trainer.fit()
+    assert result.checkpoint is not None
+    # multi-worker checkpoints land as rank_k subdirs of one checkpoint dir
+    with result.checkpoint.as_directory() as d:
+        assert open(os.path.join(d, "rank_0", "step.txt")).read() == "2"
+        assert open(os.path.join(d, "rank_1", "step.txt")).read() == "2"
+    # resume from it: loop starts at step 3 -> reports only step 3,4
+    trainer2 = JaxTrainer(
+        _loop_ckpt, train_loop_config={"steps": 5},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="ck2", storage_path=str(tmp_path)),
+        resume_from_checkpoint=result.checkpoint,
+    )
+    r2 = trainer2.fit()
+    assert r2.metrics["step"] == 4
+
+
+_CRASH_FLAG = "/tmp/ray_tpu_test_train_crash_once"
+
+
+def _loop_crash_once(config):
+    ctx = train.get_context()
+    restored = train.get_checkpoint()
+    start = 0
+    if restored:
+        with restored.as_directory() as d:
+            sub = os.path.join(d, f"rank_{ctx.get_world_rank()}")
+            src = sub if os.path.isdir(sub) else d
+            start = int(open(os.path.join(src, "step.txt")).read()) + 1
+    import tempfile
+
+    for step in range(start, config["steps"]):
+        if (step == 1 and ctx.get_world_rank() == 0
+                and not os.path.exists(config["flag"])):
+            open(config["flag"], "w").close()
+            os._exit(1)  # hard-kill this worker: simulates host failure
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, "step.txt"), "w") as f:
+                f.write(str(step))
+            train.report({"step": step}, checkpoint=Checkpoint(d))
+
+
+def test_trainer_elastic_restart(ray_cluster, tmp_path):
+    if os.path.exists(_CRASH_FLAG):
+        os.remove(_CRASH_FLAG)
+    trainer = JaxTrainer(
+        _loop_crash_once,
+        train_loop_config={"steps": 3, "flag": _CRASH_FLAG},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="el", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=2)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    os.remove(_CRASH_FLAG)
+
+
+def _loop_user_error(config):
+    train.report({"step": 0})
+    raise ValueError("boom")
+
+
+def test_trainer_user_error_not_retried(ray_cluster, tmp_path):
+    trainer = JaxTrainer(
+        _loop_user_error,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="err", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=3)),
+    )
+    with pytest.raises(train.TrainingFailedError):
+        trainer.fit()
+
+
+def _loop_collective(config):
+    import numpy as np
+
+    from ray_tpu import collective
+
+    ctx = train.get_context()
+    collective.init_collective_group(ctx.get_world_size(),
+                                     ctx.get_world_rank(),
+                                     group_name="test-train-cg")
+    out = collective.allreduce(np.array([float(ctx.get_world_rank() + 1)]),
+                               group_name="test-train-cg")
+    collective.destroy_collective_group("test-train-cg")
+    train.report({"sum": float(out[0])})
+
+
+def test_workers_can_allreduce(ray_cluster, tmp_path):
+    trainer = JaxTrainer(
+        _loop_collective,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="coll", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.metrics["sum"] == 3.0

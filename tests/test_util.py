@@ -1,0 +1,120 @@
+"""Tests for ray_tpu.util: placement groups, ActorPool, Queue (mirrors
+reference tests: python/ray/tests/test_placement_group*.py,
+test_actor_pool.py, test_queue.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (ActorPool, Empty, PlacementGroupSchedulingStrategy,
+                          Queue, get_placement_group, placement_group,
+                          placement_group_table, remove_placement_group)
+
+
+def test_placement_group_lifecycle(ray_cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK",
+                         name="test-pg")
+    assert pg.ready(timeout=30)
+    table = placement_group_table()
+    assert pg.id in table
+    assert table[pg.id]["state"] == "ALIVE"
+    assert get_placement_group("test-pg").id == pg.id
+    assert pg.bundle_count == 2
+    remove_placement_group(pg)
+
+
+def test_placement_group_infeasible(ray_cluster):
+    pg = placement_group([{"CPU": 512}])
+    assert not pg.ready(timeout=1.0)
+    remove_placement_group(pg)
+
+
+def test_placement_group_scheduling(ray_cluster):
+    pg = placement_group([{"CPU": 1}])
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote
+    def where():
+        import os
+
+        return os.getpid()
+
+    pid = ray_tpu.get(where.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            pg, placement_group_bundle_index=0)).remote())
+    assert pid > 0
+    remove_placement_group(pg)
+
+
+def test_placement_group_validation(ray_cluster):
+    with pytest.raises(ValueError):
+        placement_group([], strategy="PACK")
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="BOGUS")
+    with pytest.raises(ValueError):
+        placement_group([{}])
+
+
+@ray_tpu.remote
+class _PoolWorker:
+    def double(self, x):
+        return 2 * x
+
+
+def test_actor_pool_ordered(ray_cluster):
+    actors = [_PoolWorker.options(num_cpus=0).remote() for _ in range(2)]
+    pool = ActorPool(actors)
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(6)))
+    assert out == [0, 2, 4, 6, 8, 10]
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_actor_pool_unordered(ray_cluster):
+    actors = [_PoolWorker.options(num_cpus=0).remote() for _ in range(2)]
+    pool = ActorPool(actors)
+    out = sorted(pool.map_unordered(lambda a, v: a.double.remote(v),
+                                    range(6)))
+    assert out == [0, 2, 4, 6, 8, 10]
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_actor_pool_push_pop(ray_cluster):
+    a1 = _PoolWorker.options(num_cpus=0).remote()
+    pool = ActorPool([a1])
+    got = pool.pop_idle()
+    assert got is a1
+    assert pool.pop_idle() is None
+    pool.push(a1)
+    with pytest.raises(ValueError):
+        pool.push(a1)
+    ray_tpu.kill(a1)
+
+
+def test_queue_basic(ray_cluster):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    assert q.qsize() == 2
+    assert q.full()
+    assert q.get() == 1
+    assert q.get_nowait() == 2
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.put_nowait_batch([5, 6])
+    assert q.get_nowait_batch(2) == [5, 6]
+    q.shutdown()
+
+
+def test_queue_from_workers(ray_cluster):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    assert ray_tpu.get(producer.remote(q, 5))
+    assert sorted(q.get() for _ in range(5)) == [0, 1, 2, 3, 4]
+    q.shutdown()
