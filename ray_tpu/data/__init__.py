@@ -1,0 +1,123 @@
+"""ray_tpu.data: distributed datasets over the ray_tpu task runtime.
+
+Capability-parity redesign of the reference's Ray Data (reference:
+python/ray/data/ — Dataset, read_api.py, streaming executor): lazy logical
+plans over arrow blocks, a pull-based streaming executor running map
+transforms as ray_tpu tasks with bounded in-flight budgets, all-to-all
+exchanges (shuffle/sort/groupby), and device-fed iteration
+(`iter_jax_batches`) that double-buffers batches into TPU HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+from . import aggregate
+from .aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
+from .block import Block, BlockAccessor, BlockMetadata
+from .context import DataContext
+from .dataset import Dataset, MaterializedDataset
+from .datasource import (BinaryDatasource, BlocksDatasource, CSVDatasource,
+                         Datasource, ItemsDatasource, JSONDatasource,
+                         NumpyDatasource, ParquetDatasource, RangeDatasource,
+                         ReadTask, TextDatasource)
+from .grouped import GroupedData
+from .logical import LogicalPlan, Read
+
+
+def read_datasource(datasource: Datasource, *,
+                    override_num_blocks: Optional[int] = None) -> Dataset:
+    """reference: python/ray/data/read_api.py:334"""
+    return Dataset(Read(datasource, override_num_blocks or -1))
+
+
+def range(n: int, *, override_num_blocks: Optional[int] = None) -> Dataset:  # noqa: A001
+    return read_datasource(RangeDatasource(n),
+                           override_num_blocks=override_num_blocks
+                           or min(n, 16) or 1)
+
+
+def range_tensor(n: int, *, shape=(1,),
+                 override_num_blocks: Optional[int] = None) -> Dataset:
+    return read_datasource(RangeDatasource(n, tensor_shape=tuple(shape)),
+                           override_num_blocks=override_num_blocks
+                           or min(n, 16) or 1)
+
+
+def from_items(items: List[Any], *,
+               override_num_blocks: Optional[int] = None) -> Dataset:
+    return read_datasource(ItemsDatasource(items),
+                           override_num_blocks=override_num_blocks
+                           or min(len(items), 8) or 1)
+
+
+def from_numpy(arr: np.ndarray, column: str = "data") -> Dataset:
+    from .block import batch_to_block
+
+    return read_datasource(
+        BlocksDatasource([batch_to_block({column: np.asarray(arr)})]))
+
+
+def from_pandas(dfs) -> Dataset:
+    import pandas as pd
+    import pyarrow as pa
+
+    if isinstance(dfs, pd.DataFrame):
+        dfs = [dfs]
+    blocks = [pa.Table.from_pandas(df, preserve_index=False) for df in dfs]
+    return read_datasource(BlocksDatasource(blocks))
+
+
+def from_arrow(tables) -> Dataset:
+    import pyarrow as pa
+
+    if isinstance(tables, pa.Table):
+        tables = [tables]
+    return read_datasource(BlocksDatasource(list(tables)))
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 override_num_blocks: Optional[int] = None) -> Dataset:
+    return read_datasource(ParquetDatasource(paths, columns=columns),
+                           override_num_blocks=override_num_blocks)
+
+
+def read_csv(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    return read_datasource(CSVDatasource(paths),
+                           override_num_blocks=override_num_blocks)
+
+
+def read_json(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    return read_datasource(JSONDatasource(paths),
+                           override_num_blocks=override_num_blocks)
+
+
+def read_text(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    return read_datasource(TextDatasource(paths),
+                           override_num_blocks=override_num_blocks)
+
+
+def read_binary_files(paths, *, include_paths: bool = False,
+                      override_num_blocks: Optional[int] = None) -> Dataset:
+    return read_datasource(
+        BinaryDatasource(paths, include_paths=include_paths),
+        override_num_blocks=override_num_blocks)
+
+
+def read_numpy(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    return read_datasource(NumpyDatasource(paths),
+                           override_num_blocks=override_num_blocks)
+
+
+__all__ = [
+    "Dataset", "MaterializedDataset", "DataContext", "GroupedData",
+    "Datasource", "ReadTask", "Block", "BlockAccessor", "BlockMetadata",
+    "AggregateFn", "Count", "Sum", "Min", "Max", "Mean", "Std",
+    "read_datasource", "range", "range_tensor", "from_items", "from_numpy",
+    "from_pandas", "from_arrow", "read_parquet", "read_csv", "read_json",
+    "read_text", "read_binary_files", "read_numpy", "aggregate",
+]

@@ -1,0 +1,371 @@
+"""Dataset: the lazy, distributed data abstraction.
+
+Reference: python/ray/data/dataset.py (Dataset.map_batches :391,
+iter_batches :3820, materialize :4768).  A Dataset is a logical plan; all
+transforms append logical ops; consumption plans + runs the streaming
+executor (execution.py) over ray_tpu tasks.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+
+from . import aggregate as agg_mod
+from . import logical as L
+from .block import Block, BlockAccessor, BlockMetadata, batch_to_block
+from .context import DataContext
+from .datasource import write_block
+from .execution import RefBundle, StreamingExecutor, build_executor
+from .iterator import iter_block_batches, iter_jax_batches, prefetch_iter
+
+
+class Dataset:
+    def __init__(self, dag: L.LogicalOp):
+        self._dag = dag
+        self._last_stats: Optional[str] = None
+
+    # ------------------------------------------------------------------
+    # transforms (lazy)
+
+    def _with(self, op: L.LogicalOp) -> "Dataset":
+        return Dataset(op)
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    batch_format: Optional[str] = None, fn_args=(),
+                    fn_kwargs=None, num_cpus: Optional[float] = None,
+                    num_tpus: Optional[float] = None, **_ignored
+                    ) -> "Dataset":
+        resources = {}
+        if num_cpus:
+            resources["CPU"] = num_cpus
+        if num_tpus:
+            resources["TPU"] = num_tpus
+        ctx = DataContext.get_current()
+        return self._with(L.MapBatches(
+            self._dag, fn, batch_size=batch_size,
+            batch_format=batch_format or ctx.default_batch_format,
+            fn_args=fn_args, fn_kwargs=fn_kwargs,
+            resources=resources or None))
+
+    def map(self, fn: Callable, **kw) -> "Dataset":
+        return self._with(L.MapRows(self._dag, fn))
+
+    def filter(self, fn: Callable, **kw) -> "Dataset":
+        return self._with(L.Filter(self._dag, fn))
+
+    def flat_map(self, fn: Callable, **kw) -> "Dataset":
+        return self._with(L.FlatMap(self._dag, fn))
+
+    def add_column(self, name: str, fn: Callable[[Any], Any]) -> "Dataset":
+        def add(batch: Dict[str, np.ndarray], _name=name, _fn=fn):
+            batch = dict(batch)
+            batch[_name] = np.asarray(_fn(batch))
+            return batch
+
+        return self._with(L.MapBatches(self._dag, add, batch_format="numpy",
+                                       name=f"AddColumn({name})"))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def drop(block: Block, _cols=tuple(cols)):
+            return BlockAccessor(block).drop(list(_cols))
+
+        return self._with(L.MapBlocks(self._dag, drop,
+                                      name=f"DropColumns({cols})"))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def select(block: Block, _cols=tuple(cols)):
+            return BlockAccessor(block).select(list(_cols))
+
+        return self._with(L.MapBlocks(self._dag, select,
+                                      name=f"SelectColumns({cols})"))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        def rename(block: Block, _m=dict(mapping)):
+            return BlockAccessor(block).rename(_m)
+
+        return self._with(L.MapBlocks(self._dag, rename, name="Rename"))
+
+    def random_sample(self, fraction: float,
+                      seed: Optional[int] = None) -> "Dataset":
+        def sample(block: Block, _frac=fraction, _seed=seed):
+            acc = BlockAccessor(block)
+            rng = np.random.RandomState(_seed)
+            mask = rng.random_sample(acc.num_rows()) < _frac
+            return acc.take(np.nonzero(mask)[0].tolist())
+
+        return self._with(L.MapBlocks(self._dag, sample, name="Sample"))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(L.Limit(self._dag, n))
+
+    def repartition(self, num_blocks: int, shuffle: bool = False) -> "Dataset":
+        return self._with(L.Repartition(self._dag, num_blocks, shuffle))
+
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_blocks: Optional[int] = None) -> "Dataset":
+        return self._with(L.RandomShuffle(self._dag, seed=seed,
+                                          num_outputs=num_blocks))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._with(L.Sort(self._dag, key, descending))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._with(L.Union([self._dag] + [o._dag for o in others]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._with(L.Zip(self._dag, other._dag))
+
+    def groupby(self, key: Optional[str]) -> "GroupedData":
+        from .grouped import GroupedData
+
+        return GroupedData(self, key)
+
+    # ------------------------------------------------------------------
+    # execution
+
+    def _execute(self) -> Iterator[RefBundle]:
+        executor = build_executor(self._dag)
+        try:
+            yield from executor.run()
+        finally:
+            self._last_stats = executor.stats_summary()
+
+    def iter_internal_ref_bundles(self) -> Iterator[RefBundle]:
+        return self._execute()
+
+    def materialize(self) -> "MaterializedDataset":
+        bundles = list(self._execute())
+        return MaterializedDataset(bundles, stats=self._last_stats)
+
+    def stats(self) -> str:
+        return self._last_stats or "(not executed)"
+
+    # ------------------------------------------------------------------
+    # consumption
+
+    def take(self, limit: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for bundle in self.limit(limit)._execute():
+            block = ray_tpu.get(bundle.block_ref, timeout=600)
+            for row in BlockAccessor(block).iter_rows():
+                out.append(row)
+                if len(out) >= limit:
+                    return out
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for bundle in self._execute():
+            block = ray_tpu.get(bundle.block_ref, timeout=600)
+            out.extend(BlockAccessor(block).iter_rows())
+        return out
+
+    def count(self) -> int:
+        return sum(b.metadata.num_rows for b in self._execute())
+
+    def schema(self) -> Optional[pa.Schema]:
+        for bundle in self.limit(1)._execute():
+            if bundle.metadata.schema is not None:
+                return bundle.metadata.schema
+            block = ray_tpu.get(bundle.block_ref, timeout=600)
+            return BlockAccessor(block).schema()
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s is not None else []
+
+    def show(self, limit: int = 20) -> None:
+        for row in self.take(limit):
+            print(row)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        parts = []
+        for bundle in self._execute():
+            block = ray_tpu.get(bundle.block_ref, timeout=600)
+            parts.append(BlockAccessor(block).to_pandas())
+        if not parts:
+            return pd.DataFrame()
+        return pd.concat(parts, ignore_index=True)
+
+    def to_arrow(self) -> pa.Table:
+        blocks = [ray_tpu.get(b.block_ref, timeout=600)
+                  for b in self._execute()]
+        return BlockAccessor.concat(blocks)
+
+    def unique(self, column: str) -> List[Any]:
+        vals = set()
+        for bundle in self._execute():
+            block = ray_tpu.get(bundle.block_ref, timeout=600)
+            col = BlockAccessor(block).to_numpy([column])[column]
+            vals.update(np.asarray(col).tolist())
+        return sorted(vals)
+
+    # global aggregates (no shuffle: distributed partials + driver combine,
+    # reference: Dataset.sum/min/max/mean/std)
+    def aggregate(self, *aggs: agg_mod.AggregateFn) -> Dict[str, Any]:
+        partial_refs = []
+        for bundle in self._execute():
+            ref = ray_tpu.remote(_partials_task).options(
+                name="data:aggregate").remote(list(aggs), bundle.block_ref)
+            partial_refs.append(ref)
+        partials = ray_tpu.get(partial_refs, timeout=600)
+        out = {}
+        for i, agg in enumerate(aggs):
+            parts = [p[i] for p in partials]
+            out[agg.name] = agg.finalize(agg.combine(parts)) if parts \
+                else None
+        return out
+
+    def sum(self, on: str):
+        return self.aggregate(agg_mod.Sum(on))[f"sum({on})"]
+
+    def min(self, on: str):
+        return self.aggregate(agg_mod.Min(on))[f"min({on})"]
+
+    def max(self, on: str):
+        return self.aggregate(agg_mod.Max(on))[f"max({on})"]
+
+    def mean(self, on: str):
+        return self.aggregate(agg_mod.Mean(on))[f"mean({on})"]
+
+    def std(self, on: str, ddof: int = 1):
+        return self.aggregate(agg_mod.Std(on, ddof))[f"std({on})"]
+
+    # ------------------------------------------------------------------
+    # iteration
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for bundle in self._execute():
+            block = ray_tpu.get(bundle.block_ref, timeout=600)
+            yield from BlockAccessor(block).iter_rows()
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: Optional[str] = None,
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None,
+                     prefetch_batches: Optional[int] = None) -> Iterator:
+        ctx = DataContext.get_current()
+        fmt = batch_format or ctx.default_batch_format
+
+        def blocks():
+            for bundle in self._execute():
+                yield ray_tpu.get(bundle.block_ref, timeout=600)
+
+        it = iter_block_batches(
+            blocks(), batch_size=batch_size, batch_format=fmt,
+            drop_last=drop_last,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            seed=local_shuffle_seed)
+        depth = ctx.prefetch_batches if prefetch_batches is None \
+            else prefetch_batches
+        return prefetch_iter(it, depth)
+
+    def iter_jax_batches(self, *, batch_size: Optional[int] = 256,
+                         sharding=None, drop_last: bool = True,
+                         prefetch: int = 2, **kw) -> Iterator:
+        """Iterate device-resident batches (dict of jax.Array), double
+        buffered into HBM; with `sharding`, each batch is laid out across
+        the mesh data axis."""
+        host = self.iter_batches(batch_size=batch_size, batch_format="numpy",
+                                 drop_last=drop_last, **kw)
+        return iter_jax_batches(host, sharding=sharding, prefetch=prefetch)
+
+    # ------------------------------------------------------------------
+    # split / writes
+
+    def split(self, n: int, *, equal: bool = False,
+              locality_hints=None) -> List["MaterializedDataset"]:
+        mat = self.materialize()
+        bundles = mat._bundles
+        if equal:
+            total = sum(b.metadata.num_rows for b in bundles)
+            per = total // n
+            # rebalance by slicing through repartition
+            ds = mat.repartition(n)
+            mat = ds.materialize()
+            bundles = mat._bundles
+        splits: List[List[RefBundle]] = [[] for _ in range(n)]
+        # round-robin whole blocks (balanced by count)
+        order = sorted(range(len(bundles)),
+                       key=lambda i: -bundles[i].metadata.num_rows)
+        sizes = [0] * n
+        for i in order:
+            j = sizes.index(min(sizes))
+            splits[j].append(bundles[i])
+            sizes[j] += bundles[i].metadata.num_rows
+        return [MaterializedDataset(s) for s in splits]
+
+    def split_at_indices(self, indices: List[int]
+                         ) -> List["MaterializedDataset"]:
+        rows = self.take_all()
+        bounds = [0] + list(indices) + [len(rows)]
+        out = []
+        for s, e in zip(bounds[:-1], bounds[1:]):
+            out.append(from_rows_materialized(rows[s:e]))
+        return out
+
+    def _write(self, path: str, fmt: str, **writer_args) -> List[str]:
+        def write(block: Block, _path=path, _fmt=fmt, _wa=writer_args):
+            fname = write_block(block, _path, _fmt, **_wa)
+            return pa.table({"path": [fname]})
+
+        ds = self._with(L.MapBlocks(self._dag, write, name=f"Write({fmt})"))
+        return [r["path"] for r in ds.take_all()]
+
+    def write_parquet(self, path: str, **kw) -> List[str]:
+        return self._write(path, "parquet", **kw)
+
+    def write_csv(self, path: str, **kw) -> List[str]:
+        return self._write(path, "csv", **kw)
+
+    def write_json(self, path: str, **kw) -> List[str]:
+        return self._write(path, "json", **kw)
+
+    def write_numpy(self, path: str, **kw) -> List[str]:
+        return self._write(path, "npy", **kw)
+
+    def __repr__(self):
+        return f"Dataset(dag={self._dag!r})"
+
+
+def _partials_task(aggs, block: Block):
+    return [agg.partial(BlockAccessor(block).to_arrow()) for agg in aggs]
+
+
+class MaterializedDataset(Dataset):
+    """A Dataset whose blocks are already computed and held by refs
+    (reference: MaterializedDataset)."""
+
+    def __init__(self, bundles: List[RefBundle],
+                 stats: Optional[str] = None):
+        super().__init__(L.InputData(bundles))
+        self._bundles = bundles
+        self._last_stats = stats
+
+    def num_blocks(self) -> int:
+        return len(self._bundles)
+
+    def count(self) -> int:  # no execution needed
+        return sum(b.metadata.num_rows for b in self._bundles)
+
+    def get_internal_block_refs(self):
+        return [b.block_ref for b in self._bundles]
+
+
+def from_rows_materialized(rows: List[Dict[str, Any]]) -> MaterializedDataset:
+    from .block import rows_to_block
+
+    block = rows_to_block(rows)
+    ref = ray_tpu.put(block)
+    meta = BlockAccessor(block).get_metadata()
+    return MaterializedDataset([RefBundle(ref, meta)])

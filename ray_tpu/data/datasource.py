@@ -1,0 +1,260 @@
+"""Datasources: how Datasets begin and end.
+
+Reference: python/ray/data/datasource/ (Datasource, ReadTask) and
+python/ray/data/read_api.py:334 read_datasource.  A Datasource produces
+``ReadTask``s — serializable thunks that each yield one or more blocks on a
+worker.  Writes are map tasks that persist blocks and return paths.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from .block import Block, BlockMetadata, VALUE_COL, rows_to_block
+
+
+@dataclass
+class ReadTask:
+    """A serializable unit of reading; runs on a worker and yields blocks."""
+
+    read_fn: Callable[[], Iterable[Block]]
+    metadata: BlockMetadata  # estimate (rows may be None-ish / approximate)
+
+    def __call__(self) -> Iterable[Block]:
+        return self.read_fn()
+
+
+class Datasource:
+    """Base datasource (reference: python/ray/data/datasource/datasource.py)."""
+
+    def get_name(self) -> str:
+        return type(self).__name__.replace("Datasource", "")
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, *, tensor_shape: Optional[tuple] = None):
+        self._n = n
+        self._tensor_shape = tensor_shape
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = self._n
+        parallelism = max(1, min(parallelism, n or 1))
+        tasks = []
+        chunk = (n + parallelism - 1) // parallelism if n else 0
+        shape = self._tensor_shape
+        for start in range(0, n, max(chunk, 1)):
+            end = min(start + chunk, n)
+
+            def read(start=start, end=end):
+                ids = np.arange(start, end, dtype=np.int64)
+                if shape:
+                    size = int(np.prod(shape))
+                    data = (ids[:, None] * size
+                            + np.arange(size, dtype=np.int64)[None, :])
+                    batch = {"data": data.reshape((end - start,) + shape)}
+                else:
+                    batch = {"id": ids}
+                from .block import batch_to_block
+
+                yield batch_to_block(batch)
+
+            meta = BlockMetadata(num_rows=end - start,
+                                 size_bytes=(end - start) * 8)
+            tasks.append(ReadTask(read, meta))
+        return tasks
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: List[Any]):
+        self._items = list(items)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        items = self._items
+        n = len(items)
+        parallelism = max(1, min(parallelism, n or 1))
+        chunk = (n + parallelism - 1) // parallelism if n else 0
+        tasks = []
+        for start in range(0, n, max(chunk, 1)):
+            part = items[start:start + chunk]
+
+            def read(part=part):
+                yield rows_to_block(part)
+
+            meta = BlockMetadata(num_rows=len(part), size_bytes=0)
+            tasks.append(ReadTask(read, meta))
+        return tasks
+
+
+class BlocksDatasource(Datasource):
+    """From already-materialized in-memory blocks (from_pandas/arrow/numpy)."""
+
+    def __init__(self, blocks: List[Block]):
+        self._blocks = blocks
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for b in self._blocks:
+            def read(b=b):
+                yield b
+
+            tasks.append(ReadTask(read, BlockMetadata(
+                num_rows=b.num_rows, size_bytes=b.nbytes, schema=b.schema)))
+        return tasks
+
+
+def _expand_paths(paths, suffixes: Optional[List[str]] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _, files in os.walk(p):
+                for f in sorted(files):
+                    out.append(os.path.join(root, f))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if suffixes:
+        out = [p for p in out
+               if any(p.endswith(s) for s in suffixes)] or out
+    if not out:
+        raise FileNotFoundError(f"no input files found for {paths!r}")
+    return out
+
+
+class FileBasedDatasource(Datasource):
+    """One-or-more files per read task (reference:
+    python/ray/data/datasource/file_based_datasource.py)."""
+
+    _suffixes: Optional[List[str]] = None
+
+    def __init__(self, paths, **reader_args):
+        self._paths = _expand_paths(paths, self._suffixes)
+        self._reader_args = reader_args
+
+    def _read_file(self, path: str, **kwargs) -> Block:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        paths = self._paths
+        parallelism = max(1, min(parallelism, len(paths)))
+        groups: List[List[str]] = [[] for _ in range(parallelism)]
+        for i, p in enumerate(paths):
+            groups[i % parallelism].append(p)
+        read_file = self._read_file
+        args = self._reader_args
+        tasks = []
+        for group in groups:
+            if not group:
+                continue
+
+            def read(group=group):
+                for p in group:
+                    yield read_file(p, **args)
+
+            est = sum(os.path.getsize(p) for p in group
+                      if os.path.exists(p))
+            tasks.append(ReadTask(read, BlockMetadata(
+                num_rows=0, size_bytes=est, input_files=group)))
+        return tasks
+
+
+class ParquetDatasource(FileBasedDatasource):
+    _suffixes = [".parquet"]
+
+    def _read_file(self, path: str, columns=None, **kw) -> Block:
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path, columns=columns)
+
+
+class CSVDatasource(FileBasedDatasource):
+    _suffixes = [".csv"]
+
+    def _read_file(self, path: str, **kw) -> Block:
+        import pyarrow.csv as pcsv
+
+        return pcsv.read_csv(path)
+
+
+class JSONDatasource(FileBasedDatasource):
+    _suffixes = [".json", ".jsonl"]
+
+    def _read_file(self, path: str, **kw) -> Block:
+        import pyarrow.json as pjson
+
+        return pjson.read_json(path)
+
+
+class TextDatasource(FileBasedDatasource):
+    def _read_file(self, path: str, encoding="utf-8", drop_empty_lines=True,
+                   **kw) -> Block:
+        with open(path, "r", encoding=encoding) as f:
+            lines = f.read().split("\n")
+        if drop_empty_lines:
+            lines = [ln for ln in lines if ln.strip()]
+        return pa.table({"text": lines})
+
+
+class BinaryDatasource(FileBasedDatasource):
+    def _read_file(self, path: str, include_paths=False, **kw) -> Block:
+        with open(path, "rb") as f:
+            data = f.read()
+        cols = {"bytes": [data]}
+        if include_paths:
+            cols["path"] = [path]
+        return pa.table(cols)
+
+
+class NumpyDatasource(FileBasedDatasource):
+    _suffixes = [".npy"]
+
+    def _read_file(self, path: str, **kw) -> Block:
+        from .block import batch_to_block
+
+        return batch_to_block({"data": np.load(path)})
+
+
+# ---------------------------------------------------------------------------
+# Writers (run inside map tasks; reference: file_datasink.py)
+
+def write_block(block: Block, path: str, file_format: str,
+                **writer_args) -> str:
+    os.makedirs(path, exist_ok=True)
+    fname = os.path.join(path, f"{uuid.uuid4().hex[:12]}.{file_format}")
+    if file_format == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(block, fname, **writer_args)
+    elif file_format == "csv":
+        import pyarrow.csv as pcsv
+
+        pcsv.write_csv(block, fname)
+    elif file_format == "json":
+        df = block.to_pandas()
+        df.to_json(fname, orient="records", lines=True)
+    elif file_format == "npy":
+        from .block import BlockAccessor
+
+        cols = BlockAccessor(block).to_numpy()
+        if len(cols) == 1:
+            np.save(fname, next(iter(cols.values())))
+        else:
+            np.save(fname, cols, allow_pickle=True)
+    else:
+        raise ValueError(f"unknown write format {file_format}")
+    return fname

@@ -1,0 +1,923 @@
+"""Streaming execution of logical plans over ray_tpu tasks.
+
+Reference: python/ray/data/_internal/execution/ — StreamingExecutor
+(streaming_executor.py:48) drives a DAG of PhysicalOperators; MapOperator
+(operators/map_operator.py:44) fans block transforms out as tasks with
+bounded in-flight budgets and backpressure; all-to-all ops (shuffle/sort/
+groupby) run partition+reduce phases.
+
+TPU-first notes: blocks are host-side arrow tables moved via the object
+plane; device placement happens only at iteration time (iterator.py) where
+batches are staged into HBM with double buffering.  The executor itself is
+a pure control loop — no data flows through the driver except metadata.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+
+from . import logical as L
+from .block import Block, BlockAccessor, BlockBuilder, BlockMetadata, \
+    batch_to_block, rows_to_block
+from .context import DataContext
+
+
+@dataclass
+class RefBundle:
+    """A block reference + its metadata (reference:
+    _internal/execution/interfaces/ref_bundle.py)."""
+
+    block_ref: Any  # ObjectRef[Block]
+    metadata: BlockMetadata
+
+
+# ---------------------------------------------------------------------------
+# Remote task bodies (run on workers)
+
+def _apply_stage(blocks: List[Block], stage: Dict) -> List[Block]:
+    kind = stage["kind"]
+    fn = stage["fn"]
+    fn_args = stage.get("fn_args") or ()
+    fn_kwargs = stage.get("fn_kwargs") or {}
+    if kind == "block":
+        return [batch_to_block(fn(b, *fn_args, **fn_kwargs)) for b in blocks]
+    if kind == "batch":
+        batch_size = stage.get("batch_size")
+        batch_format = stage.get("batch_format") or "numpy"
+        out = []
+        for b in blocks:
+            acc = BlockAccessor(b)
+            n = acc.num_rows()
+            if batch_size is None or batch_size >= n:
+                slices = [b] if n else []
+            else:
+                slices = [acc.slice(i, min(i + batch_size, n))
+                          for i in range(0, n, batch_size)]
+            builder = BlockBuilder()
+            for s in slices:
+                res = fn(BlockAccessor(s).to_batch(batch_format),
+                         *fn_args, **fn_kwargs)
+                if hasattr(res, "__next__") or (
+                        hasattr(res, "__iter__")
+                        and not isinstance(res, (dict, list, tuple))
+                        and type(res).__module__ not in ("numpy", "pandas",
+                                                         "pyarrow.lib")):
+                    for r in res:
+                        builder.add_block(batch_to_block(r))
+                else:
+                    builder.add_block(batch_to_block(res))
+            out.append(builder.build())
+        return out
+    # row-wise kinds
+    out = []
+    for b in blocks:
+        builder = BlockBuilder()
+        for row in BlockAccessor(b).iter_rows():
+            if kind == "row":
+                builder.add_row(fn(row, *fn_args, **fn_kwargs))
+            elif kind == "filter":
+                if fn(row, *fn_args, **fn_kwargs):
+                    builder.add_row(row)
+            elif kind == "flat":
+                for r in fn(row, *fn_args, **fn_kwargs):
+                    builder.add_row(r)
+            else:
+                raise ValueError(f"unknown stage kind {kind}")
+        out.append(builder.build())
+    return out
+
+
+def _map_task(chain: List[Dict], *blocks: Block):
+    """Apply a fused chain of stages to input block(s); returns
+    (block, metadata)."""
+    t0 = time.perf_counter()
+    out = _apply_stage(list(blocks), chain[0])
+    for stage in chain[1:]:
+        out = _apply_stage(out, stage)
+    block = BlockAccessor.concat(out)
+    meta = BlockAccessor(block).get_metadata(
+        exec_stats={"wall_s": time.perf_counter() - t0})
+    return block, meta
+
+
+def _read_task(rt, chain: List[Dict]):
+    """Run a ReadTask then any fused downstream stages."""
+    t0 = time.perf_counter()
+    blocks = list(rt())
+    for stage in chain:
+        blocks = _apply_stage(blocks, stage)
+    block = BlockAccessor.concat(blocks)
+    meta = BlockAccessor(block).get_metadata(
+        input_files=rt.metadata.input_files,
+        exec_stats={"wall_s": time.perf_counter() - t0})
+    return block, meta
+
+
+def _slice_task(n: int, block: Block):
+    acc = BlockAccessor(block)
+    out = acc.slice(0, min(n, acc.num_rows()))
+    return out, BlockAccessor(out).get_metadata()
+
+
+def _partition_task(spec: Dict, block: Block):
+    """Split one block into spec['n'] parts (hash/random/range)."""
+    acc = BlockAccessor(block)
+    n = spec["n"]
+    how = spec["how"]
+    nrows = acc.num_rows()
+    if nrows == 0:
+        empty = acc.slice(0, 0)
+        return tuple(empty for _ in range(n)) if n > 1 else empty
+    if how == "random":
+        rng = np.random.RandomState(spec.get("seed"))
+        assign = rng.randint(0, n, size=nrows)
+    elif how == "round_robin":
+        assign = np.arange(nrows) % n
+    elif how == "hash":
+        key = spec["key"]
+        col = acc.to_numpy([key])[key]
+        # stable hash of the key column
+        import pandas as pd
+
+        assign = pd.util.hash_array(np.asarray(col)) % n
+    elif how == "range":
+        key = spec["key"]
+        boundaries = spec["boundaries"]
+        col = np.asarray(acc.to_numpy([key])[key])
+        assign = np.searchsorted(np.asarray(boundaries), col,
+                                 side="right")
+        if spec.get("descending"):
+            assign = (n - 1) - assign
+    else:
+        raise ValueError(how)
+    parts = []
+    for i in range(n):
+        idx = np.nonzero(assign == i)[0]
+        parts.append(acc.take(idx.tolist()))
+    return tuple(parts) if n > 1 else parts[0]
+
+
+def _reduce_task(spec: Dict, *parts: Block):
+    """Combine partition pieces into one output block."""
+    block = BlockAccessor.concat([p for p in parts if p is not None])
+    acc = BlockAccessor(block)
+    how = spec["how"]
+    if how == "shuffle":
+        block = acc.random_permutation(spec.get("seed"))
+    elif how == "sort":
+        block = acc.sort(spec["key"], spec.get("descending", False))
+    elif how == "aggregate":
+        block = _aggregate_block(block, spec["key"], spec["aggs"])
+    elif how == "map_groups":
+        block = _map_groups_block(block, spec["key"], spec["fn"],
+                                  spec.get("batch_format") or "numpy")
+    elif how == "concat":
+        pass
+    else:
+        raise ValueError(how)
+    return block, BlockAccessor(block).get_metadata()
+
+
+def _iter_groups(block: Block, key: str):
+    acc = BlockAccessor(block)
+    if acc.num_rows() == 0:
+        return
+    block = acc.sort(key)
+    acc = BlockAccessor(block)
+    keys = np.asarray(acc.to_numpy([key])[key])
+    # group boundaries in the sorted key column
+    change = np.nonzero(keys[1:] != keys[:-1])[0] + 1
+    starts = np.concatenate([[0], change])
+    ends = np.concatenate([change, [len(keys)]])
+    for s, e in zip(starts, ends):
+        yield keys[s], acc.slice(int(s), int(e))
+
+
+def _aggregate_block(block: Block, key: Optional[str], aggs) -> Block:
+    rows = []
+    if key is None:
+        row = {}
+        for agg in aggs:
+            row[agg.name] = agg.finalize(agg.combine([agg.partial(block)]))
+        rows.append(row)
+    else:
+        for kval, group in _iter_groups(block, key):
+            row = {key: kval}
+            for agg in aggs:
+                row[agg.name] = agg.finalize(
+                    agg.combine([agg.partial(group)]))
+            rows.append(row)
+    return rows_to_block(rows)
+
+
+def _map_groups_block(block: Block, key: Optional[str], fn,
+                      batch_format: str) -> Block:
+    builder = BlockBuilder()
+    if key is None:
+        res = fn(BlockAccessor(block).to_batch(batch_format))
+        builder.add_block(batch_to_block(res))
+    else:
+        for _, group in _iter_groups(block, key):
+            res = fn(BlockAccessor(group).to_batch(batch_format))
+            builder.add_block(batch_to_block(res))
+    return builder.build()
+
+
+def _sample_task(key, k: int, block: Block):
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    if n == 0:
+        return []
+    idx = np.linspace(0, n - 1, num=min(k, n), dtype=np.int64)
+    sample = BlockAccessor(acc.take(idx.tolist()))
+    col = sample.to_numpy([key] if isinstance(key, str) else key)
+    return list(np.asarray(col[key if isinstance(key, str) else key[0]]))
+
+
+def _zip_task(l_off: int, slices: List[Tuple[int, int, int]],
+              left: Block, *rights: Block):
+    """Zip: align `left` with slices of right-side blocks.
+    slices: (right_block_index, start_in_right, length)."""
+    import pyarrow as pa
+
+    parts = []
+    for (ri, start, length) in slices:
+        parts.append(BlockAccessor(rights[ri]).slice(start, start + length))
+    right = BlockAccessor.concat(parts)
+    lt = BlockAccessor(left).to_arrow()
+    rt = BlockAccessor(right).to_arrow()
+    cols = {c: lt.column(c) for c in lt.column_names}
+    for c in rt.column_names:
+        name = c if c not in cols else f"{c}_1"
+        cols[name] = rt.column(c)
+    out = pa.table(cols)
+    return out, BlockAccessor(out).get_metadata()
+
+
+# ---------------------------------------------------------------------------
+# Physical operators
+
+@dataclass
+class _TaskRec:
+    refs: List[Any]           # return refs; refs[-1] is metadata when paired
+    on_done: Callable[["_TaskRec"], None]
+    tag: Any = None
+
+
+class PhysicalOperator:
+    def __init__(self, name: str, num_inputs: int = 1):
+        self.name = name
+        self.num_inputs = num_inputs
+        self.in_queues = [collections.deque() for _ in range(num_inputs)]
+        self.in_done = [False] * num_inputs
+        self.out_queue: collections.deque = collections.deque()
+        self.finished = False
+        self.active = 0
+        self.stats = {"tasks": 0, "rows_out": 0, "blocks_out": 0,
+                      "wall_s": 0.0}
+        self.downstream: List[Tuple["PhysicalOperator", int]] = []
+
+    # -- wiring
+    def connect(self, downstream: "PhysicalOperator", index: int = 0):
+        self.downstream.append((downstream, index))
+
+    def _emit(self, bundle: RefBundle):
+        self.stats["rows_out"] += bundle.metadata.num_rows
+        self.stats["blocks_out"] += 1
+        if bundle.metadata.exec_stats:
+            self.stats["wall_s"] += bundle.metadata.exec_stats.get("wall_s", 0)
+        self.out_queue.append(bundle)
+
+    # -- executor interface
+    def add_input(self, bundle: RefBundle, index: int = 0):
+        self.in_queues[index].append(bundle)
+
+    def notify_input_done(self, index: int = 0):
+        self.in_done[index] = True
+
+    def all_inputs_done(self) -> bool:
+        return all(self.in_done)
+
+    def has_work(self) -> bool:
+        return any(self.in_queues)
+
+    def try_submit(self, submit) -> List[_TaskRec]:
+        """Submit up to one task if work is buffered; returns task recs."""
+        return []
+
+    def maybe_finish(self):
+        if (self.all_inputs_done() and not self.has_work()
+                and self.active == 0):
+            self.finished = True
+
+
+class InputOperator(PhysicalOperator):
+    """Source of pre-existing bundles (materialized data)."""
+
+    def __init__(self, bundles: List[RefBundle]):
+        super().__init__("Input", num_inputs=0)
+        for b in bundles:
+            self.out_queue.append(b)
+        self.finished = True
+
+    def all_inputs_done(self):
+        return True
+
+
+class ReadOperator(PhysicalOperator):
+    def __init__(self, read_tasks, chain: List[Dict], resources=None):
+        super().__init__("Read", num_inputs=0)
+        self._pending = collections.deque(read_tasks)
+        self._chain = chain
+        self._resources = resources
+
+    def all_inputs_done(self):
+        return True
+
+    def has_work(self):
+        return bool(self._pending)
+
+    def try_submit(self, submit) -> List[_TaskRec]:
+        if not self._pending:
+            return []
+        rt = self._pending.popleft()
+        refs = submit(_read_task, (rt, self._chain), num_returns=2,
+                      resources=self._resources, name=f"data:{self.name}")
+        self.active += 1
+        self.stats["tasks"] += 1
+
+        def on_done(rec: _TaskRec):
+            self.active -= 1
+            meta = ray_tpu.get(rec.refs[1], timeout=300)
+            self._emit(RefBundle(rec.refs[0], meta))
+            self.maybe_finish()
+
+        return [_TaskRec(refs, on_done)]
+
+
+class MapOperator(PhysicalOperator):
+    """Fused chain of map stages; one task per input block."""
+
+    def __init__(self, name: str, chain: List[Dict], resources=None):
+        super().__init__(name)
+        self._chain = chain
+        self._resources = resources
+
+    def try_submit(self, submit) -> List[_TaskRec]:
+        if not self.in_queues[0]:
+            return []
+        bundle: RefBundle = self.in_queues[0].popleft()
+        refs = submit(_map_task, (self._chain, bundle.block_ref),
+                      num_returns=2, resources=self._resources,
+                      name=f"data:{self.name}")
+        self.active += 1
+        self.stats["tasks"] += 1
+
+        def on_done(rec: _TaskRec):
+            self.active -= 1
+            meta = ray_tpu.get(rec.refs[1], timeout=300)
+            self._emit(RefBundle(rec.refs[0], meta))
+            self.maybe_finish()
+
+        return [_TaskRec(refs, on_done)]
+
+
+class LimitOperator(PhysicalOperator):
+    def __init__(self, limit: int):
+        super().__init__(f"Limit({limit})")
+        self._remaining = limit
+
+    def try_submit(self, submit) -> List[_TaskRec]:
+        recs = []
+        while self.in_queues[0] and self._remaining > 0:
+            bundle: RefBundle = self.in_queues[0].popleft()
+            n = bundle.metadata.num_rows
+            if n <= self._remaining:
+                self._remaining -= n
+                self._emit(bundle)
+                continue
+            take = self._remaining
+            self._remaining = 0
+            refs = submit(_slice_task, (take, bundle.block_ref),
+                          num_returns=2, name=f"data:{self.name}")
+            self.active += 1
+            self.stats["tasks"] += 1
+
+            def on_done(rec: _TaskRec):
+                self.active -= 1
+                meta = ray_tpu.get(rec.refs[1], timeout=300)
+                self._emit(RefBundle(rec.refs[0], meta))
+                self.maybe_finish()
+
+            recs.append(_TaskRec(refs, on_done))
+        if self._remaining == 0:
+            # drop any remaining input; upstream stops via executor check
+            for q in self.in_queues:
+                q.clear()
+            if self.active == 0:
+                self.finished = True
+        else:
+            self.maybe_finish()
+        return recs
+
+    def satisfied(self) -> bool:
+        return self._remaining <= 0
+
+    def maybe_finish(self):
+        if self.satisfied() and self.active == 0:
+            self.finished = True
+            return
+        super().maybe_finish()
+
+
+class UnionOperator(PhysicalOperator):
+    def __init__(self, n: int):
+        super().__init__("Union", num_inputs=n)
+
+    def try_submit(self, submit) -> List[_TaskRec]:
+        for q in self.in_queues:
+            while q:
+                self._emit(q.popleft())
+        self.maybe_finish()
+        return []
+
+
+class ZipOperator(PhysicalOperator):
+    """Barrier: buffers both sides, then zips row-aligned slices."""
+
+    def __init__(self):
+        super().__init__("Zip", num_inputs=2)
+        self._left: List[RefBundle] = []
+        self._right: List[RefBundle] = []
+        self._planned = False
+
+    def try_submit(self, submit) -> List[_TaskRec]:
+        while self.in_queues[0]:
+            self._left.append(self.in_queues[0].popleft())
+        while self.in_queues[1]:
+            self._right.append(self.in_queues[1].popleft())
+        if not (self.in_done[0] and self.in_done[1]) or self._planned:
+            self.maybe_finish()
+            return []
+        self._planned = True
+        lrows = sum(b.metadata.num_rows for b in self._left)
+        rrows = sum(b.metadata.num_rows for b in self._right)
+        if lrows != rrows:
+            raise ValueError(
+                f"zip(): datasets have different row counts: {lrows} vs "
+                f"{rrows}")
+        # For each left block, find overlapping right slices.
+        r_offsets = []
+        off = 0
+        for b in self._right:
+            r_offsets.append(off)
+            off += b.metadata.num_rows
+        recs = []
+        l_off = 0
+        for lb in self._left:
+            ln = lb.metadata.num_rows
+            slices = []
+            need_start, need_end = l_off, l_off + ln
+            for ri, rb in enumerate(self._right):
+                rs = r_offsets[ri]
+                re = rs + rb.metadata.num_rows
+                s = max(need_start, rs)
+                e = min(need_end, re)
+                if s < e:
+                    slices.append((ri, s - rs, e - s))
+            right_refs = [self._right[ri].block_ref
+                          for ri, _, _ in slices]
+            # compact indices to the refs we pass
+            idx_map = {}
+            cslices = []
+            crefs = []
+            for (ri, st, lnn) in slices:
+                if ri not in idx_map:
+                    idx_map[ri] = len(crefs)
+                    crefs.append(self._right[ri].block_ref)
+                cslices.append((idx_map[ri], st, lnn))
+            refs = submit(_zip_task,
+                          (l_off, cslices, lb.block_ref, *crefs),
+                          num_returns=2, name="data:Zip")
+            self.active += 1
+            self.stats["tasks"] += 1
+
+            def on_done(rec: _TaskRec):
+                self.active -= 1
+                meta = ray_tpu.get(rec.refs[1], timeout=300)
+                self._emit(RefBundle(rec.refs[0], meta))
+                self.maybe_finish()
+
+            recs.append(_TaskRec(refs, on_done))
+            l_off += ln
+        self.maybe_finish()
+        return recs
+
+
+class AllToAllOperator(PhysicalOperator):
+    """Barrier op: partition phase fans each input block into N parts;
+    reduce phase combines part i of every block into output block i
+    (reference: _internal/planner/exchange/)."""
+
+    def __init__(self, name: str, kind: str, *, num_outputs=None, key=None,
+                 descending=False, seed=None, aggs=None, fn=None,
+                 batch_format=None, shuffle_blocks=False):
+        super().__init__(name)
+        self.kind = kind
+        self.num_outputs = num_outputs
+        self.key = key
+        self.descending = descending
+        self.seed = seed
+        self.aggs = aggs
+        self.fn = fn
+        self.batch_format = batch_format
+        self.shuffle_blocks = shuffle_blocks
+        self._bundles: List[RefBundle] = []
+        self._phase = "collect"
+        self._samples: List[Any] = []
+        self._sample_refs: List[Any] = []
+        self._boundaries = None
+        self._parts: List[List[Any]] = []  # [input][partition] -> ref
+        self._n_parts_done = 0
+
+    def _resolved_num_outputs(self) -> int:
+        if self.kind in ("groupby", "map_groups") and self.key is None:
+            return 1
+        if self.num_outputs:
+            return self.num_outputs
+        ctx = DataContext.get_current()
+        if ctx.default_shuffle_partitions:
+            return ctx.default_shuffle_partitions
+        return max(1, len(self._bundles))
+
+    def try_submit(self, submit) -> List[_TaskRec]:
+        while self.in_queues[0]:
+            self._bundles.append(self.in_queues[0].popleft())
+        if not self.all_inputs_done():
+            return []
+        if self._phase == "collect":
+            if self.kind in ("sort", "groupby_sort"):
+                self._phase = "sample"
+            else:
+                self._phase = "partition"
+        recs: List[_TaskRec] = []
+        if self._phase == "sample":
+            self._phase = "sampling"
+            for b in self._bundles:
+                refs = submit(_sample_task, (self.key, 8, b.block_ref),
+                              num_returns=1, name=f"data:{self.name}:sample")
+                self.active += 1
+
+                def on_done(rec: _TaskRec):
+                    self.active -= 1
+                    self._samples.extend(ray_tpu.get(rec.refs[0],
+                                                     timeout=300))
+                    if self.active == 0:
+                        self._compute_boundaries()
+                        self._phase = "partition"
+
+                recs.append(_TaskRec(refs, on_done))
+            if not recs:  # no input blocks at all
+                self._phase = "partition"
+        if self._phase == "partition":
+            self._phase = "reduce_wait"
+            n = self._resolved_num_outputs()
+            if not self._bundles:
+                self.finished = True
+                return recs
+            spec = self._partition_spec(n)
+            self._parts = [None] * len(self._bundles)
+            for i, b in enumerate(self._bundles):
+                refs = submit(_partition_task, (spec, b.block_ref),
+                              num_returns=n, name=f"data:{self.name}:part")
+                self.active += 1
+                self.stats["tasks"] += 1
+
+                def on_done(rec: _TaskRec, i=i):
+                    self.active -= 1
+                    self._parts[i] = rec.refs
+                    self._n_parts_done += 1
+                    if self._n_parts_done == len(self._bundles):
+                        self._phase = "reduce"
+
+                recs.append(_TaskRec(refs, on_done))
+        if self._phase == "reduce":
+            self._phase = "done_wait"
+            n = self._resolved_num_outputs()
+            rspec = self._reduce_spec()
+            order = list(range(n))
+            if self.kind == "shuffle" and self.shuffle_blocks:
+                rng = np.random.RandomState(self.seed)
+                rng.shuffle(order)
+            for j in order:
+                part_refs = [self._parts[i][j]
+                             for i in range(len(self._bundles))]
+                refs = submit(_reduce_task, (rspec, *part_refs),
+                              num_returns=2,
+                              name=f"data:{self.name}:reduce")
+                self.active += 1
+                self.stats["tasks"] += 1
+
+                def on_done(rec: _TaskRec):
+                    self.active -= 1
+                    meta = ray_tpu.get(rec.refs[1], timeout=300)
+                    self._emit(RefBundle(rec.refs[0], meta))
+                    if self.active == 0 and self._phase == "done_wait":
+                        self.finished = True
+
+                recs.append(_TaskRec(refs, on_done))
+        return recs
+
+    def _compute_boundaries(self):
+        n = self._resolved_num_outputs()
+        if not self._samples:
+            self._boundaries = []
+            return
+        qs = np.linspace(0, 1, n + 1)[1:-1]
+        self._boundaries = list(np.quantile(
+            np.asarray(sorted(self._samples)), qs, method="nearest")) \
+            if len(qs) else []
+
+    def _partition_spec(self, n: int) -> Dict:
+        if self.kind == "shuffle":
+            return {"how": "random", "n": n, "seed": self.seed}
+        if self.kind == "repartition":
+            return {"how": "round_robin", "n": n}
+        if self.kind in ("groupby", "map_groups"):
+            if self.key is None:
+                return {"how": "round_robin", "n": 1}
+            return {"how": "hash", "n": n, "key": self.key}
+        if self.kind == "sort":
+            return {"how": "range", "n": n, "key": self.key,
+                    "boundaries": self._boundaries or [],
+                    "descending": self.descending}
+        raise ValueError(self.kind)
+
+    def _reduce_spec(self) -> Dict:
+        if self.kind == "shuffle":
+            return {"how": "shuffle", "seed": self.seed}
+        if self.kind == "repartition":
+            return {"how": "concat"}
+        if self.kind == "sort":
+            return {"how": "sort", "key": self.key,
+                    "descending": self.descending}
+        if self.kind == "groupby":
+            return {"how": "aggregate", "key": self.key, "aggs": self.aggs}
+        if self.kind == "map_groups":
+            return {"how": "map_groups", "key": self.key, "fn": self.fn,
+                    "batch_format": self.batch_format}
+        raise ValueError(self.kind)
+
+    def maybe_finish(self):
+        # completion handled by phases
+        if (self.all_inputs_done() and not self._bundles
+                and self._phase == "collect"):
+            self.finished = True
+
+
+# ---------------------------------------------------------------------------
+# Planner: logical DAG -> physical DAG
+
+def _stage_of(op: L.AbstractMap) -> Dict:
+    return {"kind": op.fn_kind, "fn": op.fn, "batch_size": op.batch_size,
+            "batch_format": op.batch_format, "fn_args": op.fn_args,
+            "fn_kwargs": op.fn_kwargs}
+
+
+def plan(logical_dag: L.LogicalOp
+         ) -> Tuple[PhysicalOperator, List[PhysicalOperator]]:
+    """Build the physical DAG, fusing chains of AbstractMap into single
+    operators (the reference's OperatorFusionRule).  Returns (sink, ops)."""
+    ctx = DataContext.get_current()
+    ops: List[PhysicalOperator] = []
+
+    def register(phys: PhysicalOperator) -> PhysicalOperator:
+        if phys not in ops:
+            ops.append(phys)
+        return phys
+
+    def build(op: L.LogicalOp) -> PhysicalOperator:
+        return register(_build(op))
+
+    def _build(op: L.LogicalOp) -> PhysicalOperator:
+        if isinstance(op, L.InputData):
+            return InputOperator(op.bundles)
+        if isinstance(op, L.Read):
+            parallelism = op.parallelism
+            if parallelism is None or parallelism < 0:
+                parallelism = 200
+            tasks = op.datasource.get_read_tasks(parallelism)
+            return ReadOperator(tasks, chain=[])
+        if isinstance(op, L.AbstractMap):
+            upstream = build(op.inputs[0])
+            stage = _stage_of(op)
+            resources = op.resources or None
+            # fuse into upstream Read / Map when compatible
+            if isinstance(upstream, ReadOperator) and not resources:
+                upstream._chain.append(stage)
+                upstream.name = f"{upstream.name}->{op.name}"
+                return upstream
+            if isinstance(upstream, MapOperator) and \
+                    upstream._resources == resources:
+                upstream._chain.append(stage)
+                upstream.name = f"{upstream.name}->{op.name}"
+                return upstream
+            phys = MapOperator(op.name, [stage], resources=resources)
+            upstream.connect(phys, 0)
+            return phys
+        if isinstance(op, L.Limit):
+            upstream = build(op.inputs[0])
+            phys = LimitOperator(op.limit)
+            upstream.connect(phys, 0)
+            return phys
+        if isinstance(op, L.Union):
+            phys = UnionOperator(len(op.inputs))
+            for i, parent in enumerate(op.inputs):
+                build(parent).connect(phys, i)
+            return phys
+        if isinstance(op, L.Zip):
+            phys = ZipOperator()
+            build(op.inputs[0]).connect(phys, 0)
+            build(op.inputs[1]).connect(phys, 1)
+            return phys
+        if isinstance(op, L.Repartition):
+            upstream = build(op.inputs[0])
+            phys = AllToAllOperator(
+                f"Repartition({op.num_outputs})",
+                "shuffle" if op.shuffle else "repartition",
+                num_outputs=op.num_outputs)
+            upstream.connect(phys, 0)
+            return phys
+        if isinstance(op, L.RandomShuffle):
+            upstream = build(op.inputs[0])
+            seed = op.seed if op.seed is not None else ctx.seed
+            phys = AllToAllOperator("RandomShuffle", "shuffle",
+                                    num_outputs=op.num_outputs, seed=seed,
+                                    shuffle_blocks=True)
+            upstream.connect(phys, 0)
+            return phys
+        if isinstance(op, L.Sort):
+            upstream = build(op.inputs[0])
+            phys = AllToAllOperator(f"Sort({op.key})", "sort",
+                                    num_outputs=op.num_outputs, key=op.key,
+                                    descending=op.descending)
+            upstream.connect(phys, 0)
+            return phys
+        if isinstance(op, L.GroupByAggregate):
+            upstream = build(op.inputs[0])
+            phys = AllToAllOperator(f"Aggregate({op.key})", "groupby",
+                                    num_outputs=op.num_outputs, key=op.key,
+                                    aggs=op.aggs)
+            upstream.connect(phys, 0)
+            return phys
+        if isinstance(op, L.MapGroups):
+            upstream = build(op.inputs[0])
+            phys = AllToAllOperator(f"MapGroups({op.key})", "map_groups",
+                                    num_outputs=op.num_outputs, key=op.key,
+                                    fn=op.fn, batch_format=op.batch_format)
+            upstream.connect(phys, 0)
+            return phys
+        raise TypeError(f"unknown logical op {op!r}")
+
+    sink = build(logical_dag)
+    return sink, ops
+
+
+class StreamingExecutor:
+    """Pull-based streaming scheduling loop (reference:
+    streaming_executor.py:48 + streaming_executor_state.py
+    select_operator_to_run)."""
+
+    def __init__(self, sink: PhysicalOperator, all_ops: List[PhysicalOperator]):
+        self.sink = sink
+        self.ops = all_ops
+        self.ctx = DataContext.get_current()
+        self._inflight: Dict[str, Tuple[_TaskRec, Any]] = {}
+        self._started = time.perf_counter()
+        self.wall_s = 0.0
+
+    def _submit(self, fn, args, *, num_returns=1, resources=None, name=""):
+        remote_fn = ray_tpu.remote(fn).options(
+            num_returns=num_returns, name=name,
+            resources=self.ctx.task_resources or None,
+            num_cpus=1)
+        refs = remote_fn.remote(*args)
+        if num_returns == 1:
+            refs = [refs]
+        return refs
+
+    def _route_outputs(self, op: PhysicalOperator):
+        while op.out_queue:
+            bundle = op.out_queue.popleft()
+            if not op.downstream:
+                yield bundle
+                continue
+            for (d, idx) in op.downstream:
+                d.add_input(bundle, idx)
+
+    def _propagate_done(self):
+        for op in self.ops:
+            if op.finished or (op.all_inputs_done() and not op.has_work()
+                               and op.active == 0):
+                op.maybe_finish()
+                if op.finished or isinstance(op, (InputOperator,)):
+                    for (d, idx) in op.downstream:
+                        if not d.in_done[idx] and not op.out_queue:
+                            d.notify_input_done(idx)
+
+    def _limit_reached(self) -> bool:
+        return isinstance(self.sink, LimitOperator) and self.sink.satisfied()
+
+    def run(self) -> Iterator[RefBundle]:
+        """Generator over output bundles of the sink."""
+        try:
+            yield from self._run_loop()
+        finally:
+            self.wall_s = time.perf_counter() - self._started
+
+    def _run_loop(self) -> Iterator[RefBundle]:
+        out_buffer: collections.deque = collections.deque()
+        while True:
+            progressed = False
+            # 1. submissions
+            budget = self.ctx.max_concurrent_tasks - len(self._inflight)
+            backpressured = (len(out_buffer)
+                            >= self.ctx.max_buffered_output_bundles)
+            if budget > 0 and not backpressured and not self._limit_reached():
+                for op in self.ops:
+                    if budget <= 0:
+                        break
+                    percap = self.ctx.max_tasks_per_operator
+                    if percap is not None and op.active >= percap:
+                        continue
+                    recs = op.try_submit(
+                        lambda fn, args, **kw: self._submit(fn, args, **kw))
+                    for rec in recs:
+                        key = rec.refs[0].id
+                        self._inflight[key] = (rec, op)
+                        budget -= 1
+                        progressed = True
+            else:
+                # even without budget, zero-task ops (limit/union) progress
+                for op in self.ops:
+                    if isinstance(op, (LimitOperator, UnionOperator,
+                                       ZipOperator)) and op.has_work():
+                        recs = op.try_submit(
+                            lambda fn, args, **kw: self._submit(fn, args,
+                                                                **kw))
+                        for rec in recs:
+                            self._inflight[rec.refs[0].id] = (rec, op)
+                            progressed = True
+            # 2. completions
+            if self._inflight:
+                first_refs = [rec.refs[0] for rec, _ in
+                              self._inflight.values()]
+                ready, _ = ray_tpu.wait(
+                    first_refs, num_returns=len(first_refs), timeout=0.05)
+                for r in ready:
+                    rec, op = self._inflight.pop(r.id)
+                    rec.on_done(rec)
+                    progressed = True
+            # 3. route outputs downstream / to the consumer
+            for op in self.ops:
+                for bundle in self._route_outputs(op):
+                    out_buffer.append(bundle)
+            while out_buffer:
+                progressed = True
+                yield out_buffer.popleft()
+            # 4. done propagation
+            self._propagate_done()
+            if self.sink.finished and not self._inflight and \
+                    not self.sink.out_queue:
+                for op in self.ops:
+                    for bundle in self._route_outputs(op):
+                        yield bundle
+                return
+            if self._limit_reached() and not self._inflight:
+                self.sink.maybe_finish()
+                if self.sink.finished:
+                    return
+            if not progressed:
+                time.sleep(0.002)
+
+    def stats_summary(self) -> str:
+        lines = []
+        for op in self.ops:
+            s = op.stats
+            lines.append(
+                f"{op.name}: {s['tasks']} tasks, {s['blocks_out']} blocks, "
+                f"{s['rows_out']} rows, {s['wall_s']:.3f}s task-time")
+        lines.append(f"total wall: {self.wall_s:.3f}s")
+        return "\n".join(lines)
+
+
+def build_executor(logical_dag: L.LogicalOp) -> StreamingExecutor:
+    sink, ops = plan(logical_dag)
+    return StreamingExecutor(sink, ops)

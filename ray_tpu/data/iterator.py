@@ -1,0 +1,130 @@
+"""Batch iteration, including device-fed iteration for TPU training.
+
+Reference: python/ray/data/iterator.py (iter_batches, iter_torch_batches).
+TPU-native twist: ``iter_jax_batches`` stages host batches into HBM with
+double buffering — ``jax.device_put`` of batch N+1 is issued while batch N
+is being consumed, so input feeding overlaps the device step (the role the
+reference delegates to torch DataLoader pinned-memory prefetch).
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+from .block import BlockAccessor
+from .context import DataContext
+
+
+def iter_block_batches(block_iter, *, batch_size: Optional[int],
+                       batch_format: str, drop_last: bool = False,
+                       local_shuffle_buffer_size: Optional[int] = None,
+                       seed: Optional[int] = None):
+    """Re-batch a stream of blocks into fixed-size batches."""
+    carry = None  # carry-over arrow table smaller than batch_size
+    rng = np.random.RandomState(seed)
+    shuffle_pool: List[Any] = []
+
+    def emit(table):
+        return BlockAccessor(table).to_batch(batch_format)
+
+    for block in block_iter:
+        acc = BlockAccessor(block)
+        if acc.num_rows() == 0:
+            continue
+        table = acc.to_arrow()
+        if local_shuffle_buffer_size:
+            table = BlockAccessor(table).random_permutation(
+                int(rng.randint(0, 2**31)))
+        if carry is not None:
+            table = BlockAccessor.concat([carry, table])
+            carry = None
+        if batch_size is None:
+            yield emit(table)
+            continue
+        n = table.num_rows
+        start = 0
+        while n - start >= batch_size:
+            yield emit(table.slice(start, batch_size))
+            start += batch_size
+        if start < n:
+            carry = table.slice(start)
+    if carry is not None and not drop_last:
+        yield emit(carry)
+
+
+def prefetch_iter(it: Iterator, depth: int) -> Iterator:
+    """Run `it` in a background thread with a bounded queue."""
+    if depth <= 0:
+        yield from it
+        return
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    DONE = object()
+    err: List[BaseException] = []
+
+    def worker():
+        try:
+            for item in it:
+                q.put(item)
+        except BaseException as e:  # propagate to consumer
+            err.append(e)
+        finally:
+            q.put(DONE)
+
+    t = threading.Thread(target=worker, daemon=True, name="data-prefetch")
+    t.start()
+    while True:
+        item = q.get()
+        if item is DONE:
+            if err:
+                raise err[0]
+            return
+        yield item
+
+
+def iter_jax_batches(batch_iter: Iterator[Dict[str, np.ndarray]], *,
+                     sharding=None, dtypes: Optional[Dict[str, Any]] = None,
+                     prefetch: int = 2) -> Iterator:
+    """Move numpy batches onto device with double buffering.
+
+    With a `jax.sharding.Sharding` (e.g. NamedSharding over a data axis),
+    each batch is placed sharded across the mesh; otherwise it goes to the
+    default device.
+    """
+    import jax
+
+    def put(batch):
+        def place(x):
+            arr = np.asarray(x)
+            if dtypes and getattr(x, "dtype", None) is not None:
+                pass
+            if sharding is not None:
+                return jax.device_put(arr, sharding)
+            return jax.device_put(arr)
+
+        if isinstance(batch, dict):
+            out = {k: place(v) for k, v in batch.items()}
+        else:
+            out = place(batch)
+        return out
+
+    buf: collections.deque = collections.deque()
+    it = iter(batch_iter)
+    # fill the pipeline
+    try:
+        for _ in range(max(1, prefetch)):
+            buf.append(put(next(it)))
+    except StopIteration:
+        pass
+    for batch in it:
+        nxt = put(batch)  # enqueue transfer for N+1 before yielding N
+        yield buf.popleft()
+        buf.append(nxt)
+    while buf:
+        yield buf.popleft()

@@ -73,6 +73,13 @@ class _FinishedMarker:
         self.final = final
 
 
+class SessionAborted(BaseException):
+    """Raised inside the user train loop when the driver aborts the session
+    (trial paused/stopped).  BaseException so user `except Exception`
+    blocks don't swallow it; `finally` blocks (worker-group shutdown,
+    placement-group release) still run as the loop unwinds."""
+
+
 class TrainSession:
     """Owns the user-loop thread inside one training worker."""
 
@@ -92,6 +99,7 @@ class TrainSession:
         # the rounds already consumed, so checkpoint_<n> dirs never collide
         # with (and never clobber) pre-failure checkpoints
         self._iteration = start_iteration
+        self._aborted = False
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"train-rank{ctx.world_rank}")
         self._started = False
@@ -107,7 +115,11 @@ class TrainSession:
         try:
             out = self._train_fn()
             self._results.put(_FinishedMarker(final=out if isinstance(out, dict) else None))
+        except SessionAborted:
+            return  # driver-initiated teardown; nobody is consuming results
         except BaseException as e:  # surfaced to the driver, not swallowed
+            if self._aborted:
+                return
             self._results.put(_FinishedMarker(error=e))
 
     def next_result(self, timeout: Optional[float] = None):
@@ -129,16 +141,34 @@ class TrainSession:
         if self._started:
             self._thread.join(timeout=timeout)
 
+    def abort(self, timeout: float = 10.0):
+        """Unwind the user loop: its next (or currently blocked) report()
+        raises SessionAborted, so nested resources held by the loop (worker
+        groups, placement groups) are released by its finally blocks."""
+        self._aborted = True
+        self._continue.release()
+        # drain a possibly queued result so a blocked put() can't wedge
+        try:
+            self._results.get_nowait()
+        except queue.Empty:
+            pass
+        if self._started:
+            self._thread.join(timeout=timeout)
+
     # -- user-facing (called from the train loop thread) -------------------
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
+        if self._aborted:
+            raise SessionAborted()
         self._iteration += 1
         ckpt_path = None
         if checkpoint is not None:
             ckpt_path = self._persist_checkpoint(checkpoint)
         self._results.put((dict(metrics), ckpt_path))
         self._continue.acquire()  # lockstep with the driver's consumption
+        if self._aborted:
+            raise SessionAborted()
 
     def _persist_checkpoint(self, checkpoint: Checkpoint) -> str:
         """Copy the worker-local checkpoint dir into run storage.
@@ -156,6 +186,11 @@ class TrainSession:
         os.makedirs(dest, exist_ok=True)
         if os.path.abspath(checkpoint.path) != os.path.abspath(dest_rank):
             shutil.copytree(checkpoint.path, dest_rank, dirs_exist_ok=True)
+        # completion marker, written last: restore paths skip checkpoint
+        # dirs that died mid-copy (no marker present)
+        with open(os.path.join(
+                dest, f".complete_rank_{self.ctx.world_rank}"), "w"):
+            pass
         return dest
 
     def get_checkpoint(self) -> Optional[Checkpoint]:

@@ -40,9 +40,13 @@ logger = logging.getLogger(__name__)
 
 
 def _find_latest_checkpoint(trial_dir: str) -> Optional[Checkpoint]:
-    """Scan <trial_dir>/checkpoint_* for the newest complete checkpoint."""
+    """Scan <trial_dir>/checkpoint_* for the newest complete checkpoint
+    (one with at least one `.complete_rank_*` marker — written after the
+    copy, so a dir that died mid-copy is skipped)."""
     cands = sorted(glob.glob(os.path.join(trial_dir, "checkpoint_*")))
-    cands = [c for c in cands if re.search(r"checkpoint_\d+$", c)]
+    cands = [c for c in cands
+             if re.search(r"checkpoint_\d+$", c)
+             and glob.glob(os.path.join(c, ".complete_rank_*"))]
     return Checkpoint(cands[-1]) if cands else None
 
 

@@ -101,18 +101,30 @@ class WorkerGroup:
         remote_cls = ray_tpu.remote(actor_cls)
         self.workers: List[Worker] = []
         handles = []
-        for i in range(num_workers):
-            b = bundles[i]
-            handles.append(remote_cls.options(
-                num_cpus=b.get("CPU", 0),
-                num_tpus=b.get("TPU", 0) or None,
-                resources={k: v for k, v in b.items()
-                           if k not in ("CPU", "TPU")} or None,
-                max_concurrency=2,  # next_result blocks; keep control lane free
-                scheduling_strategy=PlacementGroupSchedulingStrategy(
-                    self._pg, placement_group_bundle_index=i),
-            ).remote())
-        metas = ray_tpu.get([h.node_metadata.remote() for h in handles])
+        try:
+            for i in range(num_workers):
+                b = bundles[i]
+                handles.append(remote_cls.options(
+                    num_cpus=b.get("CPU", 0),
+                    num_tpus=b.get("TPU", 0) or None,
+                    resources={k: v for k, v in b.items()
+                               if k not in ("CPU", "TPU")} or None,
+                    max_concurrency=2,  # next_result blocks; keep control lane free
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        self._pg, placement_group_bundle_index=i),
+                ).remote())
+            metas = ray_tpu.get([h.node_metadata.remote() for h in handles])
+        except Exception:
+            for h in handles:
+                try:
+                    ray_tpu.kill(h)
+                except Exception:
+                    pass
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+            raise
         self.workers = [Worker(h, m) for h, m in zip(handles, metas)]
 
     @property

@@ -92,6 +92,15 @@ class _TrialRunnerActor:
             metrics.setdefault("timestamp", time.time())
         return (kind, metrics, ckpt_path)
 
+    def stop(self):
+        """Graceful teardown: unwind the trainable so nested resources
+        (trainer-adapter worker groups + placement groups) are released
+        before the actor dies."""
+        if self._session is not None:
+            self._session.abort()
+            self._session = None
+        return True
+
 
 class Callback:
     """Experiment callbacks (reference: tune/callback.py)."""
@@ -194,6 +203,12 @@ class TuneController:
     def _teardown_actor(self, trial: Trial):
         actor = self._actors.pop(trial.trial_id, None)
         if actor is not None:
+            # graceful first: unwind the trainable (releases nested worker
+            # groups / placement groups held by trainer adapters)
+            try:
+                ray_tpu.get(actor.stop.remote(), timeout=15.0)
+            except Exception:
+                pass
             try:
                 ray_tpu.kill(actor)
             except Exception:
@@ -249,6 +264,11 @@ class TuneController:
             trial.status = PAUSED
             self._teardown_actor(trial)
             self._maybe_exploit(trial)
+            if trial.status == PAUSED:
+                # no exploit pending (non-PBT scheduler, or donor not ready):
+                # requeue so the trial resumes from its checkpoint rather
+                # than stranding in PAUSED (the experiment would exit)
+                trial.status = PENDING
 
     def _maybe_exploit(self, trial: Trial):
         """PBT exploit/explore: clone a donor's config+checkpoint."""

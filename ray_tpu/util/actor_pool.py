@@ -55,6 +55,10 @@ class ActorPool:
         """Next result in submission order."""
         if not self.has_next():
             raise StopIteration("no more results")
+        # skip holes left by earlier unordered consumption
+        while (self._next_return_index not in self._index_to_future
+               and self._next_return_index < self._next_task_index):
+            self._next_return_index += 1
         future = self._index_to_future[self._next_return_index]
         if timeout is not None:
             ready, _ = ray_tpu.wait([future], num_returns=1, timeout=timeout)

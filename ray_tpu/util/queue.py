@@ -87,14 +87,20 @@ class Queue:
     def put(self, item: Any, block: bool = True,
             timeout: Optional[float] = None) -> None:
         deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            if ray_tpu.get(self.actor.put.remote(item)):
-                return
+        if not ray_tpu.get(self.actor.put.remote(item)):
             if not block:
                 raise Full
-            if deadline is not None and time.monotonic() > deadline:
-                raise Full
-            time.sleep(0.01)
+            # ship the payload once: poll full-ness with a payload-free
+            # probe, resend only when space appeared
+            while True:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise Full
+                time.sleep(0.01)
+                if not ray_tpu.get(self.actor.full.remote()):
+                    if ray_tpu.get(self.actor.put.remote(item)):
+                        return
+        else:
+            return
 
     def put_nowait(self, item: Any) -> None:
         self.put(item, block=False)

@@ -1,0 +1,235 @@
+"""ray_tpu.data tests (model: reference python/ray/data/tests/ —
+test_map.py, test_sort.py, test_consumption.py shapes)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+def test_range_count_take(ray_cluster):
+    ds = rd.range(100, override_num_blocks=4)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+
+
+def test_from_items_schema(ray_cluster):
+    ds = rd.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}])
+    assert ds.count() == 2
+    assert set(ds.columns()) == {"a", "b"}
+
+
+def test_map_batches_numpy(ray_cluster):
+    ds = rd.range(64, override_num_blocks=4).map_batches(
+        lambda b: {"id": b["id"] * 2})
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == [2 * i for i in range(64)]
+
+
+def test_map_batches_pandas(ray_cluster):
+    def add_col(df):
+        df = df.copy()
+        df["y"] = df["id"] + 1
+        return df
+
+    ds = rd.range(10, override_num_blocks=2).map_batches(
+        add_col, batch_format="pandas")
+    rows = sorted(ds.take_all(), key=lambda r: r["id"])
+    assert rows[3]["y"] == 4
+
+
+def test_map_filter_flat_map_fusion(ray_cluster):
+    ds = (rd.range(20, override_num_blocks=2)
+          .map(lambda r: {"v": r["id"] + 1})
+          .filter(lambda r: r["v"] % 2 == 0)
+          .flat_map(lambda r: [{"v": r["v"]}, {"v": -r["v"]}]))
+    vals = sorted(r["v"] for r in ds.take_all())
+    evens = [v for v in range(1, 21) if v % 2 == 0]
+    assert vals == sorted([-v for v in evens] + evens)
+
+
+def test_batch_size_rebatching(ray_cluster):
+    ds = rd.range(100, override_num_blocks=3)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=32)]
+    assert sum(sizes) == 100
+    assert all(s <= 32 for s in sizes)
+
+
+def test_iter_batches_drop_last(ray_cluster):
+    ds = rd.range(100, override_num_blocks=3)
+    sizes = [len(b["id"])
+             for b in ds.iter_batches(batch_size=32, drop_last=True)]
+    assert all(s == 32 for s in sizes)
+
+
+def test_limit_streaming(ray_cluster):
+    ds = rd.range(1000, override_num_blocks=8).limit(37)
+    assert ds.count() == 37
+
+
+def test_repartition(ray_cluster):
+    mat = rd.range(100, override_num_blocks=7).repartition(3).materialize()
+    assert mat.num_blocks() == 3
+    assert mat.count() == 100
+
+
+def test_random_shuffle(ray_cluster):
+    ds = rd.range(200, override_num_blocks=4).random_shuffle(seed=7)
+    vals = [r["id"] for r in ds.take_all()]
+    assert sorted(vals) == list(range(200))
+    assert vals != list(range(200))
+
+
+def test_sort(ray_cluster):
+    ds = rd.range(150, override_num_blocks=5).random_shuffle(seed=3)
+    out = [r["id"] for r in ds.sort("id").take_all()]
+    assert out == list(range(150))
+    out_desc = [r["id"] for r in ds.sort("id", descending=True).take_all()]
+    assert out_desc == list(reversed(range(150)))
+
+
+def test_global_aggregates(ray_cluster):
+    ds = rd.range(100, override_num_blocks=4)
+    assert ds.sum("id") == sum(range(100))
+    assert ds.min("id") == 0
+    assert ds.max("id") == 99
+    assert ds.mean("id") == pytest.approx(49.5)
+    assert ds.std("id") == pytest.approx(np.std(np.arange(100), ddof=1))
+
+
+def test_groupby_aggregate(ray_cluster):
+    ds = rd.from_items([{"k": i % 3, "v": i} for i in range(30)],
+                       override_num_blocks=4)
+    out = ds.groupby("k").sum("v").take_all()
+    expect = {k: sum(i for i in range(30) if i % 3 == k) for k in range(3)}
+    got = {r["k"]: r["sum(v)"] for r in out}
+    assert got == expect
+
+
+def test_groupby_count_mean(ray_cluster):
+    ds = rd.from_items([{"k": i % 2, "v": float(i)} for i in range(10)])
+    rows = ds.groupby("k").mean("v").take_all()
+    got = {r["k"]: r["mean(v)"] for r in rows}
+    assert got[0] == pytest.approx(4.0)
+    assert got[1] == pytest.approx(5.0)
+
+
+def test_map_groups(ray_cluster):
+    ds = rd.from_items([{"k": i % 2, "v": i} for i in range(8)])
+    out = ds.groupby("k").map_groups(
+        lambda batch: {"k": batch["k"][:1], "n": [len(batch["v"])]})
+    rows = out.take_all()
+    assert sorted((r["k"], r["n"]) for r in rows) == [(0, 4), (1, 4)]
+
+
+def test_union_zip(ray_cluster):
+    a = rd.range(10, override_num_blocks=2)
+    b = rd.range(10, override_num_blocks=2).map_batches(
+        lambda x: {"other": x["id"] + 100})
+    assert a.union(a).count() == 20
+    z = a.zip(b)
+    rows = sorted(z.take_all(), key=lambda r: r["id"])
+    assert rows[0]["other"] == 100
+    assert rows[9]["other"] == 109
+
+
+def test_columns_ops(ray_cluster):
+    ds = rd.from_items([{"a": 1, "b": 2}, {"a": 3, "b": 4}])
+    assert set(ds.select_columns(["a"]).columns()) == {"a"}
+    assert set(ds.drop_columns(["a"]).columns()) == {"b"}
+    renamed = ds.rename_columns({"a": "alpha"})
+    assert set(renamed.columns()) == {"alpha", "b"}
+    added = ds.add_column("c", lambda batch: batch["a"] + batch["b"])
+    row = sorted(added.take_all(), key=lambda r: r["a"])[0]
+    assert row["c"] == 3
+
+
+def test_parquet_roundtrip(ray_cluster, tmp_path):
+    ds = rd.range(50, override_num_blocks=3)
+    paths = ds.write_parquet(str(tmp_path / "out"))
+    assert len(paths) >= 1
+    back = rd.read_parquet(str(tmp_path / "out"))
+    assert back.count() == 50
+    assert sorted(r["id"] for r in back.take_all()) == list(range(50))
+
+
+def test_csv_json_roundtrip(ray_cluster, tmp_path):
+    ds = rd.from_items([{"x": i, "y": f"s{i}"} for i in range(10)])
+    ds.write_csv(str(tmp_path / "csv"))
+    assert rd.read_csv(str(tmp_path / "csv")).count() == 10
+    ds.write_json(str(tmp_path / "json"))
+    back = rd.read_json(str(tmp_path / "json"))
+    assert sorted(r["x"] for r in back.take_all()) == list(range(10))
+
+
+def test_from_pandas_numpy_arrow(ray_cluster):
+    import pandas as pd
+    import pyarrow as pa
+
+    df = pd.DataFrame({"a": [1, 2, 3]})
+    assert rd.from_pandas(df).count() == 3
+    assert rd.from_numpy(np.arange(5)).count() == 5
+    assert rd.from_arrow(pa.table({"z": [1, 2]})).count() == 2
+
+
+def test_tensor_blocks(ray_cluster):
+    ds = rd.range_tensor(8, shape=(2, 2), override_num_blocks=2)
+    batch = next(iter(ds.iter_batches(batch_size=8)))
+    assert batch["data"].shape == (8, 2, 2)
+
+
+def test_split(ray_cluster):
+    parts = rd.range(90, override_num_blocks=6).split(3)
+    counts = [p.count() for p in parts]
+    assert sum(counts) == 90
+    assert all(c > 0 for c in counts)
+
+
+def test_random_sample(ray_cluster):
+    ds = rd.range(1000, override_num_blocks=2).random_sample(0.5, seed=11)
+    n = ds.count()
+    assert 350 < n < 650
+
+
+def test_unique(ray_cluster):
+    ds = rd.from_items([{"v": i % 4} for i in range(20)])
+    assert ds.unique("v") == [0, 1, 2, 3]
+
+
+def test_iter_jax_batches(ray_cluster):
+    import jax
+
+    ds = rd.range(64, override_num_blocks=2)
+    batches = list(ds.iter_jax_batches(batch_size=16))
+    assert len(batches) == 4
+    assert all(isinstance(b["id"], jax.Array) for b in batches)
+    total = sum(int(b["id"].sum()) for b in batches)
+    assert total == sum(range(64))
+
+
+def test_iter_jax_batches_sharded(ray_cluster):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    ds = rd.range(32, override_num_blocks=2)
+    batches = list(ds.iter_jax_batches(batch_size=8, sharding=sharding))
+    assert batches and batches[0]["id"].sharding == sharding
+
+
+def test_materialize_reuse(ray_cluster):
+    mat = rd.range(40, override_num_blocks=4).materialize()
+    assert mat.count() == 40
+    # reuse without re-execution
+    assert mat.map_batches(lambda b: {"id": b["id"]}).count() == 40
+    assert "blocks" in (mat.stats() or "") or mat.stats()
+
+
+def test_stats_populated(ray_cluster):
+    ds = rd.range(10, override_num_blocks=2)
+    ds.count()
+    assert "Read" in ds.stats()
