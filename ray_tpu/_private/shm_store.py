@@ -75,8 +75,11 @@ def unpack(buf: memoryview) -> Tuple[bytes, List[memoryview]]:
     return meta, bufs
 
 
-class ShmObjectStore:
-    """Per-node store rooted at a /dev/shm session directory."""
+class FileObjectStore:
+    """File-per-object store rooted at a /dev/shm session directory.
+
+    Pure-Python fallback (and overflow tier) for the native arena store
+    (ray_tpu/native/shm_arena.cc via _private/native_store.py)."""
 
     def __init__(self, root: str):
         self.root = root
@@ -174,7 +177,7 @@ class ShmObjectStore:
 
     def list_objects(self) -> List[str]:
         return [n for n in os.listdir(self.root) if not n.endswith(".tmp")
-                and ".tmp." not in n]
+                and ".tmp." not in n and n != "arena.shm"]
 
     def wait_sealed(self, object_id: str, timeout: float) -> bool:
         deadline = time.monotonic() + timeout
@@ -194,3 +197,20 @@ class ShmObjectStore:
             os.rmdir(self.root)
         except OSError:
             pass
+
+
+def ShmObjectStore(root: str):
+    """Store factory: native C++ arena when the toolchain is available
+    (the default), file-per-object otherwise or when
+    RAY_TPU_DISABLE_NATIVE_STORE=1."""
+    if not os.environ.get("RAY_TPU_DISABLE_NATIVE_STORE"):
+        try:
+            from .native_store import NativeShmObjectStore
+
+            return NativeShmObjectStore(root)
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "native object store unavailable (%s); using file store", e)
+    return FileObjectStore(root)

@@ -25,6 +25,10 @@ from .execution import RefBundle, StreamingExecutor, build_executor
 from .iterator import iter_block_batches, iter_jax_batches, prefetch_iter
 
 
+def _slice_block_task(block: Block, start: int, length: int) -> Block:
+    return BlockAccessor(block).to_arrow().slice(start, length)
+
+
 class Dataset:
     def __init__(self, dag: L.LogicalOp):
         self._dag = dag
@@ -39,9 +43,10 @@ class Dataset:
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     batch_format: Optional[str] = None, fn_args=(),
                     fn_kwargs=None, num_cpus: Optional[float] = None,
-                    num_tpus: Optional[float] = None, **_ignored
+                    num_tpus: Optional[float] = None,
+                    resources: Optional[Dict[str, float]] = None, **_ignored
                     ) -> "Dataset":
-        resources = {}
+        resources = dict(resources or {})
         if num_cpus:
             resources["CPU"] = num_cpus
         if num_tpus:
@@ -271,14 +276,16 @@ class Dataset:
         return prefetch_iter(it, depth)
 
     def iter_jax_batches(self, *, batch_size: Optional[int] = 256,
-                         sharding=None, drop_last: bool = True,
+                         sharding=None, dtypes=None, drop_last: bool = True,
                          prefetch: int = 2, **kw) -> Iterator:
         """Iterate device-resident batches (dict of jax.Array), double
         buffered into HBM; with `sharding`, each batch is laid out across
-        the mesh data axis."""
+        the mesh data axis; `dtypes` maps column -> target dtype cast
+        before transfer (host-side, so e.g. bf16 halves the HBM traffic)."""
         host = self.iter_batches(batch_size=batch_size, batch_format="numpy",
                                  drop_last=drop_last, **kw)
-        return iter_jax_batches(host, sharding=sharding, prefetch=prefetch)
+        return iter_jax_batches(host, sharding=sharding, dtypes=dtypes,
+                                prefetch=prefetch)
 
     # ------------------------------------------------------------------
     # split / writes
@@ -288,12 +295,35 @@ class Dataset:
         mat = self.materialize()
         bundles = mat._bundles
         if equal:
+            # exact equal-row splits: slice straddling blocks at the
+            # per-split row boundaries (extra `total % n` rows dropped,
+            # matching the reference's equal=True contract)
             total = sum(b.metadata.num_rows for b in bundles)
             per = total // n
-            # rebalance by slicing through repartition
-            ds = mat.repartition(n)
-            mat = ds.materialize()
-            bundles = mat._bundles
+            slicer = ray_tpu.remote(_slice_block_task)
+            out: List[List[RefBundle]] = [[] for _ in range(n)]
+            bi = 0          # current block index
+            boff = 0        # rows of current block already consumed
+            for j in range(n):
+                need = per
+                while need > 0 and bi < len(bundles):
+                    b = bundles[bi]
+                    avail = b.metadata.num_rows - boff
+                    take = min(need, avail)
+                    if take == avail and boff == 0:
+                        out[j].append(b)  # whole block, no slice task
+                    else:
+                        ref = slicer.remote(b.block_ref, boff, take)
+                        meta = BlockMetadata(num_rows=take, size_bytes=max(
+                            1, b.metadata.size_bytes * take
+                            // max(1, b.metadata.num_rows)))
+                        out[j].append(RefBundle(ref, meta))
+                    need -= take
+                    boff += take
+                    if boff >= b.metadata.num_rows:
+                        bi += 1
+                        boff = 0
+            return [MaterializedDataset(s) for s in out]
         splits: List[List[RefBundle]] = [[] for _ in range(n)]
         # round-robin whole blocks (balanced by count)
         order = sorted(range(len(bundles)),

@@ -492,8 +492,6 @@ class ZipOperator(PhysicalOperator):
                 e = min(need_end, re)
                 if s < e:
                     slices.append((ri, s - rs, e - s))
-            right_refs = [self._right[ri].block_ref
-                          for ri, _, _ in slices]
             # compact indices to the refs we pass
             idx_map = {}
             cslices = []
@@ -698,13 +696,32 @@ def plan(logical_dag: L.LogicalOp
     ctx = DataContext.get_current()
     ops: List[PhysicalOperator] = []
 
+    # Count consumers of every logical node: a shared (diamond) subtree must
+    # build exactly ONE physical operator (else nondeterministic shared ops
+    # like unseeded shuffles diverge per branch), and fusion into a shared
+    # upstream is forbidden (it would apply one consumer's stages to all).
+    consumers: Dict[int, int] = {}
+
+    def count(op: L.LogicalOp):
+        for parent in getattr(op, "inputs", ()):
+            consumers[id(parent)] = consumers.get(id(parent), 0) + 1
+            if consumers[id(parent)] == 1:
+                count(parent)
+
+    count(logical_dag)
+    memo: Dict[int, PhysicalOperator] = {}
+
     def register(phys: PhysicalOperator) -> PhysicalOperator:
         if phys not in ops:
             ops.append(phys)
         return phys
 
     def build(op: L.LogicalOp) -> PhysicalOperator:
-        return register(_build(op))
+        if id(op) in memo:
+            return memo[id(op)]
+        phys = register(_build(op))
+        memo[id(op)] = phys
+        return phys
 
     def _build(op: L.LogicalOp) -> PhysicalOperator:
         if isinstance(op, L.InputData):
@@ -719,12 +736,15 @@ def plan(logical_dag: L.LogicalOp
             upstream = build(op.inputs[0])
             stage = _stage_of(op)
             resources = op.resources or None
-            # fuse into upstream Read / Map when compatible
-            if isinstance(upstream, ReadOperator) and not resources:
+            # fuse into upstream Read / Map when compatible — but never
+            # into a node other consumers also read (diamond DAGs)
+            fusable = consumers.get(id(op.inputs[0]), 0) <= 1
+            if fusable and isinstance(upstream, ReadOperator) \
+                    and not resources:
                 upstream._chain.append(stage)
                 upstream.name = f"{upstream.name}->{op.name}"
                 return upstream
-            if isinstance(upstream, MapOperator) and \
+            if fusable and isinstance(upstream, MapOperator) and \
                     upstream._resources == resources:
                 upstream._chain.append(stage)
                 upstream.name = f"{upstream.name}->{op.name}"
@@ -804,9 +824,12 @@ class StreamingExecutor:
         self.wall_s = 0.0
 
     def _submit(self, fn, args, *, num_returns=1, resources=None, name=""):
+        res = dict(self.ctx.task_resources or {})
+        if resources:
+            res.update(resources)  # per-operator demands win
         remote_fn = ray_tpu.remote(fn).options(
             num_returns=num_returns, name=name,
-            resources=self.ctx.task_resources or None,
+            resources=res or None,
             num_cpus=1)
         refs = remote_fn.remote(*args)
         if num_returns == 1:

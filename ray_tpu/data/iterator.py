@@ -27,9 +27,13 @@ def iter_block_batches(block_iter, *, batch_size: Optional[int],
                        local_shuffle_buffer_size: Optional[int] = None,
                        seed: Optional[int] = None):
     """Re-batch a stream of blocks into fixed-size batches."""
+    if local_shuffle_buffer_size:
+        yield from _iter_shuffled_batches(
+            block_iter, batch_size=batch_size, batch_format=batch_format,
+            drop_last=drop_last, buffer_size=local_shuffle_buffer_size,
+            seed=seed)
+        return
     carry = None  # carry-over arrow table smaller than batch_size
-    rng = np.random.RandomState(seed)
-    shuffle_pool: List[Any] = []
 
     def emit(table):
         return BlockAccessor(table).to_batch(batch_format)
@@ -39,9 +43,6 @@ def iter_block_batches(block_iter, *, batch_size: Optional[int],
         if acc.num_rows() == 0:
             continue
         table = acc.to_arrow()
-        if local_shuffle_buffer_size:
-            table = BlockAccessor(table).random_permutation(
-                int(rng.randint(0, 2**31)))
         if carry is not None:
             table = BlockAccessor.concat([carry, table])
             carry = None
@@ -57,6 +58,45 @@ def iter_block_batches(block_iter, *, batch_size: Optional[int],
             carry = table.slice(start)
     if carry is not None and not drop_last:
         yield emit(carry)
+
+
+def _iter_shuffled_batches(block_iter, *, batch_size, batch_format,
+                           drop_last, buffer_size, seed):
+    """Local shuffle: rows pool in a buffer that mixes ACROSS blocks; once
+    the pool holds >= buffer_size + batch_size rows it is permuted and
+    batches are drawn from it (reference: iterator's
+    local_shuffle_buffer_size contract — a bigger buffer means more
+    mixing)."""
+    rng = np.random.RandomState(seed)
+    bs = batch_size or int(buffer_size)
+    buf = None
+
+    def emit(table):
+        return BlockAccessor(table).to_batch(batch_format)
+
+    def permute(table):
+        return BlockAccessor(table).random_permutation(
+            int(rng.randint(0, 2**31)))
+
+    for block in block_iter:
+        acc = BlockAccessor(block)
+        if acc.num_rows() == 0:
+            continue
+        t = acc.to_arrow()
+        buf = t if buf is None else BlockAccessor.concat([buf, t])
+        if buf.num_rows >= buffer_size + bs:
+            buf = permute(buf)
+            while buf.num_rows >= buffer_size + bs:
+                yield emit(buf.slice(0, bs))
+                buf = buf.slice(bs)
+    if buf is not None and buf.num_rows:
+        buf = permute(buf)
+        start = 0
+        while buf.num_rows - start >= bs:
+            yield emit(buf.slice(start, bs))
+            start += bs
+        if start < buf.num_rows and not drop_last:
+            yield emit(buf.slice(start))
 
 
 def prefetch_iter(it: Iterator, depth: int) -> Iterator:
@@ -100,18 +140,20 @@ def iter_jax_batches(batch_iter: Iterator[Dict[str, np.ndarray]], *,
     import jax
 
     def put(batch):
-        def place(x):
+        def place(x, dtype=None):
             arr = np.asarray(x)
-            if dtypes and getattr(x, "dtype", None) is not None:
-                pass
+            if dtype is not None:
+                arr = arr.astype(dtype)
             if sharding is not None:
                 return jax.device_put(arr, sharding)
             return jax.device_put(arr)
 
         if isinstance(batch, dict):
-            out = {k: place(v) for k, v in batch.items()}
+            out = {k: place(v, dtypes.get(k) if dtypes else None)
+                   for k, v in batch.items()}
         else:
-            out = place(batch)
+            out = place(batch, dtypes if not isinstance(dtypes, dict)
+                        else None)
         return out
 
     buf: collections.deque = collections.deque()

@@ -1,0 +1,8 @@
+"""Native (C++) runtime components.
+
+Built lazily with g++ on first use; cached under ``_build/``.  Each
+component degrades gracefully to a pure-Python fallback when the toolchain
+is unavailable (CI images always have g++).
+"""
+
+from .build import build_extension, load_library  # noqa: F401

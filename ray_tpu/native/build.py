@@ -1,0 +1,60 @@
+"""Lazy g++ build + cache for native components.
+
+The reference ships prebuilt native binaries (bazel); we compile on first
+use instead — a few hundred ms once per machine — and cache the .so next to
+the sources keyed by source mtime, so edits rebuild automatically.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+logger = logging.getLogger(__name__)
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_SRC_DIR, "_build")
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+def build_extension(name: str, sources: list, extra_flags: list = ()) -> str:
+    """Compile sources into _build/lib<name>.so; returns the path.
+
+    Rebuilds when any source is newer than the cached .so.  Raises
+    RuntimeError if the compiler fails.
+    """
+    out = os.path.join(_BUILD_DIR, f"lib{name}.so")
+    srcs = [os.path.join(_SRC_DIR, s) for s in sources]
+    if os.path.exists(out):
+        so_mtime = os.path.getmtime(out)
+        if all(os.path.getmtime(s) <= so_mtime for s in srcs):
+            return out
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = out + ".tmp.%d" % os.getpid()
+    cmd = ["g++", "-O2", "-g", "-std=c++17", "-shared", "-fPIC",
+           "-o", tmp, *srcs, "-lpthread", *extra_flags]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise RuntimeError(f"native build failed to run: {e}") from e
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build of {name} failed:\n{proc.stderr[-4000:]}")
+    os.replace(tmp, out)  # atomic: concurrent builders race benignly
+    return out
+
+
+def load_library(name: str, sources: list) -> ctypes.CDLL:
+    """Build (if needed) and dlopen a native component; cached per-process."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        path = build_extension(name, sources)
+        lib = ctypes.CDLL(path)
+        _cache[name] = lib
+        return lib

@@ -233,3 +233,74 @@ def test_stats_populated(ray_cluster):
     ds = rd.range(10, override_num_blocks=2)
     ds.count()
     assert "Read" in ds.stats()
+
+
+def test_split_equal_rows(ray_cluster):
+    # 10 rows into 3 equal splits: exactly 3/3/3, remainder dropped
+    ds = rd.range(10, override_num_blocks=4)
+    parts = ds.split(3, equal=True)
+    counts = [p.count() for p in parts]
+    assert counts == [3, 3, 3]
+    seen = sorted(r["id"] for p in parts for r in p.take_all())
+    assert len(seen) == 9 and len(set(seen)) == 9
+
+
+def test_local_shuffle_mixes_across_blocks(ray_cluster):
+    # rows must mix across block boundaries with a big buffer
+    ds = rd.range(64, override_num_blocks=8)  # blocks of 8
+    batches = list(ds.iter_batches(batch_size=16, batch_format="numpy",
+                                   local_shuffle_buffer_size=64,
+                                   local_shuffle_seed=0))
+    ids = np.concatenate([b["id"] for b in batches])
+    assert sorted(ids.tolist()) == list(range(64))
+    crossing = sum(1 for b in batches
+                   if len({int(i) // 8 for i in b["id"]}) > 1)
+    assert crossing > 0  # at least one batch spans source blocks
+
+
+def test_iter_jax_batches_dtypes(ray_cluster):
+    import jax.numpy as jnp
+
+    ds = rd.range(16, override_num_blocks=1)
+    batches = list(ds.iter_jax_batches(batch_size=8,
+                                       dtypes={"id": jnp.bfloat16}))
+    assert batches[0]["id"].dtype == jnp.bfloat16
+
+
+def test_diamond_dag_consistent(ray_cluster):
+    # a shared shuffled subtree must execute once: zip(ds, ds.map) pairs rows
+    base = rd.range(32, override_num_blocks=4).random_shuffle()
+    left = base
+    right = base.map(lambda r: {"id2": r["id"] * 10})
+    rows = left.zip(right).take_all()
+    assert all(r["id"] * 10 == r["id2"] for r in rows)
+
+
+def test_map_batches_resources_reach_scheduler(ray_cluster, monkeypatch):
+    # per-op resource demands must reach the task submission options
+    from ray_tpu.data.execution import MapOperator, StreamingExecutor, plan
+
+    ds = rd.range(4, override_num_blocks=1).map_batches(
+        lambda b: b, resources={"TPU": 1})
+    _, ops = plan(ds._dag)
+    mops = [o for o in ops if isinstance(o, MapOperator)]
+    assert mops and mops[0]._resources == {"TPU": 1}
+
+    # and _submit merges them over the context defaults
+    seen = {}
+
+    class _FakeRemote:
+        def options(self, **kw):
+            seen.update(kw)
+            return self
+
+        def remote(self, *a):
+            return "ref"
+
+    import ray_tpu.data.execution as ex
+
+    se = StreamingExecutor.__new__(StreamingExecutor)
+    se.ctx = type("Ctx", (), {"task_resources": {"host": 1}})()
+    monkeypatch.setattr(ex.ray_tpu, "remote", lambda fn: _FakeRemote())
+    se._submit(lambda: None, (), resources={"TPU": 1})
+    assert seen["resources"] == {"host": 1, "TPU": 1}
