@@ -13,6 +13,8 @@ reference's concurrency groups.
 from __future__ import annotations
 
 import argparse
+import asyncio
+import inspect
 import logging
 import os
 import queue
@@ -29,6 +31,8 @@ from .core import CoreWorker, ObjectRef
 from .protocol import Deferred, ServerConn
 
 logger = logging.getLogger(__name__)
+
+_ASYNC_INFLIGHT = object()  # sentinel: reply will come from the aio loop
 
 
 class WorkerMain:
@@ -50,6 +54,13 @@ class WorkerMain:
         self.actor_instance = None
         self.actor_concurrency = 1
         self._stop = threading.Event()
+        # Async actors (reference: core_worker fiber.h / async actor event
+        # loop): methods returning coroutines run on this loop; the exec
+        # thread does NOT block on them — the Deferred resolves from the
+        # loop when the coroutine finishes, so one actor can interleave
+        # many in-flight async calls.
+        self._aio_loop: asyncio.AbstractEventLoop = None
+        self._aio_lock = threading.Lock()
 
         # raylet client push handling (shutdown) + death of raylet kills us
         self.core.raylet._on_push = self._on_raylet_push
@@ -91,6 +102,13 @@ class WorkerMain:
             env = (spec.get("runtime_env") or {}).get("env_vars") or {}
             os.environ.update(env)
             self.actor_instance = cls(*args, **kwargs)
+            # async actors (any coroutine method) run ALL their methods on
+            # the event-loop thread — the reference's async-actor model:
+            # cooperative concurrency on one thread, sync methods block the
+            # loop.  This keeps actor state single-threaded.
+            self.actor_is_async = any(
+                inspect.iscoroutinefunction(getattr(cls, m, None))
+                for m in dir(cls) if not m.startswith("__"))
             self.actor_concurrency = spec.get("max_concurrency", 1) or 1
             if self.actor_concurrency > 1:
                 for i in range(self.actor_concurrency - 1):
@@ -142,10 +160,63 @@ class WorkerMain:
                 kind, spec, d = self.task_queue.get(timeout=0.2)
             except queue.Empty:
                 continue
-            reply = self._execute(kind, spec)
-            d.resolve(reply)
+            reply = self._execute(kind, spec, d)
+            if reply is not _ASYNC_INFLIGHT:
+                d.resolve(reply)
 
-    def _execute(self, kind: str, spec: TaskSpec):
+    def _get_aio_loop(self) -> asyncio.AbstractEventLoop:
+        with self._aio_lock:
+            if self._aio_loop is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                loop = asyncio.new_event_loop()
+
+                def _mark_executing():
+                    # blocking get() from the loop thread (or from
+                    # run_in_executor workers) must still notify the raylet
+                    # it is blocked, else CPU slots are never lent back
+                    self.core._executing.active = True
+
+                loop.set_default_executor(ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix="actor-aio-exec",
+                    initializer=_mark_executing))
+
+                def _loop_main():
+                    _mark_executing()
+                    asyncio.set_event_loop(loop)
+                    loop.run_forever()
+
+                t = threading.Thread(target=_loop_main, name="actor-aio",
+                                     daemon=True)
+                t.start()
+                self._aio_loop = loop
+            return self._aio_loop
+
+    def _store_reply(self, spec: TaskSpec, out, t0: float):
+        if spec.num_returns > 1:
+            values = list(out)
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"task {spec.function_name} declared num_returns="
+                    f"{spec.num_returns} but returned {len(values)} values")
+        else:
+            values = [out]
+        reply = self.core.store_task_results(spec, values)
+        reply["exec_ms"] = (time.monotonic() - t0) * 1000.0
+        return reply
+
+    def _error_reply(self, e: BaseException, spec: TaskSpec):
+        tb = traceback.format_exc()
+        try:
+            err_blob = serialization.dumps_inline(
+                TaskError(e, tb, spec.function_name))
+        except BaseException:
+            err_blob = serialization.dumps_inline(
+                TaskError(RuntimeError(f"{type(e).__name__}: {e}"), tb,
+                          spec.function_name))
+        return {"status": "error", "error": err_blob}
+
+    def _execute(self, kind: str, spec: TaskSpec, d: Deferred = None):
         self.core._executing.active = True
         t0 = time.monotonic()
         try:
@@ -158,31 +229,47 @@ class WorkerMain:
                 if self.actor_instance is None:
                     raise common.ActorDiedError("actor instance not initialized")
                 fn = getattr(self.actor_instance, spec.function_name)
+                if getattr(self, "actor_is_async", False):
+                    # async actor: invoke on the event loop (even sync
+                    # methods — they block the loop, the reference's
+                    # semantics) so actor state stays single-threaded; the
+                    # Deferred resolves from the loop and this exec thread
+                    # moves on to the next queued task.
+                    args, kwargs = self.core.resolve_args(spec)
+
+                    async def _finish(spec=spec, t0=t0, d=d):
+                        try:
+                            out = fn(*args, **kwargs)
+                            if inspect.iscoroutine(out):
+                                out = await out
+                            reply = self._store_reply(spec, out, t0)
+                        except BaseException as e:
+                            reply = self._error_reply(e, spec)
+                        d.resolve(reply)
+
+                    asyncio.run_coroutine_threadsafe(_finish(),
+                                                     self._get_aio_loop())
+                    return _ASYNC_INFLIGHT
             else:
                 fn = self.core.get_function(spec.function_id)
             args, kwargs = self.core.resolve_args(spec)
             out = fn(*args, **kwargs)
-            if spec.num_returns > 1:
-                values = list(out)
-                if len(values) != spec.num_returns:
-                    raise ValueError(
-                        f"task {spec.function_name} declared num_returns="
-                        f"{spec.num_returns} but returned {len(values)} values")
-            else:
-                values = [out]
-            reply = self.core.store_task_results(spec, values)
-            reply["exec_ms"] = (time.monotonic() - t0) * 1000.0
-            return reply
+            if inspect.iscoroutine(out):
+                # async function task: run to completion on the loop
+                async def _finish(coro=out, spec=spec, t0=t0, d=d):
+                    try:
+                        value = await coro
+                        reply = self._store_reply(spec, value, t0)
+                    except BaseException as e:
+                        reply = self._error_reply(e, spec)
+                    d.resolve(reply)
+
+                asyncio.run_coroutine_threadsafe(_finish(),
+                                                 self._get_aio_loop())
+                return _ASYNC_INFLIGHT
+            return self._store_reply(spec, out, t0)
         except BaseException as e:
-            tb = traceback.format_exc()
-            try:
-                err_blob = serialization.dumps_inline(
-                    TaskError(e, tb, spec.function_name))
-            except BaseException:
-                err_blob = serialization.dumps_inline(
-                    TaskError(RuntimeError(f"{type(e).__name__}: {e}"), tb,
-                              spec.function_name))
-            return {"status": "error", "error": err_blob}
+            return self._error_reply(e, spec)
         finally:
             self.core._executing.active = False
 

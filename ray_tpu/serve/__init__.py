@@ -1,0 +1,25 @@
+"""ray_tpu.serve: online model serving.
+
+Reference: python/ray/serve/ (73k LoC) — controller + proxy + replica
+actors, p2c routing, autoscaling, batching, multiplexing, composition via
+DeploymentHandle.  TPU-native angle: replicas hold *compiled* jax programs;
+@serve.batch turns concurrent requests into MXU-shaped batches; multiplexed
+replicas LRU-swap model weights in HBM.
+"""
+
+from ._common import AutoscalingConfig
+from ._deployment import Application, Deployment, deployment
+from ._handle import DeploymentHandle, DeploymentResponse
+from ._proxy import Request, Response
+from .api import (delete, get_app_handle, get_deployment_handle, run,
+                  shutdown, start, status)
+from .batching import batch
+from .multiplex import get_multiplexed_model_id, multiplexed
+
+__all__ = [
+    "Application", "AutoscalingConfig", "Deployment", "DeploymentHandle",
+    "DeploymentResponse", "Request", "Response", "batch", "delete",
+    "deployment", "get_app_handle", "get_deployment_handle",
+    "get_multiplexed_model_id", "multiplexed", "run", "shutdown", "start",
+    "status",
+]

@@ -1,0 +1,69 @@
+"""Shared Serve dataclasses (reference: python/ray/serve/schema.py,
+serve/config.py AutoscalingConfig/DeploymentConfig)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+DEFAULT_ROUTE_PREFIX = "/"
+
+# replica states (reference: serve/_private/common.py ReplicaState)
+STARTING = "STARTING"
+RUNNING = "RUNNING"
+STOPPING = "STOPPING"
+
+# app states (reference: ApplicationStatus)
+DEPLOYING = "DEPLOYING"
+APP_RUNNING = "RUNNING"
+DEPLOY_FAILED = "DEPLOY_FAILED"
+DELETING = "DELETING"
+
+
+@dataclass
+class AutoscalingConfig:
+    """reference: serve/config.py AutoscalingConfig."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 30.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas,
+                "target_ongoing_requests": self.target_ongoing_requests,
+                "upscale_delay_s": self.upscale_delay_s,
+                "downscale_delay_s": self.downscale_delay_s}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "AutoscalingConfig":
+        return AutoscalingConfig(**d)
+
+
+@dataclass
+class ReplicaStatus:
+    replica_id: str
+    state: str
+    ongoing: int = 0
+
+
+@dataclass
+class DeploymentStatus:
+    name: str
+    status: str
+    target_num_replicas: int
+    replicas: List[ReplicaStatus] = field(default_factory=list)
+    message: str = ""
+
+
+@dataclass
+class ApplicationStatus:
+    name: str
+    status: str
+    route_prefix: Optional[str]
+    deployments: Dict[str, DeploymentStatus] = field(default_factory=dict)
+    message: str = ""
+    ingress: str = ""

@@ -1,0 +1,434 @@
+"""ServeController: the serve control-plane actor.
+
+Reference: python/ray/serve/_private/controller.py:84 ServeController and
+deployment_state.py:1245 DeploymentState — a singleton actor holding target
+state (apps -> deployments -> target replica counts) and a reconcile loop
+that starts/stops replica actors, health-checks them, autoscales from
+replica queue metrics, and serves the routing table to proxies/handles.
+
+Config fan-out is pull-based: proxies and handles poll
+``get_routing_table(version)`` / ``get_replica_table(...)`` cheaply and
+re-pull on version bumps (the role LongPollHost plays in the reference,
+long_poll.py:177).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+from ._common import (APP_RUNNING, DEPLOY_FAILED, DEPLOYING, RUNNING,
+                      STARTING, ApplicationStatus, AutoscalingConfig,
+                      DeploymentStatus, ReplicaStatus)
+from ._replica import Replica
+
+logger = logging.getLogger(__name__)
+
+RECONCILE_PERIOD_S = 0.25
+
+
+class _ReplicaState:
+    def __init__(self, replica_id: str, handle):
+        self.replica_id = replica_id
+        self.handle = handle
+        self.state = STARTING
+        self.ready_ref = None
+        self.ongoing = 0
+        self.model_ids: List[str] = []
+        self.last_health_ts = time.time()
+        self.health_ref = None
+        self.metrics_ref = None
+
+
+class _DeploymentState:
+    def __init__(self, app_name: str, spec: Dict[str, Any]):
+        self.app_name = app_name
+        self.spec = spec  # serialized deployment info
+        self.target_num_replicas = spec["num_replicas"]
+        self.replicas: Dict[str, _ReplicaState] = {}
+        self.next_replica_no = 0
+        self.autoscaling = (AutoscalingConfig.from_dict(
+            spec["autoscaling_config"]) if spec.get("autoscaling_config")
+            else None)
+        self.last_scale_up = 0.0
+        self.last_scale_down = 0.0
+        self.message = ""
+
+    @property
+    def name(self) -> str:
+        return self.spec["name"]
+
+
+class ServeController:
+    def __init__(self, http_host: str = "127.0.0.1", http_port: int = 8000):
+        self._apps: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.RLock()
+        self._routing_version = 0
+        self._replica_version = 0
+        self._http_host = http_host
+        self._http_port = http_port
+        self._proxy = None
+        self._shutdown = False
+        self._reconciler = threading.Thread(target=self._reconcile_loop,
+                                            name="serve-reconcile",
+                                            daemon=True)
+        self._reconciler.start()
+
+    # -- app deploy/delete --------------------------------------------------
+
+    def deploy_app(self, name: str, route_prefix: Optional[str],
+                   deployment_specs: List[Dict[str, Any]],
+                   ingress_name: str) -> bool:
+        with self._lock:
+            old = self._apps.get(name)
+            deployments: Dict[str, _DeploymentState] = {}
+            for spec in deployment_specs:
+                ds = _DeploymentState(name, spec)
+                if old and spec["name"] in old["deployments"]:
+                    prev = old["deployments"][spec["name"]]
+                    if (prev.spec["callable_blob"] == spec["callable_blob"]
+                            and prev.spec["init_args_blob"]
+                            == spec["init_args_blob"]):
+                        # same code: keep live replicas, adopt new target
+                        ds.replicas = prev.replicas
+                        ds.next_replica_no = prev.next_replica_no
+                        if spec.get("user_config") is not None and \
+                                spec.get("user_config") != prev.spec.get(
+                                    "user_config"):
+                            for r in ds.replicas.values():
+                                try:
+                                    r.handle.reconfigure.remote(
+                                        spec["user_config"])
+                                except Exception:
+                                    pass
+                    else:
+                        self._stop_replicas(prev)
+                deployments[spec["name"]] = ds
+            if old:
+                for dname, prev in old["deployments"].items():
+                    if dname not in deployments:
+                        self._stop_replicas(prev)
+            self._apps[name] = {
+                "deployments": deployments,
+                "route_prefix": route_prefix,
+                "ingress": ingress_name,
+                "status": DEPLOYING,
+                "message": "",
+            }
+            self._routing_version += 1
+            self._replica_version += 1
+        return True
+
+    def delete_app(self, name: str, drain_s: float = 2.0) -> bool:
+        with self._lock:
+            app = self._apps.pop(name, None)
+            if app is None:
+                return False
+            states = list(app["deployments"].values())
+            self._routing_version += 1
+            self._replica_version += 1
+        # drain + kill SYNCHRONOUSLY: delete/shutdown must not return while
+        # replica actors are still alive (a killed controller would leak
+        # them — its drain threads die with it)
+        victims = []
+        for ds in states:
+            with self._lock:
+                vs = list(ds.replicas.values())
+                ds.replicas.clear()
+            victims.extend(vs)
+        refs = []
+        for r in victims:
+            try:
+                refs.append(r.handle.prepare_shutdown.remote(drain_s))
+            except Exception:
+                pass
+        try:
+            ray_tpu.wait(refs, num_returns=len(refs), timeout=drain_s + 2.0)
+        except Exception:
+            pass
+        for r in victims:
+            try:
+                ray_tpu.kill(r.handle)
+            except Exception:
+                pass
+        return True
+
+    def shutdown(self) -> bool:
+        with self._lock:
+            self._shutdown = True  # stop reconcile from respawning
+            names = list(self._apps)
+        for name in names:
+            self.delete_app(name, drain_s=0.5)
+        return True
+
+    # -- read API (proxies / handles / status) ------------------------------
+
+    def get_routing_table(self) -> Dict[str, Any]:
+        with self._lock:
+            routes = {}
+            for app_name, app in self._apps.items():
+                if app["route_prefix"]:
+                    routes[app["route_prefix"]] = {
+                        "app": app_name, "deployment": app["ingress"]}
+            return {"version": self._routing_version, "routes": routes}
+
+    def get_replica_table(self, app_name: str,
+                          deployment_name: str) -> Dict[str, Any]:
+        with self._lock:
+            app = self._apps.get(app_name)
+            if app is None:
+                return {"version": self._replica_version, "replicas": [],
+                        "max_ongoing_requests": 100}
+            ds = app["deployments"].get(deployment_name)
+            if ds is None:
+                return {"version": self._replica_version, "replicas": [],
+                        "max_ongoing_requests": 100}
+            return {
+                "version": self._replica_version,
+                "replicas": [
+                    {"replica_id": r.replica_id, "handle": r.handle,
+                     "model_ids": list(r.model_ids)}
+                    for r in ds.replicas.values() if r.state == RUNNING],
+                "max_ongoing_requests": ds.spec.get(
+                    "max_ongoing_requests", 100),
+            }
+
+    def get_replica_version(self) -> int:
+        return self._replica_version
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {}
+            for app_name, app in self._apps.items():
+                deps = {}
+                for dname, ds in app["deployments"].items():
+                    deps[dname] = DeploymentStatus(
+                        name=dname,
+                        status="HEALTHY" if all(
+                            r.state == RUNNING
+                            for r in ds.replicas.values())
+                        and len(ds.replicas) >= ds.target_num_replicas
+                        else "UPDATING",
+                        target_num_replicas=ds.target_num_replicas,
+                        replicas=[ReplicaStatus(r.replica_id, r.state,
+                                                r.ongoing)
+                                  for r in ds.replicas.values()],
+                        message=ds.message)
+                out[app_name] = ApplicationStatus(
+                    name=app_name, status=app["status"],
+                    route_prefix=app["route_prefix"], deployments=deps,
+                    message=app["message"], ingress=app["ingress"])
+            return out
+
+    def get_http_config(self):
+        return {"host": self._http_host, "port": self._http_port}
+
+    def ensure_proxy(self) -> Any:
+        """Start the HTTP proxy actor on demand; returns (host, port)."""
+        with self._lock:
+            if self._proxy is None:
+                from ._proxy import HTTPProxy
+
+                self._proxy = ray_tpu.remote(HTTPProxy).options(
+                    name="SERVE_PROXY", max_concurrency=8,
+                    num_cpus=0).remote(self._http_host, self._http_port)
+            proxy = self._proxy
+        return ray_tpu.get(proxy.ready.remote(), timeout=30.0)
+
+    # -- reconcile loop -----------------------------------------------------
+
+    def _reconcile_loop(self):
+        while not self._shutdown:
+            try:
+                self._reconcile_once()
+            except Exception:
+                logger.error("serve reconcile error:\n%s",
+                             traceback.format_exc())
+            time.sleep(RECONCILE_PERIOD_S)
+
+    def _reconcile_once(self):
+        with self._lock:
+            apps = list(self._apps.items())
+        for app_name, app in apps:
+            all_ready = True
+            failed_msg = None
+            for ds in list(app["deployments"].values()):
+                with self._lock:
+                    # a concurrent redeploy may have replaced this
+                    # _DeploymentState — reconciling the orphan would leak
+                    # replicas running stale code
+                    live = self._apps.get(app_name, {}).get(
+                        "deployments", {}).get(ds.name)
+                    if live is not ds:
+                        all_ready = False
+                        continue
+                    try:
+                        self._reconcile_deployment(ds)
+                    except _DeployFailed as e:
+                        failed_msg = str(e)
+                        all_ready = False
+                        continue
+                    running = sum(1 for r in ds.replicas.values()
+                                  if r.state == RUNNING)
+                    if running < ds.target_num_replicas:
+                        all_ready = False
+            with self._lock:
+                if app_name in self._apps:
+                    if failed_msg:
+                        self._apps[app_name]["status"] = DEPLOY_FAILED
+                        self._apps[app_name]["message"] = failed_msg
+                    elif all_ready:
+                        self._apps[app_name]["status"] = APP_RUNNING
+
+    def _reconcile_deployment(self, ds: _DeploymentState):
+        # caller holds self._lock (RLock): replica-map mutations are never
+        # concurrent with get_replica_table/status readers
+        self._poll_replica_futures(ds)
+        self._autoscale(ds)
+        running_or_starting = [r for r in ds.replicas.values()
+                               if r.state in (STARTING, RUNNING)]
+        # scale up
+        while len(running_or_starting) < ds.target_num_replicas:
+            r = self._start_replica(ds)
+            running_or_starting.append(r)
+        # scale down (prefer draining STARTING last-in first)
+        excess = len(running_or_starting) - ds.target_num_replicas
+        if excess > 0:
+            victims = sorted(running_or_starting,
+                             key=lambda r: (r.state == RUNNING, -r.ongoing))
+            self._stop_replica_set(ds, victims[:excess])
+
+    def _poll_replica_futures(self, ds: _DeploymentState):
+        changed = False
+        for r in list(ds.replicas.values()):
+            if r.state == STARTING and r.ready_ref is not None:
+                done, _ = ray_tpu.wait([r.ready_ref], num_returns=1,
+                                       timeout=0)
+                if done:
+                    try:
+                        ray_tpu.get(done[0])
+                        r.state = RUNNING
+                        r.ready_ref = None
+                        changed = True
+                    except Exception as e:
+                        ds.message = f"replica failed to start: {e}"
+                        del ds.replicas[r.replica_id]
+                        changed = True
+                        raise _DeployFailed(ds.message)
+            elif r.state == RUNNING:
+                # harvest metrics probe
+                if r.metrics_ref is not None:
+                    done, _ = ray_tpu.wait([r.metrics_ref], num_returns=1,
+                                           timeout=0)
+                    if done:
+                        try:
+                            m = ray_tpu.get(done[0])
+                            r.ongoing = m.get("ongoing", 0)
+                            new_models = m.get("model_ids", [])
+                            if new_models != r.model_ids:
+                                r.model_ids = new_models
+                                changed = True
+                            r.last_health_ts = time.time()
+                        except Exception:
+                            # replica died: drop + let scale-up replace it
+                            logger.warning("replica %s died; replacing",
+                                           r.replica_id)
+                            del ds.replicas[r.replica_id]
+                            changed = True
+                            continue
+                        r.metrics_ref = None
+                if r.metrics_ref is None:
+                    r.metrics_ref = r.handle.get_metrics.remote()
+        if changed:
+            with self._lock:
+                self._replica_version += 1
+
+    def _start_replica(self, ds: _DeploymentState) -> _ReplicaState:
+        rid = f"{ds.app_name}#{ds.name}#{ds.next_replica_no}"
+        ds.next_replica_no += 1
+        opts = dict(ds.spec.get("ray_actor_options") or {})
+        opts.setdefault("num_cpus", 0)
+        opts["max_concurrency"] = max(
+            2, min(8, ds.spec.get("max_ongoing_requests", 100)))
+        actor = ray_tpu.remote(Replica).options(**opts).remote(
+            ds.app_name, ds.name, rid,
+            ds.spec["callable_blob"], ds.spec["init_args_blob"],
+            ds.spec.get("user_config"), ds.spec.get("is_function", False))
+        r = _ReplicaState(rid, actor)
+        r.ready_ref = actor.check_health.remote()
+        ds.replicas[rid] = r
+        return r
+
+    def _stop_replica_set(self, ds: _DeploymentState,
+                          victims: List[_ReplicaState],
+                          drain_s: float = 5.0):
+        if not victims:
+            return
+        refs, handles = [], []
+        for r in victims:
+            ds.replicas.pop(r.replica_id, None)
+            handles.append(r.handle)
+            try:
+                refs.append(r.handle.prepare_shutdown.remote(drain_s))
+            except Exception:
+                pass
+        with self._lock:
+            self._replica_version += 1
+
+        def _drain_then_kill():
+            # drain off-thread so neither reconcile nor deploy_app blocks
+            try:
+                ray_tpu.wait(refs, num_returns=len(refs),
+                             timeout=drain_s + 2.0)
+            except Exception:
+                pass
+            for h in handles:
+                try:
+                    ray_tpu.kill(h)
+                except Exception:
+                    pass
+
+        threading.Thread(target=_drain_then_kill, daemon=True).start()
+
+    def _stop_replicas(self, ds: _DeploymentState):
+        self._stop_replica_set(ds, list(ds.replicas.values()))
+
+    # -- autoscaling --------------------------------------------------------
+
+    def _autoscale(self, ds: _DeploymentState):
+        cfg = ds.autoscaling
+        if cfg is None:
+            return
+        running = [r for r in ds.replicas.values() if r.state == RUNNING]
+        if not running:
+            return
+        total_ongoing = sum(r.ongoing for r in running)
+        desired = math.ceil(total_ongoing
+                            / max(cfg.target_ongoing_requests, 1e-9))
+        desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+        now = time.time()
+        if desired > ds.target_num_replicas:
+            if now - ds.last_scale_up >= cfg.upscale_delay_s:
+                logger.info("autoscale %s: %d -> %d (ongoing=%d)", ds.name,
+                            ds.target_num_replicas, desired, total_ongoing)
+                ds.target_num_replicas = desired
+                ds.last_scale_up = now
+        elif desired < ds.target_num_replicas:
+            if now - ds.last_scale_down >= cfg.downscale_delay_s:
+                logger.info("autoscale %s: %d -> %d (ongoing=%d)", ds.name,
+                            ds.target_num_replicas, desired, total_ongoing)
+                ds.target_num_replicas = desired
+                ds.last_scale_down = now
+        else:
+            ds.last_scale_up = now
+            ds.last_scale_down = now
+
+
+class _DeployFailed(RuntimeError):
+    pass

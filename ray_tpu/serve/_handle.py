@@ -1,0 +1,92 @@
+"""DeploymentHandle: typed Python calls into a deployment.
+
+Reference: python/ray/serve/handle.py DeploymentHandle/DeploymentResponse —
+``handle.remote(*a)`` routes through the same p2c router as HTTP and
+returns a `DeploymentResponse` future; handles pickle by (app, deployment)
+name so they can be shipped into other replicas for model composition, and
+`await response` works inside async replicas without blocking their loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+from ._router import get_router
+
+
+class DeploymentResponse:
+    def __init__(self, ref, done_cb=None):
+        self._ref = ref
+        self._done_cb = done_cb
+        self._result = None
+        self._have_result = False
+
+    def result(self, timeout_s: Optional[float] = 300.0):
+        if not self._have_result:
+            try:
+                self._result = ray_tpu.get(self._ref, timeout=timeout_s)
+            finally:
+                self._fire_done()
+            self._have_result = True
+        return self._result
+
+    def _to_object_ref(self):
+        return self._ref
+
+    def _fire_done(self):
+        if self._done_cb is not None:
+            cb, self._done_cb = self._done_cb, None
+            cb()
+
+    def __await__(self):
+        loop = asyncio.get_event_loop()
+        fut = loop.run_in_executor(None, self.result)
+        return fut.__await__()
+
+    def __del__(self):
+        # dropped without .result(): still release the router's inflight slot
+        self._fire_done()
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str,
+                 method_name: Optional[str] = None,
+                 multiplexed_model_id: Optional[str] = None):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._method_name = method_name
+        self._multiplexed_model_id = multiplexed_model_id
+
+    def options(self, *, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.deployment_name, self.app_name,
+            method_name=method_name or self._method_name,
+            multiplexed_model_id=(multiplexed_model_id
+                                  or self._multiplexed_model_id))
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        router = get_router(self.app_name, self.deployment_name)
+        metadata: Dict[str, Any] = {}
+        if self._multiplexed_model_id:
+            metadata["multiplexed_model_id"] = self._multiplexed_model_id
+        ref, done = router.assign(self._method_name, args, kwargs, metadata)
+        return DeploymentResponse(ref, done)
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self._method_name,
+                 self._multiplexed_model_id))
+
+    def __repr__(self):
+        return (f"DeploymentHandle(app={self.app_name!r}, "
+                f"deployment={self.deployment_name!r})")

@@ -1,0 +1,193 @@
+"""HTTP proxy actor: the cluster's HTTP ingress.
+
+Reference: python/ray/serve/_private/proxy.py (HTTPProxy :779, proxy_request
+:446) — accepts HTTP, matches the longest route prefix from the
+controller's routing table, wraps the request, routes it through the p2c
+router to a replica, and converts the return value to an HTTP response
+(dict/list -> JSON, str -> text, bytes -> raw, Response for full control).
+
+Built on aiohttp (in-image) running inside the actor on a dedicated event
+loop thread; replica calls resolve via an executor so the accept loop never
+blocks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+from ._common import CONTROLLER_NAME
+from ._router import get_router
+
+logger = logging.getLogger(__name__)
+
+_ROUTES_TTL_S = 1.0
+
+
+class Response:
+    """Explicit HTTP response (reference: starlette Response usage)."""
+
+    def __init__(self, body: Any = b"", status: int = 200,
+                 content_type: str = "application/octet-stream",
+                 headers: Optional[Dict[str, str]] = None):
+        self.body = body
+        self.status = status
+        self.content_type = content_type
+        self.headers = dict(headers or {})
+
+
+class Request:
+    """Minimal request facade handed to HTTP ingress callables."""
+
+    def __init__(self, method: str, path: str, route_path: str,
+                 query_params: Dict[str, str], headers: Dict[str, str],
+                 body: bytes):
+        self.method = method
+        self.path = path            # full path
+        self.route_path = route_path  # path with route prefix stripped
+        self.query_params = query_params
+        self.headers = headers
+        self._body = body
+
+    async def body(self) -> bytes:
+        return self._body
+
+    async def json(self) -> Any:
+        return json.loads(self._body or b"null")
+
+    def __repr__(self):
+        return f"Request({self.method} {self.path})"
+
+
+class HTTPProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._host = host
+        self._port = port
+        self._actual_port = None
+        self._routes: Dict[str, Dict[str, str]] = {}
+        self._routes_ts = 0.0
+        self._controller = None
+        self._started = threading.Event()
+        self._start_err: Optional[str] = None
+        self._thread = threading.Thread(target=self._serve_thread,
+                                        name="serve-http", daemon=True)
+        self._thread.start()
+
+    # -- actor API ----------------------------------------------------------
+
+    def ready(self):
+        if not self._started.wait(timeout=15.0):
+            raise RuntimeError("http proxy failed to start (timeout)")
+        if self._start_err:
+            raise RuntimeError(f"http proxy failed: {self._start_err}")
+        return (self._host, self._actual_port)
+
+    # -- server -------------------------------------------------------------
+
+    def _serve_thread(self):
+        try:
+            from aiohttp import web
+
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            app = web.Application(client_max_size=256 * 1024 * 1024)
+            app.router.add_route("*", "/{tail:.*}", self._handle)
+            runner = web.AppRunner(app, access_log=None)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, self._host, self._port)
+            loop.run_until_complete(site.start())
+            self._actual_port = site._server.sockets[0].getsockname()[1]
+            self._started.set()
+            loop.run_forever()
+        except BaseException as e:
+            self._start_err = f"{type(e).__name__}: {e}"
+            self._started.set()
+
+    def _refresh_routes(self):
+        """Blocking controller RPC — only ever called via run_in_executor
+        so the aiohttp accept loop never stalls on it."""
+        try:
+            if self._controller is None:
+                self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            table = ray_tpu.get(
+                self._controller.get_routing_table.remote(),
+                timeout=10.0)
+            self._routes = table["routes"]
+            self._routes_ts = time.monotonic()
+        except Exception:
+            logger.exception("route table refresh failed")
+
+    async def _route_for(self, path: str) -> Optional[Dict[str, str]]:
+        if time.monotonic() - self._routes_ts > _ROUTES_TTL_S:
+            await asyncio.get_event_loop().run_in_executor(
+                None, self._refresh_routes)
+        best = None
+        for prefix, target in self._routes.items():
+            if path == prefix or path.startswith(
+                    prefix if prefix.endswith("/") else prefix + "/") \
+                    or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, target)
+        return best and {"prefix": best[0], **best[1]}
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        path = request.path
+        if path == "/-/healthz":
+            return web.Response(text="ok")
+        if path == "/-/routes":
+            self._routes_ts = 0.0
+            await self._route_for(path)
+            return web.json_response(self._routes)
+        target = await self._route_for(path)
+        if target is None:
+            return web.Response(status=404,
+                                text=f"no serve app matches {path!r}")
+        prefix = target["prefix"]
+        route_path = path[len(prefix):] if prefix != "/" else path
+        body = await request.read()
+        req = Request(request.method, path, route_path or "/",
+                      dict(request.query), dict(request.headers), body)
+        router = get_router(target["app"], target["deployment"])
+        loop = asyncio.get_event_loop()
+
+        def call():
+            ref, done = router.assign(None, (req,), {}, {})
+            try:
+                return ray_tpu.get(ref, timeout=300.0)
+            finally:
+                done()
+
+        try:
+            out = await loop.run_in_executor(None, call)
+        except Exception as e:
+            logger.exception("request to %s failed", path)
+            return web.Response(status=500,
+                               text=f"{type(e).__name__}: {e}")
+        return self._to_http(out)
+
+    def _to_http(self, out: Any):
+        from aiohttp import web
+
+        if isinstance(out, Response):
+            body = out.body
+            if isinstance(body, str):
+                body = body.encode()
+            elif not isinstance(body, (bytes, bytearray)):
+                body = json.dumps(body, default=str).encode()
+            return web.Response(body=body, status=out.status,
+                                content_type=out.content_type,
+                                headers=out.headers)
+        if isinstance(out, (bytes, bytearray)):
+            return web.Response(body=bytes(out))
+        if isinstance(out, str):
+            return web.Response(text=out)
+        return web.json_response(out, dumps=lambda o: json.dumps(
+            o, default=str))

@@ -1,0 +1,112 @@
+"""Replica actor: hosts one copy of a deployment's callable.
+
+Reference: python/ray/serve/_private/replica.py — the replica wraps the
+user callable, tracks ongoing-request counts (the router's routing signal
+and the controller's autoscaling signal), runs health checks, and applies
+``reconfigure(user_config)`` without a restart.
+
+Requests run as *async actor tasks*: ``handle_request`` is a coroutine, so
+one replica interleaves up to max_ongoing_requests concurrent calls on its
+event loop — the TPU-relevant case being a replica that holds a compiled
+jax program and batches requests into it (see batching.py).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import inspect
+import time
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+# set during request execution; read by serve.get_multiplexed_model_id()
+_request_context: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_request_context", default=None)
+
+
+class _FunctionWrapper:
+    """Adapts a function deployment to the callable-object protocol."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    async def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        if inspect.iscoroutine(out):
+            out = await out
+        return out
+
+
+class Replica:
+    def __init__(self, app_name: str, deployment_name: str, replica_id: str,
+                 callable_blob: bytes, init_args_blob: bytes,
+                 user_config: Optional[Any], is_function: bool):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        self._ongoing = 0
+        self._total = 0
+        func_or_class = cloudpickle.loads(callable_blob)
+        args, kwargs = cloudpickle.loads(init_args_blob)
+        if is_function:
+            self._callable = _FunctionWrapper(func_or_class)
+        else:
+            self._callable = func_or_class(*args, **kwargs)
+        if user_config is not None:
+            self._apply_reconfigure(user_config)
+
+    # -- request path -------------------------------------------------------
+
+    async def handle_request(self, method_name: Optional[str], args, kwargs,
+                             metadata: Optional[Dict[str, Any]] = None):
+        self._ongoing += 1
+        self._total += 1
+        token = _request_context.set(metadata or {})
+        try:
+            target = (self._callable if method_name in (None, "__call__")
+                      and callable(self._callable)
+                      else getattr(self._callable, method_name or "__call__"))
+            out = target(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                out = await out
+            return out
+        finally:
+            _request_context.reset(token)
+            self._ongoing -= 1
+
+    # -- control path ---------------------------------------------------------
+
+    def get_metrics(self) -> Dict[str, Any]:
+        """Queue-length probe (router p2c) + autoscaling stats + loaded
+        multiplexed models (router affinity)."""
+        from .multiplex import loaded_model_ids
+
+        return {"ongoing": self._ongoing, "total": self._total,
+                "model_ids": loaded_model_ids(self._callable),
+                "ts": time.time()}
+
+    def check_health(self) -> bool:
+        fn = getattr(self._callable, "check_health", None)
+        if fn is not None:
+            fn()
+        return True
+
+    def _apply_reconfigure(self, user_config):
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+
+    def reconfigure(self, user_config) -> bool:
+        self._apply_reconfigure(user_config)
+        return True
+
+    async def prepare_shutdown(self, drain_s: float = 5.0) -> bool:
+        """Drain: wait (cooperatively — this replica is an async actor, so
+        in-flight requests keep running) until ongoing hits 0."""
+        import asyncio
+
+        deadline = time.time() + drain_s
+        while self._ongoing > 0 and time.time() < deadline:
+            await asyncio.sleep(0.02)
+        return True

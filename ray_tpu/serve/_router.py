@@ -1,0 +1,117 @@
+"""Router: picks a replica for each request.
+
+Reference: python/ray/serve/_private/router.py:313 Router +
+replica_scheduler/pow_2_scheduler.py:52 PowerOfTwoChoicesReplicaScheduler —
+pick two random candidates, route to the one with the shorter queue.  Queue
+lengths come from the controller's metrics probes (cached replica table)
+plus a local in-flight count per replica, so the hot path makes NO extra
+RPCs.  Multiplexed requests prefer replicas that already hold the model.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+from ._common import CONTROLLER_NAME
+
+_TABLE_TTL_S = 1.0
+
+
+class Router:
+    def __init__(self, app_name: str, deployment_name: str, controller=None):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self._controller = controller
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, Dict[str, Any]] = {}
+        self._max_ongoing = 100
+        self._inflight: Dict[str, int] = {}
+        self._last_refresh = 0.0
+
+    def _get_controller(self):
+        if self._controller is None:
+            self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        return self._controller
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_refresh < _TABLE_TTL_S:
+            return
+        table = ray_tpu.get(
+            self._get_controller().get_replica_table.remote(
+                self.app_name, self.deployment_name), timeout=30.0)
+        with self._lock:
+            self._replicas = {r["replica_id"]: r
+                              for r in table["replicas"]}
+            self._max_ongoing = table.get("max_ongoing_requests", 100)
+            for rid in list(self._inflight):
+                if rid not in self._replicas:
+                    del self._inflight[rid]
+            self._last_refresh = now
+
+    def _pick(self, model_id: Optional[str] = None) -> Dict[str, Any]:
+        deadline = time.monotonic() + 30.0
+        while True:
+            self._refresh()
+            with self._lock:
+                cands = list(self._replicas.values())
+            if cands:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no RUNNING replicas of "
+                    f"{self.app_name}:{self.deployment_name}")
+            time.sleep(0.05)
+            self._last_refresh = 0.0  # force re-pull
+        if model_id is not None:
+            warm = [c for c in cands if model_id in c.get("model_ids", ())]
+            if warm:
+                cands = warm
+        if len(cands) == 1:
+            return cands[0]
+        a, b = random.sample(cands, 2)
+        qa = self._inflight.get(a["replica_id"], 0)
+        qb = self._inflight.get(b["replica_id"], 0)
+        return a if qa <= qb else b
+
+    def assign(self, method_name: Optional[str], args, kwargs,
+               metadata: Optional[Dict[str, Any]] = None):
+        """Submit to a chosen replica; returns (ObjectRef, done_cb)."""
+        model_id = (metadata or {}).get("multiplexed_model_id")
+        replica = self._pick(model_id)
+        rid = replica["replica_id"]
+        with self._lock:
+            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+        ref = replica["handle"].handle_request.remote(
+            method_name, args, kwargs, metadata or {})
+
+        def done():
+            with self._lock:
+                n = self._inflight.get(rid, 1)
+                self._inflight[rid] = max(0, n - 1)
+
+        return ref, done
+
+
+_routers: Dict[Any, Router] = {}
+_routers_lock = threading.Lock()
+
+
+def get_router(app_name: str, deployment_name: str) -> Router:
+    key = (app_name, deployment_name)
+    with _routers_lock:
+        r = _routers.get(key)
+        if r is None:
+            r = Router(app_name, deployment_name)
+            _routers[key] = r
+        return r
+
+
+def reset_routers():
+    with _routers_lock:
+        _routers.clear()

@@ -1,0 +1,117 @@
+"""@serve.batch: transparent request batching inside a replica.
+
+Reference: python/ray/serve/batching.py (692 LoC) — callers invoke the
+wrapped method with a single item and get a single result; the wrapper
+pools concurrent calls into a list, invokes the underlying function once
+per batch, and scatters results.  On TPU replicas this is the mechanism
+that turns concurrent single requests into one MXU-efficient batched
+forward pass of the compiled program.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import inspect
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.batch_wait_timeout_s = batch_wait_timeout_s
+        self.queue: Optional[asyncio.Queue] = None
+        self._flusher: Optional[asyncio.Task] = None
+
+    def _ensure(self):
+        # bound to whichever loop first executes a request
+        if self.queue is None:
+            self.queue = asyncio.Queue()
+            self._flusher = asyncio.get_event_loop().create_task(
+                self._flush_loop())
+
+    async def submit(self, item: Any) -> Any:
+        self._ensure()
+        fut = asyncio.get_event_loop().create_future()
+        self.queue.put_nowait((item, fut))
+        return await fut
+
+    async def _flush_loop(self):
+        while True:
+            item, fut = await self.queue.get()
+            batch = [(item, fut)]
+            deadline = asyncio.get_event_loop().time() \
+                + self.batch_wait_timeout_s
+            while len(batch) < self.max_batch_size:
+                remaining = deadline - asyncio.get_event_loop().time()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(await asyncio.wait_for(
+                        self.queue.get(), timeout=remaining))
+                except asyncio.TimeoutError:
+                    break
+            items = [b[0] for b in batch]
+            futs = [b[1] for b in batch]
+            try:
+                out = self.fn(items)
+                if inspect.iscoroutine(out):
+                    out = await out
+                if not isinstance(out, (list, tuple)) \
+                        or len(out) != len(items):
+                    raise TypeError(
+                        f"@serve.batch function must return a list of "
+                        f"{len(items)} results, got {type(out).__name__}")
+                for f, r in zip(futs, out):
+                    if not f.done():
+                        f.set_result(r)
+            except BaseException as e:
+                for f in futs:
+                    if not f.done():
+                        f.set_exception(e)
+
+
+def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator for (async) methods taking a List of items and returning a
+    List of results; callers pass single items."""
+
+    def wrap(fn):
+        attr = f"__serve_batch_queue_{fn.__name__}"
+
+        if _is_method(fn):
+            @functools.wraps(fn)
+            async def method_wrapper(self, item):
+                q = getattr(self, attr, None)
+                if q is None:
+                    q = _BatchQueue(
+                        lambda items: fn(self, items),
+                        max_batch_size, batch_wait_timeout_s)
+                    setattr(self, attr, q)
+                return await q.submit(item)
+
+            method_wrapper._is_serve_batch = True
+            return method_wrapper
+
+        q_holder: List[Optional[_BatchQueue]] = [None]
+
+        @functools.wraps(fn)
+        async def func_wrapper(item):
+            if q_holder[0] is None:
+                q_holder[0] = _BatchQueue(fn, max_batch_size,
+                                          batch_wait_timeout_s)
+            return await q_holder[0].submit(item)
+
+        func_wrapper._is_serve_batch = True
+        return func_wrapper
+
+    if _func is not None:
+        return wrap(_func)
+    return wrap
+
+
+def _is_method(fn: Callable) -> bool:
+    params = list(inspect.signature(fn).parameters)
+    return bool(params) and params[0] == "self"

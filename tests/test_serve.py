@@ -1,0 +1,279 @@
+"""Serve library tests.
+
+Reference test model: python/ray/serve/tests/ (test_api.py, test_handle,
+test_batching, test_autoscaling_policy, test_multiplex, proxy e2e tests).
+"""
+
+import asyncio
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_instance(ray_cluster):
+    serve.start()
+    yield
+    serve.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# handles / deployment basics
+# ---------------------------------------------------------------------------
+
+def test_function_deployment(serve_instance):
+    @serve.deployment
+    def double(x: int) -> int:
+        return x * 2
+
+    h = serve.run(double.bind(), name="fn_app", route_prefix=None)
+    assert h.remote(21).result(timeout_s=60) == 42
+    serve.delete("fn_app")
+
+
+def test_class_deployment_and_methods(serve_instance):
+    @serve.deployment
+    class Counter:
+        def __init__(self, start: int):
+            self.n = start
+
+        def __call__(self):
+            return self.n
+
+        def incr(self, by: int = 1):
+            self.n += by
+            return self.n
+
+    h = serve.run(Counter.bind(10), name="cls_app", route_prefix=None)
+    assert h.remote().result(timeout_s=60) == 10
+    assert h.incr.remote(5).result(timeout_s=60) == 15
+    assert h.options(method_name="incr").remote().result(timeout_s=60) == 16
+    serve.delete("cls_app")
+
+
+def test_num_replicas_and_status(serve_instance):
+    @serve.deployment(num_replicas=2)
+    class D:
+        def __call__(self):
+            import os
+
+            return os.getpid()
+
+    serve.run(D.bind(), name="rep_app", route_prefix=None)
+    st = serve.status()
+    dep = st["rep_app"].deployments["D"]
+    assert dep.target_num_replicas == 2
+    assert len(dep.replicas) == 2
+    h = serve.get_app_handle("rep_app")
+    pids = {h.remote().result(timeout_s=60) for _ in range(20)}
+    assert len(pids) == 2  # p2c spread requests over both replicas
+    serve.delete("rep_app")
+
+
+def test_composition_with_handles(serve_instance):
+    @serve.deployment
+    class Adder:
+        def __init__(self, inc):
+            self.inc = inc
+
+        def __call__(self, x):
+            return x + self.inc
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, a, b):
+            self.a = a  # DeploymentHandles
+            self.b = b
+
+        async def __call__(self, x):
+            y = await self.a.remote(x)
+            z = await self.b.remote(y)
+            return z
+
+    app = Ingress.bind(Adder.options(name="A1").bind(1),
+                       Adder.options(name="A2").bind(10))
+    h = serve.run(app, name="comp_app", route_prefix=None)
+    assert h.remote(5).result(timeout_s=60) == 16
+    serve.delete("comp_app")
+
+
+def test_reconfigure_user_config(serve_instance):
+    @serve.deployment(user_config={"threshold": 1})
+    class Configurable:
+        def __init__(self):
+            self.threshold = None
+
+        def reconfigure(self, cfg):
+            self.threshold = cfg["threshold"]
+
+        def __call__(self):
+            return self.threshold
+
+    h = serve.run(Configurable.bind(), name="cfg_app", route_prefix=None)
+    assert h.remote().result(timeout_s=60) == 1
+    serve.delete("cfg_app")
+
+
+def test_replica_failure_recovery(serve_instance):
+    @serve.deployment
+    class Fragile:
+        def __call__(self):
+            return "alive"
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    h = serve.run(Fragile.bind(), name="frag_app", route_prefix=None)
+    assert h.remote().result(timeout_s=60) == "alive"
+    try:
+        h.die.remote().result(timeout_s=30)
+    except Exception:
+        pass
+    # controller replaces the dead replica
+    deadline = time.time() + 60
+    ok = False
+    while time.time() < deadline:
+        try:
+            if h.remote().result(timeout_s=10) == "alive":
+                ok = True
+                break
+        except Exception:
+            time.sleep(0.3)
+    assert ok, "replica was not replaced after crash"
+    serve.delete("frag_app")
+
+
+# ---------------------------------------------------------------------------
+# batching / multiplex
+# ---------------------------------------------------------------------------
+
+def test_serve_batch(serve_instance):
+    @serve.deployment
+    class Batcher:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        async def handle(self, items):
+            self.batch_sizes.append(len(items))
+            return [i * 2 for i in items]
+
+        async def __call__(self, x):
+            return await self.handle(x)
+
+        def sizes(self):
+            return self.batch_sizes
+
+    h = serve.run(Batcher.bind(), name="batch_app", route_prefix=None)
+    responses = [h.remote(i) for i in range(16)]
+    out = [r.result(timeout_s=60) for r in responses]
+    assert out == [i * 2 for i in range(16)]
+    sizes = h.sizes.remote().result(timeout_s=60)
+    assert max(sizes) > 1, f"no batching happened: {sizes}"
+    serve.delete("batch_app")
+
+
+def test_multiplex(serve_instance):
+    @serve.deployment
+    class Multi:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            return {"id": model_id, "weights": model_id.upper()}
+
+        async def __call__(self):
+            mid = serve.get_multiplexed_model_id()
+            model = await self.get_model(mid)
+            return model["weights"]
+
+    h = serve.run(Multi.bind(), name="mux_app", route_prefix=None)
+    r = h.options(multiplexed_model_id="alpha").remote().result(timeout_s=60)
+    assert r == "ALPHA"
+    r = h.options(multiplexed_model_id="beta").remote().result(timeout_s=60)
+    assert r == "BETA"
+    serve.delete("mux_app")
+
+
+# ---------------------------------------------------------------------------
+# autoscaling
+# ---------------------------------------------------------------------------
+
+def test_autoscaling_up(serve_instance):
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1.0, "upscale_delay_s": 0.0,
+        "downscale_delay_s": 3600.0})
+    class Slow:
+        async def __call__(self):
+            await asyncio.sleep(1.0)
+            return "done"
+
+    h = serve.run(Slow.bind(), name="auto_app", route_prefix=None)
+    st = serve.status()
+    assert st["auto_app"].deployments["Slow"].target_num_replicas == 1
+    # flood with concurrent requests -> controller should scale up
+    responses = [h.remote() for _ in range(12)]
+    deadline = time.time() + 45
+    scaled = False
+    while time.time() < deadline:
+        st = serve.status()
+        if st["auto_app"].deployments["Slow"].target_num_replicas > 1:
+            scaled = True
+            break
+        time.sleep(0.25)
+    for r in responses:
+        r.result(timeout_s=120)
+    assert scaled, "deployment did not scale up under load"
+    serve.delete("auto_app")
+
+
+# ---------------------------------------------------------------------------
+# HTTP proxy
+# ---------------------------------------------------------------------------
+
+def _http_get(url: str, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _http_post(url: str, data: bytes, timeout=30):
+    req = urllib.request.Request(url, data=data, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def test_http_ingress(serve_instance):
+    @serve.deployment
+    class Echo:
+        async def __call__(self, request: serve.Request):
+            if request.method == "POST":
+                body = await request.json()
+                return {"got": body}
+            return {"path": request.route_path,
+                    "q": request.query_params.get("q")}
+
+    host, port = serve.start(proxy=True)
+    serve.run(Echo.bind(), name="http_app", route_prefix="/echo")
+    base = f"http://{host}:{port}"
+
+    status_code, body = _http_get(f"{base}/echo/sub/path?q=hi")
+    assert status_code == 200
+    data = json.loads(body)
+    assert data == {"path": "/sub/path", "q": "hi"}
+
+    status_code, body = _http_post(f"{base}/echo", json.dumps(
+        {"x": 1}).encode())
+    assert json.loads(body) == {"got": {"x": 1}}
+
+    status_code, _ = _http_get(f"{base}/-/healthz")
+    assert status_code == 200
+
+    with pytest.raises(urllib.error.HTTPError):
+        _http_get(f"{base}/nomatch")
+    serve.delete("http_app")
