@@ -60,6 +60,17 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
                                "ignore_reinit_error=True to allow")
         if address is None and os.environ.get("RAY_TPU_ADDRESS"):
             address = os.environ["RAY_TPU_ADDRESS"]
+        if address == "auto":
+            # connect to the CLI-started cluster (reference: address="auto"
+            # reading /tmp/ray/ray_current_cluster)
+            from .scripts.cli import read_cluster_file
+
+            info = read_cluster_file()
+            if info is None:
+                raise ConnectionError(
+                    "address='auto' but no running cluster found "
+                    "(start one with `python -m ray_tpu start --head`)")
+            address = info["control_address"]
         if address is None:
             from ._private import bootstrap
 
@@ -152,9 +163,39 @@ def nodes() -> List[Dict[str, Any]]:
     return _require().control.call("get_nodes", {})
 
 
+def timeline(filename: Optional[str] = None) -> Optional[str]:
+    """Export the task timeline as Chrome trace JSON (reference:
+    ray.timeline, python/ray/_private/worker.py)."""
+    from .util.state import timeline as _timeline
+
+    _require().task_events.flush()
+    return _timeline(filename)
+
+
+class profile:
+    """Span context manager feeding the timeline (reference:
+    ray._private.profiling / TaskEventBuffer profile events)."""
+
+    def __init__(self, event_name: str, task_id: str = ""):
+        self._name = event_name
+        self._task_id = task_id
+
+    def __enter__(self):
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        core = _require()
+        if core is not None:
+            core.task_events.record_profile(
+                self._task_id, self._name, self._t0, time.time())
+        return False
+
+
 __all__ = [
     "init", "shutdown", "is_initialized", "put", "get", "wait", "remote",
     "kill", "get_actor", "cluster_resources", "available_resources", "nodes",
+    "timeline", "profile",
     "ObjectRef", "ActorHandle", "ActorClass", "RemoteFunction",
     "RayTpuError", "TaskError", "ActorDiedError", "WorkerCrashedError",
     "ObjectLostError", "GetTimeoutError",

@@ -76,6 +76,13 @@ class RemoteFunction:
             f"remote function {self._fn.__name__} cannot be called directly; "
             f"use .remote()")
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node instead of executing (reference: python/ray/dag
+        function_node.py) — used by interpreted DAGs and workflows."""
+        from ray_tpu.dag.dag_node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
@@ -93,6 +100,13 @@ class ActorMethod:
                                       num_returns=self._num_returns)
         return refs[0] if self._num_returns == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node instead of executing (reference:
+        python/ray/dag — actor.method.bind)."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
+
 
 class ActorHandle:
     def __init__(self, actor_id: str, class_name: str = "Actor",
@@ -108,6 +122,11 @@ class ActorHandle:
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id})"
+
+    def _actor_call(self, fn, *args, **kwargs):
+        """Run `fn(actor_instance, *args)` inside the actor (reference:
+        ActorHandle.__ray_call__) — returns an ObjectRef."""
+        return ActorMethod(self, "__apply__").remote(fn, *args, **kwargs)
 
     def __reduce__(self):
         # deserialized handles are borrowed: they never own the lifetime

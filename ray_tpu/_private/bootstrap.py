@@ -149,6 +149,8 @@ class Cluster:
             except subprocess.TimeoutExpired:
                 self.control_proc.kill()
         self.control_proc = None
+        if os.environ.get("RAY_TPU_KEEP_SESSION"):
+            return  # debugging: leave logs + store on disk
         import shutil
 
         shutil.rmtree(self.session_dir, ignore_errors=True)

@@ -27,9 +27,11 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import pickle
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from . import common
@@ -84,6 +86,7 @@ class ActorRecord:
         self.incarnation = 0
         self.error: Optional[str] = None
         self.class_name = ""
+        self.last_pending_warn = -1e9  # monotonic ts of last pending warning
 
     def view(self):
         return {
@@ -98,6 +101,7 @@ class ActorRecord:
             "error": self.error,
             "class_name": self.class_name,
             "pg_id": self.pg_id,
+            "resources": common.denormalize_resources(self.resources),
         }
 
 
@@ -138,6 +142,17 @@ class ControlServer:
         self.pool = DaemonPool(max_workers=16, name="control")
         self._stop = threading.Event()
         self.start_time = time.time()
+        # task-event manager (reference: GcsTaskManager,
+        # src/ray/gcs/gcs_server/gcs_task_manager.h): bounded per-task
+        # merged lifecycle records + profile spans for the timeline
+        self.task_records: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self.profile_events: List[Dict[str, Any]] = []
+        self.task_events_dropped = 0
+        self.max_task_records = int(
+            os.environ.get("RAY_TPU_MAX_TASK_EVENTS", "10000"))
+        # pending-actor scheduler queue (reference: GcsActorScheduler)
+        self.pending_actors: List[ActorRecord] = []
+        self._sched_event = threading.Event()
 
         s = self.server
         s.handle("ping", lambda c, p: "pong")
@@ -171,6 +186,9 @@ class ControlServer:
         s.handle("list_pgs", lambda c, p: [pg.view() for pg in self.pgs.values()])
         s.handle("cluster_resources", self.h_cluster_resources)
         s.handle("state_dump", self.h_state_dump)
+        s.handle("report_task_events", self.h_report_task_events)
+        s.handle("list_task_events", self.h_list_task_events)
+        s.handle("list_profile_events", self.h_list_profile_events)
         s.on_disconnect(self.h_disconnect)
 
         self.health_thread = threading.Thread(
@@ -181,6 +199,10 @@ class ControlServer:
 
     def start(self, block: bool = False):
         self.health_thread.start()
+        self._actor_sched_thread = threading.Thread(
+            target=self._actor_sched_loop, name="control-actor-sched",
+            daemon=True)
+        self._actor_sched_thread.start()
         self.server.start(thread=not block)
 
     def stop(self):
@@ -389,56 +411,78 @@ class ControlServer:
                     return
                 self.named_actors[rec.name] = rec.actor_id
             self.actors[rec.actor_id] = rec
-        self.pool.submit(self._schedule_actor, rec, d)
+        # creation is async (reference: RegisterActor replies before the
+        # actor is scheduled; the caller learns placement via
+        # wait_actor_alive / pubsub) — an unschedulable actor stays
+        # PENDING as autoscaler demand instead of failing fast
+        d.resolve(rec.view())
+        self._schedule_actor(rec, None)
 
-    def _schedule_actor(self, rec: ActorRecord, d: Optional[Deferred]):
-        """Lease a worker for the actor on a chosen node and hand it the
-        creation spec (reference: GcsActorScheduler::Schedule,
-        gcs_actor_scheduler.h:146)."""
+    def _schedule_actor(self, rec: ActorRecord, d=None):
+        """Queue for the scheduler loop (reference:
+        GcsActorScheduler::Schedule, gcs_actor_scheduler.h:146)."""
+        with self.lock:
+            if rec not in self.pending_actors:
+                self.pending_actors.append(rec)
+        self._sched_event.set()
+
+    def _actor_sched_loop(self):
+        """Single placement loop over pending actors: retries forever as
+        resources free up (the reference keeps unschedulable actors
+        pending and reports them as resource demand)."""
+        while not self._stop.is_set():
+            self._sched_event.wait(0.2)
+            self._sched_event.clear()
+            with self.lock:
+                pending = list(self.pending_actors)
+            for rec in pending:
+                placed_or_dropped = self._try_place_actor(rec)
+                if placed_or_dropped:
+                    with self.lock:
+                        if rec in self.pending_actors:
+                            self.pending_actors.remove(rec)
+
+    def _try_place_actor(self, rec: ActorRecord) -> bool:
+        """One placement attempt; True if the actor left the queue
+        (started on a node, or died)."""
         strategy = None
         if rec.pg_id:
             strategy = {"kind": "placement_group", "pg_id": rec.pg_id,
                         "bundle_index": rec.bundle_index}
-        deadline = time.monotonic() + 60.0
-        while not self._stop.is_set():
-            with self.lock:
-                if rec.state == DEAD:
-                    if d:
-                        d.resolve(rec.view())
-                    return
-                node = self._pick_node_locked(rec.resources, strategy)
-            if node is not None:
-                cli = self._node_client(node.node_id)
-                if cli is not None:
-                    try:
-                        r = cli.call("start_actor_worker", {
-                            "actor_id": rec.actor_id,
-                            "resources": common.denormalize_resources(rec.resources),
-                            "pg_id": rec.pg_id,
-                            "bundle_index": rec.bundle_index,
-                            "incarnation": rec.incarnation,
-                        }, timeout=60.0)
-                        if r and r.get("ok"):
-                            with self.lock:
-                                rec.node_id = node.node_id
-                                rec.worker_addr = tuple(r["worker_addr"])
-                                # stays PENDING until worker reports ready
-                            if d:
-                                d.resolve(rec.view())
-                            return
-                    except Exception as e:
-                        logger.warning("actor %s placement on %s failed: %s",
-                                       rec.actor_id[:12], node.node_id[:12], e)
-            if time.monotonic() > deadline:
-                with self.lock:
-                    rec.state = DEAD
-                    rec.error = "actor scheduling timed out: no node with resources " + str(
+        with self.lock:
+            if rec.state == DEAD:
+                return True
+            node = self._pick_node_locked(rec.resources, strategy)
+            if node is None:
+                now = time.monotonic()
+                if now - rec.last_pending_warn > 30.0:
+                    rec.last_pending_warn = now
+                    logger.warning(
+                        "actor %s (%s) pending: no node with free %s",
+                        rec.actor_id[:12], rec.class_name,
                         common.denormalize_resources(rec.resources))
-                self.publish("actor", {"event": "dead", "actor": rec.view()})
-                if d:
-                    d.resolve(rec.view())
-                return
-            time.sleep(0.2)
+                return False
+        cli = self._node_client(node.node_id)
+        if cli is None:
+            return False
+        try:
+            r = cli.call("start_actor_worker", {
+                "actor_id": rec.actor_id,
+                "resources": common.denormalize_resources(rec.resources),
+                "pg_id": rec.pg_id,
+                "bundle_index": rec.bundle_index,
+                "incarnation": rec.incarnation,
+            }, timeout=60.0)
+            if r and r.get("ok"):
+                with self.lock:
+                    rec.node_id = node.node_id
+                    rec.worker_addr = tuple(r["worker_addr"])
+                    # stays PENDING until worker reports ready
+                return True
+        except Exception as e:
+            logger.warning("actor %s placement on %s failed: %s",
+                           rec.actor_id[:12], node.node_id[:12], e)
+        return False
 
     def h_actor_ready(self, conn, p):
         """Worker finished running the creation task."""
@@ -754,6 +798,57 @@ class ControlServer:
                 "jobs": dict(self.jobs),
                 "start_time": self.start_time,
             }
+
+    # -- task events (reference: GcsTaskManager) --------------------------
+
+    def h_report_task_events(self, conn, p):
+        with self.lock:
+            self.task_events_dropped += p.get("dropped", 0)
+            for ev in p.get("events", []):
+                if ev.get("kind") == "profile":
+                    self.profile_events.append(ev)
+                    if len(self.profile_events) > self.max_task_records:
+                        self.profile_events.pop(0)
+                    continue
+                tid = ev["task_id"]
+                rec = self.task_records.get(tid)
+                if rec is None:
+                    rec = {"task_id": tid, "state_ts": {}}
+                    self.task_records[tid] = rec
+                    while len(self.task_records) > self.max_task_records:
+                        self.task_records.popitem(last=False)
+                        self.task_events_dropped += 1
+                for k in ("name", "job_id", "actor_id", "node_id",
+                          "worker_id", "error", "type"):
+                    if ev.get(k):
+                        rec[k] = ev[k]
+                state = ev.get("state")
+                if state:
+                    # merge out-of-order batches: a terminal state must not
+                    # be overwritten by a late RUNNING report
+                    terminal = rec.get("state") in ("FINISHED", "FAILED")
+                    if not terminal or state in ("FINISHED", "FAILED"):
+                        rec["state"] = state
+                    rec["state_ts"][state] = ev["ts"]
+        return True
+
+    def h_list_task_events(self, conn, p):
+        filters = p.get("filters") or {}
+        limit = p.get("limit", 1000)
+        out = []
+        with self.lock:
+            for rec in reversed(self.task_records.values()):
+                if all(rec.get(k) == v for k, v in filters.items()):
+                    out.append(dict(rec, state_ts=dict(rec["state_ts"])))
+                    if len(out) >= limit:
+                        break
+        return {"records": out, "dropped": self.task_events_dropped,
+                "total": len(self.task_records)}
+
+    def h_list_profile_events(self, conn, p):
+        limit = p.get("limit", 10000)
+        with self.lock:
+            return list(self.profile_events[-limit:])
 
 
 def main():

@@ -262,6 +262,16 @@ class CoreWorker:
         self._blocked_depth = 0
         self._executing = threading.local()
 
+        # task-event export (reference: task_event_buffer.h:220)
+        from .task_events import NULL_BUFFER, TaskEventBuffer
+
+        if os.environ.get("RAY_TPU_TASK_EVENTS", "1") == "1":
+            self.task_events = TaskEventBuffer(
+                self.control, worker_id=self.worker_id,
+                node_id=self.node_id or "", job_id=self.job_id)
+        else:
+            self.task_events = NULL_BUFFER
+
         if mode == "driver":
             self.control.call("register_job", {"job_id": self.job_id,
                                                "driver_pid": os.getpid()})
@@ -310,6 +320,10 @@ class CoreWorker:
                 ac.client.close()
         for c in owners:
             c.close()
+        try:
+            self.task_events.stop()
+        except Exception:
+            pass
         try:
             self.control.close()
         except Exception:
@@ -805,6 +819,9 @@ class CoreWorker:
             if pool is None:
                 pool = self.pools[key] = SchedPool(key)
             pool.queue.append(rec)
+        self.task_events.record_status(
+            spec.task_id, "PENDING_ARGS_AVAIL", name=spec.function_name,
+            extra={"type": "NORMAL_TASK"})
         self._pump(pool)
         return refs
 
@@ -986,6 +1003,9 @@ class CoreWorker:
         else:
             err = WorkerCrashedError(
                 f"task {rec.spec.function_name} failed: worker died ({exc})")
+            self.task_events.record_status(
+                rec.spec.task_id, "FAILED", name=rec.spec.function_name,
+                error=str(err))
             for oid in rec.spec.return_ids():
                 with self.lock:
                     e = self.objects.get(oid)
@@ -1142,6 +1162,9 @@ class CoreWorker:
                 e.pins = 1
                 self.local_ref_counts[oid] = 1
                 refs.append(ObjectRef(oid, self.addr, self.worker_id))
+        self.task_events.record_status(
+            spec.task_id, "PENDING_ARGS_AVAIL", name=method_name,
+            actor_id=actor_id, extra={"type": "ACTOR_TASK"})
         # single critical section decides buffer vs send (no double-send
         # race with _resolve_actor's buffer flush)
         with ac.lock:

@@ -50,6 +50,7 @@ class WorkerRecord:
         self.blocked = False
         self.lease_resources: Dict[str, int] = {}
         self.bundle_key: Optional[Tuple[str, int]] = None
+        self.tpu = False  # spawned with TPU device visibility
 
 
 class PendingLease:
@@ -108,8 +109,20 @@ class Raylet:
         s.handle("delete_objects", self.h_delete_objects)
         s.handle("store_stats", self.h_store_stats)
         s.handle("node_info", self.h_node_info)
+        s.handle("list_workers", self.h_list_workers)
+        s.handle("pending_demands", self.h_pending_demands)
         s.on_disconnect(self.h_disconnect)
 
+        # prestarted warm workers (reference: worker_pool.h prestart):
+        # interpreter + framework import is paid once off the critical path;
+        # leases and actor creations pop a warm worker
+        cpu_slots = max(1, int(sum(
+            v for k, v in self.total.items() if k == common.CPU)
+            / common._GRAN))
+        self.prestart_target = min(cpu_slots, int(os.environ.get(
+            "RAY_TPU_PRESTART_WORKERS", "4")))
+        self._prestart_thread = threading.Thread(
+            target=self._prestart_loop, name="raylet-prestart", daemon=True)
         self._grant_thread = threading.Thread(target=self._grant_loop,
                                               name="raylet-grant", daemon=True)
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
@@ -133,6 +146,7 @@ class Raylet:
         self._grant_thread.start()
         self._hb_thread.start()
         self._reap_thread.start()
+        self._prestart_thread.start()
         logger.info("raylet %s up at %s resources=%s", self.node_id[:12],
                     self.server.addr, common.denormalize_resources(self.total))
         if block:
@@ -166,17 +180,29 @@ class Raylet:
     # -- worker pool -------------------------------------------------------
 
     def _spawn_worker(self, actor_id: Optional[str] = None,
-                      env_extra: Optional[Dict[str, str]] = None) -> WorkerRecord:
+                      env_extra: Optional[Dict[str, str]] = None,
+                      tpu: bool = False) -> WorkerRecord:
         with self.lock:
             self._next_token += 1
             token = self._next_token
         wid = common.worker_id()
         rec = WorkerRecord(wid, None, token)
         rec.actor_id = actor_id
+        rec.tpu = tpu
         with self.lock:
             self.workers[wid] = rec
             self.workers_by_token[token] = rec
         env = dict(os.environ)
+        if not tpu and "PALLAS_AXON_POOL_IPS" in env:
+            # CPU-only worker: skip the TPU-plugin sitecustomize (it
+            # imports jax at interpreter start, ~2.4s of CPU per process,
+            # and contends for the single chip).  Only workers granted a
+            # TPU resource get device access — on a TPU host the chip
+            # belongs to whichever process holds the TPU resource, exactly
+            # like the reference's TPU_VISIBLE_CHIPS visibility scoping
+            # (reference: _private/accelerators/tpu.py:155-195).
+            env.pop("PALLAS_AXON_POOL_IPS")
+            env["JAX_PLATFORMS"] = "cpu"
         from .bootstrap import _package_pythonpath
 
         env["PYTHONPATH"] = _package_pythonpath()
@@ -299,25 +325,54 @@ class Raylet:
             time.sleep(LEASE_GRANT_TICK_S)
             self._try_grant()
 
+    def _prestart_loop(self):
+        while not self._stop.is_set():
+            try:
+                with self.lock:
+                    warm = sum(1 for r in self.workers.values()
+                               if r.actor_id is None
+                               and r.state in ("starting", "idle"))
+                    deficit = self.prestart_target - warm
+                    room = self.max_workers - len(self.workers)
+                # spawn at most one per tick: on small hosts concurrent
+                # interpreter+jax imports thrash the CPU
+                if deficit > 0 and room > 0:
+                    self._spawn_worker()
+            except Exception:
+                logger.exception("prestart failed")
+            time.sleep(0.25)
+
     def _try_grant(self):
         grants: List[Tuple[PendingLease, WorkerRecord]] = []
         spawn = 0
+        spawn_tpu = False
         with self.lock:
             while self.pending_leases:
                 pl = self.pending_leases[0]
                 if not self._lease_fits(pl):
                     break
+                wants_tpu = any(k.startswith(common.TPU)
+                                for k in pl.demand)
                 w = None
+                skipped: List[WorkerRecord] = []
                 while self.idle:
                     cand = self.idle.popleft()
-                    if cand.state == "idle":
-                        w = cand
-                        break
+                    if cand.state != "idle":
+                        continue
+                    if wants_tpu and not cand.tpu:
+                        skipped.append(cand)  # CPU-only worker: no device
+                        continue
+                    w = cand
+                    break
+                self.idle.extend(skipped)
                 if w is None:
-                    n_starting = sum(1 for r in self.workers.values()
-                                     if r.state == "starting" and r.actor_id is None)
+                    n_starting = sum(
+                        1 for r in self.workers.values()
+                        if r.state == "starting" and r.actor_id is None
+                        and r.tpu == wants_tpu)
                     if n_starting == 0 and len(self.workers) < self.max_workers:
                         spawn += 1
+                        spawn_tpu = wants_tpu
                     break
                 self.pending_leases.popleft()
                 if pl.bundle is not None:
@@ -335,7 +390,7 @@ class Raylet:
                 w.lease_resources = pl.demand
                 grants.append((pl, w))
         for _ in range(spawn):
-            self._spawn_worker()
+            self._spawn_worker(tpu=spawn_tpu)
         for pl, w in grants:
             pl.deferred.resolve({
                 "ok": True, "lease_id": w.lease_id, "worker_id": w.worker_id,
@@ -345,6 +400,9 @@ class Raylet:
     def _free_lease_resources(self, rec: WorkerRecord):
         """Return a worker's held resources to the right pool (general
         availability or its PG bundle's reservation).  Caller holds lock."""
+        logger.info("free_lease %s lease=%s blocked=%s bundle=%s avail=%s",
+                    rec.worker_id[:12], rec.lease_resources, rec.blocked,
+                    rec.bundle_key, self.available)
         if rec.bundle_key is not None:
             if not rec.blocked:  # blocked leases already gave resources back
                 b = self.bundles.get(rec.bundle_key)
@@ -427,10 +485,40 @@ class Raylet:
                     d.resolve({"ok": False, "error": "insufficient resources"})
                     return
                 subtract(self.available, demand)
+        # prefer a prestarted idle worker: assign_actor turns it into the
+        # actor's dedicated process with zero spawn latency (reference:
+        # WorkerPool::PopWorker worker_pool.h:366).  TPU actors need a
+        # device-visible process — the warm pool is CPU-only, so they spawn.
+        wants_tpu = any(k.startswith(common.TPU) for k in demand)
+        w = None
+        with self.lock:
+            while not wants_tpu and self.idle:
+                cand = self.idle.popleft()
+                if cand.state == "idle" and cand.conn is not None:
+                    w = cand
+                    break
+            if w is not None:
+                w.state = "actor"
+                w.actor_id = p["actor_id"]
+                w.lease_resources = demand if not from_bundle else {}
+        if w is not None:
+            ok = w.conn.push("assign_actor", {
+                "actor_id": p["actor_id"],
+                "incarnation": p.get("incarnation", 0)})
+            if ok:
+                d.resolve({"ok": True, "worker_addr": w.addr,
+                           "worker_id": w.worker_id})
+                return
+            with self.lock:  # conn raced shut: fall through to fresh spawn
+                w.state = "dead"
+                if not from_bundle:
+                    add(self.available, w.lease_resources)
+                w.lease_resources = {}
         env = {}
         if p.get("incarnation") is not None:
             env["RAY_TPU_ACTOR_INCARNATION"] = str(p["incarnation"])
-        rec = self._spawn_worker(actor_id=p["actor_id"], env_extra=env)
+        rec = self._spawn_worker(actor_id=p["actor_id"], env_extra=env,
+                                 tpu=wants_tpu)
         rec.lease_resources = demand if not from_bundle else {}
 
         def waiter():
@@ -455,6 +543,9 @@ class Raylet:
         aid = p["actor_id"]
         with self.lock:
             rec = next((r for r in self.workers.values() if r.actor_id == aid), None)
+        logger.info("kill_actor_worker %s -> rec=%s lease=%s", aid[:12],
+                    rec.worker_id[:12] if rec else None,
+                    rec.lease_resources if rec else None)
         if rec is None:
             return False
 
@@ -550,8 +641,32 @@ class Raylet:
 
     def h_store_stats(self, conn, p):
         objs = self.store.list_objects()
-        return {"num_objects": len(objs),
-                "bytes": sum(self.store.size(o) or 0 for o in objs)}
+        out = {"num_objects": len(objs),
+               "bytes": sum(self.store.size(o) or 0 for o in objs)}
+        if p and p.get("detail"):
+            out["objects"] = [{"object_id": o,
+                               "size_bytes": self.store.size(o) or 0}
+                              for o in objs]
+        return out
+
+    def h_pending_demands(self, conn, p):
+        """Queued lease demands — autoscaler scale-up signal (reference:
+        raylet resource_load in ray_syncer feeding load_metrics)."""
+        with self.lock:
+            return [common.denormalize_resources(pl.demand)
+                    for pl in self.pending_leases]
+
+    def h_list_workers(self, conn, p):
+        """State-API source (reference: WorkerInfoGcsService + raylet state)."""
+        with self.lock:
+            return [{
+                "worker_id": r.worker_id,
+                "pid": r.proc.pid if r.proc else None,
+                "state": r.state,
+                "actor_id": r.actor_id,
+                "node_id": self.node_id,
+                "tpu": r.tpu,
+            } for r in self.workers.values()]
 
     def h_node_info(self, conn, p):
         with self.lock:

@@ -1,0 +1,133 @@
+"""Buffered export of task lifecycle + profile events to the control plane.
+
+Analog of the reference's TaskEventBuffer (reference:
+src/ray/core_worker/task_event_buffer.h:220): every task submission and
+execution transition is recorded locally and flushed in batches to the
+control plane's task-event manager (reference: GcsTaskManager,
+src/ray/gcs/gcs_server/gcs_task_manager.h), which the state API
+(`ray_tpu.util.state`) and the Chrome-trace timeline read back.
+
+States follow the reference's task lifecycle (common.proto TaskStatus):
+PENDING_ARGS_AVAIL -> SUBMITTED_TO_WORKER -> RUNNING -> FINISHED | FAILED.
+Profile events (named spans inside a task) feed the timeline view
+(reference: `ray timeline` -> chrome://tracing).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+FLUSH_INTERVAL_S = 1.0
+MAX_BUFFERED = 10_000  # drop-oldest beyond this (reference: task_events_max_buffer_size)
+
+
+class TaskEventBuffer:
+    """Thread-safe accumulator; a daemon thread flushes to the control plane."""
+
+    def __init__(self, control_client, *, worker_id: str = "",
+                 node_id: str = "", job_id: str = ""):
+        self._client = control_client
+        self._worker_id = worker_id
+        self._node_id = node_id
+        self._job_id = job_id
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._dropped = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._flush_loop,
+                                        name="task-events-flush", daemon=True)
+        self._thread.start()
+
+    # -- recording ---------------------------------------------------------
+
+    def record_status(self, task_id: str, state: str, *,
+                      name: str = "", actor_id: Optional[str] = None,
+                      error: Optional[str] = None,
+                      extra: Optional[Dict[str, Any]] = None):
+        ev = {
+            "kind": "status",
+            "task_id": task_id,
+            "state": state,
+            "name": name,
+            "actor_id": actor_id,
+            "job_id": self._job_id,
+            "node_id": self._node_id,
+            "worker_id": self._worker_id,
+            "ts": time.time(),
+        }
+        if error:
+            ev["error"] = error[:2000]
+        if extra:
+            ev.update(extra)
+        self._append(ev)
+
+    def record_profile(self, task_id: str, event_name: str,
+                       start_ts: float, end_ts: float,
+                       extra: Optional[Dict[str, Any]] = None):
+        ev = {
+            "kind": "profile",
+            "task_id": task_id,
+            "event_name": event_name,
+            "start_ts": start_ts,
+            "end_ts": end_ts,
+            "job_id": self._job_id,
+            "node_id": self._node_id,
+            "worker_id": self._worker_id,
+        }
+        if extra:
+            ev.update(extra)
+        self._append(ev)
+
+    def _append(self, ev: Dict[str, Any]):
+        with self._lock:
+            if len(self._events) >= MAX_BUFFERED:
+                self._events.pop(0)
+                self._dropped += 1
+            self._events.append(ev)
+
+    # -- flushing ----------------------------------------------------------
+
+    def _flush_loop(self):
+        while not self._stop.wait(FLUSH_INTERVAL_S):
+            self.flush()
+
+    def flush(self):
+        with self._lock:
+            if not self._events:
+                return
+            batch, self._events = self._events, []
+            dropped, self._dropped = self._dropped, 0
+        try:
+            self._client.call("report_task_events",
+                              {"events": batch, "dropped": dropped},
+                              timeout=5.0)
+        except Exception:
+            # control plane unreachable: re-queue (bounded) so a blip
+            # doesn't lose the whole window
+            with self._lock:
+                self._events = (batch + self._events)[-MAX_BUFFERED:]
+
+    def stop(self):
+        self._stop.set()
+        self.flush()
+
+
+class _NullBuffer:
+    """No-op stand-in before init / after shutdown."""
+
+    def record_status(self, *a, **k):
+        pass
+
+    def record_profile(self, *a, **k):
+        pass
+
+    def flush(self):
+        pass
+
+    def stop(self):
+        pass
+
+
+NULL_BUFFER = _NullBuffer()

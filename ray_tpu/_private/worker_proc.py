@@ -142,6 +142,13 @@ class WorkerMain:
     def _on_raylet_push(self, topic, payload):
         if topic == "shutdown":
             self._exit_soon()
+        elif topic == "assign_actor":
+            # prestarted-worker reuse (reference: worker_pool.h PopWorker):
+            # a warm idle worker becomes this actor's dedicated process,
+            # skipping the interpreter + jax import cost of a fresh spawn
+            self.actor_id = payload["actor_id"]
+            self.incarnation = payload.get("incarnation", 0)
+            threading.Thread(target=self._init_actor, daemon=True).start()
 
     def _exit_soon(self):
         self._stop.set()
@@ -203,10 +210,16 @@ class WorkerMain:
             values = [out]
         reply = self.core.store_task_results(spec, values)
         reply["exec_ms"] = (time.monotonic() - t0) * 1000.0
+        self.core.task_events.record_status(
+            spec.task_id, "FINISHED", name=spec.function_name,
+            actor_id=spec.actor_id)
         return reply
 
     def _error_reply(self, e: BaseException, spec: TaskSpec):
         tb = traceback.format_exc()
+        self.core.task_events.record_status(
+            spec.task_id, "FAILED", name=spec.function_name,
+            actor_id=spec.actor_id, error=f"{type(e).__name__}: {e}")
         try:
             err_blob = serialization.dumps_inline(
                 TaskError(e, tb, spec.function_name))
@@ -219,6 +232,9 @@ class WorkerMain:
     def _execute(self, kind: str, spec: TaskSpec, d: Deferred = None):
         self.core._executing.active = True
         t0 = time.monotonic()
+        self.core.task_events.record_status(
+            spec.task_id, "RUNNING", name=spec.function_name,
+            actor_id=spec.actor_id)
         try:
             if kind == "actor":
                 # wait for actor init to finish (creation runs async)
@@ -228,7 +244,16 @@ class WorkerMain:
                     time.sleep(0.005)
                 if self.actor_instance is None:
                     raise common.ActorDiedError("actor instance not initialized")
-                fn = getattr(self.actor_instance, spec.function_name)
+                if spec.function_name == "__apply__":
+                    # free function applied to the actor instance
+                    # (reference: ActorHandle.__ray_call__) — powers
+                    # compiled-graph exec loops without user-class changes
+                    inst = self.actor_instance
+
+                    def fn(_f, *a, **k):
+                        return _f(inst, *a, **k)
+                else:
+                    fn = getattr(self.actor_instance, spec.function_name)
                 if getattr(self, "actor_is_async", False):
                     # async actor: invoke on the event loop (even sync
                     # methods — they block the loop, the reference's

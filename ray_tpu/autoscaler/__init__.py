@@ -1,0 +1,12 @@
+"""Autoscaler (reference: python/ray/autoscaler/)."""
+
+from .autoscaler import (LoadMetrics, Monitor, ResourceDemandScheduler,
+                         StandardAutoscaler)
+from .node_provider import (GCPTpuNodeProvider, LocalNodeProvider,
+                            NodeProvider)
+
+__all__ = [
+    "StandardAutoscaler", "Monitor", "LoadMetrics",
+    "ResourceDemandScheduler", "NodeProvider", "LocalNodeProvider",
+    "GCPTpuNodeProvider",
+]

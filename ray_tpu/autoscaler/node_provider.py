@@ -1,0 +1,220 @@
+"""Node providers: pluggable machine lifecycle backends.
+
+Analog of the reference's NodeProvider interface (reference:
+python/ray/autoscaler/node_provider.py) with two implementations:
+
+  * LocalNodeProvider — "launches" nodes as local raylet processes
+    against the running control plane (the reference's
+    FakeMultiNodeProvider pattern, autoscaler/_private/fake_multi_node/
+    node_provider.py) — the workhorse for autoscaler tests.
+  * GCPTpuNodeProvider — models GCE TPU pod-slice provisioning (the
+    reference's gcp provider + TPU support, autoscaler/_private/gcp/
+    config.py:42-216): one *slice* is the atomic unit, creating N host
+    nodes with ICI-topology labels.  API calls are delegated to an
+    injectable transport so it is testable offline (zero egress here).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+TAG_NODE_KIND = "node-kind"        # head | worker
+TAG_NODE_TYPE = "node-type"        # user node type name
+TAG_NODE_STATUS = "node-status"    # pending | up-to-date | terminated
+
+
+class NodeProvider:
+    """Machine lifecycle interface (reference: node_provider.py)."""
+
+    def __init__(self, provider_config: Dict[str, Any], cluster_name: str):
+        self.provider_config = provider_config
+        self.cluster_name = cluster_name
+
+    def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
+        raise NotImplementedError
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        raise NotImplementedError
+
+    def create_node(self, node_config: Dict[str, Any],
+                    tags: Dict[str, str], count: int) -> List[str]:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str):
+        raise NotImplementedError
+
+    def is_running(self, node_id: str) -> bool:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Launch raylets as local processes joined to a live control plane.
+
+    provider_config: {"control_address": "host:port"}.
+    node_config: {"resources": {...}, "labels": {...}}.
+    """
+
+    def __init__(self, provider_config, cluster_name):
+        super().__init__(provider_config, cluster_name)
+        from ray_tpu._private.bootstrap import Cluster
+
+        addr = provider_config["control_address"].rsplit(":", 1)
+        self._cluster = Cluster(
+            session_name=f"autoscaler-{cluster_name}-{uuid.uuid4().hex[:6]}")
+        self._cluster.control_addr = (addr[0], int(addr[1]))
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, Dict[str, Any]] = {}  # id -> {handle, tags}
+
+    def non_terminated_nodes(self, tag_filters):
+        with self._lock:
+            out = []
+            for nid, rec in self._nodes.items():
+                if rec["tags"].get(TAG_NODE_STATUS) == "terminated":
+                    continue
+                if all(rec["tags"].get(k) == v
+                       for k, v in tag_filters.items()):
+                    out.append(nid)
+            return out
+
+    def node_tags(self, node_id):
+        with self._lock:
+            return dict(self._nodes[node_id]["tags"])
+
+    def create_node(self, node_config, tags, count):
+        created = []
+        for _ in range(count):
+            handle = self._cluster.add_node(
+                resources=node_config.get("resources"),
+                labels=node_config.get("labels"), wait=True)
+            nid = handle.node_id
+            with self._lock:
+                self._nodes[nid] = {
+                    "handle": handle,
+                    "tags": {**tags, TAG_NODE_STATUS: "up-to-date"},
+                }
+            created.append(nid)
+        return created
+
+    def terminate_node(self, node_id):
+        with self._lock:
+            rec = self._nodes.get(node_id)
+        if rec is None:
+            return
+        rec["handle"].terminate()
+        with self._lock:
+            rec["tags"][TAG_NODE_STATUS] = "terminated"
+
+    def is_running(self, node_id):
+        with self._lock:
+            rec = self._nodes.get(node_id)
+        return rec is not None and rec["handle"].proc.poll() is None
+
+    def shutdown(self):
+        with self._lock:
+            recs = list(self._nodes.values())
+        for rec in recs:
+            try:
+                rec["handle"].terminate()
+            except Exception:
+                pass
+
+
+class GCPTpuNodeProvider(NodeProvider):
+    """TPU pod-slice provisioning model (offline transport-injected).
+
+    The reference provisions TPU VMs through the GCE API with tpu.admin
+    role and validates multi-host slices (reference:
+    autoscaler/_private/gcp/config.py:42 `_get_num_tpu_chips`, multi-host
+    validation :150-216; example configs autoscaler/gcp/tpu.yaml).  Here a
+    node type describes a *slice* (accelerator_type like "v5e-16"); one
+    create_node provisions every host of the slice with slice/worker
+    topology labels so the scheduler can gang-place onto one ICI domain.
+
+    provider_config["transport"]: object with create_tpu_slice(name, type,
+    zone) / delete_tpu_slice(name) / list_slices() — a real GCE client in
+    production, a fake in tests.  Without one, creation raises (zero
+    egress).
+    """
+
+    #: chips per host for each generation (reference: tpu.py host bounds)
+    CHIPS_PER_HOST = {"v4": 4, "v5e": 4, "v5p": 4, "v6e": 4}
+
+    def __init__(self, provider_config, cluster_name):
+        super().__init__(provider_config, cluster_name)
+        self.transport = provider_config.get("transport")
+        self.zone = provider_config.get("zone", "us-central2-b")
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, Dict[str, Any]] = {}
+
+    @classmethod
+    def slice_hosts(cls, accelerator_type: str) -> int:
+        """"v5e-16" -> 16 chips -> 4 hosts."""
+        gen, chips = accelerator_type.rsplit("-", 1)
+        per_host = cls.CHIPS_PER_HOST.get(gen, 4)
+        return max(1, int(chips) // per_host)
+
+    def create_node(self, node_config, tags, count):
+        if self.transport is None:
+            raise RuntimeError(
+                "GCPTpuNodeProvider needs provider_config['transport'] "
+                "(a GCE TPU API client); none configured")
+        acc = node_config["accelerator_type"]
+        created = []
+        for _ in range(count):
+            slice_name = f"{self.cluster_name}-{uuid.uuid4().hex[:8]}"
+            self.transport.create_tpu_slice(slice_name, acc, self.zone)
+            hosts = self.slice_hosts(acc)
+            per_host = self.CHIPS_PER_HOST.get(acc.rsplit("-", 1)[0], 4)
+            for w in range(hosts):
+                nid = f"{slice_name}-w{w}"
+                with self._lock:
+                    self._nodes[nid] = {
+                        "slice": slice_name,
+                        "tags": {
+                            **tags,
+                            TAG_NODE_STATUS: "up-to-date",
+                            "tpu-slice": slice_name,
+                            "tpu-worker-id": str(w),
+                            "tpu-accelerator-type": acc,
+                        },
+                        "resources": {"CPU": 96.0, "TPU": float(per_host)},
+                        "created_at": time.time(),
+                    }
+                created.append(nid)
+        return created
+
+    def non_terminated_nodes(self, tag_filters):
+        with self._lock:
+            return [nid for nid, rec in self._nodes.items()
+                    if rec["tags"].get(TAG_NODE_STATUS) != "terminated"
+                    and all(rec["tags"].get(k) == v
+                            for k, v in tag_filters.items())]
+
+    def node_tags(self, node_id):
+        with self._lock:
+            return dict(self._nodes[node_id]["tags"])
+
+    def terminate_node(self, node_id):
+        """Terminating any host of a slice releases the whole slice (a
+        partial TPU slice is unusable)."""
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            if rec is None:
+                return
+            slice_name = rec["slice"]
+            peers = [n for n, r in self._nodes.items()
+                     if r.get("slice") == slice_name]
+        if self.transport is not None:
+            self.transport.delete_tpu_slice(slice_name)
+        with self._lock:
+            for n in peers:
+                self._nodes[n]["tags"][TAG_NODE_STATUS] = "terminated"
+
+    def is_running(self, node_id):
+        with self._lock:
+            rec = self._nodes.get(node_id)
+        return rec is not None and \
+            rec["tags"].get(TAG_NODE_STATUS) == "up-to-date"

@@ -1,0 +1,16 @@
+"""ray_tpu.dag: lazily-bound DAGs + compiled multi-actor graphs.
+
+Reference: python/ray/dag/ (2.4k LoC compiled_dag_node.py) +
+experimental/channel/.  See compiled_dag.py for the TPU-native design.
+"""
+
+from .channel import Channel, ChannelClosed, ChannelTimeout
+from .compiled_dag import CompiledDAG, CompiledDAGRef
+from .dag_node import (ClassMethodNode, DAGNode, FunctionNode, InputNode,
+                       MultiOutputNode)
+
+__all__ = [
+    "Channel", "ChannelClosed", "ChannelTimeout", "ClassMethodNode",
+    "CompiledDAG", "CompiledDAGRef", "DAGNode", "FunctionNode", "InputNode",
+    "MultiOutputNode",
+]

@@ -1,0 +1,199 @@
+"""Python endpoints for native shared-memory ring channels.
+
+Binding over ray_tpu/native/shm_channel.cc — the mutable-object transport
+under compiled graphs (reference: experimental/channel/
+shared_memory_channel.py over experimental_mutable_object_manager.h).
+
+Value envelope (first byte):
+  0x01  inline payload: serialization.dumps_inline bytes follow
+  0x02  spilled payload: pickled ObjectRef follows (value was larger than
+        the slot; it went through the object store instead)
+  0x03  error: pickled exception follows (propagates through the DAG)
+  0x00  stop sentinel (teardown)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Any, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu._private import serialization
+
+TAG_STOP = 0
+TAG_INLINE = 1
+TAG_SPILLED = 2
+TAG_ERROR = 3
+
+DEFAULT_SLOT_BYTES = 1 << 20
+DEFAULT_NSLOTS = 4
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    from ray_tpu.native.build import load_library
+
+    lib = load_library("shm_channel", ["shm_channel.cc"])
+    lib.rt_chan_open.restype = ctypes.c_void_p
+    lib.rt_chan_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                 ctypes.c_uint32]
+    lib.rt_chan_close_handle.argtypes = [ctypes.c_void_p]
+    lib.rt_chan_slot_size.restype = ctypes.c_uint64
+    lib.rt_chan_slot_size.argtypes = [ctypes.c_void_p]
+    lib.rt_chan_write_acquire.restype = ctypes.c_int64
+    lib.rt_chan_write_acquire.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.rt_chan_write_release.restype = ctypes.c_int
+    lib.rt_chan_write_release.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.rt_chan_read_acquire.restype = ctypes.c_int64
+    lib.rt_chan_read_acquire.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64]
+    lib.rt_chan_read_release.restype = ctypes.c_int
+    lib.rt_chan_read_release.argtypes = [ctypes.c_void_p]
+    lib.rt_chan_close.argtypes = [ctypes.c_void_p]
+    lib.rt_chan_is_closed.restype = ctypes.c_int
+    lib.rt_chan_is_closed.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class ChannelTimeout(Exception):
+    pass
+
+
+class Channel:
+    """One endpoint (this process may use it as writer, reader, or both in
+    tests).  SPSC: exactly one writer process and one reader process."""
+
+    def __init__(self, path: str, slot_bytes: int = DEFAULT_SLOT_BYTES,
+                 nslots: int = DEFAULT_NSLOTS):
+        self.path = path
+        self._lib = _load()
+        self._chan = self._lib.rt_chan_open(path.encode(), slot_bytes,
+                                            nslots)
+        if not self._chan:
+            raise RuntimeError(f"rt_chan_open failed for {path}")
+        self._slot = self._lib.rt_chan_slot_size(self._chan)
+        self._fd = os.open(path, os.O_RDWR)
+        self._mm = None  # lazily map the whole (small) channel file
+        self._closed_handle = False
+
+    def _map(self):
+        if self._mm is None:
+            import mmap
+
+            size = os.fstat(self._fd).st_size
+            self._mm = mmap.mmap(self._fd, size)
+        return self._mm
+
+    # -- write -------------------------------------------------------------
+
+    def write(self, value: Any, timeout_s: Optional[float] = None):
+        payload = serialization.dumps_inline(value)
+        if 1 + len(payload) > self._slot:
+            import ray_tpu
+
+            ref = ray_tpu.put(value)
+            # dumps_inline swaps the ref for a SerializedRef marker so the
+            # reader re-wraps it with borrower ref-counting intact
+            payload = serialization.dumps_inline(ref)
+            tag = TAG_SPILLED
+        else:
+            tag = TAG_INLINE
+        self._write_raw(tag, payload, timeout_s)
+
+    def write_error(self, exc: BaseException,
+                    timeout_s: Optional[float] = None):
+        try:
+            payload = cloudpickle.dumps(exc)
+        except BaseException:
+            payload = cloudpickle.dumps(
+                RuntimeError(f"{type(exc).__name__}: {exc}"))
+        self._write_raw(TAG_ERROR, payload, timeout_s)
+
+    def write_stop(self, timeout_s: Optional[float] = 1.0):
+        try:
+            self._write_raw(TAG_STOP, b"", timeout_s)
+        except (ChannelClosed, ChannelTimeout):
+            pass
+
+    def _write_raw(self, tag: int, payload: bytes,
+                   timeout_s: Optional[float]):
+        if 1 + len(payload) > self._slot:
+            raise ValueError(
+                f"payload of {len(payload)}B exceeds channel slot "
+                f"{self._slot}B even after spilling")
+        t_us = -1 if timeout_s is None else int(timeout_s * 1e6)
+        off = self._lib.rt_chan_write_acquire(self._chan, t_us)
+        if off == -3:
+            raise ChannelClosed(self.path)
+        if off == -2:
+            raise ChannelTimeout(self.path)
+        mm = self._map()
+        mm[off] = tag
+        mm[off + 1:off + 1 + len(payload)] = payload
+        self._lib.rt_chan_write_release(self._chan, 1 + len(payload))
+
+    # -- read --------------------------------------------------------------
+
+    def read(self, timeout_s: Optional[float] = None) -> Tuple[int, Any]:
+        """Returns (tag, value).  Raises ChannelClosed / ChannelTimeout."""
+        t_us = -1 if timeout_s is None else int(timeout_s * 1e6)
+        nbytes = ctypes.c_uint64(0)
+        off = self._lib.rt_chan_read_acquire(self._chan,
+                                             ctypes.byref(nbytes), t_us)
+        if off == -3:
+            raise ChannelClosed(self.path)
+        if off == -2:
+            raise ChannelTimeout(self.path)
+        mm = self._map()
+        try:
+            tag = mm[off]
+            payload = bytes(mm[off + 1:off + nbytes.value])
+        finally:
+            self._lib.rt_chan_read_release(self._chan)
+        if tag == TAG_INLINE:
+            return tag, serialization.loads_inline(payload)
+        if tag == TAG_SPILLED:
+            import ray_tpu
+
+            ref = serialization.loads_inline(payload)
+            return TAG_INLINE, ray_tpu.get(ref, timeout=300.0)
+        if tag == TAG_ERROR:
+            return tag, cloudpickle.loads(payload)
+        return TAG_STOP, None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self):
+        """Mark the channel closed (wakes both sides)."""
+        if not self._closed_handle:
+            self._lib.rt_chan_close(self._chan)
+
+    def release(self):
+        if self._closed_handle:
+            return
+        self._closed_handle = True
+        try:
+            if self._mm is not None:
+                self._mm.close()
+            os.close(self._fd)
+        except OSError:
+            pass
+        self._lib.rt_chan_close_handle(self._chan)
+        self._chan = None
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass

@@ -1,0 +1,176 @@
+"""Dashboard head: HTTP API over cluster state + Prometheus metrics.
+
+Analog of the reference's dashboard backend (reference:
+python/ray/dashboard/dashboard.py + head.py + modules/): a separate
+process on the head node serving JSON state endpoints and the Prometheus
+scrape target.  Stdlib http.server (threaded) instead of aiohttp — the
+data volumes are controlplane-sized, and it keeps the daemon
+dependency-free.
+
+Endpoints (mirroring the reference's dashboard REST surface):
+  GET /api/version              build/version info
+  GET /api/cluster_status       nodes + resource totals (reference: /api/cluster_status)
+  GET /api/nodes                node table
+  GET /api/actors               actor table
+  GET /api/tasks                task events
+  GET /api/jobs                 submitted jobs (reference: /api/jobs/)
+  GET /api/placement_groups     placement groups
+  GET /api/objects              object-store summary
+  GET /metrics                  Prometheus exposition (reference: agent scrape)
+  GET /healthz                  liveness (reference: modules/healthz)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+logger = logging.getLogger(__name__)
+
+
+class DashboardHead:
+    def __init__(self, control_address: str, host: str = "127.0.0.1",
+                 port: int = 8265):
+        from ray_tpu._private.protocol import Client
+
+        chost, cport = control_address.rsplit(":", 1)
+        self.control = Client((chost, int(cport)), name="dashboard")
+        self.control_address = control_address
+        self.host = host
+        self.port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    # -- data providers ----------------------------------------------------
+
+    def _state_dump(self) -> Dict[str, Any]:
+        return self.control.call("state_dump", {}, timeout=10.0)
+
+    def route(self, path: str, query: Dict[str, Any]) -> Tuple[int, str, str]:
+        """Returns (status, content_type, body)."""
+        try:
+            if path == "/healthz":
+                return 200, "text/plain", "success"
+            if path == "/api/version":
+                import ray_tpu
+
+                return self._json({"ray_tpu_version": ray_tpu.__version__,
+                                   "control_address": self.control_address})
+            if path == "/api/cluster_status":
+                dump = self._state_dump()
+                res = self.control.call("cluster_resources", {},
+                                        timeout=10.0)
+                return self._json({
+                    "nodes": dump["nodes"],
+                    "total_resources": res["total"],
+                    "available_resources": res["available"],
+                    "alive_nodes": sum(1 for n in dump["nodes"]
+                                       if n["state"] == "ALIVE"),
+                })
+            if path == "/api/nodes":
+                return self._json(self._state_dump()["nodes"])
+            if path == "/api/actors":
+                return self._json(self._state_dump()["actors"])
+            if path == "/api/placement_groups":
+                return self._json(self._state_dump()["pgs"])
+            if path == "/api/jobs":
+                from ray_tpu.job.job_manager import JOB_NS
+
+                keys = self.control.call(
+                    "kv_keys", {"ns": JOB_NS, "prefix": ""}, timeout=10.0)
+                jobs = []
+                for k in keys:
+                    raw = self.control.call(
+                        "kv_get", {"ns": JOB_NS, "key": k}, timeout=10.0)
+                    if raw:
+                        jobs.append(json.loads(raw))
+                return self._json(jobs)
+            if path == "/api/tasks":
+                limit = int(query.get("limit", ["1000"])[0])
+                out = self.control.call("list_task_events",
+                                        {"limit": limit}, timeout=10.0)
+                return self._json(out)
+            if path == "/api/objects":
+                from ray_tpu.util.state.api import StateApiClient
+
+                c = StateApiClient(self.control_address)
+                try:
+                    return self._json(c.per_node("store_stats"))
+                finally:
+                    c.close()
+            if path == "/metrics":
+                from ray_tpu.util.metrics import (collect_cluster_metrics,
+                                                  prometheus_text)
+
+                merged = collect_cluster_metrics(self.control)
+                return 200, "text/plain; version=0.0.4", \
+                    prometheus_text(merged)
+            return 404, "text/plain", f"no route {path}"
+        except Exception as e:
+            logger.exception("dashboard route %s failed", path)
+            return 500, "text/plain", f"error: {e}"
+
+    def _json(self, obj) -> Tuple[int, str, str]:
+        return 200, "application/json", json.dumps(obj, default=str)
+
+    # -- server ------------------------------------------------------------
+
+    def start(self, block: bool = False):
+        head = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                status, ctype, body = head.route(parsed.path,
+                                                 parse_qs(parsed.query))
+                data = body.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, fmt, *args):
+                logger.debug("http: " + fmt, *args)
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        if block:
+            self._server.serve_forever()
+        else:
+            t = threading.Thread(target=self._server.serve_forever,
+                                 name="dashboard-http", daemon=True)
+            t.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        self.control.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--control", required=True, help="host:port")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8265)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s dashboard %(levelname)s "
+                               "%(message)s")
+    head = DashboardHead(args.control, args.host, args.port)
+    logger.info("dashboard serving at %s", head.url)
+    head.start(block=True)
+
+
+if __name__ == "__main__":
+    main()

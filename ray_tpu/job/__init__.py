@@ -1,0 +1,5 @@
+"""Job submission (reference: python/ray/dashboard/modules/job/)."""
+
+from .job_manager import JobStatus, JobSubmissionClient, JobSupervisor
+
+__all__ = ["JobStatus", "JobSubmissionClient", "JobSupervisor"]

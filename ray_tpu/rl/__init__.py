@@ -1,0 +1,24 @@
+"""RL library (reference: rllib/) — jax-first RL on ray_tpu actors.
+
+New-API-stack shape mirrors the reference: RLModule (network), Learner /
+LearnerGroup (updates), EnvRunner / EnvRunnerGroup (sampling), Algorithm
+(the loop, also a Tune trainable).  TPU-native twist: pure-jax envs make
+the entire rollout one compiled `lax.scan` (see env/jax_env.py).
+"""
+
+from .algorithms.algorithm import Algorithm, AlgorithmConfig
+from .algorithms.dqn import DQN, DQNConfig
+from .algorithms.ppo import PPO, PPOConfig
+from .core.learner import Learner, LearnerGroup
+from .core.rl_module import (DiscretePolicyModule, QModule, RLModule,
+                             module_for_env)
+from .env.env_runner import EnvRunnerGroup, GymEnvRunner, JaxEnvRunner
+from .env.jax_env import CartPole, JaxEnv, make_env, register_env
+from .utils.replay_buffer import ReplayBuffer
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "Learner", "LearnerGroup", "RLModule", "DiscretePolicyModule", "QModule",
+    "module_for_env", "EnvRunnerGroup", "JaxEnvRunner", "GymEnvRunner",
+    "JaxEnv", "CartPole", "make_env", "register_env", "ReplayBuffer",
+]

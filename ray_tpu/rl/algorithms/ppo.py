@@ -1,0 +1,139 @@
+"""PPO: clipped-surrogate policy optimization with GAE.
+
+Analog of the reference's PPO (reference: rllib/algorithms/ppo/ppo.py,
+ppo_learner.py, torch/ppo_torch_learner.py) jax-first: GAE runs as a
+`lax.scan` over the time axis and the whole minibatch epoch loop executes
+as jitted updates on device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl.core.learner import Learner, LearnerGroup
+from ray_tpu.rl.core.rl_module import DiscretePolicyModule
+
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+def compute_gae(rewards, dones, values, final_value, gamma, lam):
+    """[T, B] arrays -> (advantages, value targets) via reverse scan
+    (reference: rllib general_advantage_estimation connector)."""
+    def step(carry, xs):
+        next_adv, next_value = carry
+        reward, done, value = xs
+        nonterminal = 1.0 - done
+        delta = reward + gamma * next_value * nonterminal - value
+        adv = delta + gamma * lam * nonterminal * next_adv
+        return (adv, value), adv
+
+    (_, _), advs = jax.lax.scan(
+        step, (jnp.zeros_like(final_value), final_value),
+        (rewards, dones.astype(jnp.float32), values), reverse=True)
+    return advs, advs + values
+
+
+class PPOLearner(Learner):
+    def __init__(self, module: DiscretePolicyModule, *,
+                 clip_param: float = 0.2, vf_coeff: float = 0.5,
+                 entropy_coeff: float = 0.0, **kwargs):
+        self.clip_param = clip_param
+        self.vf_coeff = vf_coeff
+        self.entropy_coeff = entropy_coeff
+        super().__init__(module, **kwargs)
+
+    def compute_loss(self, params, batch, rng):
+        logits = self.module.logits(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["action"][..., None].astype(jnp.int32),
+            axis=-1)[..., 0]
+        ratio = jnp.exp(logp - batch["logp_old"])
+        adv = batch["advantage"]
+        surrogate = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - self.clip_param, 1 + self.clip_param) * adv)
+        pi_loss = -jnp.mean(surrogate)
+        value = self.module.value(params, batch["obs"])
+        vf_loss = jnp.mean((value - batch["value_target"]) ** 2)
+        entropy = -jnp.mean(
+            jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        loss = pi_loss + self.vf_coeff * vf_loss \
+            - self.entropy_coeff * entropy
+        return loss, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                      "entropy": entropy,
+                      "clip_frac": jnp.mean(
+                          (jnp.abs(ratio - 1) > self.clip_param)
+                          .astype(jnp.float32))}
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.gae_lambda = 0.95
+        self.num_epochs = 4
+        self.minibatch_size = 256
+        self.lr = 3e-4
+
+
+class PPO(Algorithm):
+    module_kind = "policy"
+
+    def _setup(self):
+        cfg: PPOConfig = self.config
+
+        def factory():
+            module = DiscretePolicyModule(self.env_spec["obs_dim"],
+                                          self.env_spec["num_actions"],
+                                          cfg.hidden)
+            return PPOLearner(module, clip_param=cfg.clip_param,
+                              vf_coeff=cfg.vf_coeff,
+                              entropy_coeff=cfg.entropy_coeff,
+                              lr=cfg.lr, seed=cfg.seed)
+
+        self.learner_group = LearnerGroup(factory, cfg.num_learners)
+        self.runners.sync_weights(self.learner_group.get_weights())
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: PPOConfig = self.config
+        results = self.runners.sample(cfg.rollout_len)
+        batch, stats = self._merge_runner_results(results)
+
+        # GAE over the time axis, then flatten [T, B] -> [T*B]
+        rewards = jnp.asarray(batch["reward"])
+        dones = jnp.asarray(batch["done"])
+        values = jnp.asarray(batch["vf"])
+        final_vf = jnp.asarray(batch["final_vf"])
+        adv, vtarg = compute_gae(rewards, dones, values, final_vf,
+                                 cfg.gamma, cfg.gae_lambda)
+        adv = np.asarray(adv).reshape(-1)
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        flat = {
+            "obs": np.asarray(batch["obs"]).reshape(
+                -1, batch["obs"].shape[-1]),
+            "action": np.asarray(batch["action"]).reshape(-1),
+            "logp_old": np.asarray(batch["logp"]).reshape(-1),
+            "advantage": adv,
+            "value_target": np.asarray(vtarg).reshape(-1),
+        }
+        n = flat["obs"].shape[0]
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        metrics: Dict[str, float] = {}
+        for _ in range(cfg.num_epochs):
+            perm = rng.permutation(n)
+            for lo in range(0, n, cfg.minibatch_size):
+                idx = perm[lo:lo + cfg.minibatch_size]
+                metrics = self.learner_group.update(
+                    {k: v[idx] for k, v in flat.items()})
+        self.runners.sync_weights(self.learner_group.get_weights())
+        return {**stats, **metrics}
+
+
+PPOConfig.algo_cls = PPO

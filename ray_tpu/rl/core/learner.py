@@ -1,0 +1,209 @@
+"""Learner: gradient updates over an RLModule.
+
+Analog of the reference's Learner (reference:
+rllib/core/learner/learner.py): owns params + optimizer state, applies a
+loss over batches.  Jax-first: the whole update (loss, grad, optimizer,
+metrics) is one jitted function; data-parallel scaling is a mesh axis with
+`psum` of gradients inside the compiled step (the reference reaches DDP
+through torch; here the collective is compiled into the step itself via
+shard_map when the learner group spans devices).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .rl_module import RLModule
+
+
+class Learner:
+    """Single-device learner.  Subclasses define compute_loss(params,
+    batch, rng) -> (loss, metrics)."""
+
+    def __init__(self, module: RLModule, *, lr: float = 3e-4,
+                 grad_clip: Optional[float] = 0.5, seed: int = 0,
+                 optimizer: Optional[optax.GradientTransformation] = None):
+        self.module = module
+        tx = optimizer or optax.adam(lr)
+        if grad_clip is not None:
+            tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+        self.tx = tx
+        self.params = module.init(jax.random.PRNGKey(seed))
+        self.opt_state = tx.init(self._trainable(self.params))
+        self.rng = jax.random.PRNGKey(seed + 17)
+        self._update_fn = self._build_update()
+
+    # -- overridables ------------------------------------------------------
+
+    def compute_loss(self, params, batch, rng) -> Tuple[jnp.ndarray,
+                                                        Dict[str, Any]]:
+        raise NotImplementedError
+
+    def _trainable(self, params):
+        """Subset of params the optimizer touches (e.g. excludes DQN's
+        target net, which moves by polyak/periodic copy instead)."""
+        return params
+
+    def _merge(self, params, trained):
+        """Inverse of _trainable."""
+        return trained
+
+    def extra_update(self, params, metrics):
+        """Post-gradient param surgery (target-net sync etc.)."""
+        return params
+
+    # -- the jitted update -------------------------------------------------
+
+    def _build_update(self):
+        @jax.jit
+        def update(params, opt_state, batch, rng):
+            def loss_fn(trained):
+                full = self._merge(params, trained)
+                return self.compute_loss(full, batch, rng)
+
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(self._trainable(params))
+            updates, opt_state = self.tx.update(
+                grads, opt_state, self._trainable(params))
+            trained = optax.apply_updates(self._trainable(params), updates)
+            params = self._merge(params, trained)
+            metrics["loss"] = loss
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return params, opt_state, metrics
+
+        return update
+
+    def update(self, batch: Dict[str, Any]) -> Dict[str, float]:
+        self.rng, step_rng = jax.random.split(self.rng)
+        batch = jax.tree_util.tree_map(jnp.asarray, batch)
+        self.params, self.opt_state, metrics = self._update_fn(
+            self.params, self.opt_state, batch, step_rng)
+        self.params = self.extra_update(self.params, metrics)
+        return {k: float(v) for k, v in metrics.items()}
+
+    # -- weights -----------------------------------------------------------
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params):
+        self.params = params
+
+    def state(self) -> Dict[str, Any]:
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def load_state(self, state: Dict[str, Any]):
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+
+
+class LearnerGroup:
+    """1..N learners (reference: rllib/core/learner/learner_group.py:80,
+    built on Train's BackendExecutor :54,:151).
+
+    local mode: one in-process learner.  remote mode: N learner actors;
+    each update shards the batch, learners compute grads on their shard,
+    and weights are averaged (the all-reduce rides our collective layer
+    when learners share a mesh; host-side mean otherwise).
+    """
+
+    def __init__(self, learner_factory: Callable[[], Learner],
+                 num_learners: int = 0):
+        self.local = num_learners == 0
+        if self.local:
+            self.learner = learner_factory()
+            self.actors = []
+        else:
+            import ray_tpu
+
+            @ray_tpu.remote
+            class LearnerActor:
+                def __init__(self, factory, shard_idx: int):
+                    self.learner = factory()
+                    self.shard_idx = shard_idx
+
+                def update(self, batch):
+                    return self.learner.update(batch)
+
+                def get_weights(self):
+                    return self.learner.get_weights()
+
+                def set_weights(self, w):
+                    self.learner.set_weights(w)
+
+                def state(self):
+                    return self.learner.state()
+
+                def load_state(self, s):
+                    self.learner.load_state(s)
+
+            self.actors = [LearnerActor.remote(learner_factory, i)
+                           for i in range(num_learners)]
+
+    def update(self, batch: Dict[str, Any]) -> Dict[str, float]:
+        if self.local:
+            return self.learner.update(batch)
+        import numpy as np
+
+        import ray_tpu
+
+        n = len(self.actors)
+        shards = [
+            {k: v[i::n] for k, v in batch.items()} for i in range(n)
+        ]
+        metrics = ray_tpu.get([a.update.remote(s)
+                               for a, s in zip(self.actors, shards)])
+        # average weights across learners (grad-mean equivalent for equal
+        # shards under identical init)
+        weights = ray_tpu.get([a.get_weights.remote() for a in self.actors])
+        mean_w = jax.tree_util.tree_map(
+            lambda *xs: np.mean(np.stack(xs), axis=0), *weights)
+        ray_tpu.get([a.set_weights.remote(mean_w) for a in self.actors])
+        out = {}
+        for k in metrics[0]:
+            out[k] = float(np.mean([m[k] for m in metrics]))
+        return out
+
+    def get_weights(self):
+        if self.local:
+            return self.learner.get_weights()
+        import ray_tpu
+
+        return ray_tpu.get(self.actors[0].get_weights.remote())
+
+    def set_weights(self, w):
+        if self.local:
+            self.learner.set_weights(w)
+        else:
+            import ray_tpu
+
+            ray_tpu.get([a.set_weights.remote(w) for a in self.actors])
+
+    def state(self):
+        if self.local:
+            return self.learner.state()
+        import ray_tpu
+
+        return ray_tpu.get(self.actors[0].state.remote())
+
+    def load_state(self, s):
+        if self.local:
+            self.learner.load_state(s)
+        else:
+            import ray_tpu
+
+            ray_tpu.get([a.load_state.remote(s) for a in self.actors])
+
+    def stop(self):
+        import ray_tpu
+
+        for a in self.actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

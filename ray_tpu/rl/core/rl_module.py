@@ -1,0 +1,136 @@
+"""RLModule: the neural-network policy/value container.
+
+Analog of the reference's new-API-stack RLModule (reference:
+rllib/core/rl_module/rl_module.py) redesigned jax-first: a module is a
+bundle of pure functions over a params pytree — no framework Module
+objects cross process boundaries, only arrays — so the same module runs
+under jit/vmap/scan on TPU, and checkpointing is a pytree save.
+
+Three forward passes mirror the reference's contract:
+  forward_exploration(params, obs, rng) -> action, logp, extras (sampling)
+  forward_inference(params, obs)        -> deterministic action
+  forward_train(params, batch)          -> dists/values for the loss
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _init_linear(rng, n_in: int, n_out: int, scale: float = None):
+    w_key, _ = jax.random.split(rng)
+    scale = scale if scale is not None else math.sqrt(2.0 / n_in)
+    return {
+        "w": jax.random.normal(w_key, (n_in, n_out), jnp.float32) * scale,
+        "b": jnp.zeros((n_out,), jnp.float32),
+    }
+
+
+def _linear(p, x):
+    return x @ p["w"] + p["b"]
+
+
+def _mlp_init(rng, sizes: Sequence[int], out_scale: float = 0.01):
+    params = []
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        last = i == len(sizes) - 2
+        params.append(_init_linear(keys[i], a, b,
+                                   out_scale if last else None))
+    return params
+
+
+def _mlp_apply(params, x):
+    for i, p in enumerate(params):
+        x = _linear(p, x)
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class RLModule:
+    """Base: subclasses define init() and the forward fns as pure fns."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, rng) -> Any:
+        raise NotImplementedError
+
+    def forward_exploration(self, params, obs, rng):
+        raise NotImplementedError
+
+    def forward_inference(self, params, obs):
+        raise NotImplementedError
+
+
+class DiscretePolicyModule(RLModule):
+    """Separate policy and value MLP heads over a shared input
+    (reference: rllib default MLP RLModule for PG algorithms)."""
+
+    def init(self, rng):
+        pi_rng, vf_rng = jax.random.split(rng)
+        sizes = (self.obs_dim, *self.hidden)
+        return {
+            "pi": _mlp_init(pi_rng, (*sizes, self.num_actions)),
+            "vf": _mlp_init(vf_rng, (*sizes, 1), out_scale=1.0),
+        }
+
+    # -- pure functions (safe under jit) -----------------------------------
+
+    def logits(self, params, obs):
+        return _mlp_apply(params["pi"], obs)
+
+    def value(self, params, obs):
+        return _mlp_apply(params["vf"], obs)[..., 0]
+
+    def forward_exploration(self, params, obs, rng):
+        logits = self.logits(params, obs)
+        action = jax.random.categorical(rng, logits)
+        logp = jax.nn.log_softmax(logits)
+        logp_a = jnp.take_along_axis(logp, action[..., None],
+                                     axis=-1)[..., 0]
+        return action, {"logp": logp_a, "vf": self.value(params, obs)}
+
+    def forward_inference(self, params, obs):
+        return jnp.argmax(self.logits(params, obs), axis=-1)
+
+
+class QModule(RLModule):
+    """State-action value net for DQN-family algorithms
+    (reference: rllib/algorithms/dqn/ default module)."""
+
+    def init(self, rng):
+        q_rng, t_rng = jax.random.split(rng)
+        sizes = (self.obs_dim, *self.hidden, self.num_actions)
+        q = _mlp_init(q_rng, sizes, out_scale=0.01)
+        return {"q": q, "target_q": jax.tree_util.tree_map(jnp.copy, q)}
+
+    def q_values(self, params, obs, target: bool = False):
+        return _mlp_apply(params["target_q" if target else "q"], obs)
+
+    def forward_exploration(self, params, obs, rng, epsilon: float = 0.05):
+        q = self.q_values(params, obs)
+        greedy = jnp.argmax(q, axis=-1)
+        rand_rng, pick_rng = jax.random.split(rng)
+        random_a = jax.random.randint(rand_rng, greedy.shape, 0,
+                                      self.num_actions)
+        explore = jax.random.uniform(pick_rng, greedy.shape) < epsilon
+        return jnp.where(explore, random_a, greedy), {}
+
+    def forward_inference(self, params, obs):
+        return jnp.argmax(self.q_values(params, obs), axis=-1)
+
+
+def module_for_env(env_spec: Dict[str, Any], kind: str = "policy",
+                   hidden: Sequence[int] = (64, 64)) -> RLModule:
+    cls = DiscretePolicyModule if kind == "policy" else QModule
+    return cls(env_spec["obs_dim"], env_spec["num_actions"], hidden)

@@ -1,0 +1,261 @@
+"""EnvRunners: sampling actors.
+
+Analog of the reference's EnvRunner/EnvRunnerGroup (reference:
+rllib/env/env_runner.py, rllib/env/env_runner_group.py): a group of actors
+each owning vectorized environments, sampling with the current policy
+weights, returning batches to the algorithm.
+
+Two runner kinds:
+  * JaxEnvRunner — pure-jax envs, fully jitted lax.scan rollouts (the
+    TPU-native path; sampling itself compiles).
+  * GymEnvRunner — gymnasium envs stepped host-side (API-parity path for
+    external envs the reference supports).
+
+Both return batches as a dict of numpy [T, B, ...] arrays plus episode
+stats, so learners consume one format.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+class _EpisodeTracker:
+    """Accumulates per-env episode return/length across batch boundaries."""
+
+    def __init__(self, num_envs: int):
+        self.returns = np.zeros(num_envs)
+        self.lengths = np.zeros(num_envs, np.int64)
+        self.completed: List[float] = []
+
+    def update(self, rewards: np.ndarray, dones: np.ndarray):
+        # rewards/dones: [T, B]
+        for t in range(rewards.shape[0]):
+            self.returns += rewards[t]
+            self.lengths += 1
+            for i in np.nonzero(dones[t])[0]:
+                self.completed.append(float(self.returns[i]))
+                self.returns[i] = 0.0
+                self.lengths[i] = 0
+
+    def pop_stats(self) -> Dict[str, float]:
+        done, self.completed = self.completed, []
+        if not done:
+            return {"episodes_this_iter": 0}
+        return {
+            "episodes_this_iter": len(done),
+            "episode_return_mean": float(np.mean(done)),
+            "episode_return_max": float(np.max(done)),
+            "episode_return_min": float(np.min(done)),
+        }
+
+
+class JaxEnvRunner:
+    """Sampling over pure-jax envs; the rollout is one compiled scan."""
+
+    def __init__(self, env_name: str, module_spec: Dict[str, Any],
+                 num_envs: int = 8, seed: int = 0,
+                 explore_kwargs: Optional[Dict[str, Any]] = None):
+        import jax
+
+        from ray_tpu.rl.core.rl_module import module_for_env
+        from ray_tpu.rl.env import jax_env
+
+        self.env = jax_env.make_env(env_name)
+        self.module = module_for_env(self.env.spec,
+                                     kind=module_spec.get("kind", "policy"),
+                                     hidden=module_spec.get("hidden",
+                                                            (64, 64)))
+        self.num_envs = num_envs
+        self.explore_kwargs = explore_kwargs or {}
+        self.params = self.module.init(jax.random.PRNGKey(seed))
+        self.carry = jax_env.init_carry(self.env, jax.random.PRNGKey(seed + 1),
+                                        num_envs)
+        self.tracker = _EpisodeTracker(num_envs)
+        self._steps_sampled = 0
+        self._build_policy_fn()
+
+    def _build_policy_fn(self):
+        # one closure instance per (module, explore_kwargs): it is a static
+        # jit arg of rollout(), so a fresh closure per sample() would
+        # retrace every call
+        kwargs = dict(self.explore_kwargs)
+        module = self.module
+
+        def policy_fn(params, obs, rng):
+            return module.forward_exploration(params, obs, rng, **kwargs)
+
+        self._policy_fn = policy_fn
+
+    def set_explore(self, **kwargs):
+        """Update exploration params (e.g. epsilon decay); retraces once."""
+        self.explore_kwargs.update(kwargs)
+        self._build_policy_fn()
+
+    def set_weights(self, params):
+        self.params = params
+
+    def get_weights(self):
+        return self.params
+
+    def env_spec(self) -> Dict[str, Any]:
+        return dict(self.env.spec)
+
+    def sample(self, num_steps: int) -> Dict[str, Any]:
+        """num_steps per env; returns [T, B, ...] numpy batch + stats."""
+        import jax
+
+        from ray_tpu.rl.env.jax_env import rollout
+
+        self.carry, batch = rollout(self.env, self._policy_fn, self.params,
+                                    self.carry, num_steps)
+        # bootstrap value for the obs after the last step (GAE tail)
+        final_obs = self.carry[1]
+        if hasattr(self.module, "value"):
+            batch["final_vf"] = self.module.value(self.params, final_obs)
+        batch = jax.tree_util.tree_map(np.asarray, batch)
+        self.tracker.update(batch["reward"], batch["done"])
+        self._steps_sampled += num_steps * self.num_envs
+        stats = self.tracker.pop_stats()
+        stats["env_steps_sampled"] = self._steps_sampled
+        return {"batch": batch, "stats": stats}
+
+
+class GymEnvRunner:
+    """Host-side gymnasium sampling (reference:
+    single_agent_env_runner.py with gym.vector.SyncVectorEnv)."""
+
+    def __init__(self, env_name: str, module_spec: Dict[str, Any],
+                 num_envs: int = 8, seed: int = 0,
+                 explore_kwargs: Optional[Dict[str, Any]] = None):
+        import gymnasium as gym
+        import jax
+
+        from ray_tpu.rl.core.rl_module import module_for_env
+
+        self.envs = gym.vector.SyncVectorEnv(
+            [lambda: gym.make(env_name) for _ in range(num_envs)])
+        obs_space = self.envs.single_observation_space
+        act_space = self.envs.single_action_space
+        self.spec = {"obs_dim": int(np.prod(obs_space.shape)),
+                     "num_actions": int(act_space.n),
+                     "max_episode_steps": 0}
+        self.module = module_for_env(self.spec,
+                                     kind=module_spec.get("kind", "policy"),
+                                     hidden=module_spec.get("hidden",
+                                                            (64, 64)))
+        self.num_envs = num_envs
+        self.explore_kwargs = explore_kwargs or {}
+        self.params = self.module.init(jax.random.PRNGKey(seed))
+        self.rng = jax.random.PRNGKey(seed + 1)
+        self.obs, _ = self.envs.reset(seed=seed)
+        self.tracker = _EpisodeTracker(num_envs)
+        self._steps_sampled = 0
+
+    def set_explore(self, **kwargs):
+        self.explore_kwargs.update(kwargs)
+
+    def set_weights(self, params):
+        self.params = params
+
+    def get_weights(self):
+        return self.params
+
+    def env_spec(self) -> Dict[str, Any]:
+        return dict(self.spec)
+
+    def sample(self, num_steps: int) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        rows = []
+        for _ in range(num_steps):
+            self.rng, act_rng = jax.random.split(self.rng)
+            obs = jnp.asarray(self.obs, jnp.float32)
+            action, extras = self.module.forward_exploration(
+                self.params, obs, act_rng, **self.explore_kwargs)
+            action_np = np.asarray(action)
+            next_obs, reward, term, trunc, _ = self.envs.step(action_np)
+            done = np.logical_or(term, trunc)
+            rows.append({"obs": np.asarray(obs), "action": action_np,
+                         "reward": np.asarray(reward, np.float32),
+                         "done": done,
+                         **{k: np.asarray(v) for k, v in extras.items()}})
+            self.obs = next_obs
+        batch = {k: np.stack([r[k] for r in rows]) for k in rows[0]}
+        if hasattr(self.module, "value"):
+            batch["final_vf"] = np.asarray(self.module.value(
+                self.params, jnp.asarray(self.obs, jnp.float32)))
+        self.tracker.update(batch["reward"], batch["done"])
+        self._steps_sampled += num_steps * self.num_envs
+        stats = self.tracker.pop_stats()
+        stats["env_steps_sampled"] = self._steps_sampled
+        return {"batch": batch, "stats": stats}
+
+
+def make_runner(kind: str, **kwargs):
+    return (JaxEnvRunner if kind == "jax" else GymEnvRunner)(**kwargs)
+
+
+class EnvRunnerGroup:
+    """N remote runner actors + weight broadcast (reference:
+    rllib/env/env_runner_group.py EnvRunnerGroup.sync_weights)."""
+
+    def __init__(self, *, env_name: str, module_spec: Dict[str, Any],
+                 num_runners: int = 2, num_envs_per_runner: int = 8,
+                 runner_kind: str = "jax", seed: int = 0,
+                 explore_kwargs: Optional[Dict[str, Any]] = None,
+                 local: bool = False):
+        self.local = local or num_runners == 0
+        if self.local:
+            self.runner = make_runner(
+                runner_kind, env_name=env_name, module_spec=module_spec,
+                num_envs=num_envs_per_runner, seed=seed,
+                explore_kwargs=explore_kwargs)
+            self.actors = []
+        else:
+            RemoteRunner = ray_tpu.remote(
+                JaxEnvRunner if runner_kind == "jax" else GymEnvRunner)
+            self.actors = [
+                RemoteRunner.remote(
+                    env_name=env_name, module_spec=module_spec,
+                    num_envs=num_envs_per_runner, seed=seed + 1000 * i,
+                    explore_kwargs=explore_kwargs)
+                for i in range(num_runners)
+            ]
+
+    def env_spec(self) -> Dict[str, Any]:
+        if self.local:
+            return self.runner.env_spec()
+        return ray_tpu.get(self.actors[0].env_spec.remote())
+
+    def sample(self, num_steps: int) -> List[Dict[str, Any]]:
+        if self.local:
+            return [self.runner.sample(num_steps)]
+        return ray_tpu.get([a.sample.remote(num_steps)
+                            for a in self.actors])
+
+    def sync_weights(self, params):
+        if self.local:
+            self.runner.set_weights(params)
+        else:
+            ref = ray_tpu.put(params)
+            ray_tpu.get([a.set_weights.remote(ref) for a in self.actors])
+
+    def set_explore(self, **kwargs):
+        if self.local:
+            self.runner.set_explore(**kwargs)
+        else:
+            ray_tpu.get([a.set_explore.remote(**kwargs)
+                         for a in self.actors])
+
+    def stop(self):
+        for a in self.actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

@@ -1,0 +1,153 @@
+"""Pure-functional jax environments: TPU-native rollouts.
+
+The reference samples with Python gymnasium loops on CPU EnvRunner actors
+(reference: rllib/env/single_agent_env_runner.py).  The TPU-native design
+goes further: an environment is a pair of pure functions
+
+    reset(rng)           -> (state, obs)
+    step(state, action)  -> (state, obs, reward, done)
+
+so a whole rollout is one `lax.scan` — sampling compiles onto the
+accelerator with zero host round-trips (the gymnax/brax pattern), and
+vectorization is `vmap` instead of subprocess pools.  Gymnasium envs
+remain supported host-side via env_runner.GymEnvRunner for API parity.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class JaxEnv:
+    """Stateless env description; state is an explicit pytree."""
+
+    #: dict with obs_dim / num_actions / max_episode_steps
+    spec: Dict[str, Any]
+
+    def reset(self, rng) -> Tuple[Any, jnp.ndarray]:
+        raise NotImplementedError
+
+    def step(self, state, action) -> Tuple[Any, jnp.ndarray, jnp.ndarray,
+                                           jnp.ndarray]:
+        raise NotImplementedError
+
+
+class CartPoleState(NamedTuple):
+    x: jnp.ndarray
+    x_dot: jnp.ndarray
+    theta: jnp.ndarray
+    theta_dot: jnp.ndarray
+    t: jnp.ndarray
+    rng: jnp.ndarray
+
+
+class CartPole(JaxEnv):
+    """CartPole-v1 dynamics (matches gymnasium classic_control cartpole:
+    same constants, Euler integration, termination bounds), as pure jax.
+    """
+
+    GRAVITY = 9.8
+    MASSCART = 1.0
+    MASSPOLE = 0.1
+    TOTAL_MASS = MASSCART + MASSPOLE
+    LENGTH = 0.5
+    POLEMASS_LENGTH = MASSPOLE * LENGTH
+    FORCE_MAG = 10.0
+    TAU = 0.02
+    THETA_LIMIT = 12 * 2 * jnp.pi / 360
+    X_LIMIT = 2.4
+
+    spec = {"obs_dim": 4, "num_actions": 2, "max_episode_steps": 500}
+
+    def reset(self, rng):
+        rng, sub = jax.random.split(rng)
+        vals = jax.random.uniform(sub, (4,), minval=-0.05, maxval=0.05)
+        state = CartPoleState(vals[0], vals[1], vals[2], vals[3],
+                              jnp.zeros((), jnp.int32), rng)
+        return state, self._obs(state)
+
+    def _obs(self, s: CartPoleState):
+        return jnp.stack([s.x, s.x_dot, s.theta, s.theta_dot])
+
+    def step(self, s: CartPoleState, action):
+        force = jnp.where(action == 1, self.FORCE_MAG, -self.FORCE_MAG)
+        costheta = jnp.cos(s.theta)
+        sintheta = jnp.sin(s.theta)
+        temp = (force + self.POLEMASS_LENGTH * s.theta_dot ** 2 * sintheta) \
+            / self.TOTAL_MASS
+        thetaacc = (self.GRAVITY * sintheta - costheta * temp) / (
+            self.LENGTH * (4.0 / 3.0
+                           - self.MASSPOLE * costheta ** 2 / self.TOTAL_MASS))
+        xacc = temp - self.POLEMASS_LENGTH * thetaacc * costheta \
+            / self.TOTAL_MASS
+        x = s.x + self.TAU * s.x_dot
+        x_dot = s.x_dot + self.TAU * xacc
+        theta = s.theta + self.TAU * s.theta_dot
+        theta_dot = s.theta_dot + self.TAU * thetaacc
+        t = s.t + 1
+        done = (
+            (jnp.abs(x) > self.X_LIMIT)
+            | (jnp.abs(theta) > self.THETA_LIMIT)
+            | (t >= self.spec["max_episode_steps"])
+        )
+        # auto-reset on done (vectorized envs never sit idle)
+        rng, sub = jax.random.split(s.rng)
+        reset_vals = jax.random.uniform(sub, (4,), minval=-0.05, maxval=0.05)
+        new = CartPoleState(
+            jnp.where(done, reset_vals[0], x),
+            jnp.where(done, reset_vals[1], x_dot),
+            jnp.where(done, reset_vals[2], theta),
+            jnp.where(done, reset_vals[3], theta_dot),
+            jnp.where(done, 0, t), rng)
+        return new, self._obs(new), jnp.ones(()), done
+
+
+_REGISTRY: Dict[str, Callable[[], JaxEnv]] = {
+    "CartPole-v1": CartPole,
+}
+
+
+def register_env(name: str, ctor: Callable[[], JaxEnv]):
+    _REGISTRY[name] = ctor
+
+
+def make_env(name: str) -> JaxEnv:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown jax env {name!r}; "
+                       f"registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]()
+
+
+@partial(jax.jit, static_argnums=(0, 1, 4))
+def rollout(env: JaxEnv, policy_fn, params, carry, num_steps: int):
+    """Vectorized on-device rollout: (states, rngs) x num_steps scan.
+
+    policy_fn(params, obs, rng) -> (action, extras) — typically
+    RLModule.forward_exploration.  carry = (env_states, obs, rng) from a
+    previous call (or `init_carry`), so sampling is continuous across
+    batch boundaries like the reference's EnvRunner.
+
+    Returns (new_carry, batch) where batch arrays are [T, B, ...]:
+    obs, action, reward, done, plus whatever extras policy_fn emits.
+    """
+    def one_step(carry, _):
+        states, obs, rng = carry
+        rng, act_rng = jax.random.split(rng)
+        action, extras = policy_fn(params, obs, act_rng)
+        states, next_obs, reward, done = jax.vmap(env.step)(states, action)
+        out = {"obs": obs, "action": action, "reward": reward,
+               "done": done, **extras}
+        return (states, next_obs, rng), out
+
+    carry, batch = jax.lax.scan(one_step, carry, None, length=num_steps)
+    return carry, batch
+
+
+def init_carry(env: JaxEnv, rng, num_envs: int):
+    rngs = jax.random.split(rng, num_envs + 1)
+    states, obs = jax.vmap(env.reset)(rngs[1:])
+    return states, obs, rngs[0]

@@ -1,0 +1,323 @@
+"""The ``ray-tpu`` command line interface.
+
+Analog of the reference's `ray` CLI (reference:
+python/ray/scripts/scripts.py — start :626, stop :1102, status, submit
+:1636, plus the state CLI `ray list/summary/timeline` from
+python/ray/util/state/state_cli.py).
+
+Run as ``python -m ray_tpu <command>``.  Cluster bookkeeping: the head
+writes ``/tmp/ray_tpu/ray_current_cluster.json`` (control address + daemon
+pids) which stop/status/submit read back; ``ray_tpu.init(address="auto")``
+uses the same file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+CLUSTER_FILE = "/tmp/ray_tpu/ray_current_cluster.json"
+DEFAULT_PORT = 6380
+
+
+def _write_cluster_file(info):
+    os.makedirs(os.path.dirname(CLUSTER_FILE), exist_ok=True)
+    with open(CLUSTER_FILE, "w") as f:
+        json.dump(info, f)
+
+
+def read_cluster_file():
+    if not os.path.exists(CLUSTER_FILE):
+        return None
+    with open(CLUSTER_FILE) as f:
+        return json.load(f)
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None)
+    if addr and addr != "auto":
+        return addr
+    info = read_cluster_file()
+    if info is None:
+        raise SystemExit("no running cluster found (ray-tpu start --head "
+                         "first, or pass --address)")
+    return info["control_address"]
+
+
+# -- start / stop / status ---------------------------------------------------
+
+def cmd_start(args):
+    from ray_tpu._private import accelerators, common
+    from ray_tpu._private.bootstrap import Cluster, _spawn, _wait_ping
+
+    if args.head:
+        session_name = f"cli-{int(time.time())}"
+        cluster = Cluster(session_name=session_name)
+        host = args.node_ip_address
+        port = args.port or DEFAULT_PORT
+        cluster.control_proc = _spawn(
+            [sys.executable, "-m", "ray_tpu._private.control",
+             "--host", host, "--port", str(port)],
+            os.path.join(cluster.log_dir, "control.log"))
+        cluster.control_addr = (host, port)
+        _wait_ping(cluster.control_addr, what="control plane")
+        control_address = f"{host}:{port}"
+    else:
+        control_address = _resolve_address(args) if args.address is None \
+            else args.address
+        cluster = None
+
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    else:
+        resources.setdefault("CPU", float(os.cpu_count() or 1))
+    num_tpus = (args.num_tpus if args.num_tpus is not None
+                else accelerators.num_tpu_chips())
+    if num_tpus:
+        resources.setdefault("TPU", float(num_tpus))
+
+    if args.head:
+        node = cluster.add_node(resources=resources)
+        _write_cluster_file({
+            "control_address": control_address,
+            "session_dir": cluster.session_dir,
+            "control_pid": cluster.control_proc.pid,
+            "raylet_pids": [node.proc.pid],
+        })
+        print(f"ray_tpu head started at {control_address}")
+        print(f"  connect: ray_tpu.init(address='{control_address}')  "
+              f"or ray_tpu.init(address='auto')")
+    else:
+        # worker node joining an existing cluster
+        from ray_tpu._private.bootstrap import Cluster as _C
+
+        c = _C(session_name=f"cli-worker-{int(time.time())}")
+        c.control_addr = tuple(control_address.rsplit(":", 1))
+        c.control_addr = (c.control_addr[0], int(c.control_addr[1]))
+        node = c.add_node(resources=resources)
+        info = read_cluster_file()
+        if info:
+            info.setdefault("raylet_pids", []).append(node.proc.pid)
+            _write_cluster_file(info)
+        print(f"ray_tpu node joined {control_address} "
+              f"(node id {node.node_id[:12]})")
+        cluster = c
+
+    if args.block:
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            if cluster is not None:
+                cluster.shutdown()
+
+
+def cmd_stop(args):
+    info = read_cluster_file()
+    if info is None:
+        print("no running cluster")
+        return
+    pids = [info.get("control_pid")] + info.get("raylet_pids", [])
+    killed = 0
+    # raylets first so they fan shutdown out to their workers
+    for pid in reversed([p for p in pids if p]):
+        try:
+            os.killpg(os.getpgid(pid), signal.SIGTERM)
+            killed += 1
+        except (ProcessLookupError, PermissionError):
+            try:
+                os.kill(pid, signal.SIGTERM)
+                killed += 1
+            except OSError:
+                pass
+    try:
+        os.remove(CLUSTER_FILE)
+    except OSError:
+        pass
+    print(f"stopped {killed} daemon(s)")
+
+
+def cmd_status(args):
+    from ray_tpu.util.state import api as state
+
+    address = _resolve_address(args)
+    nodes = state.list_nodes(address=address)
+    total = state.cluster_resources(address=address)
+    avail = state.available_resources(address=address)
+    actors = state.list_actors(address=address)
+    print(f"cluster at {address}")
+    print(f"  nodes: {sum(1 for n in nodes if n['state'] == 'ALIVE')} alive"
+          f" / {len(nodes)} total")
+    for n in nodes:
+        print(f"    {n['node_id'][:12]} {n['state']:6} {n['total']}")
+    print(f"  resources: {avail} free of {total}")
+    alive = sum(1 for a in actors if a.get("state") == "ALIVE")
+    print(f"  actors: {alive} alive / {len(actors)} total")
+
+
+# -- job commands ------------------------------------------------------------
+
+def cmd_submit(args):
+    from ray_tpu.job import JobStatus, JobSubmissionClient
+
+    address = _resolve_address(args)
+    client = JobSubmissionClient(address=address)
+    parts = args.entrypoint
+    if parts and parts[0] == "--":
+        parts = parts[1:]
+    import shlex
+
+    entrypoint = shlex.join(parts)
+    sid = client.submit_job(
+        entrypoint=entrypoint,
+        runtime_env=json.loads(args.runtime_env) if args.runtime_env else None,
+        submission_id=args.submission_id)
+    print(f"submitted job {sid}")
+    if args.no_wait:
+        return
+    status = client.wait_until_finish(sid, timeout=args.timeout)
+    logs = client.get_job_logs(sid)
+    if logs:
+        sys.stdout.write(logs)
+    print(f"job {sid}: {status}")
+    if status != JobStatus.SUCCEEDED:
+        raise SystemExit(1)
+
+
+def cmd_job(args):
+    from ray_tpu.job import JobSubmissionClient
+
+    client = JobSubmissionClient(address=_resolve_address(args))
+    if args.job_cmd == "list":
+        for j in client.list_jobs():
+            print(f"{j['submission_id']}  {j['status']:10} "
+                  f"{j.get('entrypoint', '')[:60]}")
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.id))
+    elif args.job_cmd == "logs":
+        sys.stdout.write(client.get_job_logs(args.id))
+    elif args.job_cmd == "stop":
+        print("stopped" if client.stop_job(args.id) else "not running")
+
+
+# -- state commands ----------------------------------------------------------
+
+_LISTABLE = ("nodes", "actors", "tasks", "workers", "objects",
+             "placement_groups", "jobs")
+
+
+def cmd_list(args):
+    from ray_tpu.util.state import api as state
+
+    fn = getattr(state, f"list_{args.resource}")
+    rows = fn(address=_resolve_address(args), limit=args.limit)
+    if args.format == "json":
+        print(json.dumps(rows, indent=2, default=str))
+    else:
+        for r in rows:
+            print(json.dumps(r, default=str))
+    print(f"({len(rows)} {args.resource})", file=sys.stderr)
+
+
+def cmd_summary(args):
+    from ray_tpu.util.state import api as state
+
+    fn = getattr(state, f"summarize_{args.resource}")
+    print(json.dumps(fn(address=_resolve_address(args)), indent=2,
+                     default=str))
+
+
+def cmd_timeline(args):
+    from ray_tpu.util.state import api as state
+
+    state.timeline(args.output, address=_resolve_address(args))
+    print(f"wrote {args.output}")
+
+
+def cmd_memory(args):
+    from ray_tpu.util.state import api as state
+
+    address = _resolve_address(args)
+    print(json.dumps(state.summarize_objects(address=address), indent=2))
+
+
+# -- parser ------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ray-tpu", description="ray_tpu cluster CLI")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head or worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default=None,
+                    help="control address to join (worker nodes)")
+    sp.add_argument("--port", type=int, default=None)
+    sp.add_argument("--node-ip-address", default="127.0.0.1")
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpus", type=float, default=None)
+    sp.add_argument("--resources", default=None, help="JSON dict")
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the local cluster")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster status")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("submit", help="submit a job")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--runtime-env", default=None, help="JSON dict")
+    sp.add_argument("--submission-id", default=None)
+    sp.add_argument("--no-wait", action="store_true")
+    sp.add_argument("--timeout", type=float, default=3600.0)
+    sp.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_submit)
+
+    sp = sub.add_parser("job", help="manage jobs")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    for c in ("list", "status", "logs", "stop"):
+        jp = jsub.add_parser(c)
+        jp.add_argument("--address", default=None)
+        if c != "list":
+            jp.add_argument("id")
+    sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("list", help="list cluster entities")
+    sp.add_argument("resource", choices=_LISTABLE)
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--limit", type=int, default=100)
+    sp.add_argument("--format", choices=("jsonl", "json"), default="jsonl")
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="summarize tasks/actors/objects")
+    sp.add_argument("resource", choices=("tasks", "actors", "objects"))
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("timeline", help="export Chrome trace")
+    sp.add_argument("-o", "--output", default="timeline.json")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("memory", help="object store summary")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_memory)
+
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,224 @@
+"""User-defined metrics API: Counter / Gauge / Histogram.
+
+Analog of the reference's ray.util.metrics (reference:
+python/ray/util/metrics.py backed by the C++ opencensus registry,
+src/ray/stats/metric.h): metrics register in a process-local registry; a
+flusher thread publishes snapshots into the control-plane KV under the
+``_metrics`` namespace keyed by worker id; the dashboard merges all
+snapshots and serves Prometheus text exposition (reference: metric
+exporter -> agent -> Prometheus endpoint).
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+METRICS_NS = "_metrics"
+FLUSH_INTERVAL_S = 2.0
+
+_DEFAULT_HIST_BOUNDARIES = [
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000,
+]
+
+
+class _Registry:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.metrics: List["Metric"] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def register(self, metric: "Metric"):
+        with self.lock:
+            self.metrics.append(metric)
+        self._ensure_flusher()
+
+    def snapshot(self) -> List[Dict]:
+        with self.lock:
+            return [m._snapshot() for m in self.metrics]
+
+    def _ensure_flusher(self):
+        with self.lock:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(
+                target=self._flush_loop, name="metrics-flush", daemon=True)
+            self._thread.start()
+
+    def _flush_loop(self):
+        while not self._stop.wait(FLUSH_INTERVAL_S):
+            self.flush()
+
+    def flush(self):
+        from ray_tpu._private.api import current_core
+
+        core = current_core()
+        if core is None or getattr(core, "_shutdown", False):
+            return
+        snap = self.snapshot()
+        if not snap:
+            return
+        try:
+            core.control.call("kv_put", {
+                "ns": METRICS_NS,
+                "key": core.worker_id,
+                "val": pickle.dumps({"ts": time.time(), "metrics": snap}),
+            }, timeout=5.0)
+        except Exception:
+            pass
+
+
+_registry = _Registry()
+
+
+def collect_cluster_metrics(control_client) -> List[Dict]:
+    """Merge every process's last snapshot (dashboard-side helper)."""
+    merged: List[Dict] = []
+    try:
+        keys = control_client.call("kv_keys",
+                                   {"ns": METRICS_NS, "prefix": ""},
+                                   timeout=5.0)
+        for k in keys:
+            raw = control_client.call("kv_get",
+                                      {"ns": METRICS_NS, "key": k},
+                                      timeout=5.0)
+            if raw:
+                snap = pickle.loads(raw)
+                for m in snap["metrics"]:
+                    m["worker_id"] = k
+                    merged.append(m)
+    except Exception:
+        pass
+    return merged
+
+
+def prometheus_text(metric_dicts: List[Dict]) -> str:
+    """Render merged snapshots in Prometheus exposition format."""
+    by_name: Dict[str, List[Dict]] = {}
+    for m in metric_dicts:
+        by_name.setdefault(m["name"], []).append(m)
+    lines = []
+    for name, ms in sorted(by_name.items()):
+        kind = ms[0]["type"]
+        prom_type = {"counter": "counter", "gauge": "gauge",
+                     "histogram": "histogram"}[kind]
+        desc = ms[0].get("description", "")
+        lines.append(f"# HELP {name} {desc}")
+        lines.append(f"# TYPE {name} {prom_type}")
+        for m in ms:
+            for tags_json, value in m["series"].items():
+                tags = json.loads(tags_json)
+                tags["WorkerId"] = m.get("worker_id", "")[:16]
+                label = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+                if kind == "histogram":
+                    counts, total, num = value
+                    acc = 0
+                    for b, c in zip(m["boundaries"], counts):
+                        acc += c
+                        lines.append(
+                            f'{name}_bucket{{{label},le="{b}"}} {acc}')
+                    lines.append(
+                        f'{name}_bucket{{{label},le="+Inf"}} {num}')
+                    lines.append(f"{name}_sum{{{label}}} {total}")
+                    lines.append(f"{name}_count{{{label}}} {num}")
+                else:
+                    lines.append(f"{name}{{{label}}} {value}")
+    return "\n".join(lines) + "\n"
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name:
+            raise ValueError("metric name required")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._series: Dict[str, object] = {}  # json(tags) -> value
+        _registry.register(self)
+
+    def set_default_tags(self, default_tags: Dict[str, str]):
+        self._default_tags = dict(default_tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> str:
+        merged = {**self._default_tags, **(tags or {})}
+        extra = set(merged) - set(self._tag_keys)
+        if extra:
+            raise ValueError(f"tags {extra} not in tag_keys "
+                             f"{self._tag_keys} of metric {self._name}")
+        return json.dumps(merged, sort_keys=True)
+
+    @property
+    def info(self) -> Dict:
+        return {"name": self._name, "description": self._description,
+                "tag_keys": self._tag_keys,
+                "default_tags": dict(self._default_tags)}
+
+
+class Counter(Metric):
+    """Monotonic counter (reference: util/metrics.py Counter)."""
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        if value <= 0:
+            raise ValueError("Counter.inc requires value > 0")
+        k = self._key(tags)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0.0) + value
+
+    def _snapshot(self):
+        with self._lock:
+            return {"name": self._name, "type": "counter",
+                    "description": self._description,
+                    "series": dict(self._series)}
+
+
+class Gauge(Metric):
+    """Last-value gauge."""
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        k = self._key(tags)
+        with self._lock:
+            self._series[k] = float(value)
+
+    def _snapshot(self):
+        with self._lock:
+            return {"name": self._name, "type": "gauge",
+                    "description": self._description,
+                    "series": dict(self._series)}
+
+
+class Histogram(Metric):
+    """Bucketed histogram; series value = (bucket_counts, sum, count)."""
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        self._boundaries = sorted(boundaries or _DEFAULT_HIST_BOUNDARIES)
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        k = self._key(tags)
+        with self._lock:
+            counts, total, num = self._series.get(
+                k, ([0] * len(self._boundaries), 0.0, 0))
+            counts = list(counts)
+            for i, b in enumerate(self._boundaries):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            self._series[k] = (counts, total + value, num + 1)
+
+    def _snapshot(self):
+        with self._lock:
+            return {"name": self._name, "type": "histogram",
+                    "description": self._description,
+                    "boundaries": list(self._boundaries),
+                    "series": dict(self._series)}

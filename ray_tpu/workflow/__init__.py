@@ -1,0 +1,16 @@
+"""ray_tpu.workflow: durable DAG execution.
+
+Reference: python/ray/workflow/ (10.3k LoC — api.py:123 run, :243 resume,
+workflow_executor.py, workflow_storage.py).  Each step's result is
+persisted to storage before the next step runs; a crashed or cancelled
+workflow resumes from its last completed step.  Checkpointing long
+TPU-training DAGs composes with Train's orbax checkpoints: workflow steps
+persist the *control* state (which stage finished), the model state lives
+in the step's own checkpoint artifacts.
+"""
+
+from .api import (WorkflowStatus, delete, get_output, get_status, list_all,
+                  resume, run, run_async)
+
+__all__ = ["WorkflowStatus", "delete", "get_output", "get_status",
+           "list_all", "resume", "run", "run_async"]

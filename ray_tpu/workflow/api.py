@@ -1,0 +1,146 @@
+"""workflow public API + executor.
+
+Reference: python/ray/workflow/api.py (run :123, resume :243) +
+workflow_executor.py.  The DAG is the same FunctionNode/ClassMethodNode
+graph as ray_tpu.dag; step ids are deterministic over the DAG topology so
+a resumed run maps steps onto their persisted results.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.dag.dag_node import DAGNode, FunctionNode, InputNode
+
+from .storage import WorkflowStorage
+
+
+class WorkflowStatus:
+    RUNNING = "RUNNING"
+    SUCCESSFUL = "SUCCESSFUL"
+    FAILED = "FAILED"
+    NOT_FOUND = "NOT_FOUND"
+
+
+def _step_ids(dag: DAGNode) -> Dict[int, str]:
+    """Deterministic step id per node: topo index + function name (stable
+    across re-loads because topo_sort order is structural)."""
+    ids = {}
+    for i, node in enumerate(dag.topo_sort()):
+        if isinstance(node, FunctionNode):
+            name = getattr(node.remote_fn._fn, "__name__", "fn")
+        elif isinstance(node, InputNode):
+            name = "input"
+        else:
+            name = type(node).__name__
+        ids[node._id] = f"{i:04d}_{name}"
+    return ids
+
+
+def _execute_workflow(dag: DAGNode, storage: WorkflowStorage,
+                      args: tuple) -> Any:
+    """Topo-walk the DAG; completed steps load from storage, the rest run
+    as tasks and persist before proceeding (at-least-once per step)."""
+    ids = _step_ids(dag)
+    values: Dict[int, Any] = {}
+    storage.save_status(WorkflowStatus.RUNNING)
+    for node in dag.topo_sort():
+        sid = ids[node._id]
+        if isinstance(node, InputNode):
+            values[node._id] = args[0] if len(args) == 1 else args
+            continue
+        if storage.has_step(sid):
+            values[node._id] = storage.load_step(sid)
+            continue
+        if not isinstance(node, FunctionNode):
+            raise TypeError(
+                f"workflows support function nodes (fn.bind) and InputNode,"
+                f" got {node!r}")
+        try:
+            resolved_args = [values[a._id] if isinstance(a, DAGNode) else a
+                             for a in node.args]
+            resolved_kwargs = {
+                k: values[v._id] if isinstance(v, DAGNode) else v
+                for k, v in node.kwargs.items()}
+            ref = node.remote_fn.remote(*resolved_args, **resolved_kwargs)
+            result = ray_tpu.get(ref, timeout=3600.0)
+        except BaseException as e:
+            storage.save_status(WorkflowStatus.FAILED, failed_step=sid,
+                                error=f"{type(e).__name__}: {e}")
+            raise
+        storage.save_step(sid, result)
+        values[node._id] = result
+    out = values[dag._id]
+    storage.save_output(out)
+    storage.save_status(WorkflowStatus.SUCCESSFUL)
+    return out
+
+
+def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+        storage: Optional[str] = None) -> Any:
+    """Run a DAG durably; returns its output (reference: api.py:123)."""
+    workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:10]}"
+    st = WorkflowStorage(workflow_id, storage)
+    st.save_dag(cloudpickle.dumps((dag, args)))
+    return _execute_workflow(dag, st, args)
+
+
+def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+              storage: Optional[str] = None):
+    """Run in a background thread; returns (workflow_id, thread)."""
+    workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:10]}"
+    t = threading.Thread(
+        target=lambda: _swallow(run, dag, *args, workflow_id=workflow_id,
+                                storage=storage),
+        name=f"workflow-{workflow_id}", daemon=True)
+    t.start()
+    return workflow_id, t
+
+
+def _swallow(fn, *a, **kw):
+    try:
+        fn(*a, **kw)
+    except BaseException:
+        pass  # status already persisted as FAILED
+
+
+def resume(workflow_id: str, storage: Optional[str] = None) -> Any:
+    """Re-run a workflow from its last completed step (reference:
+    api.py:243)."""
+    st = WorkflowStorage(workflow_id, storage)
+    if st.has_output():
+        return st.load_output()
+    status = st.load_status()
+    if status["status"] == WorkflowStatus.NOT_FOUND:
+        raise ValueError(f"no workflow {workflow_id!r}")
+    dag, args = cloudpickle.loads(st.load_dag())
+    return _execute_workflow(dag, st, args)
+
+
+def get_status(workflow_id: str, storage: Optional[str] = None) -> str:
+    return WorkflowStorage(workflow_id, storage).load_status()["status"]
+
+
+def get_output(workflow_id: str, storage: Optional[str] = None) -> Any:
+    st = WorkflowStorage(workflow_id, storage)
+    if not st.has_output():
+        raise ValueError(f"workflow {workflow_id!r} has no output "
+                         f"(status={st.load_status()['status']})")
+    return st.load_output()
+
+
+def list_all(storage: Optional[str] = None) -> List[tuple]:
+    out = []
+    for wid in WorkflowStorage.list_workflows(storage):
+        out.append((wid, WorkflowStorage(wid, storage)
+                    .load_status()["status"]))
+    return out
+
+
+def delete(workflow_id: str, storage: Optional[str] = None):
+    WorkflowStorage(workflow_id, storage).delete()

@@ -1,0 +1,96 @@
+"""Workflow storage: filesystem-backed step results + workflow metadata.
+
+Reference: python/ray/workflow/workflow_storage.py — keyed blobs under a
+per-workflow directory; writes are atomic (tmp + rename) so a crash
+mid-write never corrupts a completed-step record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+DEFAULT_ROOT = os.environ.get("RAY_TPU_WORKFLOW_ROOT",
+                              "/tmp/ray_tpu_workflows")
+
+
+class WorkflowStorage:
+    def __init__(self, workflow_id: str, root: Optional[str] = None):
+        self.workflow_id = workflow_id
+        self.root = os.path.join(root or DEFAULT_ROOT, workflow_id)
+        os.makedirs(os.path.join(self.root, "steps"), exist_ok=True)
+
+    # -- atomic write helpers ----------------------------------------------
+
+    def _write(self, path: str, data: bytes):
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    # -- step results -------------------------------------------------------
+
+    def _step_path(self, step_id: str) -> str:
+        return os.path.join(self.root, "steps", f"{step_id}.pkl")
+
+    def has_step(self, step_id: str) -> bool:
+        return os.path.exists(self._step_path(step_id))
+
+    def save_step(self, step_id: str, result: Any):
+        self._write(self._step_path(step_id), cloudpickle.dumps(result))
+
+    def load_step(self, step_id: str) -> Any:
+        with open(self._step_path(step_id), "rb") as f:
+            return cloudpickle.loads(f.read())
+
+    # -- DAG + status --------------------------------------------------------
+
+    def save_dag(self, dag_blob: bytes):
+        self._write(os.path.join(self.root, "dag.pkl"), dag_blob)
+
+    def load_dag(self) -> bytes:
+        with open(os.path.join(self.root, "dag.pkl"), "rb") as f:
+            return f.read()
+
+    def save_status(self, status: str, **extra):
+        data = {"status": status, "ts": time.time(),
+                "workflow_id": self.workflow_id, **extra}
+        self._write(os.path.join(self.root, "status.json"),
+                    json.dumps(data, default=str).encode())
+
+    def load_status(self) -> Dict[str, Any]:
+        try:
+            with open(os.path.join(self.root, "status.json")) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {"status": "NOT_FOUND", "workflow_id": self.workflow_id}
+
+    def save_output(self, value: Any):
+        self._write(os.path.join(self.root, "output.pkl"),
+                    cloudpickle.dumps(value))
+
+    def load_output(self) -> Any:
+        with open(os.path.join(self.root, "output.pkl"), "rb") as f:
+            return cloudpickle.loads(f.read())
+
+    def has_output(self) -> bool:
+        return os.path.exists(os.path.join(self.root, "output.pkl"))
+
+    def delete(self):
+        import shutil
+
+        shutil.rmtree(self.root, ignore_errors=True)
+
+    @staticmethod
+    def list_workflows(root: Optional[str] = None) -> List[str]:
+        base = root or DEFAULT_ROOT
+        try:
+            return sorted(
+                d for d in os.listdir(base)
+                if os.path.isdir(os.path.join(base, d)))
+        except FileNotFoundError:
+            return []

@@ -1,0 +1,119 @@
+"""Autoscaler tests (reference: autoscaler tested against
+FakeMultiNodeProvider launching local processes)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (GCPTpuNodeProvider, LocalNodeProvider,
+                                ResourceDemandScheduler, StandardAutoscaler)
+from ray_tpu.autoscaler.node_provider import (TAG_NODE_KIND, TAG_NODE_TYPE)
+
+
+def test_demand_scheduler_bin_packing():
+    sched = ResourceDemandScheduler(
+        {"cpu4": {"resources": {"CPU": 4.0}, "max_workers": 10},
+         "big": {"resources": {"CPU": 16.0}, "max_workers": 2}},
+        max_workers=10)
+    snapshot = {
+        "nodes": [{"node_id": "head", "available": {"CPU": 1.0},
+                   "total": {"CPU": 4.0}}],
+        "demands": [{"CPU": 2.0}] * 5,  # 10 CPUs wanted, 1 free
+        "idle_s": {},
+    }
+    launch = sched.get_nodes_to_launch(snapshot, {})
+    # 4.5 demands unmet -> ffd packs 2 per cpu4 node
+    assert launch == {"cpu4": 3}
+
+
+def test_demand_scheduler_respects_max():
+    sched = ResourceDemandScheduler(
+        {"cpu4": {"resources": {"CPU": 4.0}, "max_workers": 1}},
+        max_workers=1)
+    snapshot = {"nodes": [], "demands": [{"CPU": 4.0}] * 5, "idle_s": {}}
+    launch = sched.get_nodes_to_launch(snapshot, {})
+    assert launch == {"cpu4": 1}
+
+
+def test_gcp_tpu_provider_slice_model():
+    class FakeTransport:
+        def __init__(self):
+            self.created = []
+            self.deleted = []
+
+        def create_tpu_slice(self, name, acc, zone):
+            self.created.append((name, acc, zone))
+
+        def delete_tpu_slice(self, name):
+            self.deleted.append(name)
+
+    t = FakeTransport()
+    p = GCPTpuNodeProvider({"transport": t, "zone": "us-east5-a"},
+                           "testcluster")
+    nodes = p.create_node({"accelerator_type": "v5e-16"},
+                          {TAG_NODE_KIND: "worker",
+                           TAG_NODE_TYPE: "tpu16"}, 1)
+    # v5e-16 = 16 chips / 4 per host = 4 host nodes
+    assert len(nodes) == 4
+    assert len(t.created) == 1
+    tags = p.node_tags(nodes[0])
+    assert tags["tpu-accelerator-type"] == "v5e-16"
+    assert tags["tpu-slice"] == p.node_tags(nodes[3])["tpu-slice"]
+    # terminating one host releases the whole slice
+    p.terminate_node(nodes[1])
+    assert t.deleted == [t.created[0][0]]
+    assert p.non_terminated_nodes({}) == []
+
+
+def test_autoscaler_scales_up_and_down():
+    """End-to-end: local provider launches real raylets; pending actors
+    drive scale-up; idleness drives scale-down."""
+    owned = not ray_tpu.is_initialized()
+    if owned:
+        ray_tpu.init(num_cpus=1)
+    try:
+        from ray_tpu._private.api import current_core
+
+        control = current_core().control
+        addr = ray_tpu.connection_info()["control_address"]
+        provider = LocalNodeProvider({"control_address": addr}, "t")
+        autoscaler = StandardAutoscaler(
+            {"max_workers": 3, "idle_timeout_minutes": 0.02,  # 1.2 s
+             "available_node_types": {
+                 "cpu2": {"resources": {"CPU": 2.0}, "min_workers": 0,
+                          "max_workers": 3},
+             }},
+            provider, control)
+
+        # nothing pending: no nodes
+        autoscaler.update()
+        assert autoscaler.num_launches == 0
+
+        # demand half a node more than the head has
+        @ray_tpu.remote(num_cpus=2)
+        class Big:
+            def ping(self):
+                return 1
+
+        actors = [Big.remote() for _ in range(2)]
+        time.sleep(0.5)
+        autoscaler.update()
+        assert autoscaler.num_launches >= 1
+        # the actors eventually schedule on the new nodes
+        ray_tpu.get([a.ping.remote() for a in actors], timeout=60)
+
+        # release demand -> idle timeout -> scale down to min (0)
+        for a in actors:
+            ray_tpu.kill(a)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            autoscaler.update()
+            if autoscaler.num_terminations >= autoscaler.num_launches:
+                break
+            time.sleep(0.5)
+        assert autoscaler.num_terminations >= 1
+        provider.shutdown()
+    finally:
+        if owned:
+            ray_tpu.shutdown()

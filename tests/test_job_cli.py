@@ -1,0 +1,135 @@
+"""Job submission + CLI (reference: dashboard/modules/job, scripts.py)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job import JobStatus, JobSubmissionClient
+
+
+@pytest.fixture
+def cluster():
+    # reuse a live (session-fixture) cluster; only own/tear down one we
+    # started ourselves
+    owned = not ray_tpu.is_initialized()
+    if owned:
+        ray_tpu.init(num_cpus=4)
+    yield
+    if owned:
+        ray_tpu.shutdown()
+
+
+def test_job_submit_success(cluster):
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('job ran ok')\"")
+    status = client.wait_until_finish(sid, timeout=60)
+    assert status == JobStatus.SUCCEEDED
+    assert "job ran ok" in client.get_job_logs(sid)
+    info = client.get_job_info(sid)
+    assert info["entrypoint"].endswith("\"print('job ran ok')\"")
+    assert info["end_time"] >= info["start_time"]
+
+
+def test_job_failure_reported(cluster):
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import sys; sys.exit(3)\"")
+    assert client.wait_until_finish(sid, timeout=60) == JobStatus.FAILED
+    assert "exit code 3" in client.get_job_info(sid)["message"]
+
+
+def test_job_env_vars_and_listing(cluster):
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c "
+                   "\"import os; print('VAL=' + os.environ['MY_TEST_VAR'])\"",
+        runtime_env={"env_vars": {"MY_TEST_VAR": "hello42"}})
+    assert client.wait_until_finish(sid, timeout=60) == JobStatus.SUCCEEDED
+    assert "VAL=hello42" in client.get_job_logs(sid)
+    jobs = client.list_jobs()
+    assert any(j["submission_id"] == sid for j in jobs)
+
+
+def test_job_uses_cluster(cluster):
+    """The submitted driver connects back via RAY_TPU_ADDRESS."""
+    client = JobSubmissionClient()
+    script = (
+        "import ray_tpu\n"
+        "ray_tpu.init()\n"  # picks up RAY_TPU_ADDRESS
+        "@ray_tpu.remote\n"
+        "def f(x): return x * 2\n"
+        "print('answer', ray_tpu.get(f.remote(21)))\n"
+    )
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"{script.replace(chr(34), chr(39))}\"")
+    status = client.wait_until_finish(sid, timeout=120)
+    logs = client.get_job_logs(sid)
+    assert status == JobStatus.SUCCEEDED, logs
+    assert "answer 42" in logs
+
+
+def test_job_stop(cluster):
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import time; time.sleep(60)\"")
+    # wait for RUNNING then stop
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if client.get_job_status(sid) == JobStatus.RUNNING:
+            break
+        time.sleep(0.2)
+    assert client.stop_job(sid)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if client.get_job_status(sid) in JobStatus.TERMINAL:
+            break
+        time.sleep(0.2)
+    assert client.get_job_status(sid) == JobStatus.STOPPED
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def _cli(*args, check=True, timeout=120):
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-m", "ray_tpu", *args],
+                       capture_output=True, text=True, timeout=timeout,
+                       env=env)
+    if check and r.returncode != 0:
+        raise AssertionError(
+            f"CLI {args} failed rc={r.returncode}\n{r.stdout}\n{r.stderr}")
+    return r
+
+
+def test_cli_start_status_stop(tmp_path):
+    import ray_tpu.scripts.cli as cli_mod
+
+    if os.path.exists(cli_mod.CLUSTER_FILE):
+        _cli("stop")
+    port = 6381
+    r = _cli("start", "--head", "--num-cpus", "2", "--port", str(port))
+    assert "started" in r.stdout
+    try:
+        r = _cli("status")
+        assert "1 alive" in r.stdout
+        # driver connects via auto
+        r = _cli("list", "nodes", "--format", "json")
+        nodes = json.loads(r.stdout)
+        assert len(nodes) == 1 and nodes[0]["state"] == "ALIVE"
+
+        # end-to-end submit through the CLI
+        r = _cli("submit", "--timeout", "90", "--",
+                 sys.executable, "-c", "print(11*3)")
+        assert "33" in r.stdout
+        assert "SUCCEEDED" in r.stdout
+    finally:
+        r = _cli("stop")
+        assert "stopped" in r.stdout
+    assert not os.path.exists(cli_mod.CLUSTER_FILE)

@@ -1,0 +1,195 @@
+"""RL library tests (reference: rllib test strategy — unit tests per
+component + short learning regressions on CartPole)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import (CartPole, DQNConfig, PPOConfig, ReplayBuffer,
+                        make_env)
+
+
+def test_jax_cartpole_matches_gymnasium():
+    """Dynamics parity with the reference env family: identical physics
+    constants -> identical trajectories given identical start states."""
+    import gymnasium as gym
+    import jax
+    import jax.numpy as jnp
+
+    genv = gym.make("CartPole-v1").unwrapped
+    jenv = CartPole()
+    state, obs = jenv.reset(jax.random.PRNGKey(0))
+    genv.reset(seed=0)
+    genv.state = np.asarray(obs, np.float64)
+
+    actions = [0, 1, 1, 0, 1, 0, 0, 1, 1, 1]
+    for a in actions:
+        state, obs, reward, done = jenv.step(state, jnp.asarray(a))
+        gobs, greward, gterm, gtrunc, _ = genv.step(a)
+        if done or gterm:
+            break
+        np.testing.assert_allclose(np.asarray(obs), gobs, rtol=1e-4,
+                                   atol=1e-5)
+        assert float(reward) == greward == 1.0
+
+
+def test_rollout_shapes_and_autoreset():
+    import jax
+
+    from ray_tpu.rl.env.env_runner import JaxEnvRunner
+
+    runner = JaxEnvRunner("CartPole-v1", {"kind": "policy"}, num_envs=4,
+                          seed=0)
+    out = runner.sample(50)
+    batch = out["batch"]
+    assert batch["obs"].shape == (50, 4, 4)
+    assert batch["action"].shape == (50, 4)
+    assert batch["logp"].shape == (50, 4)
+    assert batch["final_vf"].shape == (4,)
+    # with a random policy 200 env steps must finish some episodes
+    out2 = runner.sample(50)
+    total_eps = (out["stats"]["episodes_this_iter"]
+                 + out2["stats"]["episodes_this_iter"])
+    assert total_eps > 0
+
+
+def test_gae_matches_naive():
+    import jax.numpy as jnp
+
+    from ray_tpu.rl.algorithms.ppo import compute_gae
+
+    T, B = 6, 2
+    rng = np.random.default_rng(0)
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    dones = (rng.random((T, B)) < 0.2)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    final_v = rng.normal(size=(B,)).astype(np.float32)
+    gamma, lam = 0.95, 0.9
+
+    adv, vtarg = compute_gae(jnp.asarray(rewards),
+                             jnp.asarray(dones),
+                             jnp.asarray(values),
+                             jnp.asarray(final_v), gamma, lam)
+
+    # naive reference implementation
+    expected = np.zeros((T, B), np.float32)
+    for b in range(B):
+        next_adv, next_val = 0.0, final_v[b]
+        for t in reversed(range(T)):
+            nonterm = 1.0 - float(dones[t, b])
+            delta = rewards[t, b] + gamma * next_val * nonterm - values[t, b]
+            next_adv = delta + gamma * lam * nonterm * next_adv
+            next_val = values[t, b]
+            expected[t, b] = next_adv
+    np.testing.assert_allclose(np.asarray(adv), expected, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(vtarg), expected + values,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_replay_buffer_ring():
+    buf = ReplayBuffer(capacity=10)
+    buf.add_batch({"x": np.arange(6, dtype=np.float32)})
+    assert len(buf) == 6
+    buf.add_batch({"x": np.arange(6, 14, dtype=np.float32)})
+    assert len(buf) == 10  # wrapped
+    s = buf.sample(32)
+    assert s["x"].shape == (32,)
+    # oldest entries (0..3) were overwritten
+    assert s["x"].min() >= 4
+
+
+def test_ppo_learns_cartpole_local():
+    cfg = (PPOConfig().environment("CartPole-v1")
+           .env_runners(0, num_envs_per_runner=16)
+           .training(rollout_len=128, num_epochs=4, minibatch_size=512,
+                     entropy_coeff=0.01))
+    algo = cfg.build()
+    try:
+        first = algo.train()
+        last = None
+        for _ in range(11):
+            last = algo.train()
+        assert last["episode_return_mean"] > max(
+            40.0, first.get("episode_return_mean", 0.0))
+        assert last["env_steps_sampled"] == 12 * 128 * 16
+    finally:
+        algo.stop()
+
+
+def test_dqn_smoke_local():
+    cfg = (DQNConfig().environment("CartPole-v1")
+           .env_runners(0, num_envs_per_runner=8)
+           .training(rollout_len=32, learn_starts=256, updates_per_iter=8,
+                     epsilon_decay_iters=5))
+    algo = cfg.build()
+    try:
+        for _ in range(6):
+            r = algo.train()
+        assert r["buffer_size"] > 256
+        assert np.isfinite(r["loss"])
+        assert r["epsilon"] == pytest.approx(0.05)
+        # target net must differ from online net between syncs or match
+        # after one: just check both exist
+        w = algo.learner_group.get_weights()
+        assert "q" in w and "target_q" in w
+    finally:
+        algo.stop()
+
+
+def test_ppo_distributed_runners(ray_cluster):
+    cfg = (PPOConfig().environment("CartPole-v1")
+           .env_runners(2, num_envs_per_runner=4)
+           .training(rollout_len=32, num_epochs=2, minibatch_size=128))
+    algo = cfg.build()
+    try:
+        r = algo.train()
+        # 2 runners x 4 envs x 32 steps
+        assert r["env_steps_sampled"] == 256
+        r = algo.train()
+        assert r["training_iteration"] == 2
+    finally:
+        algo.stop()
+
+
+def test_algorithm_save_restore(tmp_path):
+    import jax
+
+    cfg = (PPOConfig().environment("CartPole-v1")
+           .env_runners(0, num_envs_per_runner=4)
+           .training(rollout_len=16, num_epochs=1, minibatch_size=64))
+    algo = cfg.build()
+    algo.train()
+    path = str(tmp_path / "ckpt.pkl")
+    algo.save(path)
+    w0 = algo.learner_group.get_weights()
+    algo.stop()
+
+    algo2 = cfg.build()
+    algo2.restore(path)
+    assert algo2.iteration == 1
+    w1 = algo2.learner_group.get_weights()
+    for a, b in zip(jax.tree_util.tree_leaves(w0),
+                    jax.tree_util.tree_leaves(w1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    algo2.stop()
+
+
+def test_tune_integration(ray_cluster):
+    from ray_tpu.tune import TuneConfig, Tuner
+
+    trainable = (PPOConfig().environment("CartPole-v1")
+                 .env_runners(0, num_envs_per_runner=4)
+                 .training(rollout_len=16, num_epochs=1, minibatch_size=64)
+                 .to_trainable())
+    tuner = Tuner(
+        trainable,
+        param_space={"lr": 1e-3, "training_iterations": 2},
+        tune_config=TuneConfig(metric="episode_return_mean", mode="max",
+                               num_samples=2),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 2
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.metrics["training_iteration"] == 2

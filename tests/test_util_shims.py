@@ -1,0 +1,107 @@
+"""Util shims: multiprocessing.Pool, joblib backend, parallel iterators
+(reference: python/ray/util/multiprocessing, util/joblib, util/iter)."""
+
+import math
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    # reuse a live (session-fixture) cluster; only own/tear down one we
+    # started ourselves
+    owned = not ray_tpu.is_initialized()
+    if owned:
+        ray_tpu.init(num_cpus=4)
+    yield
+    if owned:
+        ray_tpu.shutdown()
+
+
+# defined as lambdas so cloudpickle serializes them by value — a worker
+# process cannot import this test module by name
+_sq = lambda x: x * x  # noqa: E731
+_add = lambda a, b: a + b  # noqa: E731
+
+
+class TestPool:
+    def test_map(self):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(2) as p:
+            assert p.map(_sq, range(10)) == [x * x for x in range(10)]
+
+    def test_apply_and_async(self):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(2) as p:
+            assert p.apply(_add, (2, 3)) == 5
+            r = p.apply_async(_add, (10, 20))
+            assert r.get(timeout=30) == 30
+            assert r.successful()
+
+    def test_starmap_and_imap(self):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(2) as p:
+            assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+            assert list(p.imap(_sq, range(6), chunksize=2)) == \
+                [0, 1, 4, 9, 16, 25]
+            assert sorted(p.imap_unordered(_sq, range(6), chunksize=2)) == \
+                [0, 1, 4, 9, 16, 25]
+
+    def test_map_async_error(self):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(2) as p:
+            r = p.map_async(math.sqrt, [-1.0])
+            with pytest.raises(Exception):
+                r.get(timeout=30)
+
+
+class TestJoblib:
+    def test_parallel_backend(self):
+        import joblib
+
+        from ray_tpu.util.joblib import register_ray_tpu
+
+        register_ray_tpu()
+        with joblib.parallel_backend("ray_tpu", n_jobs=2):
+            out = joblib.Parallel()(
+                joblib.delayed(_sq)(i) for i in range(8))
+        assert out == [i * i for i in range(8)]
+
+
+class TestParallelIterator:
+    def test_from_items_for_each(self):
+        from ray_tpu.util import iter as rit
+
+        it = rit.from_items(list(range(10)), num_shards=2).for_each(_sq)
+        assert sorted(it.gather_sync()) == sorted(x * x for x in range(10))
+
+    def test_filter_batch_flatten(self):
+        from ray_tpu.util import iter as rit
+
+        it = (rit.from_range(20, num_shards=2)
+              .filter(lambda x: x % 2 == 0)
+              .batch(3))
+        batches = list(it.gather_sync())
+        assert all(isinstance(b, list) for b in batches)
+        flat = [x for b in batches for x in b]
+        assert sorted(flat) == [x for x in range(20) if x % 2 == 0]
+
+    def test_gather_async_and_union(self):
+        from ray_tpu.util import iter as rit
+
+        a = rit.from_items([1, 2, 3], num_shards=1)
+        b = rit.from_items([10, 20], num_shards=1)
+        u = a.union(b)
+        assert u.num_shards == 2
+        assert sorted(u.gather_async()) == [1, 2, 3, 10, 20]
+
+    def test_take(self):
+        from ray_tpu.util import iter as rit
+
+        assert len(rit.from_range(100, num_shards=4).take(5)) == 5

@@ -1,0 +1,15 @@
+import gc
+import time
+
+import ray_tpu
+from ray_tpu.util import state
+
+
+def test_zz_probe2(ray_cluster):
+    gc.collect()
+    for i in range(20):
+        actors = [(x["class_name"], x["state"])
+                  for x in state.list_actors()]
+        alive = [a for a in actors if a[1] != "DEAD"]
+        print("probe", i, alive, ray_tpu.available_resources())
+        time.sleep(1)
