@@ -41,7 +41,11 @@ from .protocol import Client, DaemonPool, Deferred, Server, ServerConn
 logger = logging.getLogger(__name__)
 
 HEARTBEAT_INTERVAL_S = 0.5
-NODE_DEATH_TIMEOUT_S = 5.0
+# generous default: CI machines stall raylet heartbeat threads for seconds
+# during worker-spawn (jax import) storms (reference equivalent:
+# num_heartbeats_timeout / health check period, gcs_health_check_manager.h)
+NODE_DEATH_TIMEOUT_S = float(os.environ.get(
+    "RAY_TPU_NODE_DEATH_TIMEOUT_S", "10.0"))
 
 ALIVE, RESTARTING, DEAD, PENDING = "ALIVE", "RESTARTING", "DEAD", "PENDING"
 
@@ -153,6 +157,17 @@ class ControlServer:
         # pending-actor scheduler queue (reference: GcsActorScheduler)
         self.pending_actors: List[ActorRecord] = []
         self._sched_event = threading.Event()
+        # native C++ selection/planning engine (reference's scheduling core
+        # is C++: cluster_resource_scheduler.h, hybrid_scheduling_policy.h);
+        # Python keeps authoritative optimistic accounting and mirrors
+        # availability into the native engine at every mutation
+        self.nsched = None
+        if os.environ.get("RAY_TPU_NATIVE_SCHED", "1") != "0":
+            try:
+                from ray_tpu.native.sched import try_create
+                self.nsched = try_create(spread_threshold=0.5, topk=1)
+            except Exception:
+                self.nsched = None
 
         s = self.server
         s.handle("ping", lambda c, p: "pong")
@@ -249,6 +264,8 @@ class ControlServer:
                          p.get("labels"))
         with self.lock:
             self.nodes[rec.node_id] = rec
+            if self.nsched is not None:
+                self.nsched.upsert_node(rec.node_id, rec.total)
         conn.meta["node_id"] = rec.node_id
         logger.info("node %s registered at %s: %s", rec.node_id[:12], rec.addr, p["resources"])
         self.publish("node", {"event": "added", "node": rec.view()})
@@ -258,10 +275,15 @@ class ControlServer:
         with self.lock:
             rec = self.nodes.get(p["node_id"])
             if rec is None or rec.state == DEAD:
-                return {"ok": False}
+                # a falsely-declared-dead raylet is still running: tell it
+                # to wipe its actor workers and re-register (the reference
+                # raylet exits and is restarted by its process manager)
+                return {"ok": False, "reregister": True}
             rec.last_heartbeat = time.monotonic()
             if "available" in p:
                 rec.available = normalize_resources(p["available"])
+                if self.nsched is not None:
+                    self.nsched.set_available(rec.node_id, rec.available)
             return {"ok": True}
 
     def h_get_nodes(self, conn, p):
@@ -297,6 +319,9 @@ class ControlServer:
                         return n
                 return None
             elif kind == "spread":
+                n = self._native_pick(demand, spread=True)
+                if n is not None:
+                    return n
                 cands = [n for n in nodes if fits(n.available, demand)]
                 if not cands:
                     return None
@@ -304,6 +329,9 @@ class ControlServer:
                 return min(cands, key=lambda n: sum(v / max(t, 1) for v, t in
                                                     ((n.total.get(k, 0) - n.available.get(k, 0), n.total.get(k, 1))
                                                      for k in n.total)))
+        n = self._native_pick(demand, spread=False)
+        if n is not None:
+            return n
         cands = [n for n in nodes if fits(n.available, demand)]
         if not cands:
             return None
@@ -312,6 +340,24 @@ class ControlServer:
             tot = sum(n.total.values()) or 1
             return 1.0 - sum(n.available.values()) / tot
         return max(cands, key=util)
+
+    def _native_pick(self, demand: Dict[str, int],
+                     spread: bool) -> Optional[NodeRecord]:
+        """Delegate selection to the native engine; validated against the
+        Python books so mirror drift can never hand out a bad node."""
+        if self.nsched is None:
+            return None
+        try:
+            from ray_tpu.native.sched import PACK, SPREAD
+            nid = self.nsched.pick(demand, SPREAD if spread else PACK)
+        except Exception:
+            return None
+        if nid is None:
+            return None
+        n = self.nodes.get(nid)
+        if n is not None and n.state == ALIVE and fits(n.available, demand):
+            return n
+        return None
 
     def h_pick_node(self, conn, p):
         demand = normalize_resources(p.get("resources"))
@@ -322,6 +368,8 @@ class ControlServer:
             # optimistic reservation so concurrent picks spread; the next
             # heartbeat overwrites with the raylet's ground truth
             subtract(n.available, demand)
+            if self.nsched is not None:
+                self.nsched.set_available(n.node_id, n.available)
             return {"node_id": n.node_id, "addr": n.addr}
 
     def h_cluster_resources(self, conn, p):
@@ -452,6 +500,10 @@ class ControlServer:
         with self.lock:
             if rec.state == DEAD:
                 return True
+            if rec.state == ALIVE:
+                # an orphaned worker's actor_ready adopted the placement
+                # while this record sat in the queue — nothing to place
+                return True
             node = self._pick_node_locked(rec.resources, strategy)
             if node is None:
                 now = time.monotonic()
@@ -475,29 +527,90 @@ class ControlServer:
             }, timeout=60.0)
             if r and r.get("ok"):
                 with self.lock:
-                    rec.node_id = node.node_id
-                    rec.worker_addr = tuple(r["worker_addr"])
-                    # stays PENDING until worker reports ready
+                    killed = rec.state == DEAD
+                    adopted_elsewhere = (
+                        rec.state == ALIVE
+                        and (rec.worker_addr or ()) != tuple(r["worker_addr"]))
+                    if not killed and not adopted_elsewhere:
+                        rec.node_id = node.node_id
+                        rec.worker_addr = tuple(r["worker_addr"])
+                        # stays PENDING until worker reports ready
+                if killed or adopted_elsewhere:
+                    # kill_actor raced with placement, or an orphaned
+                    # worker already adopted this actor: reap the spare we
+                    # just started (addressed by worker_addr so a same-node
+                    # adopted worker is never the one killed)
+                    self._kill_actor_worker(
+                        node.node_id, rec.actor_id,
+                        worker_addr=tuple(r["worker_addr"]))
                 return True
         except Exception as e:
             logger.warning("actor %s placement on %s failed: %s",
                            rec.actor_id[:12], node.node_id[:12], e)
         return False
 
+    def _kill_actor_worker(self, node_id: str, actor_id: str,
+                           worker_addr=None):
+        cli = self._node_client(node_id)
+        if cli is not None:
+            try:
+                cli.call("kill_actor_worker",
+                         {"actor_id": actor_id, "worker_addr": worker_addr},
+                         timeout=10.0)
+            except Exception:
+                pass
+
     def h_actor_ready(self, conn, p):
-        """Worker finished running the creation task."""
+        """Worker finished running the creation task.
+
+        Placement is reconciled here, not assumed from the RPC reply: if
+        the start_actor_worker call failed mid-flight but the raylet did
+        start the worker, the orphan's report *adopts* the placement; a
+        stale incarnation or a duplicate placement gets its worker reaped
+        (reference: GcsActorManager reconciles via the actor table for the
+        same reason — replies can be lost while the work happened)."""
+        aid = p["actor_id"]
+        rep_node = p.get("node_id")
+        rep_inc = p.get("incarnation", 0)
+        kill_on = None  # node to reap a stale/duplicate/killed worker from
         with self.lock:
-            rec = self.actors.get(p["actor_id"])
+            rec = self.actors.get(aid)
             if rec is None:
                 return False
-            if p.get("error"):
+            if rec.state == DEAD:
+                # killed while the creation task ran — never resurrect;
+                # make sure the node reaps the worker and frees resources
+                kill_on = rep_node or rec.node_id
+                view = None
+            elif rep_inc < rec.incarnation:
+                # report from a previous incarnation's worker: stale
+                kill_on = rep_node
+                view = None
+            elif (rec.state == ALIVE
+                  and tuple(p.get("worker_addr") or ()) != (rec.worker_addr or ())):
+                # double placement (lost-reply retry): keep the first
+                # worker, reap the spare
+                kill_on = rep_node
+                view = None
+            elif p.get("error"):
                 rec.state = DEAD
                 rec.error = p["error"]
+                view = rec.view()
             else:
                 rec.state = ALIVE
                 rec.worker_addr = tuple(p["worker_addr"])
-                rec.incarnation = p.get("incarnation", rec.incarnation)
-            view = rec.view()
+                rec.incarnation = rep_inc
+                if rep_node:
+                    rec.node_id = rep_node
+                # adopted placements leave the pending queue
+                if rec in self.pending_actors:
+                    self.pending_actors.remove(rec)
+                view = rec.view()
+        if view is None:
+            if kill_on:
+                self._kill_actor_worker(kill_on, aid,
+                                        worker_addr=p.get("worker_addr"))
+            return True
         self.publish("actor", {"event": "alive" if not p.get("error") else "dead",
                                "actor": view})
         return True
@@ -541,6 +654,10 @@ class ControlServer:
 
     def h_wait_actor_alive(self, conn, p, d: Deferred):
         aid, timeout = p["actor_id"], p.get("timeout", 60.0)
+        # callers that saw an incarnation die pass min_incarnation so a
+        # stale ALIVE view (death notification still in flight) is not
+        # returned as if it were the restarted actor
+        min_inc = p.get("min_incarnation", 0)
 
         def waiter():
             deadline = time.monotonic() + timeout
@@ -550,7 +667,8 @@ class ControlServer:
                     if rec is None:
                         d.resolve(None)
                         return
-                    if rec.state in (ALIVE, DEAD):
+                    if rec.state == DEAD or (
+                            rec.state == ALIVE and rec.incarnation >= min_inc):
                         d.resolve(rec.view())
                         return
                 time.sleep(0.05)
@@ -568,6 +686,9 @@ class ControlServer:
         aid, no_restart = p["actor_id"], p.get("no_restart", True)
 
         def do():
+            # mark DEAD under the lock *before* touching the node so any
+            # in-flight placement sees the kill and reaps its own worker
+            # (_try_place_actor / h_actor_ready re-check state)
             with self.lock:
                 rec = self.actors.get(aid)
                 if rec is None:
@@ -575,23 +696,15 @@ class ControlServer:
                     return
                 if no_restart:
                     rec.max_restarts = 0
-                nid, addr = rec.node_id, rec.worker_addr
+                    rec.state = DEAD
+                    rec.error = "killed via kill_actor"
+                    if rec.name:
+                        self.named_actors.pop(rec.name, None)
+                nid = rec.node_id
+                view = rec.view()
             if nid:
-                cli = self._node_client(nid)
-                if cli is not None:
-                    try:
-                        cli.call("kill_actor_worker", {"actor_id": aid}, timeout=10.0)
-                    except Exception:
-                        pass
+                self._kill_actor_worker(nid, aid)
             if no_restart:
-                with self.lock:
-                    rec = self.actors.get(aid)
-                    if rec is not None:
-                        rec.state = DEAD
-                        rec.error = "killed via kill_actor"
-                        if rec.name:
-                            self.named_actors.pop(rec.name, None)
-                        view = rec.view()
                 self.publish("actor", {"event": "dead", "actor": view})
             d.resolve(True)
 
@@ -666,6 +779,14 @@ class ControlServer:
     def _plan_pg(self, rec: PlacementGroupRecord) -> Optional[Dict[int, str]]:
         with self.lock:
             nodes = self._alive_nodes()
+            # native bundle planner (reference: bundle_scheduling_policy.h)
+            # handles the pure-resource case; the Python path below keeps
+            # TPU-slice-affinity ordering which the native engine lacks
+            if (self.nsched is not None
+                    and not any(n.labels.get("tpu_slice") for n in nodes)):
+                plan = self._native_plan_pg(rec)
+                if plan is not None:
+                    return plan
             # simulate availability
             sim = {n.node_id: dict(n.available) for n in nodes}
             # TPU slice affinity: prefer nodes sharing a tpu_slice label
@@ -707,6 +828,30 @@ class ControlServer:
                 out[i] = n.node_id
                 last = n.node_id
             return out
+
+    def _native_plan_pg(self, rec) -> Optional[Dict[int, str]]:
+        """Plan via the C++ engine; None falls back to the Python planner
+        (including the infeasible case, which Python re-confirms)."""
+        try:
+            from ray_tpu.native.sched import (PACK, SPREAD, STRICT_PACK,
+                                              STRICT_SPREAD)
+            strat = {"PACK": PACK, "SPREAD": SPREAD,
+                     "STRICT_PACK": STRICT_PACK,
+                     "STRICT_SPREAD": STRICT_SPREAD}.get(rec.strategy)
+            if strat is None:
+                return None
+            names = self.nsched.plan_bundles(rec.bundles, strat)
+        except Exception:
+            return None
+        if names is None:
+            return None
+        # validate against the authoritative books before trusting
+        sim = {n.node_id: dict(n.available) for n in self._alive_nodes()}
+        for b, nid in zip(rec.bundles, names):
+            if nid not in sim or not fits(sim[nid], b):
+                return None
+            subtract(sim[nid], b)
+        return {i: nid for i, nid in enumerate(names)}
 
     def h_remove_pg(self, conn, p, d: Deferred):
         pgid = p["pg_id"]
@@ -763,6 +908,8 @@ class ControlServer:
 
     def _on_node_death(self, nid: str):
         with self.lock:
+            if self.nsched is not None:
+                self.nsched.set_alive(nid, False)
             cli = self.node_clients.pop(nid, None)
             affected = [a for a in self.actors.values()
                         if a.node_id == nid and a.state in (ALIVE, PENDING, RESTARTING)]

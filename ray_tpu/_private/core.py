@@ -1092,37 +1092,56 @@ class CoreWorker:
                 self.pool_executor.submit(self._resolve_actor, actor_id)
             return ac
 
-    def _resolve_actor(self, actor_id: str):
+    def _resolve_actor(self, actor_id: str, min_incarnation: int = 0):
         ac = self._actor_conn(actor_id)
         with ac.lock:
             if ac.resolving:
                 return
             ac.resolving = True
         try:
-            view = self.control.call("wait_actor_alive",
-                                     {"actor_id": actor_id, "timeout": 120.0},
-                                     timeout=130.0)
-            if view is None or view["state"] == "DEAD":
-                err = (view or {}).get("error") or "actor not found"
-                self._fail_actor(ac, err)
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                view = self.control.call(
+                    "wait_actor_alive",
+                    {"actor_id": actor_id, "timeout": 120.0,
+                     "min_incarnation": min_incarnation},
+                    timeout=130.0)
+                if view is None or view["state"] == "DEAD":
+                    err = (view or {}).get("error") or "actor not found"
+                    self._fail_actor(ac, err)
+                    return
+                if view["state"] != "ALIVE":
+                    time.sleep(0.05)
+                    continue
+                try:
+                    client = Client(
+                        tuple(view["worker_addr"]),
+                        name=f"core->actor-{actor_id[:8]}",
+                        on_disconnect=lambda: self._on_actor_conn_lost(actor_id),
+                        connect_timeout=5.0)
+                except (ConnectionLost, OSError):
+                    # stale view: this incarnation already died and the
+                    # control plane hasn't processed the death yet — wait
+                    # for a newer incarnation (or DEAD)
+                    min_incarnation = view["incarnation"] + 1
+                    continue
+                with ac.lock:
+                    ac.client = client
+                    ac.addr = tuple(view["worker_addr"])
+                    ac.incarnation = view["incarnation"]
+                    ac.state = "ALIVE"
+                    buffered = list(ac.buffer)
+                    ac.buffer.clear()
+                for spec in buffered:
+                    self._send_actor_task(ac, spec)
                 return
-            client = Client(tuple(view["worker_addr"]),
-                            name=f"core->actor-{actor_id[:8]}",
-                            on_disconnect=lambda: self._on_actor_conn_lost(actor_id))
-            with ac.lock:
-                ac.client = client
-                ac.addr = tuple(view["worker_addr"])
-                ac.incarnation = view["incarnation"]
-                ac.state = "ALIVE"
-                buffered = list(ac.buffer)
-                ac.buffer.clear()
-            for spec in buffered:
-                self._send_actor_task(ac, spec)
+            self._fail_actor(ac, "timed out resolving actor connection")
         finally:
             with ac.lock:
                 ac.resolving = False
 
     def _fail_actor(self, ac: ActorConn, err: str):
+        logger.debug("marking actor %s DEAD at driver: %s", ac.actor_id, err)
         with ac.lock:
             ac.state = "DEAD"
             ac.dead_error = err
@@ -1223,17 +1242,23 @@ class CoreWorker:
             ac.state = "RECONNECTING"
             pending = list(ac.inflight.values())
             ac.inflight.clear()
+            # a lost connection means this incarnation is gone: anything we
+            # hear about the actor next must be a newer incarnation or DEAD
+            next_inc = ac.incarnation + 1
         if self._shutdown:
             return
 
         def recover():
             view = None
             try:
-                view = self.control.call("wait_actor_alive",
-                                         {"actor_id": actor_id, "timeout": 60.0},
-                                         timeout=70.0)
+                view = self.control.call(
+                    "wait_actor_alive",
+                    {"actor_id": actor_id, "timeout": 60.0,
+                     "min_incarnation": next_inc},
+                    timeout=70.0)
             except Exception:
                 pass
+            logger.debug("actor %s recover view: %s", actor_id, view)
             if view is not None and view["state"] == "ALIVE":
                 if ac.max_task_retries != 0:
                     with ac.lock:
@@ -1242,7 +1267,7 @@ class CoreWorker:
                 else:
                     self._error_specs(pending, ActorDiedError(
                         "actor restarted; pending calls lost (max_task_retries=0)"))
-                self._resolve_actor(actor_id)
+                self._resolve_actor(actor_id, min_incarnation=next_inc)
             else:
                 err = (view or {}).get("error") if view else "actor died"
                 self._error_specs(pending, ActorDiedError(str(err)))

@@ -541,8 +541,11 @@ class Raylet:
 
     def h_kill_actor_worker(self, conn, p):
         aid = p["actor_id"]
+        want_addr = tuple(p["worker_addr"]) if p.get("worker_addr") else None
         with self.lock:
-            rec = next((r for r in self.workers.values() if r.actor_id == aid), None)
+            rec = next((r for r in self.workers.values()
+                        if r.actor_id == aid
+                        and (want_addr is None or r.addr == want_addr)), None)
         logger.info("kill_actor_worker %s -> rec=%s lease=%s", aid[:12],
                     rec.worker_id[:12] if rec else None,
                     rec.lease_resources if rec else None)
@@ -692,13 +695,54 @@ class Raylet:
                 with self.lock:
                     avail = common.denormalize_resources(
                         {k: max(v, 0) for k, v in self.available.items()})
-                self.control.call("heartbeat", {
+                r = self.control.call("heartbeat", {
                     "node_id": self.node_id, "available": avail,
                 }, timeout=5.0)
+                if r and not r.get("ok") and r.get("reregister"):
+                    self._resurrect()
             except Exception:
                 if not self._stop.is_set():
                     logger.warning("heartbeat to control failed")
             time.sleep(HEARTBEAT_INTERVAL_S)
+
+    def _resurrect(self):
+        """The control plane declared this (live) node dead — e.g. our
+        heartbeat thread stalled past the death timeout.  The reference
+        raylet exits and gets restarted; we do the in-process equivalent:
+        reap actor workers (the control already restarted those actors
+        elsewhere), reset accounting to a clean slate, re-register."""
+        logger.warning("declared dead by control; resurrecting %s",
+                       self.node_id[:12])
+        with self.lock:
+            actor_workers = [r for r in self.workers.values()
+                             if r.actor_id is not None and r.state != "dead"]
+            bundles = list(self.bundles.keys())
+        for rec in actor_workers:
+            try:
+                if rec.conn is not None:
+                    rec.conn.push("shutdown", {})
+                self._kill_worker(rec)
+            except Exception:
+                pass
+        with self.lock:
+            for key in bundles:
+                self.bundles.pop(key, None)
+            # recompute from surviving leases: plain task workers keep
+            # running through a resurrect, so their holds must stay booked
+            self.available = dict(self.total)
+            for rec in self.workers.values():
+                if rec.state != "dead" and rec.lease_resources:
+                    subtract(self.available, rec.lease_resources)
+        try:
+            self.control.call("register_node", {
+                "node_id": self.node_id,
+                "addr": self.server.addr,
+                "resources": common.denormalize_resources(self.total),
+                "labels": self.labels,
+            }, timeout=30.0)
+        except Exception:
+            logger.warning("re-registration failed; will retry on next "
+                           "heartbeat")
 
 
 def main():

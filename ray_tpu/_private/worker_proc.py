@@ -124,6 +124,9 @@ class WorkerMain:
                 "actor_id": self.actor_id,
                 "worker_addr": self.core.addr,
                 "incarnation": self.incarnation,
+                # lets the control plane adopt this placement even if its
+                # start_actor_worker call failed mid-flight (reply lost)
+                "node_id": os.environ.get("RAY_TPU_NODE_ID"),
                 "error": err,
             }, timeout=30.0)
         except Exception:

@@ -73,7 +73,7 @@ def make_train_step(cfg: gpt.GPTConfig, mesh: Mesh, tx=None,
         tx = optax.adamw(3e-4, weight_decay=0.1)
     p_shardings = param_shardings(cfg, mesh)
     key_shard = NamedSharding(mesh, P())
-    b_shard = NamedSharding(mesh, P(("dp", "fsdp"), None))
+    b_shard = NamedSharding(mesh, P(("dp", "fsdp", "ep"), None))
 
     def init_state(key):
         params = gpt.init(key, cfg)
@@ -141,5 +141,5 @@ def make_eval_step(cfg: gpt.GPTConfig, mesh: Mesh):
 
 def shard_batch(batch: Dict[str, Any], mesh: Mesh):
     """Place a host batch onto the mesh with canonical batch sharding."""
-    sh = NamedSharding(mesh, P(("dp", "fsdp"), None))
+    sh = NamedSharding(mesh, P(("dp", "fsdp", "ep"), None))
     return jax.tree.map(lambda x: jax.device_put(x, sh), batch)

@@ -1,0 +1,135 @@
+"""Mixture-of-experts with expert parallelism (EP).
+
+Absent from the reference (SURVEY.md §2.3: "EP (expert parallel): absent
+in-tree — expert-sharded mesh axis + lax.all_to_all token dispatch"); built
+natively here, TPU-first:
+
+  * Routing uses the dense one-hot dispatch/combine formulation
+    (GShard/Switch): static shapes, pure einsums — everything tiles onto
+    the MXU and nothing falls off the compiled path.  Capacity is a static
+    bound; overflow tokens are dropped (their combine weight is zero), the
+    standard TPU MoE trade.
+  * Expert parallelism is one `lax.all_to_all` each way over the `ep` mesh
+    axis inside shard_map: dispatch [E, C, D] -> [E/n, n*C, D] so each
+    device runs only its local experts, then the inverse on the way back.
+  * Aux losses (load-balance, router z-loss) are returned to the caller —
+    the trainer adds them to the objective.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RouterOut(NamedTuple):
+    dispatch: jax.Array   # [T, E, C] 0/1 dispatch tensor
+    combine: jax.Array    # [T, E, C] gate-weighted combine tensor
+    aux_loss: jax.Array   # scalar load-balance loss
+    z_loss: jax.Array     # scalar router z-loss
+
+
+def expert_capacity(num_tokens: int, num_experts: int, k: int,
+                    capacity_factor: float) -> int:
+    """Static per-expert token budget (multiple of 8 for TPU tiling)."""
+    c = int(math.ceil(k * num_tokens / num_experts * capacity_factor))
+    return max(8, ((c + 7) // 8) * 8)
+
+
+def route_topk(logits: jax.Array, k: int, capacity: int) -> RouterOut:
+    """Top-k routing with slot-priority positioning (GShard).
+
+    logits: [T, E] router scores.  Returns dense dispatch/combine tensors
+    [T, E, C]; tokens beyond an expert's capacity get zero weight.
+    """
+    T, E = logits.shape
+    compute_dtype = jnp.promote_types(logits.dtype, jnp.float32)
+    probs = jax.nn.softmax(logits.astype(compute_dtype), axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)          # [T, k]
+    gate_vals = gate_vals / (gate_vals.sum(-1, keepdims=True) + 1e-9)
+
+    dispatch = jnp.zeros((T, E, capacity), compute_dtype)
+    combine = jnp.zeros((T, E, capacity), compute_dtype)
+    counts = jnp.zeros((E,), compute_dtype)
+    for j in range(k):  # k is tiny (1-2): unrolled at trace time
+        oh = jax.nn.one_hot(expert_idx[:, j], E, dtype=compute_dtype)  # [T, E]
+        pos = jnp.cumsum(oh, axis=0) - 1.0 + counts[None, :]  # queue position
+        counts = counts + oh.sum(axis=0)
+        within = (pos < capacity) * oh                        # [T, E]
+        pos_oh = jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1).astype(jnp.int32),
+                                capacity, dtype=compute_dtype)
+        slot = pos_oh * within[..., None]                     # [T, E, C]
+        dispatch = dispatch + slot
+        combine = combine + slot * gate_vals[:, j][:, None, None]
+
+    # load-balance: E * sum_e fraction_dispatched_e * mean_router_prob_e
+    # (Switch Transformer eq. 4, over the top-1 assignment)
+    top1 = jax.nn.one_hot(expert_idx[:, 0], E, dtype=compute_dtype)
+    frac = top1.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux = E * jnp.sum(frac * mean_prob)
+    z = jnp.mean(jax.scipy.special.logsumexp(
+        logits.astype(compute_dtype), axis=-1) ** 2)
+    return RouterOut(dispatch, combine, aux, z)
+
+
+def moe_ffn(x: jax.Array, router_w: jax.Array, w_in: jax.Array,
+            w_out: jax.Array, *, k: int = 2, capacity_factor: float = 1.25,
+            act: Callable = jax.nn.gelu,
+            capacity: Optional[int] = None) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Dense (single-device / GSPMD-auto) MoE feed-forward.
+
+    x: [T, D] tokens; router_w: [D, E]; w_in: [E, D, F]; w_out: [E, F, D].
+    Returns (out [T, D], aux_loss, z_loss).
+    """
+    T, D = x.shape
+    E = router_w.shape[1]
+    C = capacity if capacity is not None else expert_capacity(
+        T, E, k, capacity_factor)
+    logits = x @ router_w                               # [T, E]
+    r = route_topk(logits, k, C)
+    xe = jnp.einsum("td,tec->ecd", x, r.dispatch.astype(x.dtype))
+    h = act(jnp.einsum("ecd,edf->ecf", xe, w_in))
+    y = jnp.einsum("ecf,efd->ecd", h, w_out)
+    out = jnp.einsum("ecd,tec->td", y, r.combine.astype(y.dtype))
+    return out, r.aux_loss, r.z_loss
+
+
+def moe_ffn_sharded(x: jax.Array, router_w: jax.Array, w_in_local: jax.Array,
+                    w_out_local: jax.Array, *, axis_name: str = "ep",
+                    k: int = 2, capacity_factor: float = 1.25,
+                    act: Callable = jax.nn.gelu,
+                    capacity: Optional[int] = None) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-device MoE body for use inside an existing shard_map program.
+
+    Token activations are sharded over `axis_name` ([T_local, D] here);
+    expert weights are expert-sharded ([E/n, D, F] locally).  The router is
+    replicated.  One all_to_all moves each device's dispatched tokens to
+    the devices owning their experts; the inverse brings results home —
+    the `lax.all_to_all` token dispatch SURVEY.md §2.3 calls for.
+    """
+    n = jax.lax.psum(1, axis_name)
+    El = w_in_local.shape[0]
+    E = El * n
+    Tl, D = x.shape
+    C = capacity if capacity is not None else expert_capacity(
+        Tl, E, k, capacity_factor)
+    logits = x @ router_w                               # [Tl, E]
+    r = route_topk(logits, k, C)
+    xe = jnp.einsum("td,tec->ecd", x, r.dispatch.astype(x.dtype))  # [E, C, D]
+    # to expert owners: [E, C, D] -> [E/n, n*C, D]
+    xe = jax.lax.all_to_all(xe, axis_name, split_axis=0, concat_axis=1,
+                            tiled=True)
+    h = act(jnp.einsum("ecd,edf->ecf", xe, w_in_local))
+    y = jnp.einsum("ecf,efd->ecd", h, w_out_local)      # [E/n, n*C, D]
+    # back to token owners: [E/n, n*C, D] -> [E, C, D]
+    y = jax.lax.all_to_all(y, axis_name, split_axis=1, concat_axis=0,
+                           tiled=True)
+    out = jnp.einsum("ecd,tec->td", y, r.combine.astype(y.dtype))
+    # aux losses are per-shard means over the same token count: average
+    aux = jax.lax.pmean(r.aux_loss, axis_name)
+    z = jax.lax.pmean(r.z_loss, axis_name)
+    return out, aux, z

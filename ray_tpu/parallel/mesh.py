@@ -14,7 +14,8 @@ Standard axis names (outer-to-inner, DCN-friendly axes first):
     fsdp  data parallel + param sharding (ZeRO-3; wants ICI)
     sp    sequence/context parallel (ring attention; wants ICI ring)
     tp    tensor parallel          (wants fastest ICI axis, innermost)
-    ep    expert parallel          (aliased onto fsdp/sp axes in MoE layers)
+    ep    expert parallel          (all_to_all token dispatch; doubles as
+                                    a data axis outside MoE layers)
 
 jax device order for TPU meshes follows the physical torus, so keeping `tp`
 innermost places it on the fastest ICI loop — the layout recipe of the
@@ -31,7 +32,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "tp")
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,6 +42,7 @@ class MeshSpec:
     pp: int = 1
     dp: int = 1
     fsdp: int = 1
+    ep: int = 1
     sp: int = 1
     tp: int = 1
 
@@ -72,19 +74,24 @@ class MeshSpec:
         return Mesh(arr, AXIS_ORDER)
 
 
-def make_mesh(*, pp: int = 1, dp: int = 1, fsdp: int = 1, sp: int = 1,
-              tp: int = 1, devices: Optional[Sequence] = None) -> Mesh:
-    return MeshSpec(pp=pp, dp=dp, fsdp=fsdp, sp=sp, tp=tp).build(devices)
+def make_mesh(*, pp: int = 1, dp: int = 1, fsdp: int = 1, ep: int = 1,
+              sp: int = 1, tp: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    return MeshSpec(pp=pp, dp=dp, fsdp=fsdp, ep=ep, sp=sp,
+                    tp=tp).build(devices)
 
 
 def data_axes(mesh: Mesh) -> Tuple[str, ...]:
     """Axes a per-example batch is sharded over."""
-    return tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) >= 1)
+    return tuple(a for a in ("dp", "fsdp", "ep")
+                 if mesh.shape.get(a, 1) >= 1)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Canonical input-batch sharding: batch over (dp, fsdp), seq over sp."""
-    return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+    """Canonical input-batch sharding: batch over the data axes
+    (dp, fsdp, ep — ep doubles as a data axis outside MoE layers), seq
+    over sp."""
+    return NamedSharding(mesh, P(("dp", "fsdp", "ep"), "sp"))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -18,7 +18,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # logical axis -> mesh axis (or tuple of mesh axes, or None = replicate)
 DEFAULT_RULES: Dict[str, Any] = {
-    "batch": ("dp", "fsdp"),
+    "batch": ("dp", "fsdp", "ep"),
     "seq": "sp",
     "embed": "fsdp",       # ZeRO-3: shard params' embed dim over fsdp
     "heads": "tp",

@@ -31,6 +31,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _collect_cycles_after_test():
+    """Actor handles caught in exception-traceback cycles (pytest.raises,
+    try/except in tests) are only finalized by the cycle collector; run it
+    so out-of-scope actors release their resources before the next test
+    (otherwise the shared session cluster starves)."""
+    yield
+    import gc
+
+    gc.collect()
+
+
 @pytest.fixture(scope="session")
 def ray_cluster():
     """A started local cluster with 4 (virtual) CPUs, shared per session."""

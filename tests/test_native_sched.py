@@ -1,0 +1,95 @@
+"""Native C++ cluster-scheduler tests (reference model:
+src/ray/raylet/scheduling/policy/hybrid_scheduling_policy_test.cc,
+cluster_task_manager_test.cc)."""
+
+import pytest
+
+from ray_tpu.native.sched import (PACK, SPREAD, STRICT_PACK, STRICT_SPREAD,
+                                  ClusterScheduler)
+
+G = 10000  # fixed-point granularity used by _private.common
+
+
+@pytest.fixture
+def sched():
+    return ClusterScheduler(spread_threshold=0.5, topk=1)
+
+
+def test_pack_prefers_busiest_under_threshold(sched):
+    sched.upsert_node("a", {"CPU": 4 * G})
+    sched.upsert_node("b", {"CPU": 4 * G})
+    assert sched.acquire("a", {"CPU": 1 * G})
+    # a at 25% util (plus demand -> 50%), still under/at threshold: pack on a
+    assert sched.pick({"CPU": 1 * G}, PACK) == "a"
+    assert sched.acquire("a", {"CPU": 1 * G})
+    # a would go to 75% util: above threshold -> spread to b
+    assert sched.pick({"CPU": 1 * G}, PACK) == "b"
+
+
+def test_spread_prefers_least_utilized(sched):
+    sched.upsert_node("a", {"CPU": 4 * G})
+    sched.upsert_node("b", {"CPU": 4 * G})
+    assert sched.acquire("a", {"CPU": 2 * G})
+    assert sched.pick({"CPU": 1 * G}, SPREAD) == "b"
+
+
+def test_infeasible_returns_none(sched):
+    sched.upsert_node("a", {"CPU": 2 * G})
+    assert sched.pick({"CPU": 3 * G}, PACK) is None
+    assert sched.pick({"GPU": 1 * G}, PACK) is None
+
+
+def test_acquire_release_accounting(sched):
+    sched.upsert_node("a", {"CPU": 2 * G, "MEM": 8 * G})
+    assert sched.acquire("a", {"CPU": 2 * G})
+    assert not sched.acquire("a", {"CPU": 1})
+    sched.release("a", {"CPU": 1 * G})
+    assert sched.available("a", "CPU") == 1 * G
+    # release clamps at total
+    sched.release("a", {"CPU": 100 * G})
+    assert sched.available("a", "CPU") == 2 * G
+
+
+def test_dead_node_excluded(sched):
+    sched.upsert_node("a", {"CPU": 4 * G})
+    sched.upsert_node("b", {"CPU": 4 * G})
+    sched.set_alive("a", False)
+    for _ in range(4):
+        assert sched.pick({"CPU": 1 * G}, PACK) == "b"
+    sched.set_alive("a", True)
+    assert sched.pick({"CPU": 4 * G}, PACK) in ("a", "b")
+
+
+def test_bundle_strict_spread_distinct_nodes(sched):
+    for n in ("a", "b", "c"):
+        sched.upsert_node(n, {"CPU": 2 * G})
+    plan = sched.plan_bundles([{"CPU": 1 * G}] * 3, STRICT_SPREAD)
+    assert plan is not None and len(set(plan)) == 3
+    assert sched.plan_bundles([{"CPU": 1 * G}] * 4, STRICT_SPREAD) is None
+
+
+def test_bundle_strict_pack_one_node(sched):
+    sched.upsert_node("a", {"CPU": 2 * G})
+    sched.upsert_node("b", {"CPU": 4 * G})
+    plan = sched.plan_bundles([{"CPU": 2 * G}, {"CPU": 2 * G}], STRICT_PACK)
+    assert plan == ["b", "b"]
+    assert sched.plan_bundles([{"CPU": 3 * G}] * 2, STRICT_PACK) is None
+
+
+def test_bundle_pack_respects_sim_reservation(sched):
+    sched.upsert_node("a", {"CPU": 2 * G})
+    sched.upsert_node("b", {"CPU": 2 * G})
+    # four 1-cpu bundles must fill both nodes without oversubscribing
+    plan = sched.plan_bundles([{"CPU": 1 * G}] * 4, PACK)
+    assert plan is not None
+    assert sorted(plan).count("a") == 2 and sorted(plan).count("b") == 2
+    # a fifth cannot fit
+    assert sched.plan_bundles([{"CPU": 1 * G}] * 5, PACK) is None
+
+
+def test_heterogeneous_resources(sched):
+    sched.upsert_node("cpu", {"CPU": 8 * G})
+    sched.upsert_node("tpu", {"CPU": 8 * G, "TPU": 4 * G})
+    assert sched.pick({"TPU": 1 * G}, PACK) == "tpu"
+    plan = sched.plan_bundles([{"TPU": 2 * G}, {"TPU": 2 * G}], STRICT_PACK)
+    assert plan == ["tpu", "tpu"]
