@@ -1099,13 +1099,16 @@ class CoreWorker:
                 return
             ac.resolving = True
         try:
-            deadline = time.monotonic() + 120.0
-            while time.monotonic() < deadline:
+            # no overall deadline: an actor queued behind busy resources
+            # stays PENDING arbitrarily long and must not be failed for it
+            # (callers bound their own waits via get(timeout)); only a
+            # DEAD/missing actor is fatal
+            while not self._shutdown:
                 view = self.control.call(
                     "wait_actor_alive",
-                    {"actor_id": actor_id, "timeout": 120.0,
+                    {"actor_id": actor_id, "timeout": 60.0,
                      "min_incarnation": min_incarnation},
-                    timeout=130.0)
+                    timeout=70.0)
                 if view is None or view["state"] == "DEAD":
                     err = (view or {}).get("error") or "actor not found"
                     self._fail_actor(ac, err)
@@ -1135,7 +1138,6 @@ class CoreWorker:
                 for spec in buffered:
                     self._send_actor_task(ac, spec)
                 return
-            self._fail_actor(ac, "timed out resolving actor connection")
         finally:
             with ac.lock:
                 ac.resolving = False

@@ -27,6 +27,10 @@ from .datasource import (BinaryDatasource, BlocksDatasource, CSVDatasource,
                          ReadTask, TextDatasource)
 from .grouped import GroupedData
 from .logical import LogicalPlan, Read
+from .preprocessors import (BatchMapper, Chain, Concatenator, LabelEncoder,
+                            MaxAbsScaler, MinMaxScaler, OneHotEncoder,
+                            OrdinalEncoder, Preprocessor, SimpleImputer,
+                            StandardScaler)
 
 
 def read_datasource(datasource: Datasource, *,

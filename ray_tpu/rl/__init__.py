@@ -8,7 +8,10 @@ the entire rollout one compiled `lax.scan` (see env/jax_env.py).
 
 from .algorithms.algorithm import Algorithm, AlgorithmConfig
 from .algorithms.dqn import DQN, DQNConfig
+from .algorithms.impala import IMPALA, Impala, ImpalaConfig
+from .algorithms.marwil import BC, BCConfig, MARWIL, MARWILConfig
 from .algorithms.ppo import PPO, PPOConfig
+from .algorithms.sac import SAC, SACConfig
 from .core.learner import Learner, LearnerGroup
 from .core.rl_module import (DiscretePolicyModule, QModule, RLModule,
                              module_for_env)
@@ -18,6 +21,8 @@ from .utils.replay_buffer import ReplayBuffer
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "Impala", "IMPALA", "ImpalaConfig", "SAC", "SACConfig",
+    "MARWIL", "MARWILConfig", "BC", "BCConfig",
     "Learner", "LearnerGroup", "RLModule", "DiscretePolicyModule", "QModule",
     "module_for_env", "EnvRunnerGroup", "JaxEnvRunner", "GymEnvRunner",
     "JaxEnv", "CartPole", "make_env", "register_env", "ReplayBuffer",

@@ -1,0 +1,138 @@
+"""IMPALA: importance-weighted actor-critic with V-trace.
+
+Analog of the reference's IMPALA (reference: rllib/algorithms/impala/
+impala.py, torch/vtrace_torch_v2.py): actors sample with a (possibly
+stale) behavior policy; the learner corrects off-policyness with V-trace
+truncated importance weights.  Jax-first: V-trace is one `lax.scan` over
+the reversed time axis inside the jitted update — no per-step host loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl.core.learner import Learner, LearnerGroup
+from ray_tpu.rl.core.rl_module import DiscretePolicyModule
+
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+def vtrace(behavior_logp, target_logp, rewards, dones, values, final_value,
+           gamma, clip_rho: float = 1.0, clip_c: float = 1.0):
+    """V-trace targets + policy-gradient advantages over [T, B] arrays
+    (Espeholt et al. 2018, eq. 1) as a reverse lax.scan."""
+    rho = jnp.exp(target_logp - behavior_logp)
+    rho_bar = jnp.minimum(rho, clip_rho)
+    c_bar = jnp.minimum(rho, clip_c)
+    nonterminal = 1.0 - dones.astype(jnp.float32)
+    next_values = jnp.concatenate(
+        [values[1:], final_value[None]], axis=0)
+    deltas = rho_bar * (rewards + gamma * next_values * nonterminal - values)
+
+    def step(acc, xs):
+        delta, c, nt = xs
+        acc = delta + gamma * c * nt * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        step, jnp.zeros_like(final_value),
+        (deltas, c_bar, nonterminal), reverse=True)
+    vs = vs_minus_v + values
+    next_vs = jnp.concatenate([vs[1:], final_value[None]], axis=0)
+    pg_adv = rho_bar * (rewards + gamma * next_vs * nonterminal - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class ImpalaLearner(Learner):
+    def __init__(self, module: DiscretePolicyModule, *,
+                 gamma: float = 0.99, vf_coeff: float = 0.5,
+                 entropy_coeff: float = 0.01, clip_rho: float = 1.0,
+                 clip_c: float = 1.0, **kwargs):
+        self.gamma = gamma
+        self.vf_coeff = vf_coeff
+        self.entropy_coeff = entropy_coeff
+        self.clip_rho = clip_rho
+        self.clip_c = clip_c
+        super().__init__(module, **kwargs)
+
+    def compute_loss(self, params, batch, rng):
+        # batch arrives [B, T] (batch-major so LearnerGroup's axis-0
+        # sharding splits episodes, not time); V-trace wants time-major
+        batch = dict(batch)
+        for k in ("obs", "action", "reward", "done", "logp"):
+            batch[k] = jnp.swapaxes(batch[k], 0, 1)
+        logits = self.module.logits(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp = jnp.take_along_axis(
+            logp_all, batch["action"][..., None].astype(jnp.int32),
+            axis=-1)[..., 0]
+        values = self.module.value(params, batch["obs"])
+        vs, pg_adv = vtrace(batch["logp"], target_logp, batch["reward"],
+                            batch["done"], values, batch["final_vf"],
+                            self.gamma, self.clip_rho, self.clip_c)
+        pi_loss = -jnp.mean(pg_adv * target_logp)
+        vf_loss = 0.5 * jnp.mean((values - vs) ** 2)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        loss = pi_loss + self.vf_coeff * vf_loss \
+            - self.entropy_coeff * entropy
+        return loss, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                      "entropy": entropy,
+                      "mean_rho": jnp.mean(
+                          jnp.exp(target_logp - batch["logp"]))}
+
+
+class ImpalaConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.clip_rho = 1.0
+        self.clip_c = 1.0
+
+    algo_cls = None  # set below
+
+
+class Impala(Algorithm):
+    module_kind = "policy"
+
+    def _setup(self):
+        cfg: ImpalaConfig = self.config
+
+        def factory():
+            module = DiscretePolicyModule(self.env_spec["obs_dim"],
+                                          self.env_spec["num_actions"],
+                                          cfg.hidden)
+            return ImpalaLearner(module, gamma=cfg.gamma,
+                                 vf_coeff=cfg.vf_coeff,
+                                 entropy_coeff=cfg.entropy_coeff,
+                                 clip_rho=cfg.clip_rho, clip_c=cfg.clip_c,
+                                 lr=cfg.lr, seed=cfg.seed)
+
+        self.learner_group = LearnerGroup(factory, cfg.num_learners)
+        self.runners.sync_weights(self.learner_group.get_weights())
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: ImpalaConfig = self.config
+        results = self.runners.sample(cfg.rollout_len)
+        batch, stats = self._merge_runner_results(results)
+        # [T, B] -> [B, T] so every key (incl. final_vf [B]) shards along
+        # episodes under LearnerGroup's axis-0 split
+        update_batch = {
+            k: np.swapaxes(np.asarray(batch[k]), 0, 1)
+            for k in ("obs", "action", "reward", "done", "logp")
+        }
+        update_batch["final_vf"] = np.asarray(batch["final_vf"])
+        metrics = self.learner_group.update(update_batch)
+        self.runners.sync_weights(self.learner_group.get_weights())
+        metrics.update(stats)
+        return metrics
+
+
+ImpalaConfig.algo_cls = Impala
+IMPALA = Impala
+IMPALAConfig = ImpalaConfig

@@ -1,0 +1,172 @@
+"""MARWIL + BC: offline policy learning from logged experience.
+
+Analog of the reference's MARWIL/BC (reference:
+rllib/algorithms/marwil/marwil.py, torch/marwil_torch_learner.py;
+rllib/algorithms/bc/bc.py — BC is MARWIL with beta=0): exponentially
+advantage-weighted behavior cloning with a value baseline.  Offline data
+comes from any iterable of sample dicts (e.g. a ray_tpu.data Dataset of
+episodes or rollouts recorded by an EnvRunnerGroup).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl.core.learner import Learner, LearnerGroup
+from ray_tpu.rl.core.rl_module import DiscretePolicyModule
+
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+class MarwilLearner(Learner):
+    def __init__(self, module: DiscretePolicyModule, *, beta: float = 1.0,
+                 vf_coeff: float = 1.0, advantage_clip: float = 10.0,
+                 **kwargs):
+        self.beta = beta
+        self.vf_coeff = vf_coeff
+        self.advantage_clip = advantage_clip
+        super().__init__(module, **kwargs)
+
+    def compute_loss(self, params, batch, rng):
+        logits = self.module.logits(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["action"][..., None].astype(jnp.int32),
+            axis=-1)[..., 0]
+        value = self.module.value(params, batch["obs"])
+        returns = batch["return"]
+        vf_loss = jnp.mean((value - returns) ** 2)
+        if self.beta == 0.0:
+            # plain behavior cloning
+            weights = jnp.ones_like(logp)
+        else:
+            adv = jax.lax.stop_gradient(returns - value)
+            # normalize advantages by their running scale (reference keeps
+            # a moving average; per-batch rms is the jit-friendly analog)
+            rms = jnp.sqrt(jnp.mean(adv ** 2) + 1e-8)
+            weights = jnp.exp(jnp.clip(self.beta * adv / rms,
+                                       -self.advantage_clip,
+                                       self.advantage_clip))
+            weights = jax.lax.stop_gradient(weights)
+        pi_loss = -jnp.mean(weights * logp)
+        loss = pi_loss + self.vf_coeff * vf_loss * (self.beta != 0.0)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        return loss, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                      "entropy": entropy,
+                      "mean_weight": jnp.mean(weights)}
+
+
+def episodes_to_batch(batch: Dict[str, np.ndarray],
+                      gamma: float) -> Dict[str, np.ndarray]:
+    """[T, B] rollout arrays -> flat {obs, action, return} with
+    discounted reward-to-go computed per column, resetting at dones."""
+    rewards = np.asarray(batch["reward"], np.float32)
+    dones = np.asarray(batch["done"], bool)
+    T = rewards.shape[0]
+    returns = np.zeros_like(rewards)
+    acc = np.zeros(rewards.shape[1], np.float32)
+    for t in range(T - 1, -1, -1):
+        acc = rewards[t] + gamma * acc * (~dones[t])
+        returns[t] = acc
+    flat = lambda a: np.asarray(a).reshape(-1, *np.asarray(a).shape[2:])  # noqa
+    return {"obs": flat(batch["obs"]),
+            "action": flat(batch["action"]),
+            "return": returns.reshape(-1)}
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.beta = 1.0
+        self.vf_coeff = 1.0
+        self.lr = 1e-3
+        self.num_epochs = 1
+        self.minibatch_size = 512
+        #: offline experience: list of flat sample dicts
+        #: ({obs, action, return}) or [T,B] rollout dicts
+        self.offline_data: Optional[Iterable[Dict[str, Any]]] = None
+
+    algo_cls = None
+
+    def offline(self, data: Iterable[Dict[str, Any]]):
+        self.offline_data = data
+        return self
+
+
+class MARWIL(Algorithm):
+    """Offline when config.offline_data is set; otherwise clones its own
+    rollouts (useful as a smoke test / for on-policy distillation)."""
+
+    module_kind = "policy"
+
+    def _setup(self):
+        cfg: MARWILConfig = self.config
+
+        def factory():
+            module = DiscretePolicyModule(self.env_spec["obs_dim"],
+                                          self.env_spec["num_actions"],
+                                          cfg.hidden)
+            return MarwilLearner(module, beta=cfg.beta,
+                                 vf_coeff=cfg.vf_coeff,
+                                 lr=cfg.lr, seed=cfg.seed)
+
+        self.learner_group = LearnerGroup(factory, cfg.num_learners)
+        self.runners.sync_weights(self.learner_group.get_weights())
+        self._offline: List[Dict[str, np.ndarray]] = []
+        if cfg.offline_data is not None:
+            for item in cfg.offline_data:
+                if "return" not in item:
+                    item = episodes_to_batch(item, cfg.gamma)
+                self._offline.append(
+                    {k: np.asarray(v) for k, v in item.items()})
+        self._rng = np.random.RandomState(cfg.seed)
+
+    def _offline_minibatches(self):
+        cfg: MARWILConfig = self.config
+        data = self._offline
+        all_idx = [(i, j) for i, d in enumerate(data)
+                   for j in range(0, len(d["obs"]), cfg.minibatch_size)]
+        self._rng.shuffle(all_idx)
+        for i, j in all_idx:
+            d = data[i]
+            yield {k: v[j:j + cfg.minibatch_size] for k, v in d.items()}
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: MARWILConfig = self.config
+        metrics: Dict[str, float] = {}
+        if self._offline:
+            for _ in range(cfg.num_epochs):
+                for mb in self._offline_minibatches():
+                    metrics = self.learner_group.update(mb)
+            self.runners.sync_weights(self.learner_group.get_weights())
+            return metrics
+        # no dataset: clone own behavior (BC smoke mode)
+        results = self.runners.sample(cfg.rollout_len)
+        batch, stats = self._merge_runner_results(results)
+        flat = episodes_to_batch(batch, cfg.gamma)
+        metrics = self.learner_group.update(flat)
+        self.runners.sync_weights(self.learner_group.get_weights())
+        metrics.update(stats)
+        return metrics
+
+
+MARWILConfig.algo_cls = MARWIL
+
+
+class BCConfig(MARWILConfig):
+    """Behavior cloning = MARWIL with beta=0 (reference: bc.py)."""
+
+    def __init__(self):
+        super().__init__()
+        self.beta = 0.0
+
+
+class BC(MARWIL):
+    pass
+
+
+BCConfig.algo_cls = BC

@@ -1,0 +1,193 @@
+"""SAC (discrete): soft actor-critic with twin Q-nets and learned
+temperature.
+
+Analog of the reference's SAC (reference: rllib/algorithms/sac/sac.py,
+torch/sac_torch_learner.py).  Discrete-action variant (Christodoulou
+2019): the soft value and the policy objective take exact expectations
+over the action set instead of reparameterized samples — everything stays
+a dense matmul over [batch, actions], which is the TPU-friendly shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl.core.learner import Learner, LearnerGroup
+from ray_tpu.rl.core.rl_module import (MODULE_REGISTRY, RLModule, _mlp_apply,
+                                       _mlp_init)
+from ray_tpu.rl.utils.replay_buffer import ReplayBuffer
+
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+class SACModule(RLModule):
+    """Policy + twin soft-Q nets (+ polyak targets + log temperature)."""
+
+    def init(self, rng):
+        pi_rng, q1_rng, q2_rng = jax.random.split(rng, 3)
+        sizes = (self.obs_dim, *self.hidden)
+        q1 = _mlp_init(q1_rng, (*sizes, self.num_actions), out_scale=0.01)
+        q2 = _mlp_init(q2_rng, (*sizes, self.num_actions), out_scale=0.01)
+        return {
+            "pi": _mlp_init(pi_rng, (*sizes, self.num_actions)),
+            "q1": q1,
+            "q2": q2,
+            "target_q1": jax.tree_util.tree_map(jnp.copy, q1),
+            "target_q2": jax.tree_util.tree_map(jnp.copy, q2),
+            "log_alpha": jnp.zeros(()),
+        }
+
+    def logits(self, params, obs):
+        return _mlp_apply(params["pi"], obs)
+
+    def q_values(self, params, obs, which: str):
+        return _mlp_apply(params[which], obs)
+
+    def forward_exploration(self, params, obs, rng):
+        action = jax.random.categorical(rng, self.logits(params, obs))
+        return action, {}
+
+    def forward_inference(self, params, obs):
+        return jnp.argmax(self.logits(params, obs), axis=-1)
+
+
+MODULE_REGISTRY["sac"] = SACModule
+
+
+class SACLearner(Learner):
+    def __init__(self, module: SACModule, *, gamma: float = 0.99,
+                 tau: float = 0.005, target_entropy: float = None,
+                 **kwargs):
+        self.gamma = gamma
+        self.tau = tau
+        # default target entropy: 0.98 * max entropy (discrete-SAC paper)
+        self.target_entropy = (target_entropy if target_entropy is not None
+                               else 0.98 * float(np.log(module.num_actions)))
+        super().__init__(module, **kwargs)
+
+    def _trainable(self, params):
+        return {"pi": params["pi"], "q1": params["q1"], "q2": params["q2"],
+                "log_alpha": params["log_alpha"]}
+
+    def _merge(self, params, trained):
+        return {**trained, "target_q1": params["target_q1"],
+                "target_q2": params["target_q2"]}
+
+    def compute_loss(self, params, batch, rng):
+        m: SACModule = self.module
+        alpha = jax.lax.stop_gradient(jnp.exp(params["log_alpha"]))
+        logits = m.logits(params, batch["obs"])
+        logp = jax.nn.log_softmax(logits)
+        probs = jnp.exp(logp)
+
+        # soft target value from the *next* state, exact over actions
+        next_logp = jax.nn.log_softmax(m.logits(params, batch["next_obs"]))
+        next_probs = jnp.exp(next_logp)
+        next_q = jnp.minimum(
+            m.q_values(params, batch["next_obs"], "target_q1"),
+            m.q_values(params, batch["next_obs"], "target_q2"))
+        next_v = jnp.sum(next_probs * (next_q - alpha * next_logp), axis=-1)
+        target = batch["reward"] + self.gamma * next_v \
+            * (1.0 - batch["done"].astype(jnp.float32))
+        target = jax.lax.stop_gradient(target)
+
+        a_idx = batch["action"][..., None].astype(jnp.int32)
+        q1_a = jnp.take_along_axis(
+            m.q_values(params, batch["obs"], "q1"), a_idx, axis=-1)[..., 0]
+        q2_a = jnp.take_along_axis(
+            m.q_values(params, batch["obs"], "q2"), a_idx, axis=-1)[..., 0]
+        q_loss = 0.5 * (jnp.mean((q1_a - target) ** 2)
+                        + jnp.mean((q2_a - target) ** 2))
+
+        # policy: minimize E_s[ sum_a pi(a|s) (alpha log pi - min Q) ]
+        q_min = jax.lax.stop_gradient(jnp.minimum(
+            m.q_values(params, batch["obs"], "q1"),
+            m.q_values(params, batch["obs"], "q2")))
+        pi_loss = jnp.mean(jnp.sum(probs * (alpha * logp - q_min), axis=-1))
+
+        # temperature: drive policy entropy toward target_entropy
+        entropy = -jnp.sum(probs * logp, axis=-1)
+        alpha_loss = jnp.mean(params["log_alpha"] * jax.lax.stop_gradient(
+            entropy - self.target_entropy))
+
+        loss = q_loss + pi_loss + alpha_loss
+        return loss, {"q_loss": q_loss, "pi_loss": pi_loss,
+                      "alpha": jnp.exp(params["log_alpha"]),
+                      "entropy": jnp.mean(entropy)}
+
+    def extra_update(self, params, metrics):
+        # polyak target sync inside host callback (cheap tree op)
+        tau = self.tau
+        mix = lambda t, o: jax.tree_util.tree_map(  # noqa: E731
+            lambda a, b: (1 - tau) * a + tau * b, t, o)
+        params["target_q1"] = mix(params["target_q1"], params["q1"])
+        params["target_q2"] = mix(params["target_q2"], params["q2"])
+        return params
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.tau = 0.005
+        self.buffer_capacity = 50_000
+        self.learn_starts = 500
+        self.updates_per_iter = 32
+        self.train_batch_size = 128
+        self.rollout_len = 64
+        self.target_entropy = None
+
+    algo_cls = None
+
+
+class SAC(Algorithm):
+    module_kind = "sac"
+
+    def _setup(self):
+        cfg: SACConfig = self.config
+
+        def factory():
+            module = SACModule(self.env_spec["obs_dim"],
+                               self.env_spec["num_actions"], cfg.hidden)
+            return SACLearner(module, gamma=cfg.gamma, tau=cfg.tau,
+                              target_entropy=cfg.target_entropy,
+                              lr=cfg.lr, seed=cfg.seed)
+
+        self.learner_group = LearnerGroup(factory, cfg.num_learners)
+        self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
+        self.runners.sync_weights(self.learner_group.get_weights())
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: SACConfig = self.config
+        results = self.runners.sample(cfg.rollout_len)
+        batch, stats = self._merge_runner_results(results)
+
+        obs = np.asarray(batch["obs"])          # [T, B, D]
+        next_obs = np.roll(obs, -1, axis=0)
+        valid = np.ones(obs.shape[:2], bool)
+        valid[-1] = False
+        flat_idx = valid.reshape(-1)
+        flatten = lambda a: a.reshape(-1, *a.shape[2:])[flat_idx]  # noqa
+        self.buffer.add_batch({
+            "obs": flatten(obs),
+            "next_obs": flatten(next_obs),
+            "action": flatten(np.asarray(batch["action"])),
+            "reward": flatten(np.asarray(batch["reward"])),
+            "done": flatten(np.asarray(batch["done"])),
+        })
+
+        metrics: Dict[str, float] = {}
+        if len(self.buffer) >= cfg.learn_starts:
+            for _ in range(cfg.updates_per_iter):
+                metrics = self.learner_group.update(
+                    self.buffer.sample(cfg.train_batch_size))
+            self.runners.sync_weights(self.learner_group.get_weights())
+        metrics.update(stats)
+        return metrics
+
+
+SACConfig.algo_cls = SAC

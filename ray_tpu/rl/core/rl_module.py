@@ -130,7 +130,12 @@ class QModule(RLModule):
         return jnp.argmax(self.q_values(params, obs), axis=-1)
 
 
+# algorithm-owned module kinds register here (e.g. SAC's policy+twin-Q)
+MODULE_REGISTRY: Dict[str, type] = {}
+
+
 def module_for_env(env_spec: Dict[str, Any], kind: str = "policy",
                    hidden: Sequence[int] = (64, 64)) -> RLModule:
-    cls = DiscretePolicyModule if kind == "policy" else QModule
+    cls = MODULE_REGISTRY.get(kind) or (
+        DiscretePolicyModule if kind == "policy" else QModule)
     return cls(env_spec["obs_dim"], env_spec["num_actions"], hidden)

@@ -9,11 +9,12 @@ and `tune.report` via the shared train session.
 from ray_tpu.train.session import get_checkpoint, get_context, report
 
 from .schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
-                         MedianStoppingRule, PopulationBasedTraining,
-                         TrialScheduler)
-from .search import (BasicVariantGenerator, Categorical, Domain, Float,
-                     Integer, Searcher, choice, generate_variants,
-                     grid_search, loguniform, randint, sample_from, uniform)
+                         HyperBandScheduler, MedianStoppingRule, PB2,
+                         PopulationBasedTraining, TrialScheduler)
+from .search import (BasicVariantGenerator, Categorical, ConcurrencyLimiter,
+                     Domain, Float, Integer, Repeater, Searcher, TPESearch,
+                     choice, generate_variants, grid_search, loguniform,
+                     randint, sample_from, uniform)
 from .trial import Trial
 from .tune_controller import Callback, JsonLoggerCallback, TuneController
 from .tuner import ResultGrid, TuneConfig, Tuner, run
@@ -22,10 +23,12 @@ ASHAScheduler = AsyncHyperBandScheduler
 
 __all__ = [
     "ASHAScheduler", "AsyncHyperBandScheduler", "BasicVariantGenerator",
-    "Callback", "Categorical", "Domain", "FIFOScheduler", "Float",
-    "Integer", "JsonLoggerCallback", "MedianStoppingRule",
-    "PopulationBasedTraining", "ResultGrid", "Searcher", "Trial",
-    "TrialScheduler", "TuneConfig", "TuneController", "Tuner", "choice",
-    "generate_variants", "get_checkpoint", "get_context", "grid_search",
-    "loguniform", "randint", "report", "run", "sample_from", "uniform",
+    "Callback", "Categorical", "ConcurrencyLimiter", "Domain",
+    "FIFOScheduler", "Float", "HyperBandScheduler", "Integer",
+    "JsonLoggerCallback", "MedianStoppingRule", "PB2",
+    "PopulationBasedTraining", "Repeater", "ResultGrid", "Searcher",
+    "TPESearch", "Trial", "TrialScheduler", "TuneConfig", "TuneController",
+    "Tuner", "choice", "generate_variants", "get_checkpoint", "get_context",
+    "grid_search", "loguniform", "randint", "report", "run", "sample_from",
+    "uniform",
 ]

@@ -56,6 +56,11 @@ class TrialScheduler:
                 return t
         return None
 
+    def trials_to_stop(self):
+        """Trial ids the scheduler decided to cull outside their own
+        report (drained by the controller each step)."""
+        return ()
+
 
 class FIFOScheduler(TrialScheduler):
     pass
@@ -225,3 +230,220 @@ class PopulationBasedTraining(TrialScheduler):
 
     def make_exploit_config(self, donor: Trial) -> Dict[str, Any]:
         return self._explore(donor.config)
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand (reference: tune/schedulers/hyperband.py).
+
+    Trials are assigned round-robin to brackets; each bracket runs
+    successive halving: at every rung milestone the cohort synchronizes
+    (arrivals wait as PENDING but are not chosen to run), then the top
+    1/eta advance and the rest are culled via `trials_to_stop`, which the
+    controller drains.
+    """
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 81, eta: int = 3):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.eta = eta
+        # bracket s: initial budget r0 = max_t / eta^s
+        s_max = int(math.floor(math.log(max_t, eta)))
+        self.brackets: List[Dict[str, Any]] = []
+        for s in range(s_max, -1, -1):
+            r0 = max(1, int(max_t / (eta ** s)))
+            rungs = []
+            r = r0
+            while r <= max_t:
+                rungs.append(r)
+                r *= eta
+            self.brackets.append({"s": s, "rungs": rungs,
+                                  "trials": [],      # trial ids in bracket
+                                  # rung idx -> {trial_id: score}
+                                  "scores": {},
+                                  # trial_id -> next allowed rung idx
+                                  "at_rung": {}})
+        self._bracket_of: Dict[str, int] = {}
+        self._next_bracket = 0
+        self._stop: set = set()
+        self._done: set = set()
+
+    def on_trial_add(self, trial: Trial):
+        if trial.trial_id in self._bracket_of:
+            return
+        b = self._next_bracket % len(self.brackets)
+        self._next_bracket += 1
+        self._bracket_of[trial.trial_id] = b
+        br = self.brackets[b]
+        br["trials"].append(trial.trial_id)
+        br["at_rung"][trial.trial_id] = 0
+
+    def _ensure_added(self, trial: Trial):
+        """Restored experiments bypass on_trial_add (resumed trials are
+        handed straight to the controller) — register lazily."""
+        if trial.trial_id not in self._bracket_of:
+            self.on_trial_add(trial)
+
+    def trials_to_stop(self):
+        out, self._stop = self._stop, set()
+        return out
+
+    def _live_cohort(self, br, rung_idx) -> List[str]:
+        """Trials expected to report at this rung (not culled/errored)."""
+        return [tid for tid in br["trials"]
+                if br["at_rung"].get(tid, -1) == rung_idx
+                and tid not in self._done]
+
+    def _maybe_promote(self, br, rung_idx):
+        """If the rung's live cohort has fully reported, promote the top
+        1/eta and cull the rest.  Called on every report AND whenever a
+        cohort member leaves (complete/error) — otherwise the waiters
+        strand as PENDING forever."""
+        reported = br["scores"].get(rung_idx, {})
+        cohort = self._live_cohort(br, rung_idx)
+        waiting = [tid for tid in cohort if tid not in reported]
+        if waiting or not reported:
+            return
+        ranked = sorted(reported.items(), key=lambda kv: kv[1], reverse=True)
+        keep = max(1, len(ranked) // self.eta)
+        if rung_idx + 1 >= len(br["rungs"]):
+            keep = 0  # last rung: everyone stops
+        for i, (mid, _) in enumerate(ranked):
+            if mid in self._done:
+                continue
+            if i < keep:
+                br["at_rung"][mid] = rung_idx + 1
+            else:
+                self._done.add(mid)
+                self._stop.add(mid)
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        self._ensure_added(trial)
+        tid = trial.trial_id
+        br = self.brackets[self._bracket_of[tid]]
+        rung_idx = br["at_rung"].get(tid, 0)
+        if rung_idx >= len(br["rungs"]):
+            return STOP  # finished the last rung
+        t = result.get(self.time_attr, 0)
+        if t < br["rungs"][rung_idx]:
+            return CONTINUE
+        # reached the milestone: record + synchronize
+        br["scores"].setdefault(rung_idx, {})[tid] = self._score(result)
+        self._maybe_promote(br, rung_idx)
+        if tid in self._done:
+            self._stop.discard(tid)  # we answer this one directly
+            return STOP
+        if br["at_rung"].get(tid, 0) > rung_idx:
+            return CONTINUE  # promoted immediately (cohort was complete)
+        return PAUSE  # wait for the cohort (stays PENDING, not chosen)
+
+    def _on_trial_left(self, trial: Trial):
+        """Completion or error removes the trial from its cohorts; the
+        rungs it was gating may now be promotable."""
+        tid = trial.trial_id
+        self._done.add(tid)
+        b = self._bracket_of.get(tid)
+        if b is None:
+            return
+        br = self.brackets[b]
+        for rung_idx in range(len(br["rungs"])):
+            self._maybe_promote(br, rung_idx)
+
+    def on_trial_error(self, trial: Trial):
+        self._on_trial_left(trial)
+
+    def on_trial_complete(self, trial: Trial):
+        self._on_trial_left(trial)
+
+    def choose_trial_to_run(self, trials: List[Trial]) -> Optional[Trial]:
+        from .trial import PENDING
+
+        for t in trials:
+            if t.status != PENDING:
+                continue
+            self._ensure_added(t)
+            tid = t.trial_id
+            if tid in self._done or tid in self._stop:
+                continue
+            br = self.brackets[self._bracket_of[tid]]
+            rung_idx = br["at_rung"].get(tid, 0)
+            if rung_idx >= len(br["rungs"]):
+                continue
+            # runnable iff its rung is not waiting on a full lower cohort
+            if br["scores"].get(rung_idx, {}).get(tid) is None:
+                return t
+        return None
+
+
+class PB2(PopulationBasedTraining):
+    """PBT with a GP-UCB exploration step (reference:
+    tune/schedulers/pb2.py) — instead of random perturbation, fit a
+    Gaussian process mapping hyperparameters -> score improvement and pick
+    the UCB-maximizing candidate inside `hyperparam_bounds`.  Numpy-only
+    (the reference depends on GPy; same math, RBF kernel)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: Optional[Dict[str, tuple]] = None,
+                 quantile_fraction: float = 0.25,
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode, time_attr, perturbation_interval,
+                         hyperparam_mutations={}, 
+                         quantile_fraction=quantile_fraction, seed=seed)
+        self.bounds = hyperparam_bounds or {}
+        #: (hyperparam vector, score delta) observations
+        self._gp_data: List[tuple] = []
+        self._last_score: Dict[str, float] = {}
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last >= self.interval and self.bounds:
+            score = self._score(result)
+            prev = self._last_score.get(trial.trial_id)
+            if prev is not None:
+                x = [float(trial.config.get(k, (lo + hi) / 2))
+                     for k, (lo, hi) in sorted(self.bounds.items())]
+                self._gp_data.append((x, score - prev))
+            self._last_score[trial.trial_id] = score
+        return super().on_trial_result(trial, result)
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        import numpy as np
+
+        new = dict(config)
+        if not self.bounds:
+            return new
+        keys = sorted(self.bounds.keys())
+        lo = np.asarray([self.bounds[k][0] for k in keys], float)
+        hi = np.asarray([self.bounds[k][1] for k in keys], float)
+        if len(self._gp_data) < 4:
+            for k, l, h in zip(keys, lo, hi):
+                new[k] = float(self.rng.uniform(l, h))
+            return new
+        X = np.asarray([x for x, _ in self._gp_data], float)
+        y = np.asarray([d for _, d in self._gp_data], float)
+        y = (y - y.mean()) / (y.std() + 1e-8)
+        # normalize inputs to [0,1]
+        Xn = (X - lo) / np.maximum(hi - lo, 1e-12)
+        ls, sn = 0.3, 1e-3
+
+        def k(a, b):
+            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / (ls * ls))
+
+        K = k(Xn, Xn) + sn * np.eye(len(Xn))
+        Kinv_y = np.linalg.solve(K, y)
+        cand = np.random.RandomState(
+            self.rng.randrange(1 << 31)).rand(64, len(keys))
+        Kc = k(cand, Xn)
+        mu = Kc @ Kinv_y
+        var = 1.0 - np.einsum("ij,jk,ik->i", Kc, np.linalg.inv(K), Kc)
+        ucb = mu + 1.0 * np.sqrt(np.maximum(var, 1e-12))
+        best = cand[int(np.argmax(ucb))]
+        for k_, v in zip(keys, lo + best * (hi - lo)):
+            new[k_] = float(v)
+        return new

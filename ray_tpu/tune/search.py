@@ -132,13 +132,21 @@ def generate_variants(param_space: Dict[str, Any], num_samples: int = 1,
 # Searcher interface (reference: tune/search/searcher.py)
 # ---------------------------------------------------------------------------
 
+#: sentinel a searcher returns from suggest() to mean "nothing right now,
+#: ask again later" (vs None = exhausted) — used by ConcurrencyLimiter
+#: (reference: tune/search/concurrency_limiter.py returns None + retries)
+DEFER = object()
+
+
 class Searcher:
+    DEFER = DEFER
+
     def __init__(self, metric: Optional[str] = None, mode: str = "max"):
         self.metric = metric
         self.mode = mode
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
-        """Next config, or None when exhausted."""
+        """Next config, None when exhausted, or DEFER to retry later."""
         raise NotImplementedError
 
     def on_trial_result(self, trial_id: str, result: Dict[str, Any]):
@@ -170,3 +178,186 @@ class BasicVariantGenerator(Searcher):
         cfg = self._variants[self._i]
         self._i += 1
         return cfg
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions from a wrapped searcher (reference:
+    tune/search/concurrency_limiter.py)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(searcher.metric, searcher.mode or "max")
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def suggest(self, trial_id: str):
+        if len(self._live) >= self.max_concurrent:
+            return DEFER
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None and cfg is not DEFER:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error=error)
+
+
+class Repeater(Searcher):
+    """Runs each suggested config `repeat` times and reports the mean
+    metric to the wrapped searcher (reference: tune/search/repeater.py —
+    for noisy objectives)."""
+
+    def __init__(self, searcher: Searcher, repeat: int = 3,
+                 metric: Optional[str] = None):
+        super().__init__(metric or searcher.metric, searcher.mode or "max")
+        self.searcher = searcher
+        self.repeat = repeat
+        self._groups: Dict[str, List[str]] = {}   # lead trial id -> members
+        self._member_of: Dict[str, str] = {}
+        self._results: Dict[str, List[Dict[str, Any]]] = {}
+        self._finished: Dict[str, set] = {}        # lead -> finished members
+        self._queue: List[tuple] = []              # (lead, config) to repeat
+
+    def suggest(self, trial_id: str):
+        if self._queue:
+            lead, cfg = self._queue.pop(0)
+            self._groups[lead].append(trial_id)
+            self._member_of[trial_id] = lead
+            return dict(cfg)
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is None or cfg is DEFER:
+            return cfg
+        self._groups[trial_id] = [trial_id]
+        self._member_of[trial_id] = trial_id
+        self._results[trial_id] = []
+        for _ in range(self.repeat - 1):
+            self._queue.append((trial_id, cfg))
+        return dict(cfg)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        lead = self._member_of.get(trial_id, trial_id)
+        if result is not None and not error:
+            self._results.setdefault(lead, []).append(result)
+        finished = self._finished.setdefault(lead, set())
+        finished.add(trial_id)
+        # finalize once every member (including errored ones) is done, with
+        # whatever results survived — an errored member must not strand the
+        # group and starve the wrapped searcher of the observation
+        if len(finished) >= self.repeat:
+            done = self._results.get(lead, [])
+            if not done:
+                self.searcher.on_trial_complete(lead, None, error=True)
+                return
+            metric = self.metric
+            vals = [float(r[metric]) for r in done
+                    if metric and metric in r]
+            agg = dict(done[-1])
+            if vals and metric:
+                agg[metric] = sum(vals) / len(vals)
+            self.searcher.on_trial_complete(lead, agg)
+
+
+class TPESearch(Searcher):
+    """Tree-structured Parzen Estimator over a Domain param_space.
+
+    Native model-based searcher standing in for the reference's
+    hyperopt/optuna integrations (reference: tune/search/hyperopt/,
+    tune/search/optuna/) without the external dependency: observations
+    split into good/bad by quantile `gamma`; candidates are sampled from a
+    KDE over the good set and ranked by the good/bad density ratio,
+    independently per dimension.
+    """
+
+    def __init__(self, param_space: Dict[str, Any], metric: str,
+                 mode: str = "max", n_initial: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, num_samples: int = 64,
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        import numpy as np
+
+        # modeled dims: Float/Integer/Categorical.  Everything else passes
+        # through: Function domains get re-sampled each suggest, plain
+        # constants are copied verbatim.
+        self.space: Dict[str, Domain] = {}
+        self._passthrough: Dict[str, Any] = {}
+        for k, v in param_space.items():
+            if isinstance(v, (Float, Integer, Categorical)):
+                self.space[k] = v
+            else:
+                self._passthrough[k] = v
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.remaining = num_samples
+        self.rng = random.Random(seed)
+        self.np_rng = np.random.RandomState(seed)
+        self._obs: List[tuple] = []   # (config, score)
+        self._pending: Dict[str, Dict[str, Any]] = {}
+
+    def suggest(self, trial_id: str):
+        if self.remaining <= 0:
+            return None
+        self.remaining -= 1
+        if len(self._obs) < self.n_initial:
+            cfg = {k: d.sample(self.rng) for k, d in self.space.items()}
+        else:
+            cfg = self._tpe_suggest()
+        self._pending[trial_id] = cfg
+        out = dict(cfg)
+        for k, v in self._passthrough.items():
+            out[k] = v.sample(self.rng) if isinstance(v, Domain) else v
+        return out
+
+    def _tpe_suggest(self) -> Dict[str, Any]:
+        import numpy as np
+
+        obs = sorted(self._obs, key=lambda o: o[1], reverse=True)
+        n_good = max(2, int(len(obs) * self.gamma))
+        good, bad = obs[:n_good], obs[n_good:] or obs[-2:]
+        out = {}
+        for k, dom in self.space.items():
+            if isinstance(dom, Categorical):
+                # weighted by category counts in the good set (+1 smooth)
+                counts = {c: 1.0 for c in dom.categories}
+                for cfg, _ in good:
+                    if cfg.get(k) in counts:
+                        counts[cfg[k]] += 1.0
+                cats, w = zip(*counts.items())
+                w = np.asarray(w) / sum(w)
+                out[k] = cats[self.np_rng.choice(len(cats), p=w)]
+                continue
+            log = isinstance(dom, Float) and dom.log
+            xform = (lambda v: float(np.log(v))) if log else float
+            inv = (lambda v: float(np.exp(v))) if log else float
+            gv = np.asarray([xform(cfg[k]) for cfg, _ in good])
+            bv = np.asarray([xform(cfg[k]) for cfg, _ in bad])
+            lo, hi = xform(dom.lower), xform(dom.upper)
+            bw = max((hi - lo) / 10.0, 1e-6)
+
+            def kde(x, pts):
+                d = (x[:, None] - pts[None, :]) / bw
+                return np.exp(-0.5 * d * d).sum(axis=1) / max(len(pts), 1)
+
+            cand = gv[self.np_rng.randint(0, len(gv), self.n_candidates)] \
+                + self.np_rng.randn(self.n_candidates) * bw
+            cand = np.clip(cand, lo, hi)
+            ratio = (kde(cand, gv) + 1e-12) / (kde(cand, bv) + 1e-12)
+            best = inv(cand[int(np.argmax(ratio))])
+            if isinstance(dom, Integer):
+                best = int(round(best))
+                best = min(max(best, dom.lower), dom.upper - 1)
+            out[k] = best
+        return out
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        cfg = self._pending.pop(trial_id, None)
+        if cfg is None or error or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._obs.append((cfg, score))

@@ -154,6 +154,7 @@ class TuneController:
         self.trials: List[Trial] = list(resumed_trials or [])
         self._actors: Dict[str, Any] = {}          # trial_id -> actor handle
         self._inflight: Dict[Any, Trial] = {}      # next_result ref -> trial
+        self._start_refs: set = set()              # refs that are start-acks
         self._searcher_done = False
         self._runner_cls = ray_tpu.remote(_TrialRunnerActor)
         from ray_tpu._private import common as _common
@@ -167,6 +168,9 @@ class TuneController:
             return None
         tid = f"{self._experiment_name}_{len(self.trials):05d}"
         cfg = self._searcher.suggest(tid)
+        if cfg is Searcher.DEFER:
+            # searcher (e.g. ConcurrencyLimiter) will have more later
+            return None
         if cfg is None:
             self._searcher_done = True
             return None
@@ -191,9 +195,12 @@ class TuneController:
                                  trial.iteration)
         trial.status = RUNNING
         self._actors[trial.trial_id] = actor
-        # chain: once start acks, poll for the first result
-        ray_tpu.get(ref)
-        self._poll(trial)
+        # non-blocking: the start-ack joins the inflight set so the
+        # controller keeps consuming results while this actor waits for
+        # resources (a blocking get here deadlocks once trials > CPUs:
+        # nothing can finish/tear down to free the CPU being waited for)
+        self._inflight[ref] = trial
+        self._start_refs.add(ref)
 
     def _poll(self, trial: Trial):
         actor = self._actors[trial.trial_id]
@@ -213,8 +220,11 @@ class TuneController:
                 ray_tpu.kill(actor)
             except Exception:
                 pass
-        self._inflight = {r: t for r, t in self._inflight.items()
-                          if t.trial_id != trial.trial_id}
+        dropped = [r for r, t in self._inflight.items()
+                   if t.trial_id == trial.trial_id]
+        for r in dropped:
+            self._inflight.pop(r, None)
+            self._start_refs.discard(r)
 
     # -- result handling ---------------------------------------------------
 
@@ -329,9 +339,23 @@ class TuneController:
             except (ActorDiedError, WorkerCrashedError) as e:
                 self._handle_failure(nxt, e)
 
+    def _drain_scheduler_stops(self):
+        """Cull trials the scheduler condemned outside their own report
+        (HyperBand successive-halving losers waiting as PENDING)."""
+        for tid in self._scheduler.trials_to_stop():
+            t = next((x for x in self.trials if x.trial_id == tid), None)
+            if t is None or t.status in (TERMINATED, ERROR):
+                continue
+            t.status = TERMINATED
+            self._teardown_actor(t)
+            self._searcher.on_trial_complete(t.trial_id, t.last_result)
+            for cb in self._callbacks:
+                cb.on_trial_complete(t)
+
     def step(self) -> bool:
         """One controller iteration; returns False when the experiment is
         done (reference: tune_controller.py:666)."""
+        self._drain_scheduler_stops()
         self._fill()
         if not self._inflight:
             live = any(t.status in (PENDING, RUNNING) for t in self.trials)
@@ -345,6 +369,17 @@ class TuneController:
         ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=5.0)
         for ref in ready:
             trial = self._inflight.pop(ref)
+            if ref in self._start_refs:
+                self._start_refs.discard(ref)
+                try:
+                    ray_tpu.get(ref)
+                except (ActorDiedError, WorkerCrashedError,
+                        ray_tpu.TaskError) as e:
+                    self._handle_failure(trial, e)
+                    continue
+                if trial.status == RUNNING:
+                    self._poll(trial)
+                continue
             try:
                 kind, metrics, ckpt = ray_tpu.get(ref)
             except (ActorDiedError, WorkerCrashedError,

@@ -1,0 +1,144 @@
+"""Tests for the extended tune surface: HyperBand, PB2, TPE,
+ConcurrencyLimiter, Repeater (reference: tune/tests/test_trial_scheduler.py,
+test_searchers.py)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu import train, tune
+from ray_tpu.train import RunConfig
+from ray_tpu.tune import (ConcurrencyLimiter, HyperBandScheduler, PB2,
+                          Repeater, TPESearch, TuneConfig, Tuner)
+from ray_tpu.tune.search import DEFER, BasicVariantGenerator
+
+
+def _objective(config):
+    for i in range(1, 10):
+        train.report({"score": config["x"] * i, "training_iteration": i})
+
+
+# ---------------------------------------------------------------------------
+# Searchers (no cluster)
+# ---------------------------------------------------------------------------
+
+def test_concurrency_limiter_defers():
+    base = BasicVariantGenerator({"x": tune.uniform(0, 1)}, num_samples=5,
+                                 metric="score")
+    lim = ConcurrencyLimiter(base, max_concurrent=2)
+    c1 = lim.suggest("t1")
+    c2 = lim.suggest("t2")
+    assert isinstance(c1, dict) and isinstance(c2, dict)
+    assert lim.suggest("t3") is DEFER
+    lim.on_trial_complete("t1", {"score": 1.0})
+    assert isinstance(lim.suggest("t3"), dict)
+
+
+def test_repeater_aggregates():
+    seen = {}
+
+    class Rec(BasicVariantGenerator):
+        def on_trial_complete(self, tid, result=None, error=False):
+            seen[tid] = result
+
+    base = Rec({"x": tune.uniform(0, 1)}, num_samples=2, metric="score")
+    rep = Repeater(base, repeat=3, metric="score")
+    ids = []
+    for i in range(6):
+        cfg = rep.suggest(f"t{i}")
+        assert isinstance(cfg, dict)
+        ids.append(f"t{i}")
+    # t0-t2 share config 1; t3-t5 share config 2
+    for i, tid in enumerate(ids[:3]):
+        rep.on_trial_complete(tid, {"score": float(i)})
+    assert seen["t0"]["score"] == pytest.approx(1.0)  # mean(0,1,2)
+
+
+def test_tpe_improves_over_random():
+    """TPE on a smooth 1-d objective: late suggestions should cluster
+    near the optimum more than the initial random ones."""
+    tpe = TPESearch({"x": tune.uniform(-5, 5)}, metric="score", mode="max",
+                    n_initial=8, num_samples=40, seed=0)
+    xs = []
+    for i in range(40):
+        cfg = tpe.suggest(f"t{i}")
+        if cfg is None:
+            break
+        x = cfg["x"]
+        xs.append(x)
+        tpe.on_trial_complete(f"t{i}", {"score": -(x - 2.0) ** 2})
+    early = np.mean([abs(x - 2.0) for x in xs[:8]])
+    late = np.mean([abs(x - 2.0) for x in xs[-8:]])
+    assert late < early
+
+
+def test_tpe_categorical_and_int():
+    tpe = TPESearch({"c": tune.choice(["a", "b"]),
+                     "n": tune.randint(0, 10)},
+                    metric="score", mode="max", n_initial=4,
+                    num_samples=20, seed=1)
+    for i in range(20):
+        cfg = tpe.suggest(f"t{i}")
+        assert cfg["c"] in ("a", "b")
+        assert 0 <= cfg["n"] < 10
+        score = (1.0 if cfg["c"] == "b" else 0.0) + cfg["n"] * 0.1
+        tpe.on_trial_complete(f"t{i}", {"score": score})
+    # the good region (c=b, large n) should dominate late suggestions
+    lates = [tpe._obs[i][0] for i in range(-6, 0)]
+    assert sum(1 for c in lates if c["c"] == "b") >= 4
+
+
+# ---------------------------------------------------------------------------
+# Schedulers (cluster)
+# ---------------------------------------------------------------------------
+
+def test_hyperband_e2e(ray_cluster, tmp_path):
+    sched = HyperBandScheduler(metric="score", mode="max", max_t=9, eta=3)
+    tuner = Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=sched),
+        run_config=RunConfig(name="hb", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 6
+    best = grid.get_best_result()
+    assert best.metrics["config"]["x"] == 6.0
+    # successive halving must have culled some trials before iteration 9
+    iters = [r.metrics.get("training_iteration", 0) for r in grid]
+    assert min(iters) < 9
+
+
+def test_pb2_explore_uses_bounds():
+    pb2 = PB2(metric="score", mode="max", perturbation_interval=2,
+              hyperparam_bounds={"lr": (0.001, 0.1)}, seed=0)
+    cfg = pb2._explore({"lr": 0.05})
+    assert 0.001 <= cfg["lr"] <= 0.1
+    # feed observations, then explore must still respect bounds
+    for i in range(8):
+        pb2._gp_data.append(([0.001 + 0.01 * i], float(i)))
+    cfg = pb2._explore({"lr": 0.05})
+    assert 0.001 <= cfg["lr"] <= 0.1
+
+
+def test_pb2_e2e(ray_cluster, tmp_path):
+    def trainable(config):
+        import ray_tpu.tune as t
+
+        v = 0.0
+        for i in range(1, 13):
+            v += config["lr"]
+            train.report({"score": v, "training_iteration": i})
+
+    sched = PB2(metric="score", mode="max", perturbation_interval=3,
+                hyperparam_bounds={"lr": (0.01, 1.0)}, seed=0)
+    tuner = Tuner(
+        trainable,
+        param_space={"lr": tune.uniform(0.01, 1.0)},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=sched,
+                               num_samples=4),
+        run_config=RunConfig(name="pb2", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+    assert all(np.isfinite(r.metrics["score"]) for r in grid
+               if "score" in r.metrics)
