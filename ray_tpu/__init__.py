@@ -60,6 +60,18 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
                                "ignore_reinit_error=True to allow")
         if address is None and os.environ.get("RAY_TPU_ADDRESS"):
             address = os.environ["RAY_TPU_ADDRESS"]
+        if address and address.startswith("ray-tpu://"):
+            # remote-driver client mode (reference: ray.init("ray://...")
+            # through python/ray/util/client/)
+            from ._private import core as core_mod
+            from .util.client import ClientCore
+
+            cc = ClientCore(address)
+            _core = cc
+            core_mod._current_core = cc
+            atexit.register(shutdown)
+            return {"control_address": "%s:%s" % cc._server_control_addr,
+                    "job_id": cc.job_id, "client": True}
         if address == "auto":
             # connect to the CLI-started cluster (reference: address="auto"
             # reading /tmp/ray/ray_current_cluster)
@@ -125,6 +137,10 @@ def shutdown() -> None:
         cluster, _owned_cluster = _owned_cluster, None
     if core is not None:
         core.shutdown()
+        from ._private import core as core_mod
+
+        if core_mod._current_core is core:
+            core_mod._current_core = None
     if cluster is not None:
         cluster.shutdown()
 

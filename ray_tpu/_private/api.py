@@ -68,6 +68,7 @@ class RemoteFunction:
             max_retries=o.get("max_retries", 3),
             strategy=strategy, pg=pg, bundle_index=bidx,
             name=o.get("name", ""),
+            runtime_env=o.get("runtime_env"),
         )
         return refs[0] if o.get("num_returns", 1) == 1 else refs
 

@@ -311,7 +311,17 @@ class ControlServer:
                 if pg is None or pg.state != ALIVE:
                     return None
                 idx = strategy.get("bundle_index", -1)
-                indices = [idx] if idx >= 0 else list(pg.assignments)
+                if idx >= 0:
+                    indices = [idx]
+                else:
+                    # any-bundle (-1): rotate across assignment nodes so
+                    # repeated leases don't pin to one node's bundle while
+                    # the group's other bundles idle (per-bundle occupancy
+                    # lives node-side; round-robin is the control's lever)
+                    indices = list(pg.assignments)
+                    pg.rr_cursor = getattr(pg, "rr_cursor", 0) + 1
+                    k = pg.rr_cursor % max(1, len(indices))
+                    indices = indices[k:] + indices[:k]
                 for i in indices:
                     nid = pg.assignments.get(i)
                     n = self.nodes.get(nid)

@@ -779,7 +779,11 @@ class CoreWorker:
 
     def submit_task(self, fn, args, kwargs, *, num_returns=1, resources=None,
                     max_retries=3, strategy=None, pg=None, bundle_index=-1,
-                    name="") -> List[ObjectRef]:
+                    name="", runtime_env=None) -> List[ObjectRef]:
+        if runtime_env:
+            from . import runtime_env as rtenv
+
+            runtime_env = rtenv.prepare(runtime_env, self.control)
         fid, fname = self.register_function(fn)
         spec = TaskSpec(
             task_id=common.task_id(),
@@ -794,6 +798,7 @@ class CoreWorker:
             placement_bundle_index=bundle_index,
             owner_id=self.worker_id,
             owner_addr=self.addr,
+            runtime_env=runtime_env,
         )
         return self._submit_spec(spec, retries_left=max_retries)
 
@@ -1059,6 +1064,10 @@ class CoreWorker:
                      runtime_env=None) -> str:
         aid = common.actor_id()
         common._ensure_picklable_by_value(cls)
+        if runtime_env:
+            from . import runtime_env as rtenv
+
+            runtime_env = rtenv.prepare(runtime_env, self.control)
         spec = {
             "class_blob": cloudpickle.dumps(cls),
             "args_blob": self.serialize_args(args, kwargs),

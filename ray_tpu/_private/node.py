@@ -299,19 +299,56 @@ class Raylet:
         if bundle is not None:
             bundle = (bundle[0], bundle[1])
             with self.lock:
-                b = self.bundles.get(bundle)
-                if b is None or b["state"] != "committed":
-                    d.reject(f"bundle {bundle} not committed on this node")
-                    return
+                if bundle[1] == -1:
+                    # "any bundle of this group" (reference:
+                    # placement_group_bundle_index=-1): accept if the pg
+                    # has any committed bundle here; resolved at grant
+                    if not self._pg_bundles_locked(bundle[0]):
+                        d.reject(f"no committed bundle of {bundle[0]} "
+                                 f"on this node")
+                        return
+                else:
+                    b = self.bundles.get(bundle)
+                    if b is None or b["state"] != "committed":
+                        d.reject(f"bundle {bundle} not committed on this node")
+                        return
         with self.lock:
             self.pending_leases.append(
                 PendingLease(demand, d, p.get("client_id", ""), bundle))
         self._try_grant()
 
+    def _pg_bundles_locked(self, pg_id: str):
+        return [k for k, b in self.bundles.items()
+                if k[0] == pg_id and b["state"] == "committed"]
+
+    def _bundle_free_fits_locked(self, key, demand) -> bool:
+        b = self.bundles.get(key)
+        if b is None or b["state"] != "committed":
+            return False
+        free = dict(b["resources"])
+        subtract(free, b.setdefault("used", {}))
+        return fits(free, demand)
+
+    def _resolve_bundle_locked(self, bundle, demand):
+        """Concrete committed bundle key for a lease (index -1 = any bundle
+        of the pg with room)."""
+        if bundle[1] != -1:
+            return bundle if self._bundle_free_fits_locked(bundle, demand) \
+                else None
+        for key in self._pg_bundles_locked(bundle[0]):
+            if self._bundle_free_fits_locked(key, demand):
+                return key
+        return None
+
     def _lease_fits(self, pl: PendingLease) -> bool:
         """Bundle leases draw from the bundle's reservation, not general
         availability (the reservation was subtracted at PREPARE)."""
         if pl.bundle is not None:
+            if pl.bundle[1] == -1:
+                if not self._pg_bundles_locked(pl.bundle[0]):
+                    return True  # grant path rejects; don't wedge the queue
+                return self._resolve_bundle_locked(pl.bundle,
+                                                   pl.demand) is not None
             b = self.bundles.get(pl.bundle)
             if b is None or b["state"] != "committed":
                 return True  # grant path will reject; don't wedge the queue
@@ -376,13 +413,14 @@ class Raylet:
                     break
                 self.pending_leases.popleft()
                 if pl.bundle is not None:
-                    b = self.bundles.get(pl.bundle)
-                    if b is None or b["state"] != "committed":
+                    key = self._resolve_bundle_locked(pl.bundle, pl.demand)
+                    b = self.bundles.get(key) if key else None
+                    if b is None:
                         pl.deferred.reject(f"bundle {pl.bundle} no longer committed")
                         self.idle.append(w)
                         continue
                     add(b.setdefault("used", {}), pl.demand)
-                    w.bundle_key = pl.bundle
+                    w.bundle_key = key
                 else:
                     subtract(self.available, pl.demand)
                 w.state = "leased"

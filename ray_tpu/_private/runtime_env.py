@@ -1,0 +1,242 @@
+"""Runtime environments: per-task/actor/job execution contexts.
+
+Analog of the reference's runtime_env machinery (reference:
+python/ray/_private/runtime_env/ — working_dir.py, py_modules.py,
+packaging.py URI cache, plugin.py; agent materializes envs per node).
+TPU-native simplifications: packages travel through the control-plane KV
+(content-addressed zips) instead of a dedicated agent protocol, and
+materialization happens lazily in the worker with a node-shared
+content-addressed cache.
+
+Supported fields:
+  env_vars     {str: str}   applied around execution
+  working_dir  path/zip     shipped, extracted, becomes cwd + sys.path[0]
+  py_modules   [paths]      shipped, extracted, prepended to sys.path
+  pip / conda  rejected unless RAY_TPU_ALLOW_PKG_INSTALL=1 (the build
+               forbids network installs; the hook exists for parity)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import logging
+import os
+import sys
+import threading
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+KV_NS = "runtime_env_packages"
+CACHE_ROOT = os.environ.get("RAY_TPU_RTENV_CACHE",
+                            "/dev/shm/ray_tpu/rtenv-cache")
+MAX_PACKAGE_BYTES = int(os.environ.get("RAY_TPU_RTENV_MAX_BYTES",
+                                       str(256 * 1024 * 1024)))
+_EXCLUDE_DIRS = {".git", "__pycache__", ".venv", "node_modules"}
+
+_lock = threading.Lock()
+_materialized: Dict[str, str] = {}  # pkg hash -> extracted dir
+
+
+def validate(env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    env = dict(env or {})
+    unknown = set(env) - {"env_vars", "working_dir", "py_modules", "pip",
+                          "conda", "config"}
+    if unknown:
+        raise ValueError(f"unsupported runtime_env fields: {sorted(unknown)}")
+    if env.get("pip") or env.get("conda"):
+        if os.environ.get("RAY_TPU_ALLOW_PKG_INSTALL") != "1":
+            raise ValueError(
+                "runtime_env pip/conda installs are disabled in this "
+                "deployment (set RAY_TPU_ALLOW_PKG_INSTALL=1 to enable)")
+    ev = env.get("env_vars") or {}
+    if not all(isinstance(k, str) and isinstance(v, str)
+               for k, v in ev.items()):
+        raise ValueError("env_vars must be {str: str}")
+    return env
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    base = os.path.abspath(path)
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(base):
+            dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+            for f in files:
+                full = os.path.join(root, f)
+                rel = os.path.relpath(full, base)
+                try:
+                    zf.write(full, rel)
+                except OSError:
+                    pass
+        if not zf.namelist():
+            zf.writestr(".empty", "")
+    data = buf.getvalue()
+    if len(data) > MAX_PACKAGE_BYTES:
+        raise ValueError(f"runtime_env package {path!r} too large "
+                         f"({len(data)} > {MAX_PACKAGE_BYTES} bytes)")
+    return data
+
+
+_upload_cache: Dict[Tuple[str, float], str] = {}  # (abspath, max mtime) -> uri
+
+
+def _tree_mtime(path: str) -> float:
+    latest = os.path.getmtime(path)
+    for root, dirs, files in os.walk(path):
+        dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+        for f in files:
+            try:
+                m = os.path.getmtime(os.path.join(root, f))
+            except OSError:
+                continue
+            if m > latest:
+                latest = m
+    return latest
+
+
+def _upload_package(control, path: str) -> str:
+    """Zip a directory (or take a .zip file) and store it content-addressed
+    in the control KV; returns 'pkg:<sha256>'.  Repeat submissions of an
+    unchanged tree skip the re-zip via an (abspath, mtime) memo (the
+    reference uploads once per job; packaging.py URI cache)."""
+    if path.endswith(".zip") and os.path.isfile(path):
+        with open(path, "rb") as f:
+            data = f.read()
+    elif os.path.isdir(path):
+        key = (os.path.abspath(path), _tree_mtime(path))
+        cached = _upload_cache.get(key)
+        if cached is not None:
+            return cached
+        data = _zip_dir(path)
+    else:
+        raise ValueError(f"runtime_env path {path!r} is neither a "
+                         f"directory nor a .zip file")
+    digest = hashlib.sha256(data).hexdigest()
+    uri = f"pkg:{digest}"
+    if not control.call("kv_exists", {"ns": KV_NS, "key": uri},
+                        timeout=30.0):
+        control.call("kv_put", {"ns": KV_NS, "key": uri, "val": data},
+                     timeout=120.0)
+    if os.path.isdir(path):
+        _upload_cache[(os.path.abspath(path), _tree_mtime(path))] = uri
+    return uri
+
+
+def prepare(env: Optional[Dict[str, Any]], control) -> Optional[Dict[str, Any]]:
+    """Driver-side: validate + upload local dirs, returning a wire-safe
+    env whose paths are pkg: URIs (reference: packaging.py upload path)."""
+    if not env:
+        return None
+    env = validate(env)
+    out = dict(env)
+    wd = env.get("working_dir")
+    if wd and not str(wd).startswith("pkg:"):
+        out["working_dir"] = _upload_package(control, wd)
+    mods = env.get("py_modules")
+    if mods:
+        out["py_modules"] = [m if str(m).startswith("pkg:")
+                             else _upload_package(control, m) for m in mods]
+    return out
+
+
+def _fetch_package(control, uri: str) -> str:
+    """Worker-side: extract pkg:<hash> into the shared cache; idempotent."""
+    with _lock:
+        got = _materialized.get(uri)
+        if got:
+            return got
+    dest = os.path.join(CACHE_ROOT, uri.replace(":", "-"))
+    marker = os.path.join(dest, ".complete")
+    if not os.path.exists(marker):
+        data = control.call("kv_get", {"ns": KV_NS, "key": uri},
+                            timeout=120.0)
+        if data is None:
+            raise RuntimeError(f"runtime_env package {uri} missing from KV")
+        tmp = dest + f".tmp{os.getpid()}"
+        os.makedirs(tmp, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(data)) as zf:
+            zf.extractall(tmp)
+        open(os.path.join(tmp, ".complete"), "w").close()
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            # another worker won the race
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+    with _lock:
+        _materialized[uri] = dest
+    return dest
+
+
+class Context:
+    """Materialized environment, applied around execution."""
+
+    def __init__(self, env_vars: Dict[str, str], sys_paths: List[str],
+                 cwd: Optional[str]):
+        self.env_vars = env_vars
+        self.sys_paths = sys_paths
+        self.cwd = cwd
+        self._saved_env: Dict[str, Optional[str]] = {}
+        self._saved_cwd: Optional[str] = None
+        self._inserted_paths: List[str] = []
+
+    def __enter__(self):
+        for k, v in self.env_vars.items():
+            self._saved_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        for p in reversed(self.sys_paths):
+            if p not in sys.path:
+                sys.path.insert(0, p)
+                self._inserted_paths.append(p)
+        if self.cwd:
+            self._saved_cwd = os.getcwd()
+            os.chdir(self.cwd)
+        return self
+
+    def __exit__(self, *exc):
+        for k, old in self._saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        self._saved_env.clear()
+        # drop our sys.path entries so a reused worker's later tasks don't
+        # import this env's modules by accident
+        for p in self._inserted_paths:
+            try:
+                sys.path.remove(p)
+            except ValueError:
+                pass
+        self._inserted_paths.clear()
+        if self._saved_cwd:
+            try:
+                os.chdir(self._saved_cwd)
+            except OSError:
+                pass
+            self._saved_cwd = None
+        return False
+
+    def apply_permanent(self):
+        """For actor processes: the env applies for the process lifetime."""
+        self.__enter__()
+
+
+def materialize(env: Optional[Dict[str, Any]], control) -> Context:
+    """Worker-side: resolve pkg URIs and build an applicable Context
+    (reference: the runtime_env agent's CreateRuntimeEnv)."""
+    env = env or {}
+    sys_paths: List[str] = []
+    cwd = None
+    wd = env.get("working_dir")
+    if wd:
+        cwd = _fetch_package(control, wd) if str(wd).startswith("pkg:") \
+            else str(wd)
+        sys_paths.append(cwd)
+    for m in env.get("py_modules") or []:
+        p = _fetch_package(control, m) if str(m).startswith("pkg:") else str(m)
+        sys_paths.append(p)
+    return Context(dict(env.get("env_vars") or {}), sys_paths, cwd)

@@ -93,14 +93,20 @@ class WorkerMain:
             if blob is None:
                 raise RuntimeError("actor spec missing in control plane")
             spec = cloudpickle.loads(blob)
+            if spec.get("runtime_env"):
+                from . import runtime_env as rtenv
+
+                # env applies BEFORE deserializing the class/args (their
+                # unpickling may import py_modules/working_dir code) and
+                # lasts for the actor process lifetime
+                rtenv.materialize(spec["runtime_env"],
+                                  self.core.control).apply_permanent()
             cls = cloudpickle.loads(spec["class_blob"])
             args, kwargs = serialization.loads_inline(spec["args_blob"])
             args = [self.core.get(a) if isinstance(a, ObjectRef) else a
                     for a in args]
             kwargs = {k: self.core.get(v) if isinstance(v, ObjectRef) else v
                       for k, v in kwargs.items()}
-            env = (spec.get("runtime_env") or {}).get("env_vars") or {}
-            os.environ.update(env)
             self.actor_instance = cls(*args, **kwargs)
             # async actors (any coroutine method) run ALL their methods on
             # the event-loop thread — the reference's async-actor model:
@@ -280,21 +286,41 @@ class WorkerMain:
                     return _ASYNC_INFLIGHT
             else:
                 fn = self.core.get_function(spec.function_id)
-            args, kwargs = self.core.resolve_args(spec)
-            out = fn(*args, **kwargs)
+            ctx = None
+            if kind != "actor" and spec.runtime_env:
+                from . import runtime_env as rtenv
+
+                # enter the env BEFORE deserializing args: py_modules /
+                # working_dir code may be needed at unpickle time
+                ctx = rtenv.materialize(spec.runtime_env, self.core.control)
+                ctx.__enter__()
+            try:
+                args, kwargs = self.core.resolve_args(spec)
+                out = fn(*args, **kwargs)
+            except BaseException:
+                if ctx is not None:
+                    ctx.__exit__(None, None, None)
+                    ctx = None
+                raise
             if inspect.iscoroutine(out):
-                # async function task: run to completion on the loop
-                async def _finish(coro=out, spec=spec, t0=t0, d=d):
+                # async function task: run to completion on the loop; the
+                # env context stays open until the coroutine finishes
+                async def _finish(coro=out, spec=spec, t0=t0, d=d, ctx=ctx):
                     try:
                         value = await coro
                         reply = self._store_reply(spec, value, t0)
                     except BaseException as e:
                         reply = self._error_reply(e, spec)
+                    finally:
+                        if ctx is not None:
+                            ctx.__exit__(None, None, None)
                     d.resolve(reply)
 
                 asyncio.run_coroutine_threadsafe(_finish(),
                                                  self._get_aio_loop())
                 return _ASYNC_INFLIGHT
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
             return self._store_reply(spec, out, t0)
         except BaseException as e:
             return self._error_reply(e, spec)

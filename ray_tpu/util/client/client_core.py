@@ -1,0 +1,260 @@
+"""ClientCore: a CoreWorker stand-in that proxies every operation to a
+ClientServer over one TCP connection (reference: python/ray/util/client/
+worker.py — the client-side Worker speaking the ray_client protocol).
+
+Duck-types the subset of CoreWorker the API layer and libraries touch:
+submit_task / create_actor / submit_actor_task / get / put / wait /
+kill_actor / get_actor_by_name / as_future / the serialization ref hooks,
+plus a forwarding `control` handle so control-plane consumers (placement
+groups, collectives, state API, internal KV) work transparently.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu._private import common, core as core_mod, serialization
+from ray_tpu._private.common import GetTimeoutError, RayTpuError
+from ray_tpu._private.core import ObjectRef
+from ray_tpu._private.protocol import Client, ConnectionLost
+
+CLIENT_SCHEME = "ray-tpu://"
+
+
+def parse_client_address(address: str) -> Tuple[str, int]:
+    hostport = address[len(CLIENT_SCHEME):]
+    host, port = hostport.rsplit(":", 1)
+    return host, int(port)
+
+
+def _to_wire_ref(ref: ObjectRef):
+    return (ref.id, ref.owner_addr, ref.owner_id)
+
+
+class _ControlProxy:
+    """Forwarding stand-in for CoreWorker.control (a protocol Client)."""
+
+    def __init__(self, cc: "ClientCore"):
+        self._cc = cc
+
+    @property
+    def addr(self):
+        return self._cc._server_control_addr
+
+    def call(self, method: str, payload: Any = None,
+             timeout: Optional[float] = None):
+        return self._cc._call("c_control", {"method": method,
+                                            "payload": payload,
+                                            "timeout": timeout},
+                              timeout=(timeout or 60.0) + 30.0)
+
+    def call_async(self, method: str, payload: Any = None):
+        return self._cc._client.call_async(
+            "c_control", {"method": method, "payload": payload,
+                          "timeout": 60.0})
+
+    def notify(self, method: str, payload: Any = None):
+        try:
+            self._cc._client.notify(
+                "c_control_notify", {"method": method, "payload": payload})
+        except OSError:
+            pass
+
+    @property
+    def closed(self):
+        return self._cc._shutdown
+
+
+class ClientCore:
+    mode = "client"
+
+    def __init__(self, address: str, connect_timeout: float = 30.0):
+        host, port = parse_client_address(address)
+        self.worker_id = f"client-{uuid.uuid4().hex[:16]}"
+        self.addr = None
+        self.node_id = None  # client drivers live outside every node
+        self._shutdown = False
+        self.lock = threading.RLock()
+        self._client = Client((host, port), name="ray-tpu-client",
+                              connect_timeout=connect_timeout,
+                              on_disconnect=self._on_disconnect)
+        hello = self._client.call("c_hello", {"client_id": self.worker_id},
+                                  timeout=connect_timeout)
+        self.job_id = hello["job_id"]
+        self._server_control_addr = tuple(hello["control_addr"])
+        self.control = _ControlProxy(self)
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _on_disconnect(self):
+        self._shutdown = True
+
+    def _call(self, method: str, payload: Dict[str, Any],
+              timeout: Optional[float] = None):
+        if self._shutdown:
+            raise RayTpuError("client connection closed")
+        try:
+            r = self._client.call(method, payload, timeout=timeout)
+        except ConnectionLost as e:
+            self._shutdown = True
+            raise RayTpuError(f"client connection lost: {e}") from e
+        if isinstance(r, dict) and r.get("__client_error__"):
+            raise cloudpickle.loads(r["error_blob"])
+        return r
+
+    def _mk_ref(self, wire) -> ObjectRef:
+        return ObjectRef(wire[0], wire[1], wire[2])
+
+    # -- serialization hooks (duck-typed from CoreWorker) ------------------
+
+    def _on_borrowed_ref(self, ref: ObjectRef):
+        pass  # the server pins on our behalf
+
+    def _pin_for_serialization(self, ref: ObjectRef):
+        pass
+
+    def _remove_local_ref(self, ref: ObjectRef):
+        if self._shutdown:
+            return
+        try:
+            self._client.notify("c_release", {"ids": [ref.id]})
+        except OSError:
+            pass
+
+    # -- core API ----------------------------------------------------------
+
+    def put(self, value: Any) -> ObjectRef:
+        blob = serialization.dumps_inline(value)
+        wire = self._call("c_put", {"blob": blob}, timeout=300.0)
+        return self._mk_ref(wire)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        for r in ref_list:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(f"get() expects ObjectRefs, got {type(r)}")
+        r = self._call("c_get", {"ids": [x.id for x in ref_list],
+                                 "timeout": timeout},
+                       timeout=None if timeout is None else timeout + 30.0)
+        if r.get("timeout"):
+            raise GetTimeoutError(r.get("error") or "get() timed out")
+        values = serialization.loads_inline(r["blob"])
+        return values[0] if single else values
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None):
+        if num_returns > len(refs):
+            raise ValueError("num_returns > len(refs)")
+        r = self._call("c_wait", {"ids": [x.id for x in refs],
+                                  "num_returns": num_returns,
+                                  "timeout": timeout},
+                       timeout=None if timeout is None else timeout + 30.0)
+        ready_ids = set(r["ready"])
+        ready = [x for x in refs if x.id in ready_ids]
+        not_ready = [x for x in refs if x.id not in ready_ids]
+        return ready, not_ready
+
+    def as_future(self, ref: ObjectRef):
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+
+        def run():
+            try:
+                fut.set_result(self.get(ref))
+            except BaseException as e:
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def submit_task(self, fn, args, kwargs, *, num_returns=1, resources=None,
+                    max_retries=3, strategy=None, pg=None, bundle_index=-1,
+                    name="", runtime_env=None) -> List[ObjectRef]:
+        common._ensure_picklable_by_value(fn)
+        if runtime_env:
+            # package local dirs on the CLIENT machine; the server only
+            # ever sees content-addressed pkg: URIs
+            from ray_tpu._private import runtime_env as rtenv
+
+            runtime_env = rtenv.prepare(runtime_env, self.control)
+        payload = {
+            "fn_blob": cloudpickle.dumps(fn),
+            "args_blob": serialization.dumps_inline((args, kwargs)),
+            "num_returns": num_returns,
+            "resources": resources,
+            "max_retries": max_retries,
+            "strategy": strategy,
+            "pg": pg,
+            "bundle_index": bundle_index,
+            "name": name,
+            "runtime_env": runtime_env,
+        }
+        wires = self._call("c_submit_task", payload, timeout=120.0)
+        return [self._mk_ref(w) for w in wires]
+
+    def create_actor(self, cls, args, kwargs, *, resources=None, name=None,
+                     max_restarts=0, max_task_retries=0, max_concurrency=1,
+                     pg=None, bundle_index=-1, detached=False,
+                     runtime_env=None) -> str:
+        common._ensure_picklable_by_value(cls)
+        if runtime_env:
+            from ray_tpu._private import runtime_env as rtenv
+
+            runtime_env = rtenv.prepare(runtime_env, self.control)
+        payload = {
+            "cls_blob": cloudpickle.dumps(cls),
+            "args_blob": serialization.dumps_inline((args, kwargs)),
+            "resources": resources,
+            "name": name,
+            "max_restarts": max_restarts,
+            "max_task_retries": max_task_retries,
+            "max_concurrency": max_concurrency,
+            "pg": pg,
+            "bundle_index": bundle_index,
+            "detached": detached,
+            "runtime_env": runtime_env,
+        }
+        return self._call("c_create_actor", payload, timeout=120.0)
+
+    def submit_actor_task(self, actor_id: str, method_name: str, args,
+                          kwargs, num_returns: int = 1) -> List[ObjectRef]:
+        payload = {
+            "actor_id": actor_id,
+            "method": method_name,
+            "args_blob": serialization.dumps_inline((args, kwargs)),
+            "num_returns": num_returns,
+        }
+        wires = self._call("c_submit_actor_task", payload, timeout=120.0)
+        return [self._mk_ref(w) for w in wires]
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True):
+        self._call("c_kill_actor", {"actor_id": actor_id,
+                                    "no_restart": no_restart}, timeout=60.0)
+
+    def get_actor_by_name(self, name: str):
+        return self._call("c_get_actor_by_name", {"name": name},
+                          timeout=60.0)
+
+    def available_resources(self) -> Dict[str, float]:
+        r = self.control.call("cluster_resources", {}, timeout=30.0)
+        return r["available"]
+
+    def cluster_resources(self) -> Dict[str, float]:
+        r = self.control.call("cluster_resources", {}, timeout=30.0)
+        return r["total"]
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        try:
+            self._client.notify("c_bye", {})
+        except OSError:
+            pass
+        self._client.close()

@@ -1,0 +1,124 @@
+"""Remote-driver (client mode) tests — reference model:
+python/ray/tests/test_client.py over util/client."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture()
+def client_cluster():
+    """A cluster + ClientServer; yields the ray-tpu:// address.
+
+    Saves and restores the process-global core so these tests compose
+    with the session-scoped ray_cluster fixture (a real remote driver is
+    its own process; in-test we swap the global instead)."""
+    from ray_tpu._private import core as core_mod
+    from ray_tpu._private.bootstrap import Cluster
+    from ray_tpu.util.client import ClientServer
+
+    prev_core = ray_tpu._core
+    prev_current = core_mod._current_core
+    ray_tpu._core = None
+
+    c = Cluster()
+    c.start_control()
+    c.add_node(resources={"CPU": 4})
+    srv = ClientServer(c.control_addr, port=0)
+    srv.start()
+    yield f"ray-tpu://{srv.addr[0]}:{srv.addr[1]}"
+    cc = ray_tpu._core
+    if cc is not None and cc is not prev_core:
+        try:
+            cc.shutdown()
+        except Exception:
+            pass
+    srv.stop()
+    c.shutdown()
+    ray_tpu._core = prev_core
+    core_mod._current_core = prev_current
+
+
+def test_client_tasks_and_objects(client_cluster):
+    info = ray_tpu.init(client_cluster)
+    assert info.get("client") is True
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(2, 3), timeout=60) == 5
+
+    # put/get roundtrip incl. numpy
+    ref = ray_tpu.put(np.arange(1000))
+    out = ray_tpu.get(ref, timeout=60)
+    assert out.sum() == np.arange(1000).sum()
+
+    # refs as task args cross the wire as markers
+    ref2 = add.remote(ref, ref)
+    assert ray_tpu.get(ref2, timeout=60).sum() == 2 * out.sum()
+
+    # wait
+    refs = [add.remote(i, i) for i in range(4)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=2, timeout=60)
+    assert len(ready) == 2 and len(not_ready) == 2
+
+
+def test_client_actors(client_cluster):
+    ray_tpu.init(client_cluster)
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.v = start
+
+        def inc(self, n=1):
+            self.v += n
+            return self.v
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 11
+    assert ray_tpu.get(c.inc.remote(5), timeout=60) == 16
+
+    named = Counter.options(name="client-named").remote(0)
+    h = ray_tpu.get_actor("client-named")
+    assert ray_tpu.get(h.inc.remote(), timeout=60) == 1
+
+    ray_tpu.kill(c)
+    import time
+
+    time.sleep(0.5)
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(c.inc.remote(), timeout=30)
+
+
+def test_client_task_errors_propagate(client_cluster):
+    ray_tpu.init(client_cluster)
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("client-boom")
+
+    with pytest.raises(ray_tpu.TaskError, match="client-boom"):
+        ray_tpu.get(boom.remote(), timeout=60)
+
+
+def test_client_control_plane_passthrough(client_cluster):
+    """Placement groups + cluster resources go through the control proxy."""
+    ray_tpu.init(client_cluster)
+    assert ray_tpu.cluster_resources().get("CPU") == 4.0
+
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}])
+    assert pg.ready(timeout=60)
+
+    @ray_tpu.remote
+    def where():
+        return 1
+
+    assert ray_tpu.get(
+        where.options(placement_group=pg).remote(), timeout=60) == 1
+    remove_placement_group(pg)

@@ -1,0 +1,97 @@
+"""runtime_env tests (reference: python/ray/tests/test_runtime_env*.py)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+
+
+def test_task_env_vars(ray_cluster):
+    @ray_tpu.remote
+    def read():
+        import os
+
+        return os.environ.get("RTENV_TEST_VAR"), os.environ.get("HOME")
+
+    val, home = ray_tpu.get(
+        read.options(runtime_env={"env_vars": {"RTENV_TEST_VAR": "abc"}})
+        .remote(), timeout=60)
+    assert val == "abc"
+    assert home  # unrelated env untouched
+    # env must not leak into the next task on the same worker
+    val2, _ = ray_tpu.get(read.remote(), timeout=60)
+    assert val2 is None
+
+
+def test_actor_env_vars(ray_cluster):
+    @ray_tpu.remote
+    class E:
+        def read(self):
+            import os
+
+            return os.environ.get("RTENV_ACTOR_VAR")
+
+    a = E.options(runtime_env={"env_vars": {"RTENV_ACTOR_VAR": "xyz"}}) \
+        .remote()
+    assert ray_tpu.get(a.read.remote(), timeout=60) == "xyz"
+
+
+def test_working_dir_ships_files(ray_cluster, tmp_path):
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "the_data.txt").write_text("hello-from-driver")
+    (proj / "helper_mod_rtenv.py").write_text(
+        "def helper():\n    return 'helper-ok'\n")
+
+    @ray_tpu.remote
+    def use_working_dir():
+        import os
+
+        import helper_mod_rtenv  # importable: working_dir on sys.path
+
+        with open("the_data.txt") as f:  # cwd = extracted working_dir
+            data = f.read()
+        return data, helper_mod_rtenv.helper(), os.getcwd()
+
+    data, h, cwd = ray_tpu.get(
+        use_working_dir.options(
+            runtime_env={"working_dir": str(proj)}).remote(), timeout=120)
+    assert data == "hello-from-driver"
+    assert h == "helper-ok"
+    assert "rtenv-cache" in cwd
+
+
+def test_py_modules(ray_cluster, tmp_path):
+    pkg = tmp_path / "mods"
+    pkg.mkdir()
+    (pkg / "shipped_rtenv_mod.py").write_text("VALUE = 41\n")
+
+    @ray_tpu.remote
+    def imp():
+        import shipped_rtenv_mod
+
+        return shipped_rtenv_mod.VALUE + 1
+
+    out = ray_tpu.get(
+        imp.options(runtime_env={"py_modules": [str(pkg)]}).remote(),
+        timeout=120)
+    assert out == 42
+
+
+def test_pip_rejected_without_optin(ray_cluster):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="pip/conda"):
+        f.options(runtime_env={"pip": ["requests"]}).remote()
+
+
+def test_unknown_field_rejected(ray_cluster):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="unsupported"):
+        f.options(runtime_env={"bogus_field": 1}).remote()
