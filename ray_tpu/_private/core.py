@@ -255,6 +255,9 @@ class CoreWorker:
         self.pools: Dict[Any, SchedPool] = {}
         self.functions: Dict[str, Any] = {}           # fid -> callable (exec side)
         self.registered_functions: Set[str] = set()   # fids pushed to control
+        # fn object -> (fid, name); weak keys so task fns can be GC'd
+        self._fn_registration_cache = weakref.WeakKeyDictionary()
+        self._push_handlers: Dict[str, list] = {}
         self.actors: Dict[str, ActorConn] = {}
         self.owner_clients: Dict[Tuple[str, int], Client] = {}
         self.pool_executor = DaemonPool(max_workers=8, name="core")
@@ -279,7 +282,34 @@ class CoreWorker:
         self._reaper = threading.Thread(target=self._lease_reaper_loop,
                                         name="core-lease-reaper", daemon=True)
         self._reaper.start()
+        # single delayed-deletion reaper (a Timer thread per released
+        # object dominates the tiny-task hot path otherwise)
+        self._delete_queue: deque = deque()
+        self._delete_event = threading.Event()
+        self._delete_thread = threading.Thread(
+            target=self._delete_loop, name="core-object-reaper", daemon=True)
+        self._delete_thread.start()
         _current_core = self
+
+    def _delete_loop(self):
+        while not self._shutdown:
+            if not self._delete_queue:
+                self._delete_event.wait(0.5)
+                self._delete_event.clear()
+                continue
+            due, oid = self._delete_queue[0]
+            now = time.monotonic()
+            if due > now:
+                time.sleep(min(due - now, 0.5))
+                continue
+            try:
+                self._delete_queue.popleft()
+            except IndexError:
+                continue
+            try:
+                self._maybe_delete(oid)
+            except Exception:
+                pass
 
     def _lease_reaper_loop(self):
         """Return leases that have sat idle past the TTL so their resources
@@ -577,8 +607,12 @@ class CoreWorker:
         finally:
             self._mark_blocked(False)
         ready_set = {r.id for r in ready}
-        return ([r for r in refs if r.id in ready_set][:num_returns],
-                [r for r in refs if r.id not in ready_set])
+        returned = [r for r in refs if r.id in ready_set][:num_returns]
+        returned_ids = {r.id for r in returned}
+        # ready-but-not-returned refs stay in the second list (reference
+        # semantics): dropping them loses objects for wait-loop consumers
+        return (returned,
+                [r for r in refs if r.id not in returned_ids])
 
     def as_future(self, ref: ObjectRef):
         from concurrent.futures import Future
@@ -627,7 +661,9 @@ class CoreWorker:
                 return
             e.pins -= 1
             if e.pins <= 0:
-                threading.Timer(DELETE_GRACE_S, self._maybe_delete, args=(oid,)).start()
+                self._delete_queue.append(
+                    (time.monotonic() + DELETE_GRACE_S, oid))
+                self._delete_event.set()
 
     def _maybe_delete(self, oid: str):
         with self.lock:
@@ -747,6 +783,15 @@ class CoreWorker:
     # ------------------------------------------------------------------
 
     def register_function(self, fn) -> Tuple[str, str]:
+        # hot path: hashing cloudpickles the function, so memoize per
+        # function object (the reference's function table is likewise
+        # populated once per unique function, not per .remote() call)
+        try:
+            cached = self._fn_registration_cache.get(fn)
+        except TypeError:  # unhashable callables fall through
+            cached = None
+        if cached is not None:
+            return cached
         fid, blob = common.hash_function(fn)
         with self.lock:
             new = fid not in self.registered_functions
@@ -755,7 +800,12 @@ class CoreWorker:
                 self.functions[fid] = fn
         if new:
             self.control.call("register_function", {"function_id": fid, "blob": blob})
-        return fid, getattr(fn, "__qualname__", str(fn))
+        out = (fid, getattr(fn, "__qualname__", str(fn)))
+        try:
+            self._fn_registration_cache[fn] = out
+        except TypeError:
+            pass
+        return out
 
     def get_function(self, fid: str):
         with self.lock:
@@ -1312,16 +1362,26 @@ class CoreWorker:
     # control pushes
     # ------------------------------------------------------------------
 
+    def add_push_handler(self, topic: str, fn) -> None:
+        """Register a callback for a control pubsub topic this process is
+        subscribed to (callers also need control.call("subscribe", ...))."""
+        with self.lock:
+            self._push_handlers.setdefault(topic, []).append(fn)
+
     def _on_control_push(self, topic: str, payload):
         if topic == "pub:actor":
             actor = payload.get("actor", {})
             aid = actor.get("actor_id")
             with self.lock:
                 ac = self.actors.get(aid)
-            if ac is None:
-                return
-            if payload["event"] == "dead":
+            if ac is not None and payload["event"] == "dead":
                 self._fail_actor(ac, actor.get("error") or "actor died")
+        handlers = getattr(self, "_push_handlers", {}).get(topic, ())
+        for fn in list(handlers):
+            try:
+                fn(payload)
+            except Exception:
+                logger.exception("push handler for %s failed", topic)
 
     # ------------------------------------------------------------------
     # execution-side helpers (used by worker_proc)

@@ -1,0 +1,6 @@
+"""Experimental utilities (reference: python/ray/experimental/ —
+internal_kv.py, tqdm_ray.py)."""
+
+from . import internal_kv, tqdm_ray
+
+__all__ = ["internal_kv", "tqdm_ray"]

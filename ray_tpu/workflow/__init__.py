@@ -9,8 +9,9 @@ persist the *control* state (which stage finished), the model state lives
 in the step's own checkpoint artifacts.
 """
 
-from .api import (WorkflowStatus, delete, get_output, get_status, list_all,
-                  resume, run, run_async)
+from .api import (WorkflowStatus, continuation, delete, get_output,
+                  get_status, list_all, options, resume, run, run_async)
 
-__all__ = ["WorkflowStatus", "delete", "get_output", "get_status",
-           "list_all", "resume", "run", "run_async"]
+__all__ = ["WorkflowStatus", "continuation", "delete", "get_output",
+           "get_status", "list_all", "options", "resume", "run",
+           "run_async"]

@@ -27,6 +27,33 @@ class WorkflowStatus:
     NOT_FOUND = "NOT_FOUND"
 
 
+def options(*, max_retries: int = 0, catch_exceptions: bool = False
+            ) -> Dict[str, Any]:
+    """Per-step durability options, passed through fn.options(**...)
+    (reference: workflow/api.py options — max_retries, catch_exceptions).
+
+        result = my_step.options(**workflow.options(max_retries=3)).bind(x)
+    """
+    return {"_workflow_max_retries": max_retries,
+            "_workflow_catch_exceptions": catch_exceptions}
+
+
+class _Continuation:
+    """Marker a step returns to hand control to a sub-DAG (reference:
+    workflow.continuation — dynamic workflows)."""
+
+    __slots__ = ("dag",)
+
+    def __init__(self, dag: DAGNode):
+        self.dag = dag
+
+
+def continuation(dag: DAGNode) -> _Continuation:
+    """Return from a step to continue the workflow with a new DAG; the
+    sub-DAG's steps are checkpointed under the returning step's id."""
+    return _Continuation(dag)
+
+
 def _step_ids(dag: DAGNode) -> Dict[int, str]:
     """Deterministic step id per node: topo index + function name (stable
     across re-loads because topo_sort order is structural)."""
@@ -42,15 +69,40 @@ def _step_ids(dag: DAGNode) -> Dict[int, str]:
     return ids
 
 
-def _execute_workflow(dag: DAGNode, storage: WorkflowStorage,
-                      args: tuple) -> Any:
+def _run_step(node: FunctionNode, resolved_args, resolved_kwargs) -> Any:
+    """One step with per-step durability options (retries /
+    catch_exceptions, reference: workflow step options)."""
+    opts = getattr(node.remote_fn, "_opts", {}) or {}
+    retries = int(opts.get("_workflow_max_retries", 0))
+    catch = bool(opts.get("_workflow_catch_exceptions", False))
+    attempt = 0
+    while True:
+        try:
+            ref = node.remote_fn.remote(*resolved_args, **resolved_kwargs)
+            result = ray_tpu.get(ref, timeout=3600.0)
+            if isinstance(result, _Continuation):
+                # hand the continuation straight to the executor — the
+                # catch wrapper applies to step *values*, not control flow
+                return result
+            return (result, None) if catch else result
+        except BaseException as e:
+            if attempt < retries:
+                attempt += 1
+                continue
+            if catch:
+                return (None, e)
+            raise
+
+
+def _execute_dag(dag: DAGNode, storage: WorkflowStorage, args: tuple,
+                 prefix: str = "") -> Any:
     """Topo-walk the DAG; completed steps load from storage, the rest run
-    as tasks and persist before proceeding (at-least-once per step)."""
+    as tasks and persist before proceeding (at-least-once per step).
+    Continuations recurse with the parent step id as checkpoint prefix."""
     ids = _step_ids(dag)
     values: Dict[int, Any] = {}
-    storage.save_status(WorkflowStatus.RUNNING)
     for node in dag.topo_sort():
-        sid = ids[node._id]
+        sid = prefix + ids[node._id]
         if isinstance(node, InputNode):
             values[node._id] = args[0] if len(args) == 1 else args
             continue
@@ -67,15 +119,32 @@ def _execute_workflow(dag: DAGNode, storage: WorkflowStorage,
             resolved_kwargs = {
                 k: values[v._id] if isinstance(v, DAGNode) else v
                 for k, v in node.kwargs.items()}
-            ref = node.remote_fn.remote(*resolved_args, **resolved_kwargs)
-            result = ray_tpu.get(ref, timeout=3600.0)
+            result = _run_step(node, resolved_args, resolved_kwargs)
+            if isinstance(result, _Continuation):
+                # dynamic workflow: run the sub-DAG under this step's id
+                # (flat ':' namespacing keeps step files in one directory)
+                result = _execute_dag(result.dag, storage, args,
+                                      prefix=f"{sid}:")
         except BaseException as e:
-            storage.save_status(WorkflowStatus.FAILED, failed_step=sid,
-                                error=f"{type(e).__name__}: {e}")
+            # a failed continuation sub-step already recorded the precise
+            # inner step id — don't overwrite it with the parent's
+            if not getattr(e, "_wf_recorded", False):
+                storage.save_status(WorkflowStatus.FAILED, failed_step=sid,
+                                    error=f"{type(e).__name__}: {e}")
+                try:
+                    e._wf_recorded = True
+                except Exception:
+                    pass
             raise
         storage.save_step(sid, result)
         values[node._id] = result
-    out = values[dag._id]
+    return values[dag._id]
+
+
+def _execute_workflow(dag: DAGNode, storage: WorkflowStorage,
+                      args: tuple) -> Any:
+    storage.save_status(WorkflowStatus.RUNNING)
+    out = _execute_dag(dag, storage, args)
     storage.save_output(out)
     storage.save_status(WorkflowStatus.SUCCESSFUL)
     return out

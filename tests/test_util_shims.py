@@ -105,3 +105,72 @@ class TestParallelIterator:
         from ray_tpu.util import iter as rit
 
         assert len(rit.from_range(100, num_shards=4).take(5)) == 5
+
+
+def test_internal_kv():
+    from ray_tpu.experimental import internal_kv as kv
+
+    assert kv._internal_kv_initialized()
+    existed = kv._internal_kv_put(b"ik-key", b"v1")
+    assert existed is False
+    assert kv._internal_kv_get(b"ik-key") == b"v1"
+    assert kv._internal_kv_exists(b"ik-key")
+    assert b"ik-key" in kv._internal_kv_list(b"ik-")
+    assert kv._internal_kv_del(b"ik-key")
+    assert kv._internal_kv_get(b"ik-key") is None
+
+
+def test_tqdm_ray():
+    from ray_tpu.experimental import tqdm_ray
+
+    out = list(tqdm_ray.tqdm(range(10), desc="probe"))
+    assert out == list(range(10))
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    def work():
+        from ray_tpu.experimental.tqdm_ray import tqdm
+
+        t = tqdm(total=5, desc="remote")
+        for _ in range(5):
+            t.update(1)
+        t.close()
+        return t.n
+
+    assert ray_tpu.get(work.remote(), timeout=60) == 5
+
+
+def test_tqdm_driver_listener():
+    import io
+    import sys
+    import time
+
+    import ray_tpu
+    from ray_tpu.experimental import tqdm_ray
+
+    assert tqdm_ray.install_driver_listener()
+
+    @ray_tpu.remote
+    def work():
+        from ray_tpu.experimental.tqdm_ray import tqdm
+
+        t = tqdm(total=3, desc="listened", flush_interval_s=0.0)
+        for _ in range(3):
+            t.update(1)
+        t.close()
+        return True
+
+    old = sys.stderr
+    sys.stderr = io.StringIO()
+    try:
+        assert ray_tpu.get(work.remote(), timeout=60)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if "listened" in sys.stderr.getvalue():
+                break
+            time.sleep(0.2)
+        rendered = sys.stderr.getvalue()
+    finally:
+        sys.stderr = old
+    assert "listened" in rendered

@@ -82,3 +82,51 @@ def test_workflow_delete_and_list(ray_cluster, tmp_path):
     workflow.delete("wf4", str(tmp_path))
     assert all(w != "wf4" for w, _ in workflow.list_all(str(tmp_path)))
     assert workflow.get_status("wf4", str(tmp_path)) == "NOT_FOUND"
+
+
+def test_step_retries(ray_cluster, tmp_path):
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def flaky(marker_dir):
+        import os
+
+        p = os.path.join(marker_dir, "attempts")
+        n = int(open(p).read()) if os.path.exists(p) else 0
+        open(p, "w").write(str(n + 1))
+        if n < 2:
+            raise RuntimeError("transient")
+        return "recovered"
+
+    dag = flaky.options(**workflow.options(max_retries=3)).bind(str(tmp_path))
+    assert workflow.run(dag, storage=str(tmp_path / "wf")) == "recovered"
+
+
+def test_step_catch_exceptions(ray_cluster, tmp_path):
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("wf-step-error")
+
+    dag = boom.options(**workflow.options(catch_exceptions=True)).bind()
+    result, err = workflow.run(dag, storage=str(tmp_path / "wf"))
+    assert result is None
+    assert err is not None and "wf-step-error" in str(err)
+
+
+def test_continuation(ray_cluster, tmp_path):
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def maybe_recurse(x):
+        if x < 8:
+            return workflow.continuation(maybe_recurse.bind(x * 2))
+        return x
+
+    dag = maybe_recurse.bind(1)
+    assert workflow.run(dag, storage=str(tmp_path / "wf")) == 8
