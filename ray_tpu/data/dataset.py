@@ -287,6 +287,35 @@ class Dataset:
         return iter_jax_batches(host, sharding=sharding, dtypes=dtypes,
                                 prefetch=prefetch)
 
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes=None, device: str = "cpu",
+                           drop_last: bool = False, **kw) -> Iterator:
+        """Iterate dict-of-torch.Tensor batches (reference:
+        data/iterator.py iter_torch_batches) — parity surface for torch
+        consumers; jax consumers should prefer iter_jax_batches."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last, **kw):
+            out = {}
+            for k, v in batch.items():
+                # blocks are zero-copy views over read-only shm mmaps:
+                # torch tensors must own writable memory or in-place ops
+                # would fault / corrupt the shared object
+                if isinstance(v, np.ndarray) and not v.flags.writeable:
+                    v = v.copy()
+                t = torch.as_tensor(v)
+                if dtypes:
+                    want = dtypes.get(k) if isinstance(dtypes, dict) \
+                        else dtypes
+                    if want is not None:
+                        t = t.to(want)
+                if device != "cpu":
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
     # ------------------------------------------------------------------
     # split / writes
 

@@ -10,16 +10,16 @@ replicas LRU-swap model weights in HBM.
 from ._common import AutoscalingConfig
 from ._deployment import Application, Deployment, deployment
 from ._handle import DeploymentHandle, DeploymentResponse
-from ._proxy import Request, Response
+from ._proxy import Request, Response, RpcClient
 from .api import (delete, get_app_handle, get_deployment_handle, run,
-                  shutdown, start, status)
+                  shutdown, start, start_rpc_proxy, status)
 from .batching import batch
 from .multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentHandle",
-    "DeploymentResponse", "Request", "Response", "batch", "delete",
-    "deployment", "get_app_handle", "get_deployment_handle",
+    "DeploymentResponse", "Request", "Response", "RpcClient", "batch",
+    "delete", "deployment", "get_app_handle", "get_deployment_handle",
     "get_multiplexed_model_id", "multiplexed", "run", "shutdown", "start",
-    "status",
+    "start_rpc_proxy", "status",
 ]

@@ -74,6 +74,7 @@ class ServeController:
         self._http_host = http_host
         self._http_port = http_port
         self._proxy = None
+        self._rpc_proxy = None
         self._shutdown = False
         self._reconciler = threading.Thread(target=self._reconcile_loop,
                                             name="serve-reconcile",
@@ -178,6 +179,14 @@ class ServeController:
                         "app": app_name, "deployment": app["ingress"]}
             return {"version": self._routing_version, "routes": routes}
 
+    def get_app_table(self) -> Dict[str, Any]:
+        """All apps keyed by name — the RPC ingress serves apps without an
+        HTTP route_prefix too (the reference's gRPC proxy does likewise)."""
+        with self._lock:
+            apps = {name: {"app": name, "deployment": app["ingress"]}
+                    for name, app in self._apps.items()}
+            return {"version": self._routing_version, "apps": apps}
+
     def get_replica_table(self, app_name: str,
                           deployment_name: str) -> Dict[str, Any]:
         with self._lock:
@@ -239,6 +248,19 @@ class ServeController:
                     name="SERVE_PROXY", max_concurrency=8,
                     num_cpus=0).remote(self._http_host, self._http_port)
             proxy = self._proxy
+        return ray_tpu.get(proxy.ready.remote(), timeout=30.0)
+
+    def ensure_rpc_proxy(self) -> Any:
+        """Start the RPC ingress actor on demand (the reference's gRPC
+        proxy analog); returns (host, port)."""
+        with self._lock:
+            if self._rpc_proxy is None:
+                from ._proxy import RpcProxy
+
+                self._rpc_proxy = ray_tpu.remote(RpcProxy).options(
+                    name="SERVE_RPC_PROXY", max_concurrency=8,
+                    num_cpus=0).remote(self._http_host, 0)
+            proxy = self._rpc_proxy
         return ray_tpu.get(proxy.ready.remote(), timeout=30.0)
 
     # -- reconcile loop -----------------------------------------------------

@@ -121,6 +121,7 @@ class HTTPProxy:
             self._routes = table["routes"]
             self._routes_ts = time.monotonic()
         except Exception:
+            self._controller = None  # re-resolve after controller restart
             logger.exception("route table refresh failed")
 
     async def _route_for(self, path: str) -> Optional[Dict[str, str]]:
@@ -191,3 +192,118 @@ class HTTPProxy:
             return web.Response(text=out)
         return web.json_response(out, dumps=lambda o: json.dumps(
             o, default=str))
+
+
+class _ControllerTableCache:
+    """TTL-cached controller table fetch shared by the ingress proxies.
+
+    Resets the cached actor handle on failure so a restarted controller
+    (new actor, same name) is re-resolved instead of bricking refreshes.
+    """
+
+    def __init__(self, method: str, extract):
+        self._method = method
+        self._extract = extract
+        self._controller = None
+        self._value: Dict[str, Any] = {}
+        self._ts = 0.0
+
+    def get(self) -> Dict[str, Any]:
+        if time.monotonic() - self._ts > _ROUTES_TTL_S:
+            try:
+                if self._controller is None:
+                    self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                table = ray_tpu.get(
+                    getattr(self._controller, self._method).remote(),
+                    timeout=10.0)
+                self._value = self._extract(table)
+                self._ts = time.monotonic()
+            except Exception:
+                self._controller = None  # re-resolve after restarts
+                logger.exception("%s refresh failed", self._method)
+        return self._value
+
+
+class RpcProxy:
+    """Binary RPC ingress: the reference's gRPC proxy analog
+    (reference: serve/_private/proxy.py gRPCProxy :558) on the framework's
+    native frame protocol instead of grpc — one `serve_call` method routes
+    {app, method, payload} through the same p2c router as HTTP.  Serves
+    every app, including ones without an HTTP route_prefix.
+
+    Clients use serve.RpcClient (or any protocol.Client):
+        RpcClient(addr).call("my_app", payload, method="predict")
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from ray_tpu._private.protocol import DaemonPool, Server
+
+        self._table = _ControllerTableCache(
+            "get_app_table", lambda t: dict(t["apps"]))
+        self._pool = DaemonPool(max_workers=16, name="serve-rpc")
+        self._server = Server(host, port, name="serve-rpc")
+        self._server.handle("serve_call", self._handle_call, deferred=True)
+        # table fetch blocks on the controller: never on the loop thread
+        self._server.handle("serve_routes", self._handle_routes,
+                            deferred=True)
+        self._server.handle("ping", lambda c, p: "pong")
+        self._server.start()
+
+    def ready(self):
+        return self._server.addr
+
+    def _handle_routes(self, conn, p, d):
+        self._pool.submit(lambda: d.resolve(self._table.get()))
+
+    def _handle_call(self, conn, p, d):
+        def run():
+            try:
+                app = p.get("app") or "default"
+                target = self._table.get().get(app)
+                if target is None:
+                    d.reject(f"no serve app named {app!r}")
+                    return
+                router = get_router(target["app"], target["deployment"])
+                args = p.get("args", ())
+                kwargs = p.get("kwargs", {})
+                ref, done = router.assign(p.get("method"), tuple(args),
+                                          dict(kwargs), {})
+                try:
+                    out = ray_tpu.get(ref, timeout=300.0)
+                finally:
+                    done()
+                d.resolve(out)
+            except BaseException as e:
+                d.reject(f"{type(e).__name__}: {e}")
+
+        self._pool.submit(run)
+
+
+class RpcClient:
+    """Client for the serve RPC ingress.
+
+    `method` and `timeout` are client-side options; a deployment method
+    whose own kwargs collide with those names receives them via
+    `call_kwargs`.
+    """
+
+    def __init__(self, addr, connect_timeout: float = 30.0):
+        from ray_tpu._private.protocol import Client
+
+        self._client = Client(tuple(addr), name="serve-rpc-client",
+                              connect_timeout=connect_timeout)
+
+    def call(self, app: str, *args, method: Optional[str] = None,
+             timeout: float = 300.0,
+             call_kwargs: Optional[Dict[str, Any]] = None, **kwargs):
+        merged = {**(call_kwargs or {}), **kwargs}
+        return self._client.call("serve_call",
+                                 {"app": app, "method": method,
+                                  "args": args, "kwargs": merged},
+                                 timeout=timeout)
+
+    def routes(self) -> Dict[str, Any]:
+        return self._client.call("serve_routes", {}, timeout=30.0)
+
+    def close(self):
+        self._client.close()

@@ -47,6 +47,13 @@ def start(http_host: str = "127.0.0.1", http_port: int = 0,
     return _controller_handle
 
 
+def start_rpc_proxy():
+    """Start the binary RPC ingress (reference: the gRPC proxy,
+    proxy.py:558); returns its (host, port)."""
+    return ray_tpu.get(_get_controller().ensure_rpc_proxy.remote(),
+                       timeout=60.0)
+
+
 def _get_controller():
     global _controller_handle
     if _controller_handle is None:
@@ -143,11 +150,11 @@ def shutdown():
         ray_tpu.get(controller.shutdown.remote(), timeout=30.0)
     except Exception:
         pass
-    try:
-        proxy = ray_tpu.get_actor("SERVE_PROXY")
-        ray_tpu.kill(proxy)
-    except Exception:
-        pass
+    for proxy_name in ("SERVE_PROXY", "SERVE_RPC_PROXY"):
+        try:
+            ray_tpu.kill(ray_tpu.get_actor(proxy_name))
+        except Exception:
+            pass
     try:
         ray_tpu.kill(controller)
     except Exception:

@@ -304,3 +304,20 @@ def test_map_batches_resources_reach_scheduler(ray_cluster, monkeypatch):
     monkeypatch.setattr(ex.ray_tpu, "remote", lambda fn: _FakeRemote())
     se._submit(lambda: None, (), resources={"TPU": 1})
     assert seen["resources"] == {"host": 1, "TPU": 1}
+
+
+def test_iter_torch_batches(ray_cluster):
+    import torch
+
+    from ray_tpu import data as rd
+
+    ds = rd.range(100)
+    seen = 0
+    for b in ds.iter_torch_batches(batch_size=32):
+        assert isinstance(b["id"], torch.Tensor)
+        seen += b["id"].shape[0]
+    assert seen == 100
+    # dtype casting
+    b = next(iter(rd.range(8).iter_torch_batches(
+        batch_size=8, dtypes={"id": torch.float32})))
+    assert b["id"].dtype == torch.float32

@@ -277,3 +277,47 @@ def test_http_ingress(serve_instance):
     with pytest.raises(urllib.error.HTTPError):
         _http_get(f"{base}/nomatch")
     serve.delete("http_app")
+
+
+def test_rpc_ingress(serve_instance):
+    """Binary RPC ingress routes to deployments like the reference's gRPC
+    proxy (reference: serve/tests test_grpc)."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, payload):
+            return {"echo": payload}
+
+        def shout(self, payload):
+            return {"echo": str(payload).upper()}
+
+    serve.run(Echo.bind(), name="rpc-echo", route_prefix="/rpc-echo")
+    addr = serve.start_rpc_proxy()
+    cli = serve.RpcClient(addr)
+    try:
+        assert cli.routes()  # app table visible
+        out = cli.call("rpc-echo", "hello")
+        assert out == {"echo": "hello"}
+        out = cli.call("rpc-echo", "hello", method="shout")
+        assert out == {"echo": "HELLO"}
+    finally:
+        cli.close()
+        serve.delete("rpc-echo")
+
+
+def test_rpc_ingress_serves_prefixless_apps(serve_instance):
+    from ray_tpu import serve
+
+    @serve.deployment
+    def ident(x):
+        return x
+
+    serve.run(ident.bind(), name="rpc-only", route_prefix=None)
+    addr = serve.start_rpc_proxy()
+    cli = serve.RpcClient(addr)
+    try:
+        assert cli.call("rpc-only", 42) == 42
+    finally:
+        cli.close()
+        serve.delete("rpc-only")
