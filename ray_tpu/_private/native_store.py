@@ -24,8 +24,10 @@ from typing import List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
 
-DEFAULT_CAPACITY = int(os.environ.get("RAY_TPU_OBJECT_STORE_BYTES",
-                                      1 << 30))
+def DEFAULT_CAPACITY() -> int:
+    # read at store-construction time so tests/daemons can size the arena
+    # through the environment
+    return int(os.environ.get("RAY_TPU_OBJECT_STORE_BYTES", 1 << 30))
 N_ENTRIES = 16384  # power of two
 
 _lib = None
@@ -45,7 +47,13 @@ def _load():
     lib.rt_create.restype = ctypes.c_uint64
     lib.rt_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                               ctypes.c_uint64,
-                              ctypes.POINTER(ctypes.c_int)]
+                              ctypes.POINTER(ctypes.c_int),
+                              ctypes.c_uint32]
+    lib.rt_set_primary.restype = ctypes.c_int
+    lib.rt_set_primary.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int]
+    lib.rt_get_flags.restype = ctypes.c_int64
+    lib.rt_get_flags.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     for fn in ("rt_seal", "rt_abort", "rt_release", "rt_delete",
                "rt_contains"):
         f = getattr(lib, fn)
@@ -83,7 +91,7 @@ class NativeShmObjectStore:
         self.root = root
         os.makedirs(root, exist_ok=True)
         self._lib = _load()
-        self._capacity = capacity or DEFAULT_CAPACITY
+        self._capacity = capacity or DEFAULT_CAPACITY()
         self._arena_path = os.path.join(root, "arena.shm")
         self._arena = self._lib.rt_arena_open(
             self._arena_path.encode(), self._capacity, N_ENTRIES)
@@ -103,8 +111,10 @@ class NativeShmObjectStore:
         if self._state["closed"]:
             raise ValueError("object store is closed")
 
+    PRIMARY = 1  # arena kFlagPrimary: unevictable until spilled
+
     def create(self, object_id: str, meta: bytes,
-               buffers: Sequence[memoryview]) -> int:
+               buffers: Sequence[memoryview], primary: bool = True) -> int:
         from .shm_store import layout_size, pack_into
 
         self._check_open()
@@ -112,7 +122,8 @@ class NativeShmObjectStore:
         oid = object_id.encode()
         err = ctypes.c_int(0)
         off = self._lib.rt_create(self._arena, oid, size,
-                                  ctypes.byref(err))
+                                  ctypes.byref(err),
+                                  self.PRIMARY if primary else 0)
         if err.value == 1:
             return size  # already created/sealed: objects are immutable
         if off == 0:
@@ -131,7 +142,9 @@ class NativeShmObjectStore:
         return size
 
     def put_raw(self, object_id: str, data: bytes) -> int:
-        return self.create(object_id, b"", [memoryview(data)])
+        # raw blobs are cache-like (no owner tracking them): evictable
+        return self.create(object_id, b"", [memoryview(data)],
+                           primary=False)
 
     # -- read path ---------------------------------------------------------
 
@@ -190,12 +203,17 @@ class NativeShmObjectStore:
             return self._overflow.read_bytes(object_id)
         return bytes(buf)
 
-    def write_bytes(self, object_id: str, data: bytes) -> None:
+    def write_bytes(self, object_id: str, data: bytes,
+                    primary: bool = False) -> None:
+        """Write a pre-packed object.  Non-primary by default: this is the
+        path for pulled remote copies and spill restores, both of which
+        remain recoverable elsewhere and so may be LRU-evicted."""
         self._check_open()
         oid = object_id.encode()
         err = ctypes.c_int(0)
         off = self._lib.rt_create(self._arena, oid, len(data),
-                                  ctypes.byref(err))
+                                  ctypes.byref(err),
+                                  self.PRIMARY if primary else 0)
         if err.value == 1:
             return
         if off == 0:
@@ -212,6 +230,28 @@ class NativeShmObjectStore:
 
     def release(self, object_id: str) -> None:
         pass  # pins are owned by mappings (see _map_object)
+
+    def set_primary(self, object_id: str, on: bool) -> bool:
+        self._check_open()
+        return self._lib.rt_set_primary(self._arena, object_id.encode(),
+                                        1 if on else 0) == 0
+
+    def is_primary(self, object_id: str) -> bool:
+        self._check_open()
+        flags = self._lib.rt_get_flags(self._arena, object_id.encode())
+        if flags >= 0:
+            return bool(flags & self.PRIMARY)
+        # file-overflow objects hold the only copy of primary creates too;
+        # treat unknown-to-arena as spillable
+        return self._overflow.contains(object_id)
+
+    def try_free(self, object_id: str) -> bool:
+        """Delete only if the memory is actually reclaimed now (a pinned
+        arena entry survives rt_delete with rc=1)."""
+        self._check_open()
+        if self._lib.rt_delete(self._arena, object_id.encode()) == 0:
+            return True
+        return self._overflow.delete(object_id)
 
     def delete(self, object_id: str) -> bool:
         self._check_open()

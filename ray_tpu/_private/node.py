@@ -45,6 +45,7 @@ class WorkerRecord:
         self.addr: Optional[Tuple[str, int]] = None
         self.conn: Optional[ServerConn] = None
         self.state = "starting"  # starting | idle | leased | actor | dead
+        self.leased_at = 0.0
         self.actor_id: Optional[str] = None
         self.lease_id: Optional[str] = None
         self.blocked = False
@@ -131,6 +132,36 @@ class Raylet:
                                              name="raylet-reap", daemon=True)
         self._pull_pool: Dict[str, threading.Event] = {}
 
+        # object spilling + memory watchdog (reference:
+        # local_object_manager.h:110, memory_monitor.h:52)
+        from . import spilling
+
+        self.spill: Optional[spilling.SpillManager] = None
+        if os.environ.get("RAY_TPU_OBJECT_SPILLING", "1") != "0":
+            # spill to real disk — the session dir lives on /dev/shm, and
+            # spilling tmpfs→tmpfs would free no memory.  Always suffix
+            # with the node id: co-hosted raylets must not share (and on
+            # shutdown rmtree) one directory.
+            spill_base = os.environ.get("RAY_TPU_SPILL_DIR",
+                                        "/tmp/ray_tpu_spill")
+            self.spill = spilling.SpillManager(
+                self.store, os.path.join(spill_base, self.node_id))
+        self.oom_killer: Optional[spilling.OomKiller] = None
+        refresh_ms = os.environ.get("RAY_TPU_MEMORY_MONITOR_REFRESH_MS")
+        if refresh_ms is None:
+            # default on only inside a memory-limited cgroup, where the
+            # limit is real and ours; on a shared host a high ambient
+            # usage would make kills spurious
+            refresh_ms = "250" if spilling._cgroup_usage() else "0"
+        self._mem_refresh_s = max(int(refresh_ms), 0) / 1000.0
+        if self._mem_refresh_s > 0:
+            self.oom_killer = spilling.OomKiller(
+                self, spilling.MemoryMonitor())
+        self._mem_thread = None
+        if self.spill is not None or self.oom_killer is not None:
+            self._mem_thread = threading.Thread(
+                target=self._memory_loop, name="raylet-memory", daemon=True)
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self, block: bool = False):
@@ -147,6 +178,8 @@ class Raylet:
         self._hb_thread.start()
         self._reap_thread.start()
         self._prestart_thread.start()
+        if self._mem_thread is not None:
+            self._mem_thread.start()
         logger.info("raylet %s up at %s resources=%s", self.node_id[:12],
                     self.server.addr, common.denormalize_resources(self.total))
         if block:
@@ -171,6 +204,8 @@ class Raylet:
         for w in workers:
             self._kill_worker(w)
         self.server.stop()
+        if self.spill is not None:
+            self.spill.destroy()
         self.store.destroy()
         try:
             shutil.rmtree(self.session_dir, ignore_errors=True)
@@ -249,6 +284,22 @@ class Raylet:
                 rec.proc.terminate()
             except OSError:
                 pass
+
+    def kill_worker_for_oom(self, rec: WorkerRecord) -> bool:
+        """OOM-policy kill: release the lease's resources and retire the
+        record up front — marking it dead suppresses the disconnect
+        handler, which must not see this as an implicit lease return."""
+        with self.lock:
+            if rec.state != "leased":
+                return False
+            self._free_lease_resources(rec)
+            rec.blocked = False
+            rec.lease_id = None
+            self.workers.pop(rec.worker_id, None)
+            self.workers_by_token.pop(rec.token, None)
+        self._kill_worker(rec)
+        self._try_grant()
+        return True
 
     def h_disconnect(self, conn: ServerConn):
         wid = conn.meta.get("worker_id")
@@ -424,6 +475,7 @@ class Raylet:
                 else:
                     subtract(self.available, pl.demand)
                 w.state = "leased"
+                w.leased_at = time.monotonic()
                 w.lease_id = common.new_id("lease-")
                 w.lease_resources = pl.demand
                 grants.append((pl, w))
@@ -641,13 +693,19 @@ class Raylet:
         """Serve raw object bytes to a remote raylet (chunking: the frame
         layer handles large payloads; reference streams 1MiB chunks,
         object_manager.proto:61)."""
-        return self.store.read_bytes(p["object_id"])
+        data = self.store.read_bytes(p["object_id"])
+        if data is None and self.spill is not None:
+            data = self.spill.read_spilled(p["object_id"])
+        return data
 
     def h_pull_object(self, conn, p, d: Deferred):
         oid, from_addr = p["object_id"], tuple(p["from_addr"])
 
         def do():
             if self.store.contains(oid):
+                d.resolve(True)
+                return
+            if self.spill is not None and self.spill.restore(oid):
                 d.resolve(True)
                 return
             try:
@@ -676,7 +734,10 @@ class Raylet:
     def h_delete_objects(self, conn, p):
         n = 0
         for oid in p["object_ids"]:
-            if self.store.delete(oid):
+            dropped = self.store.delete(oid)
+            if self.spill is not None:
+                dropped = self.spill.delete(oid) or dropped
+            if dropped:
                 n += 1
         return n
 
@@ -684,6 +745,10 @@ class Raylet:
         objs = self.store.list_objects()
         out = {"num_objects": len(objs),
                "bytes": sum(self.store.size(o) or 0 for o in objs)}
+        if self.spill is not None:
+            out["spill"] = self.spill.stats()
+        if self.oom_killer is not None:
+            out["oom_killed"] = self.oom_killer.n_killed
         if p and p.get("detail"):
             out["objects"] = [{"object_id": o,
                                "size_bytes": self.store.size(o) or 0}
@@ -722,6 +787,29 @@ class Raylet:
                 "idle": len(self.idle),
                 "pending_leases": len(self.pending_leases),
             }
+
+    # -- memory pressure ---------------------------------------------------
+
+    def _memory_loop(self):
+        """Spill under store pressure; kill workers under system memory
+        pressure (reference: local_object_manager spilling loop +
+        memory_monitor worker killing)."""
+        spill_interval = 0.2
+        next_mem = 0.0
+        while not self._stop.is_set():
+            try:
+                if self.spill is not None and self.spill.over_high_water():
+                    n = self.spill.maybe_spill()
+                    if n:
+                        logger.info("spilled %d objects to disk (%s)", n,
+                                    self.spill.stats())
+                now = time.monotonic()
+                if self.oom_killer is not None and now >= next_mem:
+                    self.oom_killer.step()
+                    next_mem = now + self._mem_refresh_s
+            except Exception:
+                logger.exception("memory loop iteration failed")
+            self._stop.wait(spill_interval)
 
     # -- heartbeats --------------------------------------------------------
 

@@ -40,7 +40,7 @@
 namespace {
 
 constexpr uint64_t kMagic = 0x524159545055ULL;  // "RAYTPU"
-constexpr uint32_t kVersion = 1;
+constexpr uint32_t kVersion = 2;
 constexpr uint64_t kPage = 4096;
 constexpr uint32_t kMaxReaders = 8;
 constexpr uint32_t kIdLen = 64;  // incl. NUL
@@ -51,6 +51,14 @@ constexpr uint32_t kCreated = 1;  // allocated, being written
 constexpr uint32_t kSealed = 2;
 constexpr uint32_t kTomb = 3;  // deleted; probe chains continue through it
 
+// entry flags
+// Primary copy: the only in-memory copy of an owned object.  Never a
+// victim of LRU eviction — it must be spilled to disk (and the flag
+// cleared) before its memory can be reclaimed, mirroring plasma's
+// pinned-primary rule (reference: local_object_manager.h pinned_objects_;
+// eviction only reaps secondary copies).
+constexpr uint32_t kFlagPrimary = 1;
+
 struct Reader {
   uint32_t pid;
   int32_t count;
@@ -60,6 +68,8 @@ struct Entry {
   uint64_t hash;      // 0 means look at state (empty vs tomb)
   uint32_t state;
   uint32_t creator_pid;
+  uint32_t flags;
+  uint32_t pad0;
   uint64_t off;       // payload offset in arena (page aligned)
   uint64_t size;      // payload bytes (allocated extent = page-rounded)
   uint64_t lru_tick;  // larger = more recently used
@@ -242,7 +252,7 @@ uint64_t alloc_with_eviction(Arena* a, uint64_t need) {
     Entry* victim = nullptr;
     for (uint32_t i = 0; i < a->hdr->n_entries; ++i) {
       Entry& e = a->table[i];
-      if (e.state == kSealed && !pinned(e) &&
+      if (e.state == kSealed && !(e.flags & kFlagPrimary) && !pinned(e) &&
           (!victim || e.lru_tick < victim->lru_tick))
         victim = &e;
     }
@@ -356,7 +366,8 @@ void rt_arena_close(Arena* a) {
 // Allocate an object of `size` bytes.  Returns the payload offset
 // (page aligned) or 0 on failure.  errno-style result via *err:
 //   0 ok, 1 exists (created or sealed), 2 out of memory/ids.
-uint64_t rt_create(Arena* a, const char* id, uint64_t size, int* err) {
+uint64_t rt_create(Arena* a, const char* id, uint64_t size, int* err,
+                   uint32_t flags) {
   *err = 2;
   if (!a) return 0;
   if (strlen(id) >= kIdLen) return 0;
@@ -368,6 +379,11 @@ uint64_t rt_create(Arena* a, const char* id, uint64_t size, int* err) {
     return 0;  // table full
   }
   if (e->state == kCreated || e->state == kSealed) {
+    // Re-create of an existing copy: upgrade to primary if requested —
+    // lineage recovery can recompute an object on a node that held a
+    // pulled (evictable) copy, and the recomputed object is now the
+    // primary.  Never downgrade here.
+    if (flags & kFlagPrimary) e->flags |= kFlagPrimary;
     *err = 1;
     unlock(a);
     return 0;
@@ -381,6 +397,7 @@ uint64_t rt_create(Arena* a, const char* id, uint64_t size, int* err) {
   memset(e, 0, sizeof(Entry));
   e->hash = h;
   e->state = kCreated;
+  e->flags = flags;
   e->creator_pid = (uint32_t)getpid();
   e->off = off;
   e->size = size;
@@ -509,6 +526,37 @@ int rt_delete(Arena* a, const char* id) {
       // Simplest correct behavior: keep sealed, let eviction reap it.
       rc = 1;
     }
+  }
+  unlock(a);
+  return rc;
+}
+
+// Flags of a live entry, or -1 if absent.
+int64_t rt_get_flags(Arena* a, const char* id) {
+  if (!a) return -1;
+  uint64_t h = fnv1a(id);
+  if (lock(a) != 0) return -1;
+  Entry* e = find_entry(a, id, h);
+  int64_t rc =
+      (e && (e->state == kSealed || e->state == kCreated)) ? e->flags : -1;
+  unlock(a);
+  return rc;
+}
+
+// Set/clear the primary-copy flag (spill manager clears it once the
+// object's bytes are safe on disk, making the entry evictable/deletable).
+int rt_set_primary(Arena* a, const char* id, int on) {
+  if (!a) return -1;
+  uint64_t h = fnv1a(id);
+  if (lock(a) != 0) return -1;
+  Entry* e = find_entry(a, id, h);
+  int rc = -1;
+  if (e && (e->state == kSealed || e->state == kCreated)) {
+    if (on)
+      e->flags |= kFlagPrimary;
+    else
+      e->flags &= ~kFlagPrimary;
+    rc = 0;
   }
   unlock(a);
   return rc;

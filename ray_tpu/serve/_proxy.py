@@ -65,14 +65,48 @@ class Request:
         return f"Request({self.method} {self.path})"
 
 
+class _ControllerTableCache:
+    """TTL-cached controller table fetch shared by the ingress proxies.
+
+    Resets the cached actor handle on failure so a restarted controller
+    (new actor, same name) is re-resolved instead of bricking refreshes.
+    """
+
+    def __init__(self, method: str, extract):
+        self._method = method
+        self._extract = extract
+        self._controller = None
+        self._value: Dict[str, Any] = {}
+        self._ts = 0.0
+
+    def invalidate(self):
+        self._ts = 0.0
+
+    def get(self) -> Dict[str, Any]:
+        """Blocking controller RPC on miss — callers on an event loop must
+        run this in an executor."""
+        if time.monotonic() - self._ts > _ROUTES_TTL_S:
+            try:
+                if self._controller is None:
+                    self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                table = ray_tpu.get(
+                    getattr(self._controller, self._method).remote(),
+                    timeout=10.0)
+                self._value = self._extract(table)
+                self._ts = time.monotonic()
+            except Exception:
+                self._controller = None  # re-resolve after restarts
+                logger.exception("%s refresh failed", self._method)
+        return self._value
+
+
 class HTTPProxy:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         self._host = host
         self._port = port
         self._actual_port = None
-        self._routes: Dict[str, Dict[str, str]] = {}
-        self._routes_ts = 0.0
-        self._controller = None
+        self._table = _ControllerTableCache(
+            "get_routing_table", lambda t: t["routes"])
         self._started = threading.Event()
         self._start_err: Optional[str] = None
         self._thread = threading.Thread(target=self._serve_thread,
@@ -109,27 +143,11 @@ class HTTPProxy:
             self._start_err = f"{type(e).__name__}: {e}"
             self._started.set()
 
-    def _refresh_routes(self):
-        """Blocking controller RPC — only ever called via run_in_executor
-        so the aiohttp accept loop never stalls on it."""
-        try:
-            if self._controller is None:
-                self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
-            table = ray_tpu.get(
-                self._controller.get_routing_table.remote(),
-                timeout=10.0)
-            self._routes = table["routes"]
-            self._routes_ts = time.monotonic()
-        except Exception:
-            self._controller = None  # re-resolve after controller restart
-            logger.exception("route table refresh failed")
-
     async def _route_for(self, path: str) -> Optional[Dict[str, str]]:
-        if time.monotonic() - self._routes_ts > _ROUTES_TTL_S:
-            await asyncio.get_event_loop().run_in_executor(
-                None, self._refresh_routes)
+        routes = await asyncio.get_event_loop().run_in_executor(
+            None, self._table.get)
         best = None
-        for prefix, target in self._routes.items():
+        for prefix, target in routes.items():
             if path == prefix or path.startswith(
                     prefix if prefix.endswith("/") else prefix + "/") \
                     or prefix == "/":
@@ -144,9 +162,9 @@ class HTTPProxy:
         if path == "/-/healthz":
             return web.Response(text="ok")
         if path == "/-/routes":
-            self._routes_ts = 0.0
+            self._table.invalidate()
             await self._route_for(path)
-            return web.json_response(self._routes)
+            return web.json_response(self._table._value)
         target = await self._route_for(path)
         if target is None:
             return web.Response(status=404,
@@ -192,36 +210,6 @@ class HTTPProxy:
             return web.Response(text=out)
         return web.json_response(out, dumps=lambda o: json.dumps(
             o, default=str))
-
-
-class _ControllerTableCache:
-    """TTL-cached controller table fetch shared by the ingress proxies.
-
-    Resets the cached actor handle on failure so a restarted controller
-    (new actor, same name) is re-resolved instead of bricking refreshes.
-    """
-
-    def __init__(self, method: str, extract):
-        self._method = method
-        self._extract = extract
-        self._controller = None
-        self._value: Dict[str, Any] = {}
-        self._ts = 0.0
-
-    def get(self) -> Dict[str, Any]:
-        if time.monotonic() - self._ts > _ROUTES_TTL_S:
-            try:
-                if self._controller is None:
-                    self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
-                table = ray_tpu.get(
-                    getattr(self._controller, self._method).remote(),
-                    timeout=10.0)
-                self._value = self._extract(table)
-                self._ts = time.monotonic()
-            except Exception:
-                self._controller = None  # re-resolve after restarts
-                logger.exception("%s refresh failed", self._method)
-        return self._value
 
 
 class RpcProxy:
