@@ -98,11 +98,34 @@ class Cluster:
 
     def start_control(self) -> Tuple[str, int]:
         port = free_port()
-        self.control_proc = _spawn(
-            [sys.executable, "-m", "ray_tpu._private.control",
-             "--host", "127.0.0.1", "--port", str(port)],
-            os.path.join(self.log_dir, "control.log"))
+        self._spawn_control(port)
         self.control_addr = ("127.0.0.1", port)
+        _wait_ping(self.control_addr, what="control plane")
+        return self.control_addr
+
+    def _spawn_control(self, port: int):
+        cmd = [sys.executable, "-m", "ray_tpu._private.control",
+               "--host", "127.0.0.1", "--port", str(port)]
+        # RAY_TPU_CONTROL_PERSIST also works via inherited env; the flag
+        # keeps the subprocess's configuration visible in `ps`
+        persist = os.environ.get("RAY_TPU_CONTROL_PERSIST")
+        if persist:
+            cmd += ["--persist", persist]
+        self.control_proc = _spawn(
+            cmd, os.path.join(self.log_dir, "control.log"))
+
+    def kill_control(self):
+        """Hard-kill the control daemon (GCS failure injection)."""
+        if self.control_proc is not None and self.control_proc.poll() is None:
+            self.control_proc.kill()
+            self.control_proc.wait(timeout=10)
+
+    def restart_control(self):
+        """Bring the control daemon back on the same address (reference:
+        GCS restart under fault tolerance — ha_integration tests)."""
+        assert self.control_addr is not None, "start_control() first"
+        self.kill_control()
+        self._spawn_control(self.control_addr[1])
         _wait_ping(self.control_addr, what="control plane")
         return self.control_addr
 

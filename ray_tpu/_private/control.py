@@ -130,8 +130,20 @@ class PlacementGroupRecord:
         }
 
 
+class _NullDeferred:
+    """Stands in for a client Deferred when the control plane reschedules
+    restored work at boot — nobody is waiting on the reply."""
+
+    def resolve(self, *_):
+        pass
+
+    def reject(self, *_):
+        pass
+
+
 class ControlServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persist_path: Optional[str] = None):
         self.server = Server(host, port, name="control")
         self.lock = threading.RLock()
         self.kv: Dict[str, Dict[str, bytes]] = {}  # namespace -> key -> value
@@ -210,6 +222,99 @@ class ControlServer:
             target=self._health_loop, name="control-health", daemon=True
         )
 
+        # durable metadata store (reference: redis_store_client.h role —
+        # GCS fault tolerance).  Off unless a path is configured.
+        from . import persist
+
+        self.pstore = persist.open_store(
+            persist_path or os.environ.get("RAY_TPU_CONTROL_PERSIST"))
+        if self.pstore is not None:
+            self._load_persisted()
+
+    # -- persistence -------------------------------------------------------
+
+    def _persist_actor(self, rec: ActorRecord):
+        if self.pstore is None:
+            return
+        # snapshot + write under the table lock so disk ordering can't
+        # invert a pair of racing state transitions; DEAD records are
+        # pruned (the reference GCS garbage-collects destroyed actors)
+        with self.lock:
+            if rec.state == DEAD:
+                self.pstore.rec_del("actor", rec.actor_id)
+                return
+            self.pstore.rec_put("actor", rec.actor_id, {
+                "spec_blob": rec.spec_blob, "name": rec.name,
+                "resources": rec.resources,
+                "max_restarts": rec.max_restarts,
+                "owner_id": rec.owner_id, "pg_id": rec.pg_id,
+                "bundle_index": rec.bundle_index, "detached": rec.detached,
+                "state": rec.state, "restarts": rec.restarts,
+                "incarnation": rec.incarnation, "error": rec.error,
+                "class_name": rec.class_name,
+            })
+
+    def _persist_pg(self, rec: PlacementGroupRecord):
+        if self.pstore is None:
+            return
+        with self.lock:
+            if rec.state == DEAD:
+                self.pstore.rec_del("pg", rec.pg_id)
+                return
+            self.pstore.rec_put("pg", rec.pg_id, {
+                "bundles": rec.bundles, "strategy": rec.strategy,
+                "name": rec.name, "state": rec.state,
+            })
+
+    def _load_persisted(self):
+        """Reload durable tables after a control-plane restart
+        (reference: GcsInitData reload, gcs_init_data.h).
+
+        Raylets reconnect and re-register with wiped actor workers, so
+        every surviving actor record is rescheduled fresh (incarnation
+        bumped; restart budget NOT charged — the failure was ours, not
+        the actor's); live placement groups re-run 2-phase reservation
+        once nodes return."""
+        self.kv = self.pstore.load_kv()
+        self.functions = self.pstore.load_table("function")
+        self.jobs = self.pstore.load_table("job")
+        n_actors = n_pgs = 0
+        for aid, d in self.pstore.load_table("actor").items():
+            rec = ActorRecord(aid, d["spec_blob"], d["name"], d["resources"],
+                              d["max_restarts"], d["owner_id"], d["pg_id"],
+                              d["bundle_index"], d["detached"])
+            rec.class_name = d.get("class_name", "")
+            rec.restarts = d.get("restarts", 0)
+            rec.incarnation = d.get("incarnation", 0)
+            self.actors[aid] = rec
+            if d["state"] == DEAD:
+                rec.state = DEAD
+                rec.error = d.get("error")
+                continue
+            rec.state = RESTARTING
+            rec.incarnation += 1
+            if rec.name:
+                self.named_actors[rec.name] = aid
+            self.pending_actors.append(rec)
+            n_actors += 1
+        for pgid, d in self.pstore.load_table("pg").items():
+            rec = PlacementGroupRecord(pgid, d["bundles"], d["strategy"],
+                                       d["name"])
+            self.pgs[pgid] = rec
+            if d["state"] == DEAD:
+                rec.state = DEAD
+                continue
+            rec.state = PENDING
+            self.pool.submit(self._schedule_pg, rec, _NullDeferred())
+            n_pgs += 1
+        if n_actors or n_pgs or self.kv or self.functions:
+            logger.info(
+                "restored persisted state: %d kv namespaces, %d functions, "
+                "%d jobs, %d actors to reschedule, %d PGs to re-reserve",
+                len(self.kv), len(self.functions), len(self.jobs),
+                n_actors, n_pgs)
+        self._sched_event.set()
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self, block: bool = False):
@@ -224,6 +329,8 @@ class ControlServer:
         self._stop.set()
         self.server.stop()
         self.pool.shutdown(wait=False)
+        if self.pstore is not None:
+            self.pstore.close()
 
     @property
     def addr(self):
@@ -238,7 +345,10 @@ class ControlServer:
             if not overwrite and k in space:
                 return False
             space[k] = v
-            return True
+            # persisted inside the lock: disk order must match memory order
+            if self.pstore is not None:
+                self.pstore.kv_put(ns, k, v)
+        return True
 
     def h_kv_get(self, conn, p):
         with self.lock:
@@ -246,7 +356,10 @@ class ControlServer:
 
     def h_kv_del(self, conn, p):
         with self.lock:
-            return self.kv.get(p["ns"], {}).pop(p["key"], None) is not None
+            found = self.kv.get(p["ns"], {}).pop(p["key"], None) is not None
+            if found and self.pstore is not None:
+                self.pstore.kv_del(p["ns"], p["key"])
+        return found
 
     def h_kv_keys(self, conn, p):
         prefix = p.get("prefix", "")
@@ -399,6 +512,8 @@ class ControlServer:
     def h_register_function(self, conn, p):
         with self.lock:
             self.functions[p["function_id"]] = p["blob"]
+        if self.pstore is not None:
+            self.pstore.rec_put("function", p["function_id"], p["blob"])
         return True
 
     def h_get_function(self, conn, p):
@@ -409,6 +524,8 @@ class ControlServer:
         with self.lock:
             self.jobs[p["job_id"]] = {"start_time": time.time(), **p}
         conn.meta["job_id"] = p["job_id"]
+        if self.pstore is not None:
+            self.pstore.rec_put("job", p["job_id"], self.jobs[p["job_id"]])
         return True
 
     # -- pubsub ------------------------------------------------------------
@@ -463,8 +580,17 @@ class ControlServer:
         )
         rec.class_name = p.get("class_name", "")
         with self.lock:
+            # idempotent on actor_id: clients retry blindly after a
+            # control-plane reconnect, and the first attempt may have
+            # registered (and persisted) the record before the reply
+            # was lost
+            existing = self.actors.get(rec.actor_id)
+            if existing is not None:
+                d.resolve(existing.view())
+                return
             if rec.name:
-                if rec.name in self.named_actors:
+                if self.named_actors.get(rec.name, rec.actor_id) \
+                        != rec.actor_id:
                     d.reject(f"actor name {rec.name!r} already taken")
                     return
                 self.named_actors[rec.name] = rec.actor_id
@@ -473,6 +599,7 @@ class ControlServer:
         # actor is scheduled; the caller learns placement via
         # wait_actor_alive / pubsub) — an unschedulable actor stays
         # PENDING as autoscaler demand instead of failing fast
+        self._persist_actor(rec)
         d.resolve(rec.view())
         self._schedule_actor(rec, None)
 
@@ -621,6 +748,7 @@ class ControlServer:
                 self._kill_actor_worker(kill_on, aid,
                                         worker_addr=p.get("worker_addr"))
             return True
+        self._persist_actor(rec)
         self.publish("actor", {"event": "alive" if not p.get("error") else "dead",
                                "actor": view})
         return True
@@ -650,6 +778,7 @@ class ControlServer:
                 rec.error = error
                 view = rec.view()
                 restart = False
+        self._persist_actor(self.actors[aid])
         self.publish("actor", {"event": "restarting" if restart else "dead", "actor": view})
         if restart:
             self.pool.submit(self._schedule_actor, self.actors[aid], None)
@@ -712,6 +841,8 @@ class ControlServer:
                         self.named_actors.pop(rec.name, None)
                 nid = rec.node_id
                 view = rec.view()
+            if no_restart:
+                self._persist_actor(rec)
             if nid:
                 self._kill_actor_worker(nid, aid)
             if no_restart:
@@ -727,7 +858,13 @@ class ControlServer:
         rec = PlacementGroupRecord(p["pg_id"], bundles, p.get("strategy", "PACK"),
                                    p.get("name", ""))
         with self.lock:
+            existing = self.pgs.get(rec.pg_id)
+            if existing is not None:
+                # blind client retry after reconnect: never double-reserve
+                d.resolve(existing.view())
+                return
             self.pgs[rec.pg_id] = rec
+        self._persist_pg(rec)
         self.pool.submit(self._schedule_pg, rec, d)
 
     def _schedule_pg(self, rec: PlacementGroupRecord, d: Deferred):
@@ -767,6 +904,7 @@ class ControlServer:
                     with self.lock:
                         rec.assignments = dict(plan_result)
                         rec.state = ALIVE
+                    self._persist_pg(rec)
                     self.publish("pg", {"event": "alive", "pg": rec.view()})
                     d.resolve(rec.view())
                     return
@@ -782,6 +920,7 @@ class ControlServer:
             if time.monotonic() > deadline:
                 with self.lock:
                     rec.state = DEAD
+                self._persist_pg(rec)
                 d.resolve(rec.view())
                 return
             time.sleep(0.2)
@@ -874,6 +1013,7 @@ class ControlServer:
                     return
                 rec.state = DEAD
                 assignments = dict(rec.assignments)
+            self._persist_pg(rec)
             for idx, nid in assignments.items():
                 cli = self._node_client(nid)
                 if cli:
@@ -1012,10 +1152,13 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--persist", default=None,
+                    help="sqlite path for durable control-plane state "
+                         "(GCS fault-tolerance equivalent)")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s control %(levelname)s %(message)s")
-    srv = ControlServer(args.host, args.port)
+    srv = ControlServer(args.host, args.port, persist_path=args.persist)
     srv.start(block=True)
 
 

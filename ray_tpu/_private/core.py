@@ -59,6 +59,27 @@ def current_core() -> "CoreWorker":
     return _current_core
 
 
+def raise_stored(err: BaseException) -> None:
+    """Raise a stored (in-process-store) exception without mutating it.
+
+    Raising the stored object directly would attach the caller's frames
+    to its ``__traceback__``, creating an uncollectable cycle rooted in
+    the owner's object table (entry → error → traceback → caller frame →
+    ObjectRef → entry): the frame's ObjectRefs never hit refcount zero,
+    so the entry — and anything else the frame holds, like actor handles
+    — leaks for the life of the process."""
+    import copy as _copy
+
+    try:
+        clone = _copy.copy(err)
+        clone.__traceback__ = None
+        clone.__cause__ = err.__cause__
+        clone.__suppress_context__ = True
+    except Exception:
+        clone = err
+    raise clone
+
+
 class ObjectRef:
     """Handle to a (possibly pending) object.  Owner-based, like the
     reference's ObjectRef + ownership protocol."""
@@ -164,6 +185,7 @@ class LeasedWorker:
         self.raylet_addr = raylet_addr
         self.client: Client = client
         self.inflight: Set[str] = set()
+        self.inflight_since: Dict[str, float] = {}  # task_id -> push ts
         self.idle_since = time.monotonic()
 
 
@@ -233,6 +255,7 @@ class CoreWorker:
         self.server.start()
         self.addr = self.server.addr
 
+        self.control_addr = tuple(control_addr)
         self.control = Client(control_addr, name=f"{mode}->control",
                               on_push=self._on_control_push)
         self.raylet: Optional[Client] = None
@@ -289,7 +312,54 @@ class CoreWorker:
         self._delete_thread = threading.Thread(
             target=self._delete_loop, name="core-object-reaper", daemon=True)
         self._delete_thread.start()
+        # claim the process-global slot stack-wise: a scoped CoreWorker
+        # (e.g. a test driver against its own cluster) restores the
+        # previous live core on shutdown instead of stranding it
+        self._prev_current_core = _current_core
         _current_core = self
+
+    def _control_call(self, method, payload=None, timeout=30.0):
+        """Control RPC with one reconnect-and-retry on connection loss.
+        With a persistent control plane (reference: GCS fault tolerance)
+        the daemon restarts at the same address and clients re-attach."""
+        try:
+            return self.control.call(method, payload, timeout=timeout)
+        except (ConnectionLost, OSError):
+            if self._shutdown:
+                raise
+            self._rebuild_control()
+            return self.control.call(method, payload, timeout=timeout)
+
+    def _rebuild_control(self):
+        with self.lock:
+            if self.control is not None and not self.control.closed:
+                return  # someone else already re-attached
+        grace = float(os.environ.get("RAY_TPU_CONTROL_RECONNECT_S", "20"))
+        deadline = time.monotonic() + grace
+        last: Optional[BaseException] = None
+        while time.monotonic() < deadline and not self._shutdown:
+            try:
+                cli = Client(self.control_addr,
+                             name=f"{self.mode}->control(re)",
+                             on_push=self._on_control_push,
+                             connect_timeout=2.0)
+                if self.mode == "driver":
+                    cli.call("register_job", {"job_id": self.job_id,
+                                              "driver_pid": os.getpid()})
+                cli.call("subscribe", {"topics": ["actor", "node"]})
+                with self.lock:
+                    old, self.control = self.control, cli
+                if hasattr(self.task_events, "_client"):
+                    self.task_events._client = cli
+                if old is not None:
+                    old.close()
+                logger.info("re-attached to control plane at %s",
+                            self.control_addr)
+                return
+            except Exception as e:
+                last = e
+                time.sleep(0.5)
+        raise ConnectionLost(f"control plane unreachable: {last}")
 
     def _delete_loop(self):
         while not self._shutdown:
@@ -334,7 +404,9 @@ class CoreWorker:
         self._shutdown = True
         global _current_core
         if _current_core is self:
-            _current_core = None
+            prev = self._prev_current_core
+            _current_core = prev if (prev is not None
+                                     and not prev._shutdown) else None
         with self.lock:
             pools = list(self.pools.values())
             actors = list(self.actors.values())
@@ -458,7 +530,7 @@ class CoreWorker:
         if not entry.event.wait(self._remaining(deadline)):
             raise GetTimeoutError(f"get() timed out waiting for {ref.id}")
         if entry.error is not None:
-            raise entry.error
+            raise_stored(entry.error)
         if entry.has_value:
             return entry.value
         if entry.shm_node is not None:
@@ -521,7 +593,7 @@ class CoreWorker:
         if not entry.event.wait(self._remaining(deadline)):
             raise GetTimeoutError(f"timed out reconstructing {oid}")
         if entry.error is not None:
-            raise entry.error
+            raise_stored(entry.error)
         if entry.has_value:
             return entry.value
         return self._read_shm_value(oid, entry, deadline)
@@ -799,7 +871,7 @@ class CoreWorker:
                 self.registered_functions.add(fid)
                 self.functions[fid] = fn
         if new:
-            self.control.call("register_function", {"function_id": fid, "blob": blob})
+            self._control_call("register_function", {"function_id": fid, "blob": blob})
         out = (fid, getattr(fn, "__qualname__", str(fn)))
         try:
             self._fn_registration_cache[fn] = out
@@ -812,7 +884,7 @@ class CoreWorker:
             fn = self.functions.get(fid)
         if fn is not None:
             return fn
-        blob = self.control.call("get_function", {"function_id": fid}, timeout=30.0)
+        blob = self._control_call("get_function", {"function_id": fid}, timeout=30.0)
         if blob is None:
             raise RuntimeError(f"function {fid} not found in cluster function table")
         fn = cloudpickle.loads(blob)
@@ -893,32 +965,44 @@ class CoreWorker:
             while pool.queue:
                 lw = self._pick_lease(pool)
                 if lw is None:
-                    # aim for one lease per queued task (max parallelism);
-                    # pipelining onto existing leases covers the gap while
-                    # the cluster can't grant that many
+                    # every lease is saturated (or stalled on a slow task):
+                    # aim for one outstanding lease request per queued task
+                    # so queued work can run in parallel instead of
+                    # stacking behind busy workers
                     needed = len(pool.queue)
-                    have = len(pool.leases) + pool.pending_requests
-                    if have < min(needed, 64):
+                    if pool.pending_requests < min(needed, 64):
                         pool.pending_requests += 1
                         request_new = True
                     break
                 rec = pool.queue.popleft()
                 rec.pushed_to = lw.worker_id
                 lw.inflight.add(rec.spec.task_id)
+                lw.inflight_since[rec.spec.task_id] = time.monotonic()
                 to_push.append((lw, rec))
         for lw, rec in to_push:
             self._push_task(lw, rec, pool)
         if request_new:
             self.pool_executor.submit(self._request_lease, pool)
 
+    PIPELINE_STALL_S = 0.1
+
     def _pick_lease(self, pool: SchedPool) -> Optional[LeasedWorker]:
         best, best_n = None, None
         depth = pool.depth()
+        now = time.monotonic()
         for lw in list(pool.leases.values()):
             if lw.client is not None and lw.client.closed:
                 pool.leases.pop(lw.worker_id, None)
                 continue
             n = len(lw.inflight)
+            # The EWMA depth is a *prediction*; a worker whose oldest
+            # in-flight task has already overrun it is evidence the
+            # prediction is stale (e.g. a long task after a burst of tiny
+            # ones).  Don't stack more work behind it — the caller will
+            # lease another worker instead.
+            if n and lw.inflight_since and \
+                    now - min(lw.inflight_since.values()) > self.PIPELINE_STALL_S:
+                continue
             if n < depth and (best_n is None or n < best_n):
                 best, best_n = lw, n
         return best
@@ -937,7 +1021,7 @@ class CoreWorker:
             if pg_id:
                 strategy = {"kind": "placement_group", "pg_id": pg_id,
                             "bundle_index": bundle_index}
-            picked = self.control.call("pick_node", {
+            picked = self._control_call("pick_node", {
                 "resources": common.denormalize_resources(dict(resources)),
                 "strategy": strategy,
             }, timeout=30.0)
@@ -1003,6 +1087,7 @@ class CoreWorker:
     def _on_task_reply(self, pool, lw: LeasedWorker, rec: TaskRecord, reply):
         with self.lock:
             lw.inflight.discard(rec.spec.task_id)
+            lw.inflight_since.pop(rec.spec.task_id, None)
             lw.idle_since = time.monotonic()
             ms = reply.get("exec_ms")
             if ms is not None:
@@ -1045,6 +1130,7 @@ class CoreWorker:
         (reference: TaskManager retry bookkeeping, task_manager.h:208)."""
         with self.lock:
             lw.inflight.discard(rec.spec.task_id)
+            lw.inflight_since.pop(rec.spec.task_id, None)
             if lw.client is not None and lw.client.closed:
                 pool.leases.pop(lw.worker_id, None)
         if rec.retries_left > 0 and not self._shutdown:
@@ -1073,6 +1159,7 @@ class CoreWorker:
             pool.leases.pop(lw.worker_id, None)
             lost = list(lw.inflight)
             lw.inflight.clear()
+            lw.inflight_since.clear()
         # tasks whose replies will never come are retried by their pending
         # futures erroring out (ConnectionLost) via _on_task_failure
 
@@ -1128,7 +1215,7 @@ class CoreWorker:
         ac.max_task_retries = max_task_retries
         with self.lock:
             self.actors[aid] = ac
-        self.control.call("create_actor", {
+        self._control_call("create_actor", {
             "actor_id": aid,
             "spec_blob": cloudpickle.dumps(spec),
             "name": name,
@@ -1163,7 +1250,7 @@ class CoreWorker:
             # (callers bound their own waits via get(timeout)); only a
             # DEAD/missing actor is fatal
             while not self._shutdown:
-                view = self.control.call(
+                view = self._control_call(
                     "wait_actor_alive",
                     {"actor_id": actor_id, "timeout": 60.0,
                      "min_incarnation": min_incarnation},
@@ -1312,7 +1399,7 @@ class CoreWorker:
         def recover():
             view = None
             try:
-                view = self.control.call(
+                view = self._control_call(
                     "wait_actor_alive",
                     {"actor_id": actor_id, "timeout": 60.0,
                      "min_incarnation": next_inc},
@@ -1351,11 +1438,11 @@ class CoreWorker:
                     e.event.set()
 
     def kill_actor(self, actor_id: str, no_restart: bool = True):
-        self.control.call("kill_actor", {"actor_id": actor_id,
+        self._control_call("kill_actor", {"actor_id": actor_id,
                                          "no_restart": no_restart}, timeout=30.0)
 
     def get_actor_by_name(self, name: str):
-        view = self.control.call("get_actor", {"name": name}, timeout=30.0)
+        view = self._control_call("get_actor", {"name": name}, timeout=30.0)
         return view
 
     # ------------------------------------------------------------------

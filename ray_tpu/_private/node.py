@@ -86,6 +86,7 @@ class Raylet:
         self.bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}  # (pg,idx)->{resources,state}
         self._next_token = 0
         self._stop = threading.Event()
+        self._reconnecting = threading.Semaphore(1)
         self.control: Optional[Client] = None
         self.peer_clients: Dict[Tuple[str, int], Client] = {}
         self.max_workers = max(
@@ -191,9 +192,53 @@ class Raylet:
             self.shutdown()
 
     def _on_control_lost(self):
-        if not self._stop.is_set():
-            logger.warning("control plane connection lost; shutting down raylet")
-            self.shutdown()
+        """Control connection dropped.  With a persistent control plane the
+        daemon comes back at the same address (reference: GCS fault
+        tolerance — raylets reconnect and re-sync rather than exiting);
+        retry for a grace window before giving up."""
+        if self._stop.is_set():
+            return
+        # closing a superseded client re-fires this callback: only react
+        # when the *current* control client is actually down, one
+        # reconnector at a time
+        if self.control is not None and not self.control.closed:
+            return
+        if not self._reconnecting.acquire(blocking=False):
+            return
+        grace = float(os.environ.get("RAY_TPU_CONTROL_RECONNECT_S", "20"))
+        threading.Thread(target=self._reconnect_control, args=(grace,),
+                         name="raylet-reconnect", daemon=True).start()
+
+    def _reconnect_control(self, grace: float):
+        try:
+            deadline = time.monotonic() + grace
+            logger.warning("control connection lost; retrying for %.0fs",
+                           grace)
+            while not self._stop.is_set() and time.monotonic() < deadline:
+                try:
+                    cli = Client(self.control_addr, name="raylet->control",
+                                 on_disconnect=self._on_control_lost,
+                                 connect_timeout=2.0)
+                    cli.call("ping", timeout=5.0)
+                except Exception:
+                    time.sleep(0.5)
+                    continue
+                old, self.control = self.control, cli
+                if old is not None:
+                    old.close()
+                # the restarted control has no node table entry for us:
+                # full re-register with a clean actor slate (it will
+                # reschedule restored actors)
+                self._resurrect()
+                logger.info("reconnected to control plane at %s",
+                            self.control_addr)
+                return
+            if not self._stop.is_set():
+                logger.warning("control did not come back within %.0fs; "
+                               "shutting down raylet", grace)
+                self.shutdown()
+        finally:
+            self._reconnecting.release()
 
     def shutdown(self):
         if self._stop.is_set():

@@ -82,6 +82,9 @@ class _ControllerTableCache:
     def invalidate(self):
         self._ts = 0.0
 
+    def fresh(self) -> bool:
+        return time.monotonic() - self._ts <= _ROUTES_TTL_S
+
     def get(self) -> Dict[str, Any]:
         """Blocking controller RPC on miss — callers on an event loop must
         run this in an executor."""
@@ -144,8 +147,11 @@ class HTTPProxy:
             self._started.set()
 
     async def _route_for(self, path: str) -> Optional[Dict[str, str]]:
-        routes = await asyncio.get_event_loop().run_in_executor(
-            None, self._table.get)
+        if self._table.fresh():
+            routes = self._table._value  # hot path: no executor hop
+        else:
+            routes = await asyncio.get_event_loop().run_in_executor(
+                None, self._table.get)
         best = None
         for prefix, target in routes.items():
             if path == prefix or path.startswith(

@@ -66,17 +66,21 @@ def test_gcp_tpu_provider_slice_model():
     assert p.non_terminated_nodes({}) == []
 
 
-def test_autoscaler_scales_up_and_down():
+def test_autoscaler_scales_up_and_down(multi_node_cluster):
     """End-to-end: local provider launches real raylets; pending actors
-    drive scale-up; idleness drives scale-down."""
-    owned = not ray_tpu.is_initialized()
-    if owned:
-        ray_tpu.init(num_cpus=1)
-    try:
-        from ray_tpu._private.api import current_core
+    drive scale-up; idleness drives scale-down.
 
-        control = current_core().control
-        addr = ray_tpu.connection_info()["control_address"]
+    Uses its own 1-CPU-head cluster — the scale-up assertion depends on
+    the head NOT having room for the 2-CPU actors, so reusing a shared
+    session cluster (4-CPU head) would make the demand vanish."""
+    from ray_tpu._private.core import CoreWorker
+
+    c = multi_node_cluster()
+    head = c.add_node(resources={"CPU": 1})
+    core = CoreWorker(c.control_addr, head.addr, mode="driver")
+    try:
+        control = core.control
+        addr = f"{c.control_addr[0]}:{c.control_addr[1]}"
         provider = LocalNodeProvider({"control_address": addr}, "t")
         autoscaler = StandardAutoscaler(
             {"max_workers": 3, "idle_timeout_minutes": 0.02,  # 1.2 s
@@ -90,22 +94,23 @@ def test_autoscaler_scales_up_and_down():
         autoscaler.update()
         assert autoscaler.num_launches == 0
 
-        # demand half a node more than the head has
-        @ray_tpu.remote(num_cpus=2)
+        # demand more than the 1-CPU head can hold
         class Big:
             def ping(self):
                 return 1
 
-        actors = [Big.remote() for _ in range(2)]
+        aids = [core.create_actor(Big, (), {}, resources={"CPU": 2})
+                for _ in range(2)]
         time.sleep(0.5)
         autoscaler.update()
         assert autoscaler.num_launches >= 1
         # the actors eventually schedule on the new nodes
-        ray_tpu.get([a.ping.remote() for a in actors], timeout=60)
+        refs = [core.submit_actor_task(a, "ping", (), {})[0] for a in aids]
+        assert core.get(refs, timeout=120) == [1, 1]
 
         # release demand -> idle timeout -> scale down to min (0)
-        for a in actors:
-            ray_tpu.kill(a)
+        for a in aids:
+            core.kill_actor(a)
         deadline = time.time() + 30
         while time.time() < deadline:
             autoscaler.update()
@@ -115,5 +120,4 @@ def test_autoscaler_scales_up_and_down():
         assert autoscaler.num_terminations >= 1
         provider.shutdown()
     finally:
-        if owned:
-            ray_tpu.shutdown()
+        core.shutdown()
