@@ -305,7 +305,8 @@ class ControlServer:
                 rec.state = DEAD
                 continue
             rec.state = PENDING
-            self.pool.submit(self._schedule_pg, rec, _NullDeferred())
+            self.pool.submit(self._schedule_pg, rec, _NullDeferred(),
+                             600.0, False)
             n_pgs += 1
         if n_actors or n_pgs or self.kv or self.functions:
             logger.info(
@@ -867,11 +868,13 @@ class ControlServer:
         self._persist_pg(rec)
         self.pool.submit(self._schedule_pg, rec, d)
 
-    def _schedule_pg(self, rec: PlacementGroupRecord, d: Deferred):
+    def _schedule_pg(self, rec: PlacementGroupRecord, d: Deferred,
+                     deadline_s: float = 60.0,
+                     fail_on_timeout: bool = True):
         """2-phase bundle reservation: PREPARE on every chosen node, then
         COMMIT; release everything on any failure (reference:
         placement_group_resource_manager.h:54-61)."""
-        deadline = time.monotonic() + 60.0
+        deadline = time.monotonic() + deadline_s
         while not self._stop.is_set():
             plan_result = self._plan_pg(rec)
             if plan_result is not None:
@@ -918,6 +921,12 @@ class ControlServer:
                         except Exception:
                             pass
             if time.monotonic() > deadline:
+                if not fail_on_timeout:
+                    # boot-restored PG: stay PENDING — nodes may still be
+                    # rejoining after the control restart, and killing a
+                    # previously-healthy group would strand its actors
+                    d.resolve(rec.view())
+                    return
                 with self.lock:
                     rec.state = DEAD
                 self._persist_pg(rec)

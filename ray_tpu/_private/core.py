@@ -252,6 +252,12 @@ class CoreWorker:
         self.server.handle("add_ref", self.h_add_ref)
         self.server.handle("del_ref", self.h_del_ref)
         self.server.handle("ping", lambda c, p: "pong")
+        # on-demand profiling RPCs (reference: dashboard reporter agent's
+        # py-spy/memray endpoints, profile_manager.py:82)
+        from . import profiling
+
+        profiling.install_handlers(self.server)
+        profiling.maybe_start_tracemalloc()
         self.server.start()
         self.addr = self.server.addr
 
@@ -322,17 +328,21 @@ class CoreWorker:
         """Control RPC with one reconnect-and-retry on connection loss.
         With a persistent control plane (reference: GCS fault tolerance)
         the daemon restarts at the same address and clients re-attach."""
+        cli = self.control
         try:
-            return self.control.call(method, payload, timeout=timeout)
+            return cli.call(method, payload, timeout=timeout)
         except (ConnectionLost, OSError):
             if self._shutdown:
                 raise
-            self._rebuild_control()
+            self._rebuild_control(cli)
             return self.control.call(method, payload, timeout=timeout)
 
-    def _rebuild_control(self):
+    def _rebuild_control(self, failed_client=None):
         with self.lock:
-            if self.control is not None and not self.control.closed:
+            # compare by identity, not by .closed: a send-path failure
+            # (EPIPE in call) can precede the reader thread marking the
+            # client closed — the caller's client is dead either way
+            if failed_client is not None and self.control is not failed_client:
                 return  # someone else already re-attached
         grace = float(os.environ.get("RAY_TPU_CONTROL_RECONNECT_S", "20"))
         deadline = time.monotonic() + grace
@@ -913,7 +923,8 @@ class CoreWorker:
             function_name=name or fname,
             args_blob=self.serialize_args(args, kwargs),
             num_returns=num_returns,
-            resources=normalize_resources(resources or {common.CPU: 1}),
+            resources=normalize_resources(
+                {common.CPU: 1} if resources is None else resources),
             max_retries=max_retries,
             scheduling_strategy=strategy,
             placement_group_id=pg,
@@ -990,18 +1001,20 @@ class CoreWorker:
         best, best_n = None, None
         depth = pool.depth()
         now = time.monotonic()
+        # The EWMA depth is a *prediction*; a worker whose oldest
+        # in-flight task has overrun the expected full-pipeline drain
+        # time (2x slack) is evidence the prediction is stale — e.g. a
+        # long task after a burst of tiny ones.  Don't stack more work
+        # behind it; the caller leases another worker instead.
+        stall_s = max(self.PIPELINE_STALL_S,
+                      (pool.avg_ms or 0.0) * depth * 2 / 1000.0)
         for lw in list(pool.leases.values()):
             if lw.client is not None and lw.client.closed:
                 pool.leases.pop(lw.worker_id, None)
                 continue
             n = len(lw.inflight)
-            # The EWMA depth is a *prediction*; a worker whose oldest
-            # in-flight task has already overrun it is evidence the
-            # prediction is stale (e.g. a long task after a burst of tiny
-            # ones).  Don't stack more work behind it — the caller will
-            # lease another worker instead.
             if n and lw.inflight_since and \
-                    now - min(lw.inflight_since.values()) > self.PIPELINE_STALL_S:
+                    now - min(lw.inflight_since.values()) > stall_s:
                 continue
             if n < depth and (best_n is None or n < best_n):
                 best, best_n = lw, n
@@ -1220,7 +1233,7 @@ class CoreWorker:
             "spec_blob": cloudpickle.dumps(spec),
             "name": name,
             "class_name": getattr(cls, "__name__", "Actor"),
-            "resources": resources or {common.CPU: 1},
+            "resources": {common.CPU: 1} if resources is None else resources,
             "max_restarts": max_restarts,
             "owner_id": self.worker_id,
             "pg_id": pg,
@@ -1332,6 +1345,23 @@ class CoreWorker:
         self.task_events.record_status(
             spec.task_id, "PENDING_ARGS_AVAIL", name=method_name,
             actor_id=actor_id, extra={"type": "ACTOR_TASK"})
+        # A locally-DEAD conn may be stale: during control-plane failover
+        # the conn can be marked dead (lost worker + transient control
+        # unavailability) while the restored control has since restarted
+        # the actor.  Re-check the authoritative record once and revive
+        # the conn if the actor is in fact coming back.
+        if ac.state == "DEAD":
+            try:
+                view = self._control_call(
+                    "get_actor", {"actor_id": actor_id}, timeout=10.0)
+            except Exception:
+                view = None
+            if view and view["state"] in ("ALIVE", "RESTARTING", "PENDING"):
+                with ac.lock:
+                    if ac.state == "DEAD":
+                        ac.state = "RECONNECTING"
+                        ac.dead_error = None
+                        ac.client = None
         # single critical section decides buffer vs send (no double-send
         # race with _resolve_actor's buffer flush)
         with ac.lock:

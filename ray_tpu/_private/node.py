@@ -87,6 +87,8 @@ class Raylet:
         self._next_token = 0
         self._stop = threading.Event()
         self._reconnecting = threading.Semaphore(1)
+        self._resurrect_lock = threading.Lock()
+        self._registered_at = 0.0
         self.control: Optional[Client] = None
         self.peer_clients: Dict[Tuple[str, int], Client] = {}
         self.max_workers = max(
@@ -175,6 +177,7 @@ class Raylet:
             "resources": common.denormalize_resources(self.total),
             "labels": self.labels,
         }, timeout=30.0)
+        self._registered_at = time.monotonic()
         self._grant_thread.start()
         self._hb_thread.start()
         self._reap_thread.start()
@@ -352,7 +355,15 @@ class Raylet:
             return
         with self.lock:
             rec = self.workers.get(wid)
-            if rec is None or rec.state == "dead":
+            if rec is None:
+                return
+            if rec.state == "dead":
+                # killed via a kill path that already handled resources —
+                # the record must still leave the table, or it counts
+                # against max_workers forever and eventually starves all
+                # worker spawning
+                self.workers.pop(wid, None)
+                self.workers_by_token.pop(rec.token, None)
                 return
             was = rec.state
             actor_id = rec.actor_id
@@ -380,17 +391,26 @@ class Raylet:
             time.sleep(1.0)
             with self.lock:
                 for rec in list(self.workers.values()):
-                    if rec.proc is not None and rec.proc.poll() is not None \
-                            and rec.state == "starting":
+                    if rec.proc is None or rec.proc.poll() is None:
+                        continue
+                    if rec.state == "starting":
                         # died before registering
-                        logger.warning("worker %s died during startup", rec.worker_id[:12])
+                        logger.warning("worker %s died during startup",
+                                       rec.worker_id[:12])
+                        self.workers.pop(rec.worker_id, None)
+                        self.workers_by_token.pop(rec.token, None)
+                    elif rec.state == "dead":
+                        # kill paths own the resource bookkeeping; the
+                        # reaper only retires the record (backstop for
+                        # workers whose conn never fires h_disconnect)
                         self.workers.pop(rec.worker_id, None)
                         self.workers_by_token.pop(rec.token, None)
 
     # -- leases ------------------------------------------------------------
 
     def h_request_lease(self, conn, p, d: Deferred):
-        demand = normalize_resources(p.get("resources") or {common.CPU: 1})
+        res = p.get("resources")
+        demand = normalize_resources({common.CPU: 1} if res is None else res)
         bundle = p.get("bundle")  # (pg_id, index) -> draw from bundle reservation
         if bundle is not None:
             bundle = (bundle[0], bundle[1])
@@ -817,6 +837,7 @@ class Raylet:
                 "actor_id": r.actor_id,
                 "node_id": self.node_id,
                 "tpu": r.tpu,
+                "addr": r.addr,  # core server: get_object + profiling RPCs
             } for r in self.workers.values()]
 
     def h_node_info(self, conn, p):
@@ -866,11 +887,18 @@ class Raylet:
                 with self.lock:
                     avail = common.denormalize_resources(
                         {k: max(v, 0) for k, v in self.available.items()})
+                sent = time.monotonic()
                 r = self.control.call("heartbeat", {
                     "node_id": self.node_id, "available": avail,
                 }, timeout=5.0)
                 if r and not r.get("ok") and r.get("reregister"):
-                    self._resurrect()
+                    # a heartbeat that raced with a concurrent re-register
+                    # (e.g. the reconnect thread after a control restart)
+                    # may be rejected even though we ARE registered now —
+                    # resurrecting again would reap actors the restored
+                    # control just placed here
+                    if self._registered_at < sent:
+                        self._resurrect()
             except Exception:
                 if not self._stop.is_set():
                     logger.warning("heartbeat to control failed")
@@ -881,7 +909,15 @@ class Raylet:
         heartbeat thread stalled past the death timeout.  The reference
         raylet exits and gets restarted; we do the in-process equivalent:
         reap actor workers (the control already restarted those actors
-        elsewhere), reset accounting to a clean slate, re-register."""
+        elsewhere), reset accounting to a clean slate, re-register.
+
+        Serialized: concurrent resurrects (reconnect thread + heartbeat
+        rejection) would otherwise reap actor workers placed right after
+        the first re-registration."""
+        with self._resurrect_lock:
+            self._resurrect_locked()
+
+    def _resurrect_locked(self):
         logger.warning("declared dead by control; resurrecting %s",
                        self.node_id[:12])
         with self.lock:
@@ -911,6 +947,7 @@ class Raylet:
                 "resources": common.denormalize_resources(self.total),
                 "labels": self.labels,
             }, timeout=30.0)
+            self._registered_at = time.monotonic()
         except Exception:
             logger.warning("re-registration failed; will retry on next "
                            "heartbeat")

@@ -4,9 +4,13 @@ from .autoscaler import (LoadMetrics, Monitor, ResourceDemandScheduler,
                          StandardAutoscaler)
 from .node_provider import (GCPTpuNodeProvider, LocalNodeProvider,
                             NodeProvider)
+from .v2 import (AutoscalerV2, Instance, InstanceManager, InstanceStorage,
+                 Reconciler)
 
 __all__ = [
     "StandardAutoscaler", "Monitor", "LoadMetrics",
     "ResourceDemandScheduler", "NodeProvider", "LocalNodeProvider",
     "GCPTpuNodeProvider",
+    "AutoscalerV2", "Instance", "InstanceManager", "InstanceStorage",
+    "Reconciler",
 ]

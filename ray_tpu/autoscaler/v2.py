@@ -1,0 +1,324 @@
+"""Autoscaler v2: declarative instance-manager + reconciler.
+
+Reference parity: python/ray/autoscaler/v2 — `InstanceStorage` (versioned
+instance table, instance_manager/instance_storage.py), `InstanceManager`
+(update-based mutations, instance_manager/instance_manager.py), and the
+`Reconciler` (instance_manager/reconciler.py) that drives each instance
+through its lifecycle:
+
+    QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING
+           -> RAY_STOPPING -> TERMINATING -> TERMINATED
+
+Unlike v1's imperative loop (autoscaler.py StandardAutoscaler), v2 first
+declares a *target* instance set from resource demand, records it, and
+then reconciles observed cloud/node state against the declared state —
+so a crashed autoscaler resumes from its instance table instead of
+re-deriving intent from scratch.
+
+The cloud layer is the same NodeProvider interface as v1; the demand
+scheduler is reused from v1 (ResourceDemandScheduler).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .autoscaler import LoadMetrics, ResourceDemandScheduler
+from .node_provider import (NodeProvider, TAG_NODE_KIND, TAG_NODE_STATUS,
+                            TAG_NODE_TYPE)
+
+logger = logging.getLogger(__name__)
+
+# instance lifecycle states (reference: instance_manager.proto Instance)
+QUEUED = "QUEUED"
+REQUESTED = "REQUESTED"
+ALLOCATED = "ALLOCATED"
+RAY_RUNNING = "RAY_RUNNING"
+RAY_STOPPING = "RAY_STOPPING"
+TERMINATING = "TERMINATING"
+TERMINATED = "TERMINATED"
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    instance_type: str
+    status: str = QUEUED
+    cloud_instance_id: Optional[str] = None
+    node_id: Optional[str] = None  # control-plane node id once running
+    launch_request_id: str = ""
+    status_since: float = field(default_factory=time.monotonic)
+    version: int = 0
+
+    def transition(self, status: str):
+        self.status = status
+        self.status_since = time.monotonic()
+
+
+class InstanceStorage:
+    """Versioned instance table (reference: instance_storage.py).
+
+    Every batch upsert carries the expected table version; a stale writer
+    gets a conflict instead of silently clobbering a concurrent update.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instances: Dict[str, Instance] = {}
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def batch_upsert(self, instances: List[Instance],
+                     expected_version: Optional[int] = None
+                     ) -> Tuple[bool, int]:
+        with self._lock:
+            if expected_version is not None \
+                    and expected_version != self._version:
+                return False, self._version
+            self._version += 1
+            for inst in instances:
+                inst.version = self._version
+                self._instances[inst.instance_id] = inst
+            return True, self._version
+
+    def delete(self, instance_ids: List[str]) -> None:
+        with self._lock:
+            for iid in instance_ids:
+                self._instances.pop(iid, None)
+            self._version += 1
+
+    def get_instances(self, statuses: Optional[List[str]] = None
+                      ) -> Dict[str, Instance]:
+        with self._lock:
+            return {iid: inst for iid, inst in self._instances.items()
+                    if statuses is None or inst.status in statuses}
+
+
+class InstanceManager:
+    """Update-based mutations over the instance table (reference:
+    instance_manager.py — callers submit status transitions; direct table
+    writes are not exposed)."""
+
+    def __init__(self, storage: Optional[InstanceStorage] = None):
+        self.storage = storage or InstanceStorage()
+
+    def add_instances(self, instance_type: str, count: int,
+                      launch_request_id: Optional[str] = None
+                      ) -> List[Instance]:
+        rid = launch_request_id or uuid.uuid4().hex[:12]
+        instances = [
+            Instance(instance_id=f"inst-{uuid.uuid4().hex[:12]}",
+                     instance_type=instance_type,
+                     launch_request_id=rid)
+            for _ in range(count)
+        ]
+        self.storage.batch_upsert(instances)
+        return instances
+
+    def update_status(self, instance_id: str, status: str, **fields) -> bool:
+        insts = self.storage.get_instances()
+        inst = insts.get(instance_id)
+        if inst is None:
+            return False
+        inst.transition(status)
+        for k, v in fields.items():
+            setattr(inst, k, v)
+        self.storage.batch_upsert([inst])
+        return True
+
+
+class Reconciler:
+    """One reconciliation pass (reference: reconciler.py Reconcile):
+    observe cloud + cluster state, declare the target from demand, and
+    step every instance toward its goal state."""
+
+    def __init__(self, manager: InstanceManager, provider: NodeProvider,
+                 scheduler: ResourceDemandScheduler,
+                 load_metrics: LoadMetrics,
+                 idle_timeout_s: float = 60.0,
+                 request_timeout_s: float = 300.0):
+        self.im = manager
+        self.provider = provider
+        self.scheduler = scheduler
+        self.load = load_metrics
+        self.idle_timeout_s = idle_timeout_s
+        self.request_timeout_s = request_timeout_s
+        self.num_launched = 0
+        self.num_terminated = 0
+
+    # -- observation --------------------------------------------------------
+
+    def _sync_cloud_state(self):
+        """Cloud says a requested instance now exists (or a tracked one
+        vanished) — move statuses accordingly."""
+        alive = set(self.provider.non_terminated_nodes({}))
+        for inst in self.im.storage.get_instances().values():
+            if inst.status in (REQUESTED,) and inst.cloud_instance_id \
+                    and inst.cloud_instance_id in alive:
+                self.im.update_status(inst.instance_id, ALLOCATED)
+            elif inst.status in (ALLOCATED, RAY_RUNNING) \
+                    and inst.cloud_instance_id not in alive:
+                # died under us (preemption, manual delete)
+                self.im.update_status(inst.instance_id, TERMINATED)
+            elif inst.status == TERMINATING \
+                    and inst.cloud_instance_id not in alive:
+                self.im.update_status(inst.instance_id, TERMINATED)
+                self.num_terminated += 1
+
+    def _sync_ray_state(self, snapshot: Dict[str, Any]):
+        """A control-plane node appeared on an allocated instance →
+        RAY_RUNNING (reference: Reconciler matching ray nodes to
+        instances by cloud id)."""
+        running_nodes = {n["node_id"] for n in snapshot.get("nodes", [])}
+        by_cloud = {}
+        for n in snapshot.get("nodes", []):
+            cid = (n.get("labels") or {}).get("cloud_instance_id")
+            if cid:
+                by_cloud[cid] = n["node_id"]
+        for inst in self.im.storage.get_instances([ALLOCATED]).values():
+            nid = by_cloud.get(inst.cloud_instance_id)
+            if nid is None and len(running_nodes) > 0 \
+                    and inst.cloud_instance_id in running_nodes:
+                nid = inst.cloud_instance_id
+            if nid is not None:
+                self.im.update_status(inst.instance_id, RAY_RUNNING,
+                                      node_id=nid)
+
+    # -- declaration --------------------------------------------------------
+
+    def _declare_target(self, snapshot: Dict[str, Any]):
+        """Compute instances to add from unmet demand (the declarative
+        step: we only *enqueue* here; launching happens in stepping)."""
+        pending_like = self.im.storage.get_instances(
+            [QUEUED, REQUESTED, ALLOCATED])
+        # feed the scheduler a view that includes instances on the way up
+        # so demand isn't double-counted into duplicate launches
+        snap = dict(snapshot)
+        extra_nodes = []
+        for inst in pending_like.values():
+            res = self.scheduler.node_types.get(
+                inst.instance_type, {}).get("resources", {})
+            extra_nodes.append({"node_id": inst.instance_id,
+                                "available": dict(res),
+                                "total": dict(res)})
+        snap["nodes"] = list(snapshot.get("nodes", [])) + extra_nodes
+        to_launch = self.scheduler.get_nodes_to_launch(
+            snap, self._counts_by_type())
+        for type_name, count in to_launch.items():
+            if count > 0:
+                self.im.add_instances(type_name, count)
+
+    def _counts_by_type(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for inst in self.im.storage.get_instances().values():
+            if inst.status not in (TERMINATED,):
+                counts[inst.instance_type] = \
+                    counts.get(inst.instance_type, 0) + 1
+        return counts
+
+    # -- stepping -----------------------------------------------------------
+
+    def _step_queued(self):
+        for inst in self.im.storage.get_instances([QUEUED]).values():
+            node_cfg = dict(self.scheduler.node_types.get(
+                inst.instance_type, {}))
+            try:
+                cloud_ids = self.provider.create_node(
+                    node_cfg, {TAG_NODE_KIND: "worker",
+                               TAG_NODE_TYPE: inst.instance_type,
+                               TAG_NODE_STATUS: "pending"}, 1)
+            except Exception as e:
+                logger.warning("launch of %s failed: %s",
+                               inst.instance_type, e)
+                continue
+            self.num_launched += 1
+            self.im.update_status(
+                inst.instance_id, REQUESTED,
+                cloud_instance_id=cloud_ids[0] if cloud_ids else None)
+
+    def _step_idle_termination(self, snapshot: Dict[str, Any]):
+        idle_s = snapshot.get("idle_s", {})
+        min_workers = {
+            t: cfg.get("min_workers", 0)
+            for t, cfg in self.scheduler.node_types.items()}
+        counts = self._counts_by_type()
+        for inst in self.im.storage.get_instances([RAY_RUNNING]).values():
+            node_idle = idle_s.get(inst.node_id, 0.0)
+            if node_idle < self.idle_timeout_s:
+                continue
+            if counts.get(inst.instance_type, 0) \
+                    <= min_workers.get(inst.instance_type, 0):
+                continue
+            counts[inst.instance_type] -= 1
+            self.im.update_status(inst.instance_id, RAY_STOPPING)
+
+    def _step_stopping(self):
+        for inst in self.im.storage.get_instances(
+                [RAY_STOPPING]).values():
+            try:
+                if inst.cloud_instance_id:
+                    self.provider.terminate_node(inst.cloud_instance_id)
+            except Exception as e:
+                logger.warning("terminate of %s failed: %s",
+                               inst.cloud_instance_id, e)
+                continue
+            self.im.update_status(inst.instance_id, TERMINATING)
+
+    def _step_stuck_requests(self):
+        """Requests that never allocated within the timeout are retried
+        (requeued) — reference: reconciler's stuck-instance handling."""
+        now = time.monotonic()
+        for inst in self.im.storage.get_instances([REQUESTED]).values():
+            if now - inst.status_since > self.request_timeout_s:
+                logger.warning("instance %s stuck in REQUESTED; requeueing",
+                               inst.instance_id)
+                self.im.update_status(inst.instance_id, QUEUED,
+                                      cloud_instance_id=None)
+
+    def _gc_terminated(self):
+        dead = list(self.im.storage.get_instances([TERMINATED]))
+        if dead:
+            self.im.storage.delete(dead)
+
+    def reconcile(self) -> None:
+        snapshot = self.load.snapshot()
+        self._sync_cloud_state()
+        self._sync_ray_state(snapshot)
+        self._declare_target(snapshot)
+        self._step_queued()
+        self._step_idle_termination(snapshot)
+        self._step_stopping()
+        self._step_stuck_requests()
+        self._gc_terminated()
+
+
+class AutoscalerV2:
+    """Facade wiring storage + manager + reconciler, mirroring
+    autoscaler/v2/autoscaler.py's composition."""
+
+    def __init__(self, config: Dict[str, Any], provider: NodeProvider,
+                 control_client):
+        node_types = config.get("available_node_types", {})
+        self.scheduler = ResourceDemandScheduler(
+            node_types, max_workers=config.get("max_workers", 8))
+        self.manager = InstanceManager()
+        self.reconciler = Reconciler(
+            self.manager, provider, self.scheduler,
+            LoadMetrics(control_client),
+            idle_timeout_s=config.get("idle_timeout_minutes", 1.0) * 60.0)
+
+    def update(self):
+        self.reconciler.reconcile()
+
+    @property
+    def instances(self) -> Dict[str, Instance]:
+        return self.manager.storage.get_instances()

@@ -102,6 +102,57 @@ class DashboardHead:
                     return self._json(c.per_node("store_stats"))
                 finally:
                     c.close()
+            if path == "/api/workers":
+                from ray_tpu.util.state.api import StateApiClient
+
+                c = StateApiClient(self.control_address)
+                try:
+                    return self._json(c.per_node("list_workers"))
+                finally:
+                    c.close()
+            if path in ("/api/profile/stacks", "/api/profile/cpu",
+                        "/api/profile/memory"):
+                # worker=host:port of the target's core server (from
+                # /api/workers).  Reference: reporter agent's py-spy /
+                # memray endpoints (profile_manager.py:82,:189).
+                from ray_tpu._private.protocol import Client
+                from ray_tpu.util.state.api import StateApiClient
+
+                waddr = query.get("worker", [""])[0]
+                try:
+                    whost, wport = waddr.rsplit(":", 1)
+                    target = (whost, int(wport))
+                    dur = float(query.get("duration", ["2"])[0])
+                except ValueError:
+                    return 400, "text/plain", "need worker=host:port"
+                # only relay to addresses that ARE cluster workers — the
+                # dashboard must not be an arbitrary connect-and-call proxy
+                sc = StateApiClient(self.control_address)
+                try:
+                    known = {tuple(w["addr"])
+                             for ws in sc.per_node("list_workers").values()
+                             for w in ws if w.get("addr")}
+                finally:
+                    sc.close()
+                if target not in known:
+                    return 404, "text/plain", \
+                        f"{waddr} is not a cluster worker"
+                cli = Client(target, name="dash-profile")
+                try:
+                    if path.endswith("stacks"):
+                        out = cli.call("dump_stacks", timeout=15.0)
+                    elif path.endswith("memory"):
+                        out = cli.call("memory_summary", timeout=15.0)
+                    else:
+                        out = cli.call("profile_cpu", {"duration": dur},
+                                       timeout=dur + 15.0)
+                finally:
+                    cli.close()
+                return 200, "text/plain", out
+            if path == "/api/usage_stats":
+                from ray_tpu._private.usage_stats import usage_report
+
+                return self._json(usage_report(self.control))
             if path == "/metrics":
                 from ray_tpu.util.metrics import (collect_cluster_metrics,
                                                   prometheus_text)

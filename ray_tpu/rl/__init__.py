@@ -7,7 +7,10 @@ the entire rollout one compiled `lax.scan` (see env/jax_env.py).
 """
 
 from .algorithms.algorithm import Algorithm, AlgorithmConfig
+from .algorithms.appo import APPO, APPOConfig
+from .algorithms.cql import CQL, CQLConfig
 from .algorithms.dqn import DQN, DQNConfig
+from .algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 from .algorithms.impala import IMPALA, Impala, ImpalaConfig
 from .algorithms.marwil import BC, BCConfig, MARWIL, MARWILConfig
 from .algorithms.ppo import PPO, PPOConfig
@@ -23,6 +26,8 @@ __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
     "Impala", "IMPALA", "ImpalaConfig", "SAC", "SACConfig",
     "MARWIL", "MARWILConfig", "BC", "BCConfig",
+    "APPO", "APPOConfig", "CQL", "CQLConfig",
+    "DreamerV3", "DreamerV3Config",
     "Learner", "LearnerGroup", "RLModule", "DiscretePolicyModule", "QModule",
     "module_for_env", "EnvRunnerGroup", "JaxEnvRunner", "GymEnvRunner",
     "JaxEnv", "CartPole", "make_env", "register_env", "ReplayBuffer",

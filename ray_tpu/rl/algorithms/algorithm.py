@@ -116,7 +116,8 @@ class Algorithm:
         self.iteration = 0
         self.runners = EnvRunnerGroup(
             env_name=config.env_name,
-            module_spec={"kind": self.module_kind, "hidden": config.hidden},
+            module_spec={"kind": self.module_kind, "hidden": config.hidden,
+                         "kwargs": self._module_kwargs()},
             num_runners=config.num_env_runners,
             num_envs_per_runner=config.num_envs_per_runner,
             runner_kind=config.runner_kind,
@@ -130,6 +131,12 @@ class Algorithm:
     # -- overridables ------------------------------------------------------
 
     def _explore_kwargs(self) -> Dict[str, Any]:
+        return {}
+
+    def _module_kwargs(self) -> Dict[str, Any]:
+        """Extra ctor kwargs for the runner-side module — must match the
+        learner's module so synced weights apply (e.g. Dreamer latent
+        sizes)."""
         return {}
 
     def _setup(self):

@@ -1,0 +1,165 @@
+"""CQL: conservative Q-learning for offline RL.
+
+Analog of the reference's CQL (reference: rllib/algorithms/cql/cql.py,
+torch/cql_torch_learner.py — SAC's learner plus the conservative
+regularizer).  Discrete-action variant (CQL(H), Kumar et al. 2020
+eq. 4): the critic loss adds
+
+    E_s[ logsumexp_a Q(s, a) - Q(s, a_data) ]
+
+which pushes down Q on out-of-distribution actions and up on dataset
+actions — exact (no sampled actions) in the discrete case, and a dense
+[batch, actions] logsumexp is the TPU-friendly shape.
+
+Offline data comes the same way as MARWIL/BC: any iterable of sample
+dicts with {obs, action, reward, done, next_obs}.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl.core.learner import Learner, LearnerGroup
+from ray_tpu.rl.core.rl_module import QModule
+
+from .algorithm import Algorithm, AlgorithmConfig
+
+
+class CQLLearner(Learner):
+    def __init__(self, module: QModule, *, gamma: float = 0.99,
+                 cql_alpha: float = 1.0, tau: float = 0.005, **kwargs):
+        self.gamma = gamma
+        self.cql_alpha = cql_alpha
+        self.tau = tau
+        super().__init__(module, **kwargs)
+
+    def _trainable(self, params):
+        return {"q": params["q"]}
+
+    def _merge(self, params, trained):
+        return {**trained, "target_q": params["target_q"]}
+
+    def compute_loss(self, params, batch, rng):
+        q_all = self.module.q_values(params, batch["obs"])
+        a = batch["action"][..., None].astype(jnp.int32)
+        q_data = jnp.take_along_axis(q_all, a, axis=-1)[..., 0]
+        # double-DQN style target from the frozen net
+        next_q_online = self.module.q_values(params, batch["next_obs"])
+        next_a = jnp.argmax(next_q_online, axis=-1)[..., None]
+        next_q_target = self.module.q_values(params, batch["next_obs"],
+                                             target=True)
+        next_q = jnp.take_along_axis(next_q_target, next_a, axis=-1)[..., 0]
+        nonterminal = 1.0 - batch["done"].astype(jnp.float32)
+        td_target = jax.lax.stop_gradient(
+            batch["reward"] + self.gamma * nonterminal * next_q)
+        bellman = 0.5 * jnp.mean((q_data - td_target) ** 2)
+        # the conservative term: logsumexp over all actions minus the
+        # dataset action's Q (CQL(H), exact for discrete actions)
+        conservative = jnp.mean(
+            jax.scipy.special.logsumexp(q_all, axis=-1) - q_data)
+        loss = bellman + self.cql_alpha * conservative
+        return loss, {"bellman_loss": bellman,
+                      "cql_loss": conservative,
+                      "mean_q_data": jnp.mean(q_data),
+                      "mean_q_max": jnp.mean(jnp.max(q_all, axis=-1))}
+
+    def extra_update(self, params, metrics):
+        # polyak target update (SAC-style, reference cql keeps SAC's)
+        new_target = jax.tree_util.tree_map(
+            lambda t, o: (1 - self.tau) * t + self.tau * o,
+            params["target_q"], params["q"])
+        return {**params, "target_q": new_target}
+
+
+def transitions_from_rollout(batch: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """[T, B] rollout arrays -> flat {obs, action, reward, done, next_obs}
+    transitions (next_obs shifted along T; the last step of each column
+    is dropped since its successor is unknown)."""
+    obs = np.asarray(batch["obs"])
+    flat = lambda a: a.reshape(-1, *a.shape[2:])  # noqa: E731
+    return {
+        "obs": flat(obs[:-1]),
+        "next_obs": flat(obs[1:]),
+        "action": flat(np.asarray(batch["action"])[:-1]),
+        "reward": flat(np.asarray(batch["reward"], np.float32)[:-1]),
+        "done": flat(np.asarray(batch["done"], bool)[:-1]),
+    }
+
+
+class CQLConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.cql_alpha = 1.0
+        self.tau = 0.005
+        self.num_epochs = 1
+        self.minibatch_size = 256
+        #: offline experience: iterable of flat transition dicts
+        #: ({obs, action, reward, done, next_obs}) or [T,B] rollouts
+        self.offline_data: Optional[Iterable[Dict[str, Any]]] = None
+
+    algo_cls = None
+
+    def offline(self, data: Iterable[Dict[str, Any]]):
+        self.offline_data = data
+        return self
+
+
+class CQL(Algorithm):
+    """Offline when config.offline_data is set; otherwise trains
+    conservatively on its own rollouts (smoke mode)."""
+
+    module_kind = "q"
+
+    def _setup(self):
+        cfg: CQLConfig = self.config
+
+        def factory():
+            module = QModule(self.env_spec["obs_dim"],
+                             self.env_spec["num_actions"], cfg.hidden)
+            return CQLLearner(module, gamma=cfg.gamma,
+                              cql_alpha=cfg.cql_alpha, tau=cfg.tau,
+                              lr=cfg.lr, seed=cfg.seed)
+
+        self.learner_group = LearnerGroup(factory, cfg.num_learners)
+        self.runners.sync_weights(self.learner_group.get_weights())
+        self._offline: List[Dict[str, np.ndarray]] = []
+        if cfg.offline_data is not None:
+            for item in cfg.offline_data:
+                if "next_obs" not in item:
+                    item = transitions_from_rollout(item)
+                self._offline.append(
+                    {k: np.asarray(v) for k, v in item.items()})
+        self._rng = np.random.RandomState(cfg.seed)
+
+    def _offline_minibatches(self):
+        cfg: CQLConfig = self.config
+        all_idx = [(i, j) for i, d in enumerate(self._offline)
+                   for j in range(0, len(d["obs"]), cfg.minibatch_size)]
+        self._rng.shuffle(all_idx)
+        for i, j in all_idx:
+            d = self._offline[i]
+            yield {k: v[j:j + cfg.minibatch_size] for k, v in d.items()}
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: CQLConfig = self.config
+        metrics: Dict[str, float] = {}
+        if self._offline:
+            for _ in range(cfg.num_epochs):
+                for mb in self._offline_minibatches():
+                    metrics = self.learner_group.update(mb)
+            self.runners.sync_weights(self.learner_group.get_weights())
+            return metrics
+        results = self.runners.sample(cfg.rollout_len)
+        batch, stats = self._merge_runner_results(results)
+        metrics = self.learner_group.update(transitions_from_rollout(batch))
+        self.runners.sync_weights(self.learner_group.get_weights())
+        metrics.update(stats)
+        return metrics
+
+
+CQLConfig.algo_cls = CQL

@@ -135,7 +135,8 @@ MODULE_REGISTRY: Dict[str, type] = {}
 
 
 def module_for_env(env_spec: Dict[str, Any], kind: str = "policy",
-                   hidden: Sequence[int] = (64, 64)) -> RLModule:
+                   hidden: Sequence[int] = (64, 64), **kwargs) -> RLModule:
     cls = MODULE_REGISTRY.get(kind) or (
         DiscretePolicyModule if kind == "policy" else QModule)
-    return cls(env_spec["obs_dim"], env_spec["num_actions"], hidden)
+    return cls(env_spec["obs_dim"], env_spec["num_actions"], hidden,
+               **kwargs)

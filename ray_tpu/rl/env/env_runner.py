@@ -68,6 +68,7 @@ class JaxEnvRunner:
         self.env = jax_env.make_env(env_name)
         self.module = module_for_env(self.env.spec,
                                      kind=module_spec.get("kind", "policy"),
+                                     **module_spec.get("kwargs", {}),
                                      hidden=module_spec.get("hidden",
                                                             (64, 64)))
         self.num_envs = num_envs
@@ -146,6 +147,7 @@ class GymEnvRunner:
                      "max_episode_steps": 0}
         self.module = module_for_env(self.spec,
                                      kind=module_spec.get("kind", "policy"),
+                                     **module_spec.get("kwargs", {}),
                                      hidden=module_spec.get("hidden",
                                                             (64, 64)))
         self.num_envs = num_envs

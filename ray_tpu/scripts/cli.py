@@ -205,6 +205,25 @@ def cmd_job(args):
         print("stopped" if client.stop_job(args.id) else "not running")
 
 
+def cmd_serve(args):
+    """`serve deploy/status/shutdown` (reference: serve CLI over the
+    declarative schema, serve/scripts.py)."""
+    import ray_tpu
+
+    ray_tpu.init(address=_resolve_address(args), ignore_reinit_error=True)
+    from ray_tpu import serve
+
+    if args.serve_cmd == "deploy":
+        names = serve.deploy_config_file(args.config)
+        print(f"deployed applications: {', '.join(names)}")
+    elif args.serve_cmd == "status":
+        for name, st in serve.status().items():
+            print(f"{name}: {getattr(st, 'status', st)}")
+    elif args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+
+
 # -- state commands ----------------------------------------------------------
 
 _LISTABLE = ("nodes", "actors", "tasks", "workers", "objects",
@@ -289,6 +308,17 @@ def build_parser() -> argparse.ArgumentParser:
         if c != "list":
             jp.add_argument("id")
     sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("serve", help="manage serve applications")
+    ssub = sp.add_subparsers(dest="serve_cmd", required=True)
+    dp = ssub.add_parser("deploy", help="deploy apps from a YAML config")
+    dp.add_argument("config")
+    dp.add_argument("--address", default=None)
+    stp = ssub.add_parser("status")
+    stp.add_argument("--address", default=None)
+    shp = ssub.add_parser("shutdown")
+    shp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("list", help="list cluster entities")
     sp.add_argument("resource", choices=_LISTABLE)

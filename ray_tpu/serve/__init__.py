@@ -9,6 +9,8 @@ replicas LRU-swap model weights in HBM.
 
 from ._common import AutoscalingConfig
 from ._deployment import Application, Deployment, deployment
+from .schema import (ServeApplicationSchema, ServeDeploySchema,
+                     deploy_config, deploy_config_file)
 from ._handle import DeploymentHandle, DeploymentResponse
 from ._proxy import Request, Response, RpcClient
 from .api import (delete, get_app_handle, get_deployment_handle, run,
@@ -22,4 +24,6 @@ __all__ = [
     "delete", "deployment", "get_app_handle", "get_deployment_handle",
     "get_multiplexed_model_id", "multiplexed", "run", "shutdown", "start",
     "start_rpc_proxy", "status",
+    "ServeApplicationSchema", "ServeDeploySchema", "deploy_config",
+    "deploy_config_file",
 ]

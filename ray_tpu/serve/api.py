@@ -31,6 +31,9 @@ def start(http_host: str = "127.0.0.1", http_port: int = 0,
     """Start (or connect to) the serve controller; optionally the HTTP
     proxy.  Idempotent (reference: serve/api.py serve.start)."""
     global _controller_handle
+    from ray_tpu._private.usage_stats import record_library_usage
+
+    record_library_usage("serve")
     if _controller_handle is None:
         try:
             _controller_handle = ray_tpu.get_actor(CONTROLLER_NAME)

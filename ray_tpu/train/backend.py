@@ -50,6 +50,84 @@ class Backend:
 
 
 @dataclass
+class TorchConfig(BackendConfig):
+    """Backend config for torch.distributed training (reference:
+    train/torch/config.py TorchConfig — sets MASTER_ADDR/PORT, then
+    init_process_group on every worker).  gloo is the portable default;
+    nccl has no TPU analog here (device collectives belong to the jax
+    path)."""
+
+    backend: str = "gloo"
+    init_method: str = "tcp"
+    timeout_s: float = 120.0
+
+    def backend_cls(self):
+        return _TorchBackend
+
+
+def _setup_torch_group(init_method: str, backend: str, world_size: int,
+                       rank: int, timeout_s: float):
+    import datetime
+
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        dist.destroy_process_group()
+    dist.init_process_group(
+        backend=backend, init_method=init_method,
+        world_size=world_size, rank=rank,
+        timeout=datetime.timedelta(seconds=timeout_s))
+    return {"rank": dist.get_rank(), "world_size": dist.get_world_size()}
+
+
+def _teardown_torch_group():
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        dist.destroy_process_group()
+    return True
+
+
+class _TorchBackend(Backend):
+    def on_start(self, worker_group, backend_config: "TorchConfig"):
+        import ray_tpu
+        from ray_tpu._private.protocol import free_port
+
+        n = worker_group.num_workers
+        head_ip = worker_group.workers[0].metadata.get("node_ip",
+                                                       "127.0.0.1")
+        # probe the port on rank 0's host — the torch master binds there,
+        # not on the driver (reference picks the port on the worker too)
+        port = ray_tpu.get(
+            worker_group.workers[0].actor.execute.remote(free_port),
+            timeout=60)
+        init_method = f"tcp://{head_ip}:{port}"
+        env = {"MASTER_ADDR": head_ip, "MASTER_PORT": str(port),
+               "RAY_TPU_TRAIN_WORLD_SIZE": str(n)}
+        ray_tpu.get([
+            w.actor.set_env_vars.remote({**env,
+                                         "RAY_TPU_TRAIN_WORLD_RANK": str(i)})
+            for i, w in enumerate(worker_group.workers)])
+        if n > 1 or backend_config.init_method == "always":
+            refs = [w.actor.execute.remote(
+                        _setup_torch_group, init_method,
+                        backend_config.backend, n, i,
+                        backend_config.timeout_s)
+                    for i, w in enumerate(worker_group.workers)]
+            infos = ray_tpu.get(refs)
+            logger.info("torch.distributed initialized: %s", infos[0])
+
+    def on_shutdown(self, worker_group, backend_config: "TorchConfig"):
+        import ray_tpu
+
+        try:
+            ray_tpu.get([w.actor.execute.remote(_teardown_torch_group)
+                         for w in worker_group.workers], timeout=30)
+        except Exception:
+            pass
+
+
+@dataclass
 class JaxConfig(BackendConfig):
     """Backend config for JAX/TPU training.
 

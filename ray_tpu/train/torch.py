@@ -1,0 +1,95 @@
+"""TorchTrainer + torch train-loop utilities.
+
+Reference parity: python/ray/train/torch/ — ``TorchTrainer``
+(torch_trainer.py) is a DataParallelTrainer whose backend sets up
+torch.distributed; ``prepare_model`` / ``prepare_data_loader``
+(train_loop_utils.py) wrap the user's model in DDP and the loader in a
+DistributedSampler.  CPU/gloo here (no CUDA on TPU hosts); the jax path
+(JaxTrainer) is the accelerated one.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+from .backend import TorchConfig
+from .trainer import JaxTrainer
+
+__all__ = ["TorchTrainer", "TorchConfig", "prepare_model",
+           "prepare_data_loader", "get_device"]
+
+
+class TorchTrainer(JaxTrainer):
+    """Data-parallel torch training on the cluster (reference:
+    train/torch/torch_trainer.py).
+
+    Same orchestration as JaxTrainer (BackendExecutor -> WorkerGroup ->
+    per-worker train loop with session.report), with the torch process
+    group as the backend::
+
+        def train_loop(config):
+            model = prepare_model(Net())
+            for epoch in range(3):
+                ...
+                session.report({"loss": loss.item()})
+
+        TorchTrainer(train_loop,
+                     scaling_config=ScalingConfig(num_workers=2)).fit()
+    """
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 torch_config: Optional[TorchConfig] = None,
+                 **kwargs):
+        kwargs.setdefault("backend_config", torch_config or TorchConfig())
+        super().__init__(train_loop_per_worker,
+                         train_loop_config=train_loop_config, **kwargs)
+
+
+def get_device():
+    import torch
+
+    return torch.device("cpu")
+
+
+def prepare_model(model, *, ddp_kwargs: Optional[Dict[str, Any]] = None):
+    """Wrap in DistributedDataParallel when a process group is up
+    (reference: train_loop_utils.py prepare_model)."""
+    import torch.distributed as dist
+
+    if dist.is_available() and dist.is_initialized() \
+            and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model, **(ddp_kwargs or {}))
+    return model
+
+
+def prepare_data_loader(data_loader):
+    """Re-build the loader with a DistributedSampler so each rank sees
+    its shard (reference: train_loop_utils.py prepare_data_loader)."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    if not (dist.is_available() and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        return data_loader
+    sampler = DistributedSampler(data_loader.dataset,
+                                 num_replicas=dist.get_world_size(),
+                                 rank=dist.get_rank())
+    return DataLoader(data_loader.dataset,
+                      batch_size=data_loader.batch_size,
+                      sampler=sampler,
+                      num_workers=0,
+                      collate_fn=data_loader.collate_fn,
+                      drop_last=data_loader.drop_last)
+
+
+def get_world_rank() -> int:
+    return int(os.environ.get("RAY_TPU_TRAIN_WORLD_RANK", "0"))
+
+
+def get_world_size() -> int:
+    return int(os.environ.get("RAY_TPU_TRAIN_WORLD_SIZE", "1"))

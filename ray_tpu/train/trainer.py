@@ -153,6 +153,9 @@ class JaxTrainer:
                       best_checkpoints=ckpt_mgr.best_checkpoints())
 
     def fit(self) -> Result:
+        from ray_tpu._private.usage_stats import record_library_usage
+
+        record_library_usage("train")
         name = self.run_config.name or f"JaxTrainer_{int(time.time())}"
         exp_dir = os.path.join(self.run_config.resolved_storage_path(), name)
         trial_name = f"{name}_00000"

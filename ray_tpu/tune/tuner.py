@@ -98,6 +98,9 @@ class Tuner:
         self._experiment_dir = _experiment_dir
 
     def fit(self) -> ResultGrid:
+        from ray_tpu._private.usage_stats import record_library_usage
+
+        record_library_usage("tune")
         cfg = self._tune_config
         name = self._run_config.name or f"tune_{int(time.time())}"
         exp_dir = (self._experiment_dir

@@ -134,3 +134,39 @@ def test_actor_concurrency(ray_cluster):
     assert sum(ray_tpu.get(refs, timeout=60)) == 4
     # 4 overlapping 0.5 s sleeps should take well under 2 s
     assert time.time() - t0 < 1.9
+
+
+def test_actor_churn_does_not_leak_worker_records(ray_cluster):
+    """Dead actor-worker records must leave the raylet's table — they
+    count against the max-workers spawn cap, and accumulating them
+    starves all future leases (regression: 70+ tests of actor churn
+    wedged the shared cluster)."""
+    import time as _time
+
+    from ray_tpu._private.api import current_core
+    from ray_tpu.util.state.api import StateApiClient
+
+    @ray_tpu.remote
+    class Brief:
+        def ping(self):
+            return 1
+
+    for _ in range(12):
+        a = Brief.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+        ray_tpu.kill(a)
+    core = current_core()
+    c = StateApiClient("%s:%s" % core.control_addr)
+    try:
+        deadline = _time.time() + 30
+        n = 10**9
+        while _time.time() < deadline:
+            workers = [w for ws in c.per_node("list_workers").values()
+                       for w in ws]
+            n = len(workers)
+            if n <= 12:
+                break
+            _time.sleep(1.0)
+        assert n <= 12, f"{n} worker records linger after actor churn"
+    finally:
+        c.close()

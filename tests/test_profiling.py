@@ -1,0 +1,113 @@
+"""Profiling + usage stats (reference:
+dashboard/modules/reporter/profile_manager.py tests, usage_lib tests)."""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private import profiling
+
+
+def test_dump_stacks_shows_threads():
+    evt = threading.Event()
+
+    def parked_thread_fn_xyz():
+        evt.wait(30)
+
+    t = threading.Thread(target=parked_thread_fn_xyz,
+                         name="parked-thread", daemon=True)
+    t.start()
+    time.sleep(0.05)
+    out = profiling.dump_stacks()
+    evt.set()
+    assert "parked-thread" in out
+    assert "parked_thread_fn_xyz" in out
+
+
+def test_cpu_profile_collapsed_format():
+    stop = threading.Event()
+
+    def busy_fn_for_profile():
+        while not stop.is_set():
+            sum(range(500))
+
+    t = threading.Thread(target=busy_fn_for_profile, daemon=True)
+    t.start()
+    out = profiling.cpu_profile(duration_s=0.4, interval_s=0.01)
+    stop.set()
+    assert out.startswith("#")
+    body = [l for l in out.splitlines()[1:] if l]
+    assert body, out
+    # folded format: "file:func:line;... count"
+    stack, count = body[0].rsplit(" ", 1)
+    assert int(count) > 0
+    assert ";" in stack or ":" in stack
+    assert any("busy_fn_for_profile" in l for l in body)
+
+
+def test_memory_summary_reports_sites():
+    out1 = profiling.memory_summary()
+    blob = [bytearray(256 * 1024) for _ in range(8)]  # noqa: F841
+    out2 = profiling.memory_summary()
+    assert "KiB" in out2 or "started now" in out1
+
+
+def test_profile_rpcs_on_live_worker(ray_cluster):
+    """Drive the dashboard-facing RPCs against a real worker's core
+    server."""
+    import ray_tpu
+    from ray_tpu._private.api import current_core
+    from ray_tpu._private.protocol import Client
+
+    @ray_tpu.remote
+    class Spin:
+        def busy(self, s):
+            t0 = time.time()
+            n = 0
+            while time.time() - t0 < s:
+                n += sum(range(200))
+            return n
+
+    a = Spin.remote()
+    ref = a.busy.remote(3.0)
+    core = current_core()
+    # find the actor worker's core-server address
+    from ray_tpu.util.state.api import StateApiClient
+
+    c = StateApiClient("%s:%s" % core.control_addr)
+    try:
+        deadline = time.time() + 30
+        waddr = None
+        while time.time() < deadline and waddr is None:
+            for node_workers in c.per_node("list_workers").values():
+                for w in node_workers:
+                    if w.get("actor_id") and w.get("addr"):
+                        waddr = tuple(w["addr"])
+                        break
+                if waddr:
+                    break
+            time.sleep(0.3)
+    finally:
+        c.close()
+    assert waddr, "no actor worker found"
+    cli = Client(waddr, name="test-profile")
+    try:
+        stacks = cli.call("dump_stacks", timeout=15.0)
+        assert "Thread" in stacks
+        prof = cli.call("profile_cpu", {"duration": 0.5}, timeout=20.0)
+        assert prof.startswith("#")
+    finally:
+        cli.close()
+    assert ray_tpu.get(ref, timeout=60) > 0
+
+
+def test_usage_stats_report(ray_cluster):
+    from ray_tpu._private import usage_stats
+
+    usage_stats.record_library_usage("testlib")
+    usage_stats.record_extra_usage_tag("custom_tag", "42")
+    rep = usage_stats.usage_report()
+    assert rep["usage_stats_enabled"] is True
+    assert "library_testlib" in rep["tags"]
+    assert rep["tags"]["custom_tag"]["value"] == "42"

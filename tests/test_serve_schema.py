@@ -1,0 +1,90 @@
+"""Declarative serve config deploy (reference: serve/schema.py +
+`serve deploy` tests)."""
+
+import sys
+import textwrap
+
+import pytest
+
+from ray_tpu import serve
+from ray_tpu.serve.schema import (ServeDeploySchema, build_app,
+                                  deploy_config)
+
+
+def test_schema_validation():
+    with pytest.raises(ValueError, match="applications"):
+        ServeDeploySchema.parse({})
+    with pytest.raises(ValueError, match="import_path"):
+        ServeDeploySchema.parse({"applications": [{"name": "x"}]})
+    with pytest.raises(ValueError, match="duplicate"):
+        ServeDeploySchema.parse({"applications": [
+            {"name": "a", "import_path": "m:x"},
+            {"name": "a", "import_path": "m:y"}]})
+    with pytest.raises(ValueError, match="unknown deployment fields"):
+        ServeDeploySchema.parse({"applications": [
+            {"name": "a", "import_path": "m:x",
+             "deployments": [{"name": "d", "nope": 1}]}]})
+
+
+def _install_module(tmp_path, monkeypatch):
+    mod = tmp_path / "my_serve_app.py"
+    mod.write_text(textwrap.dedent("""
+        from ray_tpu import serve
+
+        @serve.deployment
+        class Doubler:
+            async def __call__(self, request):
+                return {"doubled": 2 * int(await request.body() or b"0")}
+
+        app = Doubler.bind()
+
+        def app_builder(factor=3):
+            @serve.deployment(name="Scaler")
+            class Scaler:
+                async def __call__(self, request):
+                    return {"scaled": factor * int(await request.body()
+                                                   or b"0")}
+            return Scaler.bind()
+    """))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    sys.modules.pop("my_serve_app", None)
+
+
+def test_build_app_overrides(tmp_path, monkeypatch):
+    _install_module(tmp_path, monkeypatch)
+    from ray_tpu.serve.schema import ServeApplicationSchema
+
+    app = build_app(ServeApplicationSchema.parse({
+        "name": "a", "import_path": "my_serve_app:app",
+        "deployments": [{"name": "Doubler", "num_replicas": 2}]}))
+    assert app._deployment.num_replicas == 2
+
+    with pytest.raises(ValueError, match="unknown deployments"):
+        build_app(ServeApplicationSchema.parse({
+            "name": "a", "import_path": "my_serve_app:app",
+            "deployments": [{"name": "Missing", "num_replicas": 2}]}))
+
+    # builder function with args
+    app2 = build_app(ServeApplicationSchema.parse({
+        "name": "b", "import_path": "my_serve_app:app_builder",
+        "args": {"factor": 5}}))
+    assert app2.name == "Scaler"
+
+
+def test_deploy_config_e2e(ray_cluster, tmp_path, monkeypatch):
+    _install_module(tmp_path, monkeypatch)
+    try:
+        names = deploy_config({
+            "applications": [
+                {"name": "doubling", "import_path": "my_serve_app:app",
+                 "route_prefix": "/double"},
+            ]})
+        assert names == ["doubling"]
+        h = serve.get_app_handle("doubling")
+        out = h.remote(serve.Request("POST", "/", "/", {}, {}, b"21")
+                       ).result(timeout_s=60)
+        assert out == {"doubled": 42}
+        st = serve.status()
+        assert "doubling" in st
+    finally:
+        serve.shutdown()

@@ -1,0 +1,86 @@
+"""TorchTrainer: torch.distributed (gloo) over the worker group
+(reference: train/tests/test_torch_trainer.py)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.train import ScalingConfig
+
+
+def test_torch_trainer_ddp_two_workers(ray_cluster):
+    """2-worker gloo group: allreduce works and DDP averages gradients."""
+    from ray_tpu.train.torch import TorchTrainer
+
+    def loop(config):
+        import torch
+        import torch.distributed as dist
+
+        from ray_tpu.train.session import report
+        from ray_tpu.train.torch import (get_world_rank, get_world_size,
+                                         prepare_model)
+
+        rank = get_world_rank()
+        assert get_world_size() == 2
+        assert dist.is_initialized()
+        # collective sanity: sum of ranks
+        t = torch.tensor([float(rank + 1)])
+        dist.all_reduce(t)
+        # tiny DDP step: grads average across ranks
+        model = prepare_model(torch.nn.Linear(4, 1, bias=False))
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        x = torch.full((8, 4), float(rank + 1))
+        loss = model(x).square().mean()
+        loss.backward()
+        g0 = [p.grad.clone() for p in model.parameters()]
+        opt.step()
+        report({"allreduce": float(t.item()), "rank": rank,
+                "grad_sum": float(sum(g.abs().sum() for g in g0))})
+
+    trainer = TorchTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2))
+    result = trainer.fit()
+    assert result.metrics["allreduce"] == 3.0  # (0+1) + (1+1)
+    # DDP synchronized grads: both ranks report identical values — rank 0
+    # metrics are authoritative; just check they're finite and nonzero
+    assert result.metrics["grad_sum"] > 0
+
+
+def test_torch_trainer_single_worker_no_group(ray_cluster):
+    from ray_tpu.train.torch import TorchTrainer
+
+    def loop(config):
+        import torch
+        import torch.distributed as dist
+
+        from ray_tpu.train.session import report
+        from ray_tpu.train.torch import prepare_model
+
+        assert not dist.is_initialized()
+        model = prepare_model(torch.nn.Linear(2, 1))
+        assert isinstance(model, torch.nn.Linear)  # no DDP wrap
+        report({"ok": 1})
+
+    result = TorchTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1)).fit()
+    assert result.metrics["ok"] == 1
+
+
+def test_prepare_data_loader_shards(ray_cluster):
+    from ray_tpu.train.torch import TorchTrainer
+
+    def loop(config):
+        import torch
+        from torch.utils.data import DataLoader, TensorDataset
+
+        from ray_tpu.train.session import report
+        from ray_tpu.train.torch import prepare_data_loader
+
+        ds = TensorDataset(torch.arange(20, dtype=torch.float32))
+        loader = prepare_data_loader(DataLoader(ds, batch_size=5))
+        seen = sum(len(b[0]) for b in loader)
+        report({"seen": seen})
+
+    result = TorchTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2)).fit()
+    # DistributedSampler gives each of 2 ranks half the 20 samples
+    assert result.metrics["seen"] == 10
