@@ -135,15 +135,16 @@ class ActorHandle:
 
     def __del__(self):
         # the owner handle going out of scope terminates the actor
-        # (reference semantics: actors are GC'd with their original handle
-        # unless detached)
+        # gracefully — queued behind in-flight calls, so
+        # `Actor.remote().method.remote()` temporaries don't kill the
+        # actor under their own call (reference semantics: actors are
+        # GC'd with their original handle unless detached, via a
+        # __ray_terminate__ marker task)
         if getattr(self, "_is_owner", False):
             try:
                 core = current_core()
                 if not core._shutdown:
-                    core.control.call_async(
-                        "kill_actor", {"actor_id": self._actor_id,
-                                       "no_restart": True})
+                    core.release_actor(self._actor_id)
             except Exception:
                 pass
 

@@ -25,8 +25,22 @@ def _rand_bytes(n: int) -> bytes:
     return os.urandom(n)
 
 
+# ids only need cross-process uniqueness, not cryptographic strength: an
+# 8-byte urandom prefix drawn once per process + a 16-hex-digit counter is
+# collision-safe and ~50x cheaper than os.urandom per id (the task-submit
+# hot path mints 2 ids per task)
+_id_state = None
+
+
 def new_id(prefix: str = "") -> str:
-    return prefix + _rand_bytes(16).hex()
+    global _id_state
+    pid = os.getpid()
+    if _id_state is None or _id_state[0] != pid:  # fork/spawn safe
+        import itertools
+
+        _id_state = (pid, os.urandom(8).hex(), itertools.count(1))
+    # itertools.count.__next__ is atomic in CPython: thread-safe ids
+    return f"{prefix}{_id_state[1]}{next(_id_state[2]):016x}"
 
 
 def job_id() -> str:

@@ -1471,6 +1471,39 @@ class CoreWorker:
         self._control_call("kill_actor", {"actor_id": actor_id,
                                          "no_restart": no_restart}, timeout=30.0)
 
+    def release_actor(self, actor_id: str):
+        """Owner handle went out of scope: terminate gracefully.  The
+        __ray_terminate__ marker rides the ordered actor queue, so calls
+        already submitted finish first (reference: ActorHandle.__del__ ->
+        __ray_terminate__ semantics); a hard kill_actor is the fallback
+        when the actor has no live connection to drain.
+
+        Runs off-thread: __del__ may fire inside GC while this thread
+        holds an ActorConn lock the submit path needs."""
+
+        def do():
+            with self.lock:
+                ac = self.actors.get(actor_id)
+            try:
+                if ac is not None and ac.state in ("ALIVE", "PENDING",
+                                                   "RECONNECTING"):
+                    self.submit_actor_task(actor_id, "__ray_terminate__",
+                                           (), {})
+                    return
+            except Exception:
+                pass
+            try:
+                self.control.call_async(
+                    "kill_actor", {"actor_id": actor_id,
+                                   "no_restart": True})
+            except Exception:
+                pass
+
+        try:
+            self.pool_executor.submit(do)
+        except Exception:
+            pass
+
     def get_actor_by_name(self, name: str):
         view = self._control_call("get_actor", {"name": name}, timeout=30.0)
         return view

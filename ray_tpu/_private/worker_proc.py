@@ -246,6 +246,22 @@ class WorkerMain:
             actor_id=spec.actor_id)
         try:
             if kind == "actor":
+                if spec.function_name == "__ray_terminate__":
+                    # graceful release (reference: the owner handle going
+                    # out of scope queues __ray_terminate__ BEHIND pending
+                    # calls; the actor drains, then exits).  Reply first,
+                    # then mark DEAD at the control (so the exit isn't
+                    # "restarted"), then exit.
+                    d.resolve(self._store_reply(spec, None, t0))
+                    try:
+                        self.core.control.call(
+                            "kill_actor",
+                            {"actor_id": spec.actor_id,
+                             "no_restart": True}, timeout=10.0)
+                    except Exception:
+                        pass
+                    self._exit_soon()
+                    return _ASYNC_INFLIGHT
                 # wait for actor init to finish (creation runs async)
                 deadline = time.monotonic() + 120.0
                 while self.actor_instance is None and time.monotonic() < deadline \

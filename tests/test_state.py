@@ -87,9 +87,12 @@ def test_failed_task_recorded(cluster):
     with pytest.raises(ray_tpu.TaskError):
         ray_tpu.get(boom.remote())
     _flush()
+    # match this test's qualname exactly: other tests also name a task
+    # "boom" and the shared session cluster retains their records
     tasks = _wait_for(lambda: [
         t for t in state.list_tasks()
-        if t.get("name", "").endswith("boom")
+        if t.get("name", "").endswith(
+            "test_failed_task_recorded.<locals>.boom")
         and t["state"] == "FAILED"] or None)
     assert "nope" in tasks[0].get("error", "")
 
