@@ -1,0 +1,196 @@
+"""Fault-injection utilities for chaos testing.
+
+Reference parity: python/ray/_private/test_utils.py resource-killer
+actors — ``NodeKillerBase`` (:1500), ``RayletKiller`` (:1536),
+``WorkerKillerActor`` (:1597) — used by release/nightly chaos suites
+(`setup_chaos.py --chaos KillRaylet|KillWorker`).  Same shape here:
+killer actors run *inside* the cluster under test, pick victims from
+cluster state, and record what they killed so tests can assert both
+damage and recovery.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class KillerBase:
+    """Periodically kills victims until stopped.  Subclasses implement
+    ``_pick_victims`` and ``_kill_one``."""
+
+    def __init__(self, kill_interval_s: float = 2.0,
+                 max_to_kill: int = 3, seed: Optional[int] = None):
+        self.kill_interval_s = kill_interval_s
+        self.max_to_kill = max_to_kill
+        self.killed: List[Dict[str, Any]] = []
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- actor API ---------------------------------------------------------
+
+    def run(self):
+        """Start the kill loop (returns immediately; the loop runs on a
+        thread so the actor stays responsive to stop()/get_total_killed)."""
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return True
+
+    def stop_run(self):
+        self._stop.set()
+        return True
+
+    def get_total_killed(self) -> List[Dict[str, Any]]:
+        return list(self.killed)
+
+    # -- internals ---------------------------------------------------------
+
+    def _loop(self):
+        while not self._stop.is_set() and len(self.killed) < self.max_to_kill:
+            self._stop.wait(self.kill_interval_s)
+            if self._stop.is_set():
+                return
+            victims = self._pick_victims()
+            if not victims:
+                continue
+            victim = self._rng.choice(victims)
+            try:
+                if self._kill_one(victim):
+                    self.killed.append(victim)
+            except Exception:
+                pass
+
+    def _pick_victims(self) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def _kill_one(self, victim: Dict[str, Any]) -> bool:
+        raise NotImplementedError
+
+
+class WorkerKiller(KillerBase):
+    """SIGKILLs random leased task workers (reference:
+    WorkerKillerActor).  Tasks with retries left are re-executed by
+    their owners; the test asserts results stay correct."""
+
+    def _pick_victims(self):
+        from ray_tpu._private.api import current_core
+        from ray_tpu.util.state.api import StateApiClient
+
+        core = current_core()
+        cli = StateApiClient("%s:%s" % core.control_addr)
+        try:
+            out = []
+            for node_id, workers in cli.per_node("list_workers").items():
+                for w in workers:
+                    if w["state"] == "leased" and w.get("pid") \
+                            and w["pid"] != os.getpid():
+                        out.append({"kind": "worker", "pid": w["pid"],
+                                    "worker_id": w["worker_id"],
+                                    "node_id": node_id})
+            return out
+        finally:
+            cli.close()
+
+    def _kill_one(self, victim):
+        os.kill(victim["pid"], signal.SIGKILL)
+        return True
+
+
+class RayletKiller(KillerBase):
+    """Kills whole raylets (node failure; reference: RayletKiller).
+    Only nodes without the protected label are eligible, so the node
+    hosting this killer (and the driver's node) can be exempted."""
+
+    def __init__(self, protect_node_ids: Optional[List[str]] = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.protect = set(protect_node_ids or [])
+
+    def _pick_victims(self):
+        from ray_tpu._private.api import current_core
+
+        core = current_core()
+        nodes = core.control.call("get_nodes", timeout=10.0)
+        out = []
+        for n in nodes:
+            if n["state"] != "ALIVE" or n["node_id"] in self.protect:
+                continue
+            out.append({"kind": "raylet", "node_id": n["node_id"],
+                        "addr": tuple(n["addr"])})
+        return out
+
+    def _kill_one(self, victim):
+        from ray_tpu._private.protocol import Client
+
+        # ask the raylet for its own pid, then SIGKILL the process —
+        # the control plane must detect the death via missed heartbeats
+        try:
+            cli = Client(victim["addr"], name="raylet-killer",
+                         connect_timeout=2.0)
+            info = cli.call("node_info", timeout=5.0)
+            cli.close()
+        except Exception:
+            return False
+        # node_info has no pid; kill via the session dir's worker table
+        # is overkill — raylets are processes on this host in tests, so
+        # resolve the listener's pid through /proc
+        pid = _pid_listening_on(victim["addr"][1])
+        if pid is None or pid == os.getpid():
+            return False
+        os.kill(pid, signal.SIGKILL)
+        return True
+
+
+def _pid_listening_on(port: int) -> Optional[int]:
+    """Find the local pid listening on a TCP port (test-only; /proc)."""
+    import re
+
+    want = f":{port:04X}"
+    inode = None
+    try:
+        with open("/proc/net/tcp") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) > 9 and parts[3] == "0A" \
+                        and parts[1].endswith(want.upper()):
+                    inode = parts[9]
+                    break
+    except OSError:
+        return None
+    if inode is None:
+        return None
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        fd_dir = f"/proc/{pid}/fd"
+        try:
+            for fd in os.listdir(fd_dir):
+                try:
+                    if os.readlink(f"{fd_dir}/{fd}") == f"socket:[{inode}]":
+                        return int(pid)
+                except OSError:
+                    continue
+        except OSError:
+            continue
+    return None
+
+
+def get_and_run_killer(killer_cls, *, kill_interval_s: float = 2.0,
+                       max_to_kill: int = 3, seed: Optional[int] = None,
+                       **actor_kwargs):
+    """Spawn the killer as a 0-CPU actor and start its loop (reference:
+    setup_chaos.py get_and_run_resource_killer)."""
+    import ray_tpu
+
+    KillerActor = ray_tpu.remote(killer_cls)
+    killer = KillerActor.options(num_cpus=0, max_concurrency=4).remote(
+        kill_interval_s=kill_interval_s, max_to_kill=max_to_kill,
+        seed=seed, **actor_kwargs)
+    ray_tpu.get(killer.run.remote(), timeout=60)
+    return killer

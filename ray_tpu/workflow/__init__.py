@@ -9,9 +9,11 @@ persist the *control* state (which stage finished), the model state lives
 in the step's own checkpoint artifacts.
 """
 
-from .api import (WorkflowStatus, continuation, delete, get_output,
-                  get_status, list_all, options, resume, run, run_async)
+from .api import (WorkflowCancellationError, WorkflowStatus, cancel,
+                  continuation, delete, get_output, get_status, list_all,
+                  options, resume, resume_all, run, run_async)
 
-__all__ = ["WorkflowStatus", "continuation", "delete", "get_output",
-           "get_status", "list_all", "options", "resume", "run",
+__all__ = ["WorkflowCancellationError", "WorkflowStatus", "cancel",
+           "continuation", "delete", "get_output", "get_status",
+           "list_all", "options", "resume", "resume_all", "run",
            "run_async"]

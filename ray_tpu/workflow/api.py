@@ -24,7 +24,18 @@ class WorkflowStatus:
     RUNNING = "RUNNING"
     SUCCESSFUL = "SUCCESSFUL"
     FAILED = "FAILED"
+    CANCELED = "CANCELED"
     NOT_FOUND = "NOT_FOUND"
+
+
+# cancellation flags polled between steps (reference: api.py:712 cancel —
+# the executor checks for a canceled marker before launching each task)
+_canceled: set = set()
+_canceled_lock = threading.Lock()
+
+
+class WorkflowCancellationError(RuntimeError):
+    pass
 
 
 def options(*, max_retries: int = 0, catch_exceptions: bool = False
@@ -113,6 +124,14 @@ def _execute_dag(dag: DAGNode, storage: WorkflowStorage, args: tuple,
             raise TypeError(
                 f"workflows support function nodes (fn.bind) and InputNode,"
                 f" got {node!r}")
+        with _canceled_lock:
+            was_canceled = storage.workflow_id in _canceled
+        if was_canceled:
+            storage.save_status(WorkflowStatus.CANCELED, at_step=sid)
+            e = WorkflowCancellationError(
+                f"workflow {storage.workflow_id!r} canceled before {sid}")
+            e._wf_recorded = True
+            raise e
         try:
             resolved_args = [values[a._id] if isinstance(a, DAGNode) else a
                              for a in node.args]
@@ -213,3 +232,35 @@ def list_all(storage: Optional[str] = None) -> List[tuple]:
 
 def delete(workflow_id: str, storage: Optional[str] = None):
     WorkflowStorage(workflow_id, storage).delete()
+
+
+def cancel(workflow_id: str, storage: Optional[str] = None) -> None:
+    """Stop a running workflow between steps (reference: api.py:712).
+    The executor checks the flag before each step; completed step
+    results stay persisted, so a later resume() continues from them."""
+    with _canceled_lock:
+        _canceled.add(workflow_id)
+    st = WorkflowStorage(workflow_id, storage)
+    if st.load_status()["status"] == WorkflowStatus.RUNNING:
+        st.save_status(WorkflowStatus.CANCELED)
+
+
+def resume_all(storage: Optional[str] = None,
+               include_failed: bool = True) -> List[tuple]:
+    """Resume every resumable workflow (reference: api.py:502).  Returns
+    [(workflow_id, output), ...] for those that completed."""
+    out = []
+    resumable = (WorkflowStatus.RUNNING, WorkflowStatus.CANCELED,
+                 WorkflowStatus.FAILED)
+    for wid, status in list_all(storage):
+        if status == WorkflowStatus.SUCCESSFUL:
+            continue
+        if status in resumable and (include_failed
+                                    or status != WorkflowStatus.FAILED):
+            with _canceled_lock:
+                _canceled.discard(wid)
+            try:
+                out.append((wid, resume(wid, storage)))
+            except Exception:
+                pass  # stays FAILED; caller inspects list_all()
+    return out

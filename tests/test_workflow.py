@@ -130,3 +130,42 @@ def test_continuation(ray_cluster, tmp_path):
 
     dag = maybe_recurse.bind(1)
     assert workflow.run(dag, storage=str(tmp_path / "wf")) == 8
+
+
+def test_cancel_and_resume(ray_cluster, tmp_path):
+    """cancel() stops between steps; resume() continues from persisted
+    results (reference: api.py:712 cancel, :502 resume_all)."""
+    import threading
+    import time as _t
+
+    from ray_tpu import workflow
+
+    gate = str(tmp_path / "gate")
+
+    @ray_tpu.remote
+    def slow_one(x):
+        import os
+        import time as _tt
+
+        while not os.path.exists(gate):
+            _tt.sleep(0.05)
+        return x + 1
+
+    @ray_tpu.remote
+    def plus_ten(x):
+        return x + 10
+
+    dag = plus_ten.bind(slow_one.bind(5))
+    wid, t = workflow.run_async(dag, workflow_id="wf-cancel",
+                                storage=str(tmp_path))
+    _t.sleep(0.3)
+    workflow.cancel("wf-cancel", storage=str(tmp_path))
+    open(gate, "w").write("go")  # unblock step 1; cancel hits before step 2
+    t.join(timeout=60)
+    assert workflow.get_status("wf-cancel", str(tmp_path)) \
+        == workflow.WorkflowStatus.CANCELED
+    # resume_all picks it up and finishes from the persisted first step
+    done = dict(workflow.resume_all(storage=str(tmp_path)))
+    assert done.get("wf-cancel") == 16
+    assert workflow.get_status("wf-cancel", str(tmp_path)) \
+        == workflow.WorkflowStatus.SUCCESSFUL
