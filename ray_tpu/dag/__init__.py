@@ -5,12 +5,13 @@ experimental/channel/.  See compiled_dag.py for the TPU-native design.
 """
 
 from .channel import Channel, ChannelClosed, ChannelTimeout
+from .collective import allreduce_bind
 from .compiled_dag import CompiledDAG, CompiledDAGRef
-from .dag_node import (ClassMethodNode, DAGNode, FunctionNode, InputNode,
-                       MultiOutputNode)
+from .dag_node import (ClassMethodNode, CollectiveOutputNode, DAGNode,
+                       FunctionNode, InputNode, MultiOutputNode)
 
 __all__ = [
     "Channel", "ChannelClosed", "ChannelTimeout", "ClassMethodNode",
-    "CompiledDAG", "CompiledDAGRef", "DAGNode", "FunctionNode", "InputNode",
-    "MultiOutputNode",
+    "CollectiveOutputNode", "CompiledDAG", "CompiledDAGRef", "DAGNode",
+    "FunctionNode", "InputNode", "MultiOutputNode", "allreduce_bind",
 ]

@@ -1,0 +1,70 @@
+"""Collective operations inside compiled DAGs.
+
+Reference parity: ray.dag collective nodes + experimental.collective
+(reference: dag/collective_node.py:19,93; experimental/collective/
+allreduce.py:21) — an allreduce bound across several actors' outputs,
+executed inside the compiled graph without a driver round-trip.  The
+reference moves tensors over NCCL channels; here the participants
+exchange contributions over the same shm channel mesh the DAG already
+uses (host plane).  Device-plane reductions belong to the compiled ICI
+collectives (ray_tpu.collective with the xla backend).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .dag_node import ClassMethodNode, CollectiveOutputNode
+
+__all__ = ["allreduce_bind", "REDUCERS"]
+
+
+def _sum(vals):
+    out = vals[0]
+    for v in vals[1:]:
+        out = out + v
+    return out
+
+
+def _prod(vals):
+    out = vals[0]
+    for v in vals[1:]:
+        out = out * v
+    return out
+
+
+REDUCERS = {
+    "sum": _sum,
+    "prod": _prod,
+    "max": lambda vals: np.maximum.reduce(vals),
+    "min": lambda vals: np.minimum.reduce(vals),
+}
+
+
+def allreduce_bind(nodes: List[ClassMethodNode], op: str = "sum"
+                   ) -> List[CollectiveOutputNode]:
+    """Bind an allreduce across actor-method outputs (reference:
+    experimental/collective/allreduce.py:21 `allreduce.bind`).
+
+    Each input node must run on a distinct actor; returns one output
+    node per participant, each carrying the fully-reduced value on that
+    participant's actor (usable by later same-actor nodes or as DAG
+    leaves)."""
+    if op not in REDUCERS:
+        raise ValueError(f"unknown reduce op {op!r}; "
+                         f"have {sorted(REDUCERS)}")
+    if not nodes:
+        raise ValueError("allreduce needs at least one contributor")
+    for n in nodes:
+        if not isinstance(n, ClassMethodNode):
+            raise TypeError(
+                f"allreduce contributors must be actor-method nodes, "
+                f"got {n!r}")
+    actor_ids = [n.handle._actor_id for n in nodes]
+    if len(set(actor_ids)) != len(actor_ids):
+        raise ValueError(
+            "allreduce contributors must be on distinct actors")
+    group = list(nodes)
+    return [CollectiveOutputNode(n, group, op) for n in group]

@@ -36,7 +36,8 @@ import ray_tpu
 
 from .channel import (TAG_ERROR, TAG_INLINE, TAG_STOP, Channel,
                       ChannelClosed, ChannelTimeout)
-from .dag_node import (ClassMethodNode, DAGNode, InputNode, MultiOutputNode)
+from .dag_node import (ClassMethodNode, CollectiveOutputNode, DAGNode,
+                       InputNode, MultiOutputNode)
 
 logger = logging.getLogger(__name__)
 
@@ -74,44 +75,66 @@ def _dag_exec_loop(actor_self, plan: List[Dict[str, Any]],
 
     try:
         while True:
-            # read one version from every distinct input channel
+            # Channels are read lazily, at the step that consumes them,
+            # in plan (topo) order — NOT all-upfront.  Collectives make
+            # the channel graph cyclic across actors (A⇄B contribution
+            # exchange); upfront reads would deadlock, while plan-order
+            # reads guarantee every contribution is written before the
+            # collective step blocks on its peers.  Each channel is
+            # still consumed exactly once per iteration (iter_vals).
             iter_vals: Dict[str, Any] = {}
             err: Optional[BaseException] = None
             stop = False
-            for path, c in in_chans.items():
+
+            def read_chan(path: str):
+                nonlocal err, stop
+                if path in iter_vals:
+                    return
                 try:
-                    tag, v = c.read()
+                    tag, v = in_chans[path].read()
                 except (ChannelClosed, ChannelTimeout):
                     stop = True
-                    break
+                    iter_vals[path] = (TAG_STOP, None)
+                    return
                 if tag == TAG_STOP:
                     stop = True
-                    break
-                if tag == TAG_ERROR and err is None:
+                elif tag == TAG_ERROR and err is None:
                     err = v
                 iter_vals[path] = (tag, v)
-            if stop:
-                broadcast_stop()
-                return True
+
             node_out: Dict[str, Any] = {}
             for t in plan:
+                # resolve inputs first, even under error: every channel
+                # must be drained once per iteration to stay aligned
+                args = []
+                for kind, src in t["inputs"]:
+                    if kind == "const":
+                        args.append(src)
+                    elif kind == "node":
+                        args.append(node_out.get(src))
+                    else:
+                        read_chan(src)
+                        if stop:
+                            break
+                        args.append(iter_vals[src][1])
+                if stop:
+                    break
                 outs = [out_chans[p] for p in t["outputs"]]
                 if err is not None:
                     for c in outs:
                         c.write_error(err)
                     continue
                 try:
-                    args = []
-                    for kind, src in t["inputs"]:
-                        if kind == "const":
-                            args.append(src)
-                        elif kind == "node":
-                            args.append(node_out[src])
-                        else:
-                            tag, v = iter_vals[src]
-                            args.append(v)
-                    method = getattr(actor_self, t["method"])
-                    out = method(*args)
+                    if t.get("builtin"):
+                        # collective step: reduce own contribution with
+                        # the peers' (reference: aDAG collective node —
+                        # the reduction runs inside the actor loop)
+                        from .collective import REDUCERS
+
+                        out = REDUCERS[t["builtin"]](args)
+                    else:
+                        method = getattr(actor_self, t["method"])
+                        out = method(*args)
                     node_out[t["key"]] = out
                     for c in outs:
                         c.write(out)
@@ -119,6 +142,9 @@ def _dag_exec_loop(actor_self, plan: List[Dict[str, Any]],
                     err = e
                     for c in outs:
                         c.write_error(e)
+            if stop:
+                broadcast_stop()
+                return True
     except BaseException:
         logger.exception("dag exec loop crashed")
         broadcast_stop()
@@ -162,15 +188,16 @@ class CompiledDAG:
             self._leaves = list(root.outputs)
         else:
             self._leaves = [root]
-        body = [n for n in nodes if isinstance(n, ClassMethodNode)]
-        if not body:
+        body = [n for n in nodes
+                if isinstance(n, (ClassMethodNode, CollectiveOutputNode))]
+        if not any(isinstance(n, ClassMethodNode) for n in body):
             raise ValueError("compiled DAG needs at least one actor node")
         for n in nodes:
             if not isinstance(n, (InputNode, ClassMethodNode,
-                                  MultiOutputNode)):
+                                  CollectiveOutputNode, MultiOutputNode)):
                 raise TypeError(
-                    f"compiled DAGs support actor-method and input nodes "
-                    f"only, got {n!r}")
+                    f"compiled DAGs support actor-method, collective and "
+                    f"input nodes only, got {n!r}")
 
         from ray_tpu._private.api import current_core
 
@@ -195,26 +222,39 @@ class CompiledDAG:
             aid = n.handle._actor_id
             per_actor.setdefault(aid, {"handle": n.handle, "plan": []})
 
+        def dep_input(a: DAGNode, aid: str, consumer: DAGNode):
+            """Wire one upstream value into `consumer` on actor `aid`."""
+            if a.handle._actor_id == aid:
+                # same actor: direct value handoff, no channel
+                return ("node", f"n{a._id}")
+            p = edge_path(a, f"a{aid[:8]}-{consumer._id}")
+            consumer_counts[a._id] = consumer_counts.get(a._id, 0) + 1
+            per_actor[a.handle._actor_id].setdefault(
+                "extra_out", {}).setdefault(a._id, []).append(p)
+            return ("chan", p)
+
         for n in body:
             aid = n.handle._actor_id
             inputs = []
+            if isinstance(n, CollectiveOutputNode):
+                # collective step: own contribution by direct handoff,
+                # every peer's over a channel (reference: collective_node
+                # — the aDAG schedules one send/recv pair per peer)
+                for c in n.group:
+                    inputs.append(dep_input(c, aid, n))
+                per_actor[aid]["plan"].append(
+                    {"key": f"n{n._id}", "node_id": n._id,
+                     "method": f"allreduce_{n.op}", "builtin": n.op,
+                     "inputs": inputs, "outputs": []})
+                continue
             for a in list(n.args) + list(n.kwargs.values()):
                 if isinstance(a, InputNode):
                     p = edge_path(a, f"a{aid[:8]}-{n._id}")
                     inputs.append(("chan", p))
                     if p not in self._input_chan_paths:
                         self._input_chan_paths.append(p)
-                elif isinstance(a, ClassMethodNode):
-                    if a.handle._actor_id == aid:
-                        # same actor: direct value handoff, no channel
-                        inputs.append(("node", f"n{a._id}"))
-                    else:
-                        p = edge_path(a, f"a{aid[:8]}-{n._id}")
-                        inputs.append(("chan", p))
-                        consumer_counts[a._id] = \
-                            consumer_counts.get(a._id, 0) + 1
-                        per_actor[a.handle._actor_id].setdefault(
-                            "extra_out", {}).setdefault(a._id, []).append(p)
+                elif isinstance(a, (ClassMethodNode, CollectiveOutputNode)):
+                    inputs.append(dep_input(a, aid, n))
                 elif isinstance(a, DAGNode):
                     raise TypeError(f"unsupported arg node {a!r}")
                 else:
@@ -224,8 +264,9 @@ class CompiledDAG:
                  "inputs": inputs, "outputs": []})
 
         for leaf in self._leaves:
-            if not isinstance(leaf, ClassMethodNode):
-                raise TypeError("DAG leaves must be actor-method nodes")
+            if not isinstance(leaf, (ClassMethodNode, CollectiveOutputNode)):
+                raise TypeError("DAG leaves must be actor-method or "
+                                "collective nodes")
             p = edge_path(leaf, "driver")
             self._leaf_chan_paths.append(p)
             aid = leaf.handle._actor_id

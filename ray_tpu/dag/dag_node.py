@@ -143,6 +143,44 @@ class FunctionNode(DAGNode):
         return f"FunctionNode({self.remote_fn._fn.__name__}#{self._id})"
 
 
+class CollectiveOutputNode(DAGNode):
+    """One participant's output of a cross-actor collective inside a
+    compiled DAG (reference: dag/collective_node.py:19,93 — aDAG
+    allreduce over NCCL channels; here the reduction data plane is the
+    shm channel mesh between the participating actors).
+
+    Built via :func:`ray_tpu.dag.allreduce_bind`; each contributor
+    (a ClassMethodNode) yields one CollectiveOutputNode carrying the
+    reduced value on that contributor's actor."""
+
+    def __init__(self, contributor: "ClassMethodNode",
+                 group: List["ClassMethodNode"], op: str):
+        super().__init__()
+        self.contributor = contributor
+        self.group = group
+        self.op = op
+        self.handle = contributor.handle
+
+    def _upstream(self):
+        return list(self.group)
+
+    def _exec_interpreted(self, values, input_args):
+        # interpreted mode: materialize every contribution at the driver
+        # and reduce (compiled mode reduces inside the actors)
+        import ray_tpu
+
+        from .collective import REDUCERS
+
+        vals = [values[c._id] for c in self.group]
+        vals = [ray_tpu.get(v) if isinstance(v, ray_tpu.ObjectRef) else v
+                for v in vals]
+        return REDUCERS[self.op](vals)
+
+    def __repr__(self):
+        return (f"CollectiveOutputNode({self.op}@"
+                f"{self.handle._class_name}#{self._id})")
+
+
 class MultiOutputNode(DAGNode):
     """Bundle several leaves as the DAG output (reference:
     dag/output_node.py)."""
