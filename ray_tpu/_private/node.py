@@ -114,6 +114,8 @@ class Raylet:
         s.handle("store_stats", self.h_store_stats)
         s.handle("node_info", self.h_node_info)
         s.handle("list_workers", self.h_list_workers)
+        s.handle("list_logs", self.h_list_logs)
+        s.handle("read_log", self.h_read_log)
         s.handle("pending_demands", self.h_pending_demands)
         s.on_disconnect(self.h_disconnect)
 
@@ -839,6 +841,37 @@ class Raylet:
                 "tpu": r.tpu,
                 "addr": r.addr,  # core server: get_object + profiling RPCs
             } for r in self.workers.values()]
+
+    def h_list_logs(self, conn, p):
+        """Names + sizes of this node's log files (reference: dashboard
+        modules/log + `ray logs` CLI listing)."""
+        log_dir = os.path.join(self.session_dir, "logs")
+        out = []
+        try:
+            for name in sorted(os.listdir(log_dir)):
+                path = os.path.join(log_dir, name)
+                if os.path.isfile(path):
+                    out.append({"name": name,
+                                "size_bytes": os.path.getsize(path)})
+        except OSError:
+            pass
+        return {"node_id": self.node_id, "logs": out}
+
+    def h_read_log(self, conn, p):
+        """Tail of one log file by name (no path components allowed)."""
+        name = p.get("name", "")
+        if not name or "/" in name or name.startswith("."):
+            return None
+        path = os.path.join(self.session_dir, "logs", name)
+        tail = int(p.get("tail_bytes", 64 * 1024))
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return None
 
     def h_node_info(self, conn, p):
         with self.lock:

@@ -205,6 +205,26 @@ def cmd_job(args):
         print("stopped" if client.stop_job(args.id) else "not running")
 
 
+def cmd_logs(args):
+    """`ray-tpu logs [glob]`: list or tail cluster log files (reference:
+    `ray logs` state CLI)."""
+    from ray_tpu.util.state import api as state
+
+    address = _resolve_address(args)
+    if not args.name:
+        for nid, logs in state.list_logs(address=address).items():
+            for entry in logs:
+                print(f"{nid[:12]}  {entry['size_bytes']:>9}  "
+                      f"{entry['name']}")
+        return
+    for nid, text in state.get_log(args.name, address=address,
+                                   tail_bytes=args.tail).items():
+        if text is None:
+            continue
+        print(f"==== {nid[:12]}: {args.name}")
+        sys.stdout.write(text)
+
+
 def cmd_serve(args):
     """`serve deploy/status/shutdown` (reference: serve CLI over the
     declarative schema, serve/scripts.py)."""
@@ -308,6 +328,14 @@ def build_parser() -> argparse.ArgumentParser:
         if c != "list":
             jp.add_argument("id")
     sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("logs", help="list/tail cluster log files")
+    sp.add_argument("name", nargs="?", default=None,
+                    help="log file name (omit to list)")
+    sp.add_argument("--tail", type=int, default=64 * 1024,
+                    help="bytes from the end")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_logs)
 
     sp = sub.add_parser("serve", help="manage serve applications")
     ssub = sp.add_subparsers(dest="serve_cmd", required=True)

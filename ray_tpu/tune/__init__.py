@@ -11,7 +11,8 @@ from ray_tpu.train.session import get_checkpoint, get_context, report
 from .schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
                          HyperBandScheduler, MedianStoppingRule, PB2,
                          PopulationBasedTraining, TrialScheduler)
-from .search import (BasicVariantGenerator, Categorical, ConcurrencyLimiter,
+from .search import (BasicVariantGenerator, BayesOptSearch, Categorical,
+                     ConcurrencyLimiter,
                      Domain, Float, Integer, Repeater, Searcher, TPESearch,
                      choice, generate_variants, grid_search, loguniform,
                      randint, sample_from, uniform)
@@ -23,6 +24,7 @@ ASHAScheduler = AsyncHyperBandScheduler
 
 __all__ = [
     "ASHAScheduler", "AsyncHyperBandScheduler", "BasicVariantGenerator",
+    "BayesOptSearch",
     "Callback", "Categorical", "ConcurrencyLimiter", "Domain",
     "FIFOScheduler", "Float", "HyperBandScheduler", "Integer",
     "JsonLoggerCallback", "MedianStoppingRule", "PB2",

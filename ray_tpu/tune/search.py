@@ -9,6 +9,7 @@ Searcher proposes configs and learns from completed trials.
 from __future__ import annotations
 
 import itertools
+import math
 import random
 from typing import Any, Callable, Dict, List, Optional
 
@@ -361,3 +362,153 @@ class TPESearch(Searcher):
         if self.mode == "min":
             score = -score
         self._obs.append((cfg, score))
+
+
+class BayesOptSearch(Searcher):
+    """Gaussian-process Bayesian optimization over Float/Integer domains.
+
+    Native stand-in for the reference's bayes_opt integration
+    (reference: tune/search/bayesopt/bayesopt_search.py) without the
+    external dependency: an RBF-kernel GP posterior over normalized
+    [0,1]^d inputs, maximizing Expected Improvement over random
+    candidates.  Categorical dims fall back to good-set-weighted
+    sampling (a GP has no natural metric there).
+    """
+
+    def __init__(self, param_space: Dict[str, Any], metric: str,
+                 mode: str = "max", n_initial: int = 6,
+                 n_candidates: int = 256, num_samples: int = 64,
+                 length_scale: float = 0.2, noise: float = 1e-4,
+                 xi: float = 0.01, seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        import numpy as np
+
+        self.space: Dict[str, Domain] = {}
+        self._cats: Dict[str, Categorical] = {}
+        self._passthrough: Dict[str, Any] = {}
+        for k, v in param_space.items():
+            if isinstance(v, (Float, Integer)):
+                self.space[k] = v
+            elif isinstance(v, Categorical):
+                self._cats[k] = v
+            else:
+                self._passthrough[k] = v
+        if not self.space:
+            raise ValueError(
+                "BayesOptSearch needs at least one Float/Integer dimension")
+        self.n_initial = n_initial
+        self.n_candidates = n_candidates
+        self.remaining = num_samples
+        self.length_scale = length_scale
+        self.noise = noise
+        self.xi = xi
+        self.rng = random.Random(seed)
+        self.np_rng = np.random.RandomState(seed)
+        self._obs: List[tuple] = []   # (unit-vector, cat-config, score)
+        self._pending: Dict[str, tuple] = {}
+
+    # -- unit-cube transform -------------------------------------------------
+
+    def _to_unit(self, dom: Domain, v: float) -> float:
+        import numpy as np
+
+        if isinstance(dom, Float) and dom.log:
+            lo, hi = np.log(dom.lower), np.log(dom.upper)
+            return float((np.log(v) - lo) / (hi - lo))
+        lo, hi = float(dom.lower), float(dom.upper)
+        return (float(v) - lo) / (hi - lo)
+
+    def _from_unit(self, dom: Domain, u: float):
+        import numpy as np
+
+        u = min(max(u, 0.0), 1.0)
+        if isinstance(dom, Float) and dom.log:
+            lo, hi = np.log(dom.lower), np.log(dom.upper)
+            return float(np.exp(lo + u * (hi - lo)))
+        lo, hi = float(dom.lower), float(dom.upper)
+        v = lo + u * (hi - lo)
+        if isinstance(dom, Integer):
+            return min(max(int(round(v)), dom.lower), dom.upper - 1)
+        return v
+
+    # -- searcher API --------------------------------------------------------
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        import numpy as np
+
+        if self.remaining <= 0:
+            return None
+        self.remaining -= 1
+        keys = list(self.space)
+        if len(self._obs) < self.n_initial:
+            u = self.np_rng.rand(len(keys))
+        else:
+            u = self._ei_suggest(keys)
+        cats = {k: self._weighted_cat(k, dom)
+                for k, dom in self._cats.items()}
+        self._pending[trial_id] = (u.copy(), dict(cats))
+        out = {k: self._from_unit(self.space[k], u[i])
+               for i, k in enumerate(keys)}
+        out.update(cats)
+        for k, v in self._passthrough.items():
+            out[k] = v.sample(self.rng) if isinstance(v, Domain) else v
+        return out
+
+    def _weighted_cat(self, k: str, dom: Categorical):
+        import numpy as np
+
+        counts = {c: 1.0 for c in dom.categories}
+        obs = sorted(self._obs, key=lambda o: o[2], reverse=True)
+        for _, cats, _ in obs[:max(2, len(obs) // 4)]:
+            if cats.get(k) in counts:
+                counts[cats[k]] += 1.0
+        cs, w = zip(*counts.items())
+        w = np.asarray(w) / sum(w)
+        return cs[self.np_rng.choice(len(cs), p=w)]
+
+    def _ei_suggest(self, keys):
+        """Maximize Expected Improvement of the GP posterior over random
+        candidate points (plus jittered copies of the incumbent)."""
+        import numpy as np
+
+        X = np.stack([o[0] for o in self._obs])            # [n, d]
+        y = np.asarray([o[2] for o in self._obs])          # [n]
+        y_mean, y_std = y.mean(), max(y.std(), 1e-8)
+        yn = (y - y_mean) / y_std
+
+        def k_rbf(A, B):
+            d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / self.length_scale ** 2)
+
+        K = k_rbf(X, X) + self.noise * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+
+        n_rand = self.n_candidates
+        cand = self.np_rng.rand(n_rand, len(keys))
+        best = X[int(np.argmax(yn))]
+        jitter = best[None, :] + 0.05 * self.np_rng.randn(16, len(keys))
+        cand = np.vstack([cand, np.clip(jitter, 0, 1)])
+
+        Ks = k_rbf(cand, X)                                # [m, n]
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)                       # [n, m]
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        sigma = np.sqrt(var)
+        f_best = yn.max()
+        z = (mu - f_best - self.xi) / sigma
+        # standard-normal pdf/cdf without scipy
+        pdf = np.exp(-0.5 * z * z) / np.sqrt(2 * np.pi)
+        cdf = 0.5 * (1.0 + np.vectorize(math.erf)(z / np.sqrt(2)))
+        ei = (mu - f_best - self.xi) * cdf + sigma * pdf
+        return cand[int(np.argmax(ei))]
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        pend = self._pending.pop(trial_id, None)
+        if pend is None or error or not result or self.metric not in result:
+            return
+        u, cats = pend
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._obs.append((u, cats, score))

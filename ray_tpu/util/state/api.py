@@ -171,6 +171,37 @@ def list_objects(address: Optional[str] = None, *, filters=None,
     return _run(address, go)
 
 
+def list_logs(address: Optional[str] = None, *, node_id: Optional[str] = None
+              ) -> Dict[str, List[Dict[str, Any]]]:
+    """node_id -> [{name, size_bytes}, ...] (reference: `ray logs` CLI
+    listing via the dashboard log module)."""
+    def go(c):
+        out = {}
+        for nid, reply in c.per_node("list_logs").items():
+            if node_id is not None and nid != node_id:
+                continue
+            if isinstance(reply, dict):
+                out[nid] = reply.get("logs", [])
+        return out
+    return _run(address, go)
+
+
+def get_log(name: str, address: Optional[str] = None, *,
+            node_id: Optional[str] = None,
+            tail_bytes: int = 64 * 1024) -> Dict[str, Optional[str]]:
+    """node_id -> tail of the named log file (None if absent there)."""
+    def go(c):
+        out = {}
+        for nid, text in c.per_node(
+                "read_log", {"name": name,
+                             "tail_bytes": tail_bytes}).items():
+            if node_id is not None and nid != node_id:
+                continue
+            out[nid] = text
+        return out
+    return _run(address, go)
+
+
 # -- get_* ------------------------------------------------------------------
 
 def get_node(node_id: str, address: Optional[str] = None):

@@ -146,3 +146,38 @@ def test_summarize_objects(cluster):
     s = state.summarize_objects()
     assert s["total_bytes"] >= (1 << 20)
     del ref
+
+
+def test_list_and_get_logs(cluster):
+    """Log listing + tail through the state API (reference: `ray logs`)."""
+    @ray_tpu.remote
+    def noisy():
+        print("hello-from-noisy-task")
+        return 1
+
+    assert ray_tpu.get(noisy.remote(), timeout=60) == 1
+    deadline = time.time() + 30
+    found = None
+    while time.time() < deadline and not found:
+        logs = state.list_logs()
+        for nid, entries in logs.items():
+            workers = [e for e in entries
+                       if e["name"].startswith("worker-")]
+            if workers:
+                found = (nid, workers)
+                break
+        time.sleep(0.5)
+    assert found, f"no worker logs listed: {logs}"
+    nid, workers = found
+    # the print landed in some worker's log
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        texts = [t for e in workers
+                 for t in [state.get_log(e["name"]).get(nid)] if t]
+        if any("hello-from-noisy-task" in t for t in texts):
+            break
+        time.sleep(0.5)
+        logs = state.list_logs()
+        workers = [e for e in logs.get(nid, [])
+                   if e["name"].startswith("worker-")]
+    assert any("hello-from-noisy-task" in t for t in texts)
