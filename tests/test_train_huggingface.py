@@ -1,0 +1,61 @@
+"""Transformers-on-Train integration (reference:
+train/huggingface tests — TorchTrainer + RayTrainReportCallback)."""
+
+import pytest
+
+pytest.importorskip("transformers")
+
+from ray_tpu.train import ScalingConfig
+
+
+def test_transformers_trainer_two_workers(ray_cluster):
+    from ray_tpu.train.torch import TorchTrainer
+
+    def loop(config):
+        import os
+
+        os.environ["HF_HUB_OFFLINE"] = "1"
+        import numpy as np
+        import torch
+        from transformers import (BertConfig,
+                                  BertForSequenceClassification,
+                                  Trainer, TrainingArguments)
+
+        from ray_tpu.train.huggingface import (RayTrainReportCallback,
+                                               prepare_trainer)
+
+        cfg = BertConfig(vocab_size=64, hidden_size=32,
+                         num_hidden_layers=1, num_attention_heads=2,
+                         intermediate_size=64,
+                         max_position_embeddings=32, num_labels=2)
+        torch.manual_seed(0)
+        model = BertForSequenceClassification(cfg)
+
+        class DS(torch.utils.data.Dataset):
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                rng = np.random.RandomState(i)
+                return {"input_ids": torch.tensor(rng.randint(0, 64, 8)),
+                        "attention_mask": torch.ones(8, dtype=torch.long),
+                        "labels": torch.tensor(i % 2)}
+
+        args = TrainingArguments(
+            output_dir=config["out_dir"], per_device_train_batch_size=8,
+            num_train_epochs=1, logging_steps=1, report_to=[],
+            use_cpu=True, save_strategy="no", disable_tqdm=True)
+        trainer = Trainer(model=model, args=args, train_dataset=DS())
+        trainer.add_callback(RayTrainReportCallback())
+        trainer = prepare_trainer(trainer)
+        trainer.train()
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        result = TorchTrainer(
+            loop, train_loop_config={"out_dir": d},
+            scaling_config=ScalingConfig(num_workers=2)).fit()
+    # rank-0 logs flowed through session.report
+    assert "loss" in result.metrics or "train_loss" in result.metrics
+    assert result.metrics["step"] >= 1
