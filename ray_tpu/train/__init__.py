@@ -6,8 +6,8 @@ get_context/get_checkpoint/get_dataset_shard match the reference's
 module-level session API (train/_internal/session.py:667-790).
 """
 
-from .backend import (Backend, BackendConfig, JaxConfig, TorchConfig,
-                      TPUConfig)
+from .backend import (Backend, BackendConfig, JaxConfig, TensorflowConfig,
+                      TorchConfig, TPUConfig)
 from .backend_executor import (BackendExecutor, TrainingFailedError,
                                TrainingWorkerError)
 from .checkpoint import Checkpoint
@@ -24,7 +24,8 @@ __all__ = [
     "Backend", "BackendConfig", "BackendExecutor", "Checkpoint",
     "CheckpointConfig", "CheckpointManager", "DataParallelTrainer",
     "FailureConfig", "JaxConfig", "JaxTrainer", "Result", "RunConfig",
-    "ScalingConfig", "TorchConfig", "TPUConfig", "TrainContext",
+    "ScalingConfig", "TensorflowConfig", "TorchConfig", "TPUConfig",
+    "TrainContext",
     "TrainingFailedError",
     "TrainingWorkerError", "WorkerGroup", "get_checkpoint", "get_context",
     "get_dataset_shard", "report",

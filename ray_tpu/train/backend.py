@@ -128,6 +128,55 @@ class _TorchBackend(Backend):
 
 
 @dataclass
+class TensorflowConfig(BackendConfig):
+    """Backend config for TF MultiWorkerMirroredStrategy training
+    (reference: train/tensorflow/config.py — builds TF_CONFIG with the
+    worker gang's host:port list and each rank's task index)."""
+
+    port_base: int = 0  # 0 = probe free ports on the workers
+
+    def backend_cls(self):
+        return _TensorflowBackend
+
+
+def _tf_free_port():
+    from ray_tpu._private.protocol import free_port
+
+    return free_port()
+
+
+def _setup_tf_config(workers: list, index: int):
+    import json
+    import os
+
+    os.environ["TF_CONFIG"] = json.dumps({
+        "cluster": {"worker": workers},
+        "task": {"type": "worker", "index": index},
+    })
+    return workers[index]
+
+
+class _TensorflowBackend(Backend):
+    def on_start(self, worker_group, backend_config: "TensorflowConfig"):
+        import ray_tpu
+
+        n = worker_group.num_workers
+        ports = ray_tpu.get([w.actor.execute.remote(_tf_free_port)
+                             for w in worker_group.workers])
+        hosts = [w.metadata.get("node_ip", "127.0.0.1")
+                 for w in worker_group.workers]
+        gang = [f"{h}:{p}" for h, p in zip(hosts, ports)]
+        env = {"RAY_TPU_TRAIN_WORLD_SIZE": str(n)}
+        ray_tpu.get([
+            w.actor.set_env_vars.remote({**env,
+                                         "RAY_TPU_TRAIN_WORLD_RANK": str(i)})
+            for i, w in enumerate(worker_group.workers)])
+        ray_tpu.get([w.actor.execute.remote(_setup_tf_config, gang, i)
+                     for i, w in enumerate(worker_group.workers)])
+        logger.info("TF_CONFIG distributed gang: %s", gang)
+
+
+@dataclass
 class JaxConfig(BackendConfig):
     """Backend config for JAX/TPU training.
 

@@ -1,0 +1,51 @@
+"""TensorflowTrainer: TF MultiWorkerMirroredStrategy on the cluster.
+
+Reference parity: python/ray/train/tensorflow/ — TensorflowTrainer
+(tensorflow_trainer.py) is a DataParallelTrainer whose backend publishes
+TF_CONFIG across the worker group; the user's loop opens
+``tf.distribute.MultiWorkerMirroredStrategy()`` which reads it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from .backend import TensorflowConfig
+from .trainer import JaxTrainer
+
+__all__ = ["TensorflowTrainer", "TensorflowConfig", "prepare_dataset_shard"]
+
+
+class TensorflowTrainer(JaxTrainer):
+    """Same orchestration as JaxTrainer with the TF_CONFIG backend::
+
+        def loop(config):
+            strategy = tf.distribute.MultiWorkerMirroredStrategy()
+            with strategy.scope():
+                model = ...
+            ...
+            session.report({"loss": ...})
+
+        TensorflowTrainer(loop,
+                          scaling_config=ScalingConfig(num_workers=2)).fit()
+    """
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 tensorflow_config: Optional[TensorflowConfig] = None,
+                 **kwargs):
+        kwargs.setdefault("backend_config",
+                          tensorflow_config or TensorflowConfig())
+        super().__init__(train_loop_per_worker,
+                         train_loop_config=train_loop_config, **kwargs)
+
+
+def prepare_dataset_shard(dataset):
+    """Disable TF auto-sharding on an already-per-worker dataset
+    (reference: train/tensorflow/train_loop_utils.py)."""
+    import tensorflow as tf
+
+    options = tf.data.Options()
+    options.experimental_distribute.auto_shard_policy = \
+        tf.data.experimental.AutoShardPolicy.OFF
+    return dataset.with_options(options)
