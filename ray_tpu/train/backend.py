@@ -139,12 +139,6 @@ class TensorflowConfig(BackendConfig):
         return _TensorflowBackend
 
 
-def _tf_free_port():
-    from ray_tpu._private.protocol import free_port
-
-    return free_port()
-
-
 def _setup_tf_config(workers: list, index: int):
     import json
     import os
@@ -159,10 +153,16 @@ def _setup_tf_config(workers: list, index: int):
 class _TensorflowBackend(Backend):
     def on_start(self, worker_group, backend_config: "TensorflowConfig"):
         import ray_tpu
+        from ray_tpu._private.protocol import free_port
 
         n = worker_group.num_workers
-        ports = ray_tpu.get([w.actor.execute.remote(_tf_free_port)
-                             for w in worker_group.workers])
+        if backend_config.port_base:
+            # deterministic ports for firewalled clusters
+            ports = [backend_config.port_base + i for i in range(n)]
+        else:
+            ports = ray_tpu.get(
+                [w.actor.execute.remote(free_port)
+                 for w in worker_group.workers], timeout=60)
         hosts = [w.metadata.get("node_ip", "127.0.0.1")
                  for w in worker_group.workers]
         gang = [f"{h}:{p}" for h, p in zip(hosts, ports)]
