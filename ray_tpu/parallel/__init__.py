@@ -1,10 +1,12 @@
 from .mesh import (AXIS_ORDER, MeshSpec, batch_sharding, data_axes,
-                   local_mesh, make_mesh, replicated)
+                   local_mesh, make_mesh, make_multislice_mesh,
+                   replicated, slice_groups)
 from .sharding import (DEFAULT_RULES, Logical, shard_tree, spec_from_logical,
                        tree_shardings, with_constraint)
 
 __all__ = [
-    "AXIS_ORDER", "MeshSpec", "make_mesh", "local_mesh", "batch_sharding",
+    "AXIS_ORDER", "MeshSpec", "make_mesh", "make_multislice_mesh",
+    "local_mesh", "slice_groups", "batch_sharding",
     "data_axes", "replicated",
     "DEFAULT_RULES", "Logical", "spec_from_logical", "tree_shardings",
     "shard_tree", "with_constraint",
