@@ -160,6 +160,27 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> ActorHandle:
         core = current_core()
         o = self._opts
+        if o.get("get_if_exists"):
+            # idempotent get-or-create for named actors (reference:
+            # actor options get_if_exists) — fetch first; creation races
+            # fall through to the name-collision fetch below
+            if not o.get("name"):
+                raise ValueError("get_if_exists requires a name")
+            view = core.get_actor_by_name(o["name"])
+            if view is not None and view["state"] != "DEAD":
+                return ActorHandle(view["actor_id"], self._cls.__name__,
+                                   is_owner=False)
+            try:
+                return self.options(get_if_exists=False).remote(
+                    *args, **kwargs)
+            except Exception as e:
+                if "already taken" not in str(e):
+                    raise
+                view = core.get_actor_by_name(o["name"])
+                if view is None:
+                    raise
+                return ActorHandle(view["actor_id"], self._cls.__name__,
+                                   is_owner=False)
         strategy, pg, bidx = _strategy_to_wire(o.get("scheduling_strategy"))
         if pg is None and o.get("placement_group") is not None:
             pg = o["placement_group"].id

@@ -170,3 +170,27 @@ def test_actor_churn_does_not_leak_worker_records(ray_cluster):
         assert n <= 12, f"{n} worker records linger after actor churn"
     finally:
         c.close()
+
+
+def test_get_if_exists(ray_cluster):
+    """options(get_if_exists=True) is an idempotent get-or-create
+    (reference: actor option get_if_exists)."""
+    @ray_tpu.remote
+    class Singleton:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    a = Singleton.options(name="gie-counter", lifetime="detached",
+                          get_if_exists=True).remote()
+    assert ray_tpu.get(a.inc.remote(), timeout=60) == 1
+    b = Singleton.options(name="gie-counter", lifetime="detached",
+                          get_if_exists=True).remote()
+    # same actor: state continues
+    assert ray_tpu.get(b.inc.remote(), timeout=60) == 2
+    with pytest.raises(ValueError, match="requires a name"):
+        Singleton.options(get_if_exists=True).remote()
+    ray_tpu.kill(a)

@@ -143,7 +143,7 @@ def test_multislice_mesh_layout():
 
     # a dp-psum over the multislice mesh compiles and runs
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     def f(x):
