@@ -24,7 +24,8 @@ from ._private import common as _common
 from ._private.api import (ActorClass, ActorHandle, RemoteFunction, get_actor,
                            kill, remote)
 from ._private.common import (ActorDiedError, GetTimeoutError, ObjectLostError,
-                              RayTpuError, TaskError, WorkerCrashedError)
+                              RayTpuError, TaskCancelledError, TaskError,
+                              WorkerCrashedError)
 from ._private.core import CoreWorker, ObjectRef
 
 __version__ = "0.1.0"
@@ -43,6 +44,7 @@ def is_initialized() -> bool:
 def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
          num_tpus: Optional[float] = None,
          resources: Optional[Dict[str, float]] = None,
+         namespace: Optional[str] = None,
          ignore_reinit_error: bool = False,
          logging_level: int = logging.INFO) -> Dict[str, Any]:
     """Start (or connect to) a ray_tpu cluster and connect this driver.
@@ -116,6 +118,7 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
             if os.path.isdir(info["store_root"]):
                 store_root = info["store_root"]
         _core = CoreWorker(control_addr, raylet_addr, mode="driver",
+                           namespace=namespace,
                            node_id=node_id, store_root=store_root)
         atexit.register(shutdown)
         return connection_info()
@@ -167,6 +170,15 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
     return _require().wait(refs, num_returns=num_returns, timeout=timeout)
 
 
+def cancel(ref: "ObjectRef", *, force: bool = False) -> bool:
+    """Cancel the task that produces `ref` (reference: ray.cancel).
+    Queued tasks are dropped; running ones get TaskCancelledError
+    injected (force=True kills the worker process).  Getting the ref
+    afterwards raises TaskCancelledError.  Cancelled tasks never
+    retry."""
+    return _require().cancel(ref, force=force)
+
+
 def cluster_resources() -> Dict[str, float]:
     return _require().control.call("cluster_resources", {})["total"]
 
@@ -210,9 +222,9 @@ class profile:
 
 __all__ = [
     "init", "shutdown", "is_initialized", "put", "get", "wait", "remote",
-    "kill", "get_actor", "cluster_resources", "available_resources", "nodes",
-    "timeline", "profile",
+    "kill", "cancel", "get_actor", "cluster_resources",
+    "available_resources", "nodes", "timeline", "profile",
     "ObjectRef", "ActorHandle", "ActorClass", "RemoteFunction",
     "RayTpuError", "TaskError", "ActorDiedError", "WorkerCrashedError",
-    "ObjectLostError", "GetTimeoutError",
+    "ObjectLostError", "GetTimeoutError", "TaskCancelledError",
 ]

@@ -166,7 +166,8 @@ class ActorClass:
             # fall through to the name-collision fetch below
             if not o.get("name"):
                 raise ValueError("get_if_exists requires a name")
-            view = core.get_actor_by_name(o["name"])
+            view = core.get_actor_by_name(o["name"],
+                                          namespace=o.get("namespace"))
             if view is not None and view["state"] != "DEAD":
                 return ActorHandle(view["actor_id"], self._cls.__name__,
                                    is_owner=False)
@@ -176,7 +177,8 @@ class ActorClass:
             except Exception as e:
                 if "already taken" not in str(e):
                     raise
-                view = core.get_actor_by_name(o["name"])
+                view = core.get_actor_by_name(o["name"],
+                                              namespace=o.get("namespace"))
                 if view is None:
                     raise
                 return ActorHandle(view["actor_id"], self._cls.__name__,
@@ -196,6 +198,7 @@ class ActorClass:
             pg=pg, bundle_index=bidx,
             detached=o.get("lifetime") == "detached",
             runtime_env=o.get("runtime_env"),
+            namespace=o.get("namespace"),
         )
         return ActorHandle(aid, self._cls.__name__,
                            is_owner=o.get("lifetime") != "detached")
@@ -220,9 +223,9 @@ def remote(*args, **opts):
     return wrap
 
 
-def get_actor(name: str) -> ActorHandle:
+def get_actor(name: str, namespace: str = None) -> ActorHandle:
     core = current_core()
-    view = core.get_actor_by_name(name)
+    view = core.get_actor_by_name(name, namespace=namespace)
     if view is None or view["state"] == "DEAD":
         raise ValueError(f"no alive actor named {name!r}")
     return ActorHandle(view["actor_id"], view.get("class_name") or "Actor")

@@ -253,5 +253,10 @@ class ObjectLostError(RayTpuError):
     pass
 
 
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled via ray_tpu.cancel() (reference:
+    ray.exceptions.TaskCancelledError)."""
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     pass

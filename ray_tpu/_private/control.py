@@ -73,10 +73,12 @@ class NodeRecord:
 
 class ActorRecord:
     def __init__(self, aid: str, spec_blob: bytes, name, resources, max_restarts,
-                 owner_id, pg_id=None, bundle_index=-1, detached=False):
+                 owner_id, pg_id=None, bundle_index=-1, detached=False,
+                 namespace: str = "default"):
         self.actor_id = aid
         self.spec_blob = spec_blob
         self.name = name
+        self.namespace = namespace
         self.resources = resources
         self.max_restarts = max_restarts
         self.restarts = 0
@@ -96,6 +98,7 @@ class ActorRecord:
         return {
             "actor_id": self.actor_id,
             "name": self.name,
+            "namespace": self.namespace,
             "state": self.state,
             "node_id": self.node_id,
             "worker_addr": self.worker_addr,
@@ -128,6 +131,10 @@ class PlacementGroupRecord:
             "bundles": [common.denormalize_resources(b) for b in self.bundles],
             "assignments": dict(self.assignments),
         }
+
+
+def _named_key(namespace: str, name: str) -> str:
+    return f"{namespace or 'default'}:{name}"
 
 
 class _NullDeferred:
@@ -252,6 +259,7 @@ class ControlServer:
                 "state": rec.state, "restarts": rec.restarts,
                 "incarnation": rec.incarnation, "error": rec.error,
                 "class_name": rec.class_name,
+                "namespace": rec.namespace,
             })
 
     def _persist_pg(self, rec: PlacementGroupRecord):
@@ -282,7 +290,8 @@ class ControlServer:
         for aid, d in self.pstore.load_table("actor").items():
             rec = ActorRecord(aid, d["spec_blob"], d["name"], d["resources"],
                               d["max_restarts"], d["owner_id"], d["pg_id"],
-                              d["bundle_index"], d["detached"])
+                              d["bundle_index"], d["detached"],
+                              namespace=d.get("namespace", "default"))
             rec.class_name = d.get("class_name", "")
             rec.restarts = d.get("restarts", 0)
             rec.incarnation = d.get("incarnation", 0)
@@ -294,7 +303,7 @@ class ControlServer:
             rec.state = RESTARTING
             rec.incarnation += 1
             if rec.name:
-                self.named_actors[rec.name] = aid
+                self.named_actors[_named_key(rec.namespace, rec.name)] = aid
             self.pending_actors.append(rec)
             n_actors += 1
         for pgid, d in self.pstore.load_table("pg").items():
@@ -578,6 +587,7 @@ class ControlServer:
             normalize_resources(p.get("resources")), p.get("max_restarts", 0),
             p.get("owner_id", ""), p.get("pg_id"), p.get("bundle_index", -1),
             p.get("detached", False),
+            namespace=p.get("namespace") or "default",
         )
         rec.class_name = p.get("class_name", "")
         with self.lock:
@@ -590,11 +600,13 @@ class ControlServer:
                 d.resolve(existing.view())
                 return
             if rec.name:
-                if self.named_actors.get(rec.name, rec.actor_id) \
+                key = _named_key(rec.namespace, rec.name)
+                if self.named_actors.get(key, rec.actor_id) \
                         != rec.actor_id:
-                    d.reject(f"actor name {rec.name!r} already taken")
+                    d.reject(f"actor name {rec.name!r} already taken "
+                             f"in namespace {rec.namespace!r}")
                     return
-                self.named_actors[rec.name] = rec.actor_id
+                self.named_actors[key] = rec.actor_id
             self.actors[rec.actor_id] = rec
         # creation is async (reference: RegisterActor replies before the
         # actor is scheduled; the caller learns placement via
@@ -788,7 +800,8 @@ class ControlServer:
         with self.lock:
             aid = p.get("actor_id")
             if aid is None and p.get("name"):
-                aid = self.named_actors.get(p["name"])
+                aid = self.named_actors.get(
+                    _named_key(p.get("namespace") or "default", p["name"]))
             rec = self.actors.get(aid) if aid else None
             return None if rec is None else rec.view()
 
@@ -839,7 +852,8 @@ class ControlServer:
                     rec.state = DEAD
                     rec.error = "killed via kill_actor"
                     if rec.name:
-                        self.named_actors.pop(rec.name, None)
+                        self.named_actors.pop(
+                            _named_key(rec.namespace, rec.name), None)
                 nid = rec.node_id
                 view = rec.view()
             if no_restart:

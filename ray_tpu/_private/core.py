@@ -33,8 +33,9 @@ import cloudpickle
 
 from . import common, serialization
 from .common import (INLINE_OBJECT_LIMIT, ActorDiedError, GetTimeoutError,
-                     ObjectLostError, SerializedRef, TaskError, TaskSpec,
-                     WorkerCrashedError, normalize_resources)
+                     ObjectLostError, SerializedRef, TaskCancelledError,
+                     TaskError, TaskSpec, WorkerCrashedError,
+                     normalize_resources)
 from .protocol import (Client, ConnectionLost, DaemonPool, Deferred,
                        RpcError, Server, ServerConn)
 from .shm_store import ShmObjectStore
@@ -165,7 +166,8 @@ class ObjectEntry:
 
 
 class TaskRecord:
-    __slots__ = ("spec", "pool_key", "deps", "pushed_to", "retries_left", "done")
+    __slots__ = ("spec", "pool_key", "deps", "pushed_to", "retries_left",
+                 "done", "canceled")
 
     def __init__(self, spec: TaskSpec, pool_key, retries_left: int):
         self.spec = spec
@@ -174,6 +176,7 @@ class TaskRecord:
         self.pushed_to: Optional[str] = None
         self.retries_left = retries_left
         self.done = False
+        self.canceled = False
 
 
 class LeasedWorker:
@@ -237,9 +240,13 @@ class ActorConn:
 class CoreWorker:
     def __init__(self, control_addr, raylet_addr=None, mode: str = "driver",
                  job: Optional[str] = None, worker_id: Optional[str] = None,
-                 node_id: Optional[str] = None, store_root: Optional[str] = None):
+                 node_id: Optional[str] = None, store_root: Optional[str] = None,
+                 namespace: Optional[str] = None):
         global _current_core
         self.mode = mode
+        self.namespace = (namespace
+                          or os.environ.get("RAY_TPU_NAMESPACE")
+                          or "default")
         self.worker_id = worker_id or common.worker_id()
         self.job_id = job or common.job_id()
         self.node_id = node_id
@@ -282,6 +289,7 @@ class CoreWorker:
 
         # task submission
         self.pools: Dict[Any, SchedPool] = {}
+        self.task_records: Dict[str, TaskRecord] = {}  # live normal tasks
         self.functions: Dict[str, Any] = {}           # fid -> callable (exec side)
         self.registered_functions: Set[str] = set()   # fids pushed to control
         # fn object -> (fid, name); weak keys so task fns can be GC'd
@@ -957,6 +965,7 @@ class CoreWorker:
             if pool is None:
                 pool = self.pools[key] = SchedPool(key)
             pool.queue.append(rec)
+            self.task_records[spec.task_id] = rec  # cancel() lookup
         self.task_events.record_status(
             spec.task_id, "PENDING_ARGS_AVAIL", name=spec.function_name,
             extra={"type": "NORMAL_TASK"})
@@ -1107,6 +1116,14 @@ class CoreWorker:
                 pool.avg_ms = ms if pool.avg_ms is None else \
                     0.8 * pool.avg_ms + 0.2 * ms
         rec.done = True
+        with self.lock:
+            self.task_records.pop(rec.spec.task_id, None)
+        if rec.canceled and reply.get("status") != "ok":
+            # the worker raised out of the injected cancellation: surface
+            # TaskCancelledError rather than the interrupt artifact
+            reply = {"status": "error", "error": serialization.dumps_inline(
+                TaskCancelledError(
+                    f"task {rec.spec.function_name} was cancelled"))}
         self._store_results(rec.spec, reply)
         self._pump(pool)
         self._maybe_return_idle_leases(pool)
@@ -1146,7 +1163,7 @@ class CoreWorker:
             lw.inflight_since.pop(rec.spec.task_id, None)
             if lw.client is not None and lw.client.closed:
                 pool.leases.pop(lw.worker_id, None)
-        if rec.retries_left > 0 and not self._shutdown:
+        if rec.retries_left > 0 and not self._shutdown and not rec.canceled:
             rec.retries_left -= 1
             logger.warning("task %s failed on %s (%s); retrying (%d left)",
                            rec.spec.task_id[:12], lw.worker_id[:12], exc,
@@ -1155,8 +1172,14 @@ class CoreWorker:
                 pool.queue.append(rec)
             self._pump(pool)
         else:
-            err = WorkerCrashedError(
-                f"task {rec.spec.function_name} failed: worker died ({exc})")
+            with self.lock:
+                self.task_records.pop(rec.spec.task_id, None)
+            if rec.canceled:
+                err: BaseException = TaskCancelledError(
+                    f"task {rec.spec.function_name} was cancelled")
+            else:
+                err = WorkerCrashedError(
+                    f"task {rec.spec.function_name} failed: worker died ({exc})")
             self.task_events.record_status(
                 rec.spec.task_id, "FAILED", name=rec.spec.function_name,
                 error=str(err))
@@ -1211,7 +1234,7 @@ class CoreWorker:
     def create_actor(self, cls, args, kwargs, *, resources=None, name=None,
                      max_restarts=0, max_task_retries=0, max_concurrency=1,
                      pg=None, bundle_index=-1, detached=False,
-                     runtime_env=None) -> str:
+                     runtime_env=None, namespace=None) -> str:
         aid = common.actor_id()
         common._ensure_picklable_by_value(cls)
         if runtime_env:
@@ -1234,6 +1257,7 @@ class CoreWorker:
             "name": name,
             "class_name": getattr(cls, "__name__", "Actor"),
             "resources": {common.CPU: 1} if resources is None else resources,
+            "namespace": namespace or self.namespace,
             "max_restarts": max_restarts,
             "owner_id": self.worker_id,
             "pg_id": pg,
@@ -1467,6 +1491,53 @@ class CoreWorker:
                     e.error = err
                     e.event.set()
 
+    def cancel(self, ref, force: bool = False) -> bool:
+        """Cancel the (normal) task producing `ref` (reference:
+        ray.cancel, core_worker CancelTask).  Queued tasks are dropped;
+        a running task gets TaskCancelledError injected into its thread
+        (force=True kills the worker process instead).  Cancelled tasks
+        are never retried.  Returns False if the task already finished
+        or isn't a cancellable normal task."""
+        tid = "tsk-" + ref.id[4:].rsplit("-", 1)[0] \
+            if ref.id.startswith("obj-") else None
+        with self.lock:
+            rec = self.task_records.get(tid) if tid else None
+            if rec is None or rec.done:
+                return False
+            rec.canceled = True
+            rec.retries_left = 0
+            pool = self.pools.get(rec.pool_key)
+            queued = pool is not None and rec in pool.queue
+            if queued:
+                pool.queue.remove(rec)
+                self.task_records.pop(tid, None)
+        if queued:
+            err = TaskCancelledError(
+                f"task {rec.spec.function_name} was cancelled before it "
+                f"started")
+            self.task_events.record_status(
+                rec.spec.task_id, "FAILED", name=rec.spec.function_name,
+                error=str(err))
+            for oid in rec.spec.return_ids():
+                with self.lock:
+                    e = self.objects.get(oid)
+                if e is not None and not e.ready:
+                    e.error = err
+                    e.event.set()
+            return True
+        # pushed: tell the executing worker
+        with self.lock:
+            lw = None
+            if pool is not None and rec.pushed_to:
+                lw = pool.leases.get(rec.pushed_to)
+        if lw is not None and lw.client is not None:
+            try:
+                lw.client.notify("cancel_task", {"task_id": rec.spec.task_id,
+                                                 "force": force})
+            except Exception:
+                pass
+        return True
+
     def kill_actor(self, actor_id: str, no_restart: bool = True):
         self._control_call("kill_actor", {"actor_id": actor_id,
                                          "no_restart": no_restart}, timeout=30.0)
@@ -1504,8 +1575,11 @@ class CoreWorker:
         except Exception:
             pass
 
-    def get_actor_by_name(self, name: str):
-        view = self._control_call("get_actor", {"name": name}, timeout=30.0)
+    def get_actor_by_name(self, name: str, namespace: Optional[str] = None):
+        view = self._control_call(
+            "get_actor", {"name": name,
+                          "namespace": namespace or self.namespace},
+            timeout=30.0)
         return view
 
     # ------------------------------------------------------------------

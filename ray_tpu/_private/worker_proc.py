@@ -49,8 +49,14 @@ class WorkerMain:
         self.core.server.handle("push_task", self.h_push_task, deferred=True)
         self.core.server.handle("actor_task", self.h_actor_task, deferred=True)
         self.core.server.handle("exit", lambda c, p: self._exit_soon())
+        self.core.server.handle("cancel_task", self.h_cancel_task)
 
         self.task_queue: "queue.Queue" = queue.Queue()
+        # cancellation state (reference: core_worker HandleCancelTask):
+        # queued task ids to drop + the id/thread of the running task
+        self._cancelled: set = set()
+        self._cancel_lock = threading.Lock()
+        self._running_task: dict = {}  # thread ident -> task_id
         self.actor_instance = None
         self.actor_concurrency = 1
         self._stop = threading.Event()
@@ -148,6 +154,30 @@ class WorkerMain:
     def h_actor_task(self, conn: ServerConn, spec: TaskSpec, d: Deferred):
         self.task_queue.put(("actor", spec, d))
 
+    def h_cancel_task(self, conn: ServerConn, p):
+        """Cancel a queued or running normal task (reference:
+        CoreWorker::HandleCancelTask).  force kills the process; plain
+        cancel injects TaskCancelledError into the executing thread."""
+        tid = p.get("task_id")
+        force = p.get("force", False)
+        with self._cancel_lock:
+            running_thread = next(
+                (th for th, t in self._running_task.items() if t == tid),
+                None)
+            if running_thread is None:
+                self._cancelled.add(tid)
+                return True
+        if force:
+            os._exit(1)
+        import ctypes
+
+        from .common import TaskCancelledError
+
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(running_thread),
+            ctypes.py_object(TaskCancelledError))
+        return True
+
     def _on_raylet_push(self, topic, payload):
         if topic == "shutdown":
             self._exit_soon()
@@ -176,7 +206,26 @@ class WorkerMain:
                 kind, spec, d = self.task_queue.get(timeout=0.2)
             except queue.Empty:
                 continue
-            reply = self._execute(kind, spec, d)
+            with self._cancel_lock:
+                if spec.task_id in self._cancelled:
+                    self._cancelled.discard(spec.task_id)
+                    cancelled = True
+                else:
+                    cancelled = False
+                    if kind == "normal":
+                        self._running_task[threading.get_ident()] = \
+                            spec.task_id
+            if cancelled:
+                from .common import TaskCancelledError
+
+                d.resolve(self._error_reply(
+                    TaskCancelledError("cancelled before start"), spec))
+                continue
+            try:
+                reply = self._execute(kind, spec, d)
+            finally:
+                with self._cancel_lock:
+                    self._running_task.pop(threading.get_ident(), None)
             if reply is not _ASYNC_INFLIGHT:
                 d.resolve(reply)
 

@@ -194,3 +194,31 @@ def test_get_if_exists(ray_cluster):
     with pytest.raises(ValueError, match="requires a name"):
         Singleton.options(get_if_exists=True).remote()
     ray_tpu.kill(a)
+
+
+def test_actor_namespaces(ray_cluster):
+    """Named actors are scoped per namespace (reference: ray namespaces —
+    same name in different namespaces never collides)."""
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def get(self):
+            return self.tag
+
+    a = Holder.options(name="ns-holder", namespace="team-a").remote("A")
+    b = Holder.options(name="ns-holder", namespace="team-b").remote("B")
+    ha = ray_tpu.get_actor("ns-holder", namespace="team-a")
+    hb = ray_tpu.get_actor("ns-holder", namespace="team-b")
+    assert ray_tpu.get(ha.get.remote(), timeout=60) == "A"
+    assert ray_tpu.get(hb.get.remote(), timeout=60) == "B"
+    # same name in the same namespace collides
+    with pytest.raises(Exception, match="already taken"):
+        h = Holder.options(name="ns-holder", namespace="team-a").remote("C")
+        ray_tpu.get(h.get.remote(), timeout=30)
+    # default namespace does not see scoped names
+    with pytest.raises(ValueError, match="no alive actor"):
+        ray_tpu.get_actor("ns-holder")
+    ray_tpu.kill(a)
+    ray_tpu.kill(b)

@@ -168,3 +168,67 @@ def test_no_retries_raises(ray_cluster):
 
     with pytest.raises(ray_tpu.WorkerCrashedError):
         ray_tpu.get(die.remote(), timeout=120)
+
+
+def test_cancel_queued_task(ray_cluster):
+    """Cancel before the task starts: dropped from the queue, no retry
+    (reference: test_cancel.py queued-task cases)."""
+    @ray_tpu.remote
+    def hog():
+        time.sleep(5)
+        return "hog"
+
+    @ray_tpu.remote
+    def victim():
+        return "ran"
+
+    # saturate all CPUs so the victim stays queued
+    hogs = [hog.remote() for _ in range(8)]
+    ref = victim.remote()
+    time.sleep(0.3)
+    assert ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    del hogs
+
+
+def test_cancel_running_task(ray_cluster):
+    """Cancel mid-execution: TaskCancelledError is injected and the task
+    is not retried (reference: test_cancel.py running cases)."""
+    @ray_tpu.remote(max_retries=3)
+    def spin():
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            sum(range(1000))
+        return "finished"
+
+    ref = spin.remote()
+    time.sleep(1.0)  # let it start
+    assert ray_tpu.cancel(ref)
+    t0 = time.time()
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(ref, timeout=60)
+    assert time.time() - t0 < 30, "cancel did not interrupt the task"
+
+
+def test_cancel_force_kills_worker(ray_cluster):
+    @ray_tpu.remote(max_retries=2)
+    def sleeper():
+        time.sleep(30)
+        return "done"
+
+    ref = sleeper.remote()
+    time.sleep(1.0)
+    assert ray_tpu.cancel(ref, force=True)
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_cancel_finished_task_returns_false(ray_cluster):
+    @ray_tpu.remote
+    def quick():
+        return 1
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref, timeout=60) == 1
+    assert ray_tpu.cancel(ref) is False
