@@ -201,7 +201,7 @@ class ClientCore:
     def create_actor(self, cls, args, kwargs, *, resources=None, name=None,
                      max_restarts=0, max_task_retries=0, max_concurrency=1,
                      pg=None, bundle_index=-1, detached=False,
-                     runtime_env=None) -> str:
+                     runtime_env=None, namespace=None) -> str:
         common._ensure_picklable_by_value(cls)
         if runtime_env:
             from ray_tpu._private import runtime_env as rtenv
@@ -219,6 +219,7 @@ class ClientCore:
             "bundle_index": bundle_index,
             "detached": detached,
             "runtime_env": runtime_env,
+            "namespace": namespace,
         }
         return self._call("c_create_actor", payload, timeout=120.0)
 
@@ -237,8 +238,9 @@ class ClientCore:
         self._call("c_kill_actor", {"actor_id": actor_id,
                                     "no_restart": no_restart}, timeout=60.0)
 
-    def get_actor_by_name(self, name: str):
-        return self._call("c_get_actor_by_name", {"name": name},
+    def get_actor_by_name(self, name: str, namespace=None):
+        return self._call("c_get_actor_by_name",
+                          {"name": name, "namespace": namespace},
                           timeout=60.0)
 
     def available_resources(self) -> Dict[str, float]:

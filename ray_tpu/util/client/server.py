@@ -200,7 +200,8 @@ class ClientServer:
                 max_concurrency=p.get("max_concurrency", 1),
                 pg=p.get("pg"), bundle_index=p.get("bundle_index", -1),
                 detached=p.get("detached", False),
-                runtime_env=p.get("runtime_env"))
+                runtime_env=p.get("runtime_env"),
+                namespace=p.get("namespace"))
 
         self._deferred(d, run)
 
@@ -220,7 +221,8 @@ class ClientServer:
             p["actor_id"], no_restart=p.get("no_restart", True)))
 
     def h_get_actor_by_name(self, conn, p, d: Deferred):
-        self._deferred(d, lambda: self.core.get_actor_by_name(p["name"]))
+        self._deferred(d, lambda: self.core.get_actor_by_name(
+            p["name"], namespace=p.get("namespace")))
 
     def h_release(self, conn, p):
         with self.lock:
