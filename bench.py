@@ -61,8 +61,28 @@ def bench_put_bandwidth() -> float:
     return total / dt / (1 << 30)
 
 
+# peak dense bf16 FLOP/s per chip by device kind (public specs); used for
+# MFU = achieved model FLOP/s / peak
+_TPU_PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "")
+    for k, v in _TPU_PEAK_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return None
+
+
 def bench_gpt_step():
-    """GPT-2-small train-step tokens/s on the local accelerator."""
+    """GPT-2-small train-step tokens/s (+MFU) on the local accelerator."""
     import jax
     import numpy as np
     import optax
@@ -92,24 +112,71 @@ def bench_gpt_step():
     loss = float(m["loss"])  # depends on the whole chain; forces completion
     dt = time.perf_counter() - t0
     tokens_per_s = steps * batch_size * seq / dt
-    return tokens_per_s, loss
+    # training FLOPs/token ~= 6N (fwd+bwd matmuls) + attention term
+    n_params = gpt.num_params(cfg)
+    flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq
+    peak = _peak_flops(jax.devices()[0])
+    mfu = (tokens_per_s * flops_per_token / (peak * n_dev)) if peak else None
+    return tokens_per_s, loss, mfu
+
+
+def _probe_accelerator(timeout_s: float = 120.0) -> dict:
+    """Check the jax backend answers at all, in a bounded subprocess —
+    a wedged TPU tunnel blocks forever inside backend init, so never
+    import-and-pray in the benchmarking process itself."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print(jax.default_backend(), len(d), d[0].device_kind)"],
+            capture_output=True, text=True, timeout=timeout_s)
+        if out.returncode != 0:
+            return {"ok": False,
+                    "error": (out.stderr or "nonzero exit")[-200:]}
+        backend, n, kind = out.stdout.strip().split(maxsplit=2)
+        return {"ok": True, "backend": backend, "n_devices": int(n),
+                "device_kind": kind}
+    except subprocess.TimeoutExpired:
+        return {"ok": False,
+                "error": f"accelerator probe timed out after {timeout_s}s "
+                         "(wedged TPU tunnel?)"}
+    except Exception as e:
+        return {"ok": False, "error": str(e)[:200]}
 
 
 def _extras_main():
     """Accelerator/bandwidth extras; run in a bounded subprocess so a
-    wedged TPU runtime can never hang the headline contract."""
-    extras = {}
+    wedged TPU runtime can never hang the headline contract.
+
+    Each stage prints its own JSON line as soon as it finishes, so a hang
+    in a later stage never loses an earlier measurement: put bandwidth
+    (no jax at all) first, then a short-timeout accelerator probe, and
+    only if that answers, the GPT train-step bench.
+    """
+    put = {}
     try:
-        tps, loss = bench_gpt_step()
-        extras["gpt2_small_train_tokens_per_s"] = round(tps, 1)
-        extras["gpt2_small_loss"] = round(loss, 3)
-    except Exception as e:  # accelerator bench is best-effort
-        extras["gpt_bench_error"] = str(e)[:200]
-    try:
-        extras["put_gib_per_s"] = round(bench_put_bandwidth(), 2)
+        put["put_gib_per_s"] = round(bench_put_bandwidth(), 2)
     except Exception as e:
-        extras["put_bench_error"] = str(e)[:200]
-    print(json.dumps(extras))
+        put["put_bench_error"] = str(e)[:200]
+    print(json.dumps(put), flush=True)
+
+    probe = _probe_accelerator()
+    gpt_extras = {}
+    if not probe["ok"]:
+        gpt_extras["gpt_bench_skipped"] = probe["error"]
+    else:
+        gpt_extras["accelerator"] = probe.get("device_kind", "?")
+        try:
+            tps, loss, mfu = bench_gpt_step()
+            gpt_extras["gpt2_small_train_tokens_per_s"] = round(tps, 1)
+            gpt_extras["gpt2_small_loss"] = round(loss, 3)
+            if mfu is not None:
+                gpt_extras["gpt2_small_mfu"] = round(mfu, 4)
+        except Exception as e:  # accelerator bench is best-effort
+            gpt_extras["gpt_bench_error"] = str(e)[:200]
+    print(json.dumps(gpt_extras), flush=True)
 
 
 def main():
@@ -133,13 +200,30 @@ def main():
     }
     import subprocess
 
+    stdout = ""
+    out = None
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--extras-only"],
             capture_output=True, text=True, timeout=900)
-        extras.update(json.loads(out.stdout.strip().splitlines()[-1]))
+        stdout = out.stdout or ""
+    except subprocess.TimeoutExpired as e:
+        # keep whatever stages finished before the hang
+        stdout = (e.stdout or b"").decode(errors="replace") \
+            if isinstance(e.stdout, bytes) else (e.stdout or "")
+        extras["extras_error"] = "TimeoutExpired: 900s"
     except Exception as e:
         extras["extras_error"] = f"{type(e).__name__}: {str(e)[:160]}"
+    parsed = 0
+    for line in stdout.strip().splitlines():
+        try:
+            extras.update(json.loads(line))
+            parsed += 1
+        except ValueError:
+            pass
+    if parsed == 0 and "extras_error" not in extras:
+        extras["extras_error"] = "extras subprocess produced no JSON " \
+            f"(rc={getattr(out, 'returncode', '?')})"
     print(json.dumps({"extras": extras}), file=sys.stderr)
 
 

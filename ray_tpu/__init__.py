@@ -16,6 +16,7 @@ from __future__ import annotations
 import atexit
 import logging
 import os
+import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -121,6 +122,10 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
                            namespace=namespace,
                            node_id=node_id, store_root=store_root)
         atexit.register(shutdown)
+        # metrics created before a previous shutdown() flush again
+        _metrics = sys.modules.get("ray_tpu.util.metrics")
+        if _metrics is not None:
+            _metrics._registry.restart_if_needed()
         return connection_info()
 
 
@@ -144,6 +149,11 @@ def shutdown() -> None:
 
         if core_mod._current_core is core:
             core_mod._current_core = None
+    # the metrics flusher must stop AT shutdown, not race it (it would
+    # otherwise wake after teardown and trip on the dead core)
+    _metrics = sys.modules.get("ray_tpu.util.metrics")
+    if _metrics is not None:
+        _metrics._registry.stop()
     if cluster is not None:
         cluster.shutdown()
 

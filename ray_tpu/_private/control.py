@@ -74,7 +74,11 @@ class NodeRecord:
 class ActorRecord:
     def __init__(self, aid: str, spec_blob: bytes, name, resources, max_restarts,
                  owner_id, pg_id=None, bundle_index=-1, detached=False,
-                 namespace: str = "default"):
+                 namespace: str = "default", job_id: str = ""):
+        # job_id: the owning *driver* job, when known ("" for actors
+        # created from inside workers) — used to reap restored owned
+        # actors whose driver never came back after a control restart
+        self.job_id = job_id
         self.actor_id = aid
         self.spec_blob = spec_blob
         self.name = name
@@ -229,6 +233,10 @@ class ControlServer:
             target=self._health_loop, name="control-health", daemon=True
         )
 
+        # restored owned actors awaiting their driver's re-registration:
+        # actor_id -> reap deadline (monotonic)
+        self._restored_unclaimed: Dict[str, float] = {}
+
         # durable metadata store (reference: redis_store_client.h role —
         # GCS fault tolerance).  Off unless a path is configured.
         from . import persist
@@ -256,6 +264,7 @@ class ControlServer:
                 "max_restarts": rec.max_restarts,
                 "owner_id": rec.owner_id, "pg_id": rec.pg_id,
                 "bundle_index": rec.bundle_index, "detached": rec.detached,
+                "job_id": rec.job_id,
                 "state": rec.state, "restarts": rec.restarts,
                 "incarnation": rec.incarnation, "error": rec.error,
                 "class_name": rec.class_name,
@@ -287,11 +296,13 @@ class ControlServer:
         self.functions = self.pstore.load_table("function")
         self.jobs = self.pstore.load_table("job")
         n_actors = n_pgs = 0
+        grace = float(os.environ.get("RAY_TPU_RESTORE_OWNER_GRACE_S", "60"))
         for aid, d in self.pstore.load_table("actor").items():
             rec = ActorRecord(aid, d["spec_blob"], d["name"], d["resources"],
                               d["max_restarts"], d["owner_id"], d["pg_id"],
                               d["bundle_index"], d["detached"],
-                              namespace=d.get("namespace", "default"))
+                              namespace=d.get("namespace", "default"),
+                              job_id=d.get("job_id", ""))
             rec.class_name = d.get("class_name", "")
             rec.restarts = d.get("restarts", 0)
             rec.incarnation = d.get("incarnation", 0)
@@ -305,6 +316,12 @@ class ControlServer:
             if rec.name:
                 self.named_actors[_named_key(rec.namespace, rec.name)] = aid
             self.pending_actors.append(rec)
+            # non-detached actors die with their owner in the reference;
+            # reschedule optimistically but reap unless the owning driver
+            # job re-registers within the grace window (h_register_job
+            # claims them; _health_loop reaps the rest)
+            if not rec.detached and rec.job_id:
+                self._restored_unclaimed[aid] = time.monotonic() + grace
             n_actors += 1
         for pgid, d in self.pstore.load_table("pg").items():
             rec = PlacementGroupRecord(pgid, d["bundles"], d["strategy"],
@@ -533,6 +550,12 @@ class ControlServer:
     def h_register_job(self, conn, p):
         with self.lock:
             self.jobs[p["job_id"]] = {"start_time": time.time(), **p}
+            # the owning driver came back after a control restart: its
+            # restored actors are claimed and escape the orphan reaper
+            for aid in [a for a, _ in self._restored_unclaimed.items()
+                        if self.actors.get(a) is not None
+                        and self.actors[a].job_id == p["job_id"]]:
+                self._restored_unclaimed.pop(aid, None)
         conn.meta["job_id"] = p["job_id"]
         if self.pstore is not None:
             self.pstore.rec_put("job", p["job_id"], self.jobs[p["job_id"]])
@@ -588,6 +611,7 @@ class ControlServer:
             p.get("owner_id", ""), p.get("pg_id"), p.get("bundle_index", -1),
             p.get("detached", False),
             namespace=p.get("namespace") or "default",
+            job_id=p.get("job_id", ""),
         )
         rec.class_name = p.get("class_name", "")
         with self.lock:
@@ -839,29 +863,18 @@ class ControlServer:
         aid, no_restart = p["actor_id"], p.get("no_restart", True)
 
         def do():
-            # mark DEAD under the lock *before* touching the node so any
-            # in-flight placement sees the kill and reaps its own worker
-            # (_try_place_actor / h_actor_ready re-check state)
             with self.lock:
                 rec = self.actors.get(aid)
-                if rec is None:
-                    d.resolve(False)
-                    return
-                if no_restart:
-                    rec.max_restarts = 0
-                    rec.state = DEAD
-                    rec.error = "killed via kill_actor"
-                    if rec.name:
-                        self.named_actors.pop(
-                            _named_key(rec.namespace, rec.name), None)
-                nid = rec.node_id
-                view = rec.view()
+                nid = rec.node_id if rec is not None else None
+            if rec is None:
+                d.resolve(False)
+                return
             if no_restart:
-                self._persist_actor(rec)
-            if nid:
+                self._destroy_actor(aid, "killed via kill_actor")
+            elif nid:
+                # restartable kill: just reap the worker; the failure
+                # path reschedules per max_restarts
                 self._kill_actor_worker(nid, aid)
-            if no_restart:
-                self.publish("actor", {"event": "dead", "actor": view})
             d.resolve(True)
 
         self.pool.submit(do)
@@ -1078,6 +1091,46 @@ class ControlServer:
                 logger.warning("node %s declared dead (heartbeat timeout)", rec.node_id[:12])
                 self.publish("node", {"event": "removed", "node": rec.view()})
                 self._on_node_death(rec.node_id)
+            self._reap_unclaimed_restored(now)
+
+    def _reap_unclaimed_restored(self, now: float):
+        """Destroy restored non-detached actors whose owning driver job
+        never re-registered after a control restart (the reference only
+        recreates detached actors — owned actors die with their owner;
+        gcs_actor_manager.cc ownership rules)."""
+        with self.lock:
+            expired = [aid for aid, dl in self._restored_unclaimed.items()
+                       if now > dl]
+            for aid in expired:
+                self._restored_unclaimed.pop(aid, None)
+        for aid in expired:
+            logger.warning(
+                "reaping restored actor %s: owner job never re-registered",
+                aid[:12])
+            self._destroy_actor(
+                aid, "owner driver did not return after control restart")
+
+    def _destroy_actor(self, aid: str, error: str):
+        """Force-kill an actor: mark DEAD, drop its name, reap its
+        worker, publish (shared by kill_actor and the orphan reaper)."""
+        with self.lock:
+            rec = self.actors.get(aid)
+            if rec is None or rec.state == DEAD:
+                return
+            rec.max_restarts = 0
+            rec.state = DEAD
+            rec.error = error
+            if rec.name:
+                self.named_actors.pop(
+                    _named_key(rec.namespace, rec.name), None)
+            if rec in self.pending_actors:
+                self.pending_actors.remove(rec)
+            nid = rec.node_id
+            view = rec.view()
+        self._persist_actor(rec)
+        if nid:
+            self._kill_actor_worker(nid, aid)
+        self.publish("actor", {"event": "dead", "actor": view})
 
     def _on_node_death(self, nid: str):
         with self.lock:

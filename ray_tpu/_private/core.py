@@ -974,9 +974,12 @@ class CoreWorker:
 
     def _pool_key(self, spec: TaskSpec):
         strat = spec.scheduling_strategy
+        # retriability is part of the key so a lease's OOM-victim hint
+        # (request_lease "retriable") holds for every task it ever serves
         return (tuple(sorted(spec.resources.items())),
                 spec.placement_group_id, spec.placement_bundle_index,
-                repr(strat) if strat else None)
+                repr(strat) if strat else None,
+                spec.max_retries > 0)
 
     def _pump(self, pool: SchedPool):
         to_push: List[Tuple[LeasedWorker, TaskRecord]] = []
@@ -1055,7 +1058,12 @@ class CoreWorker:
             if raylet_cli is None:
                 raise RuntimeError("no raylet available for lease request")
             payload = {"resources": common.denormalize_resources(dict(resources)),
-                       "client_id": self.worker_id}
+                       "client_id": self.worker_id,
+                       # OOM-victim hint (reference retriable-FIFO policy):
+                       # whether the work heading for this lease can be
+                       # retried if the raylet kills the worker
+                       "retriable": (spec0.max_retries > 0
+                                     if spec0 is not None else True)}
             if pg_id:
                 payload["bundle"] = (pg_id, bundle_index)
             r = raylet_cli.call("request_lease", payload, timeout=120.0)
@@ -1260,6 +1268,10 @@ class CoreWorker:
             "namespace": namespace or self.namespace,
             "max_restarts": max_restarts,
             "owner_id": self.worker_id,
+            # only driver jobs register with control, so only they can
+            # "claim" restored actors after a control restart; actors
+            # created from workers send "" (exempt from orphan reaping)
+            "job_id": self.job_id if self.mode == "driver" else "",
             "pg_id": pg,
             "bundle_index": bundle_index,
             "detached": detached,

@@ -50,17 +50,20 @@ class WorkerRecord:
         self.lease_id: Optional[str] = None
         self.blocked = False
         self.lease_resources: Dict[str, int] = {}
+        self.lease_retriable = True  # OOM-victim hint from the owner
         self.bundle_key: Optional[Tuple[str, int]] = None
         self.tpu = False  # spawned with TPU device visibility
 
 
 class PendingLease:
     def __init__(self, demand: Dict[str, int], deferred: Deferred, client_id: str,
-                 bundle: Optional[Tuple[str, int]] = None):
+                 bundle: Optional[Tuple[str, int]] = None,
+                 retriable: bool = True):
         self.demand = demand
         self.deferred = deferred
         self.client_id = client_id
         self.bundle = bundle
+        self.retriable = retriable
         self.ts = time.monotonic()
 
 
@@ -432,7 +435,8 @@ class Raylet:
                         return
         with self.lock:
             self.pending_leases.append(
-                PendingLease(demand, d, p.get("client_id", ""), bundle))
+                PendingLease(demand, d, p.get("client_id", ""), bundle,
+                             retriable=p.get("retriable", True)))
         self._try_grant()
 
     def _pg_bundles_locked(self, pg_id: str):
@@ -545,6 +549,7 @@ class Raylet:
                 w.leased_at = time.monotonic()
                 w.lease_id = common.new_id("lease-")
                 w.lease_resources = pl.demand
+                w.lease_retriable = pl.retriable
                 grants.append((pl, w))
         for _ in range(spawn):
             self._spawn_worker(tpu=spawn_tpu)

@@ -293,8 +293,13 @@ class OomKiller:
         with self.raylet.lock:
             leased = [r for r in self.raylet.workers.values()
                       if r.state == "leased" and r.proc is not None]
-            if leased:
-                victim = max(leased, key=lambda r: r.leased_at)
+            # retriable-FIFO: a max_retries=0 task dies permanently if
+            # killed, so prefer retriable victims (most recent lease
+            # first) and fall back to non-retriable only when none exist
+            pool = ([r for r in leased if r.lease_retriable]
+                    or leased)
+            if pool:
+                victim = max(pool, key=lambda r: r.leased_at)
         if victim is None:
             return False
         logger.warning(

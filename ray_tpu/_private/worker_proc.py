@@ -167,15 +167,19 @@ class WorkerMain:
             if running_thread is None:
                 self._cancelled.add(tid)
                 return True
-        if force:
-            os._exit(1)
-        import ctypes
+            if force:
+                os._exit(1)
+            import ctypes
 
-        from .common import TaskCancelledError
+            from .common import TaskCancelledError
 
-        ctypes.pythonapi.PyThreadState_SetAsyncExc(
-            ctypes.c_ulong(running_thread),
-            ctypes.py_object(TaskCancelledError))
+            # inject while still holding the lock: the exec loop clears
+            # _running_task under this same lock, so the exception can only
+            # be scheduled while the task is genuinely the current one (a
+            # late landing between tasks is absorbed by _exec_loop)
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(running_thread),
+                ctypes.py_object(TaskCancelledError))
         return True
 
     def _on_raylet_push(self, topic, payload):
@@ -201,33 +205,58 @@ class WorkerMain:
     # -- execution ---------------------------------------------------------
 
     def _exec_loop(self):
+        from .common import TaskCancelledError
+
         while not self._stop.is_set():
             try:
-                kind, spec, d = self.task_queue.get(timeout=0.2)
-            except queue.Empty:
+                self._exec_one()
+            except TaskCancelledError:
+                # a cancel injection that landed after its task already
+                # finished (between tasks); the cancel is void — survive
                 continue
-            with self._cancel_lock:
-                if spec.task_id in self._cancelled:
-                    self._cancelled.discard(spec.task_id)
-                    cancelled = True
-                else:
-                    cancelled = False
-                    if kind == "normal":
-                        self._running_task[threading.get_ident()] = \
-                            spec.task_id
-            if cancelled:
-                from .common import TaskCancelledError
+            except Exception:
+                logger.exception("exec loop error")
 
-                d.resolve(self._error_reply(
-                    TaskCancelledError("cancelled before start"), spec))
-                continue
+    def _exec_one(self):
+        from .common import TaskCancelledError
+
+        try:
+            kind, spec, d = self.task_queue.get(timeout=0.2)
+        except queue.Empty:
+            return
+        with self._cancel_lock:
+            if spec.task_id in self._cancelled:
+                self._cancelled.discard(spec.task_id)
+                cancelled = True
+            else:
+                cancelled = False
+                self._running_task[threading.get_ident()] = spec.task_id
+        if cancelled:
+            d.resolve(self._error_reply(
+                TaskCancelledError("cancelled before start"), spec))
+            return
+        reply = None
+        try:
             try:
                 reply = self._execute(kind, spec, d)
-            finally:
-                with self._cancel_lock:
-                    self._running_task.pop(threading.get_ident(), None)
-            if reply is not _ASYNC_INFLIGHT:
-                d.resolve(reply)
+            except TaskCancelledError as e:
+                # injection landed inside _execute's own error handling;
+                # still owe the owner a reply
+                reply = self._error_reply(e, spec)
+        finally:
+            # a cancel injected while _execute was unwinding may land at
+            # any bytecode below; keep clearing + resolving until it's
+            # done (at most one async exc can be pending)
+            for _attempt in range(3):
+                try:
+                    with self._cancel_lock:
+                        self._running_task.pop(threading.get_ident(), None)
+                    if reply is not None and reply is not _ASYNC_INFLIGHT:
+                        d.resolve(reply)
+                        reply = None
+                    break
+                except TaskCancelledError:
+                    continue
 
     def _get_aio_loop(self) -> asyncio.AbstractEventLoop:
         with self._aio_lock:

@@ -295,8 +295,11 @@ Arena* rt_arena_open(const char* path, uint64_t capacity, uint32_t n_entries) {
         break;
       usleep(100);
     }
+    // reject attaches across layout versions: Entry's stride changed in
+    // v2, so a mismatched attacher would misread the whole entry table
     if (pread(fd, &probe, sizeof(probe), 0) != (ssize_t)sizeof(probe) ||
-        probe.magic != kMagic || !probe.initialized) {
+        probe.magic != kMagic || !probe.initialized ||
+        probe.version != kVersion) {
       close(fd);
       return nullptr;
     }

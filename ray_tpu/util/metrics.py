@@ -37,6 +37,11 @@ class _Registry:
             self.metrics.append(metric)
         self._ensure_flusher()
 
+    def restart_if_needed(self):
+        """Re-arm the flusher after a shutdown()/init() cycle so metrics
+        created in a previous epoch keep flushing."""
+        self._ensure_flusher()
+
     def snapshot(self) -> List[Dict]:
         with self.lock:
             return [m._snapshot() for m in self.metrics]
@@ -45,18 +50,33 @@ class _Registry:
         with self.lock:
             if self._thread is not None:
                 return
+            if not self.metrics:
+                return
+            stop = self._stop = threading.Event()  # fresh after a stop()
             self._thread = threading.Thread(
-                target=self._flush_loop, name="metrics-flush", daemon=True)
+                target=self._flush_loop, args=(stop,),
+                name="metrics-flush", daemon=True)
             self._thread.start()
 
-    def _flush_loop(self):
-        while not self._stop.wait(FLUSH_INTERVAL_S):
-            self.flush()
+    def _flush_loop(self, stop: threading.Event):
+        while not stop.wait(FLUSH_INTERVAL_S):
+            try:
+                self.flush()
+            except Exception:
+                pass  # never let a flush race with shutdown kill the loop
+
+    def stop(self):
+        """Stop the flusher (called from ray_tpu.shutdown()); a later
+        metric registration restarts it."""
+        with self.lock:
+            self._stop.set()
+            self._thread = None
 
     def flush(self):
-        from ray_tpu._private.api import current_core
+        # non-raising core lookup: the flusher may fire after shutdown
+        from ray_tpu._private import core as core_mod
 
-        core = current_core()
+        core = core_mod._current_core
         if core is None or getattr(core, "_shutdown", False):
             return
         snap = self.snapshot()

@@ -126,6 +126,13 @@ def _execute_dag(dag: DAGNode, storage: WorkflowStorage, args: tuple,
                 f" got {node!r}")
         with _canceled_lock:
             was_canceled = storage.workflow_id in _canceled
+        if not was_canceled:
+            # cancel() from ANOTHER process persists CANCELED (the
+            # reference's cancel is cluster-wide); polling only the
+            # module-global set would silently lose it and let this run
+            # overwrite the status with SUCCESSFUL on completion
+            was_canceled = (storage.load_status()["status"]
+                            == WorkflowStatus.CANCELED)
         if was_canceled:
             storage.save_status(WorkflowStatus.CANCELED, at_step=sid)
             e = WorkflowCancellationError(
@@ -206,6 +213,10 @@ def resume(workflow_id: str, storage: Optional[str] = None) -> Any:
     status = st.load_status()
     if status["status"] == WorkflowStatus.NOT_FOUND:
         raise ValueError(f"no workflow {workflow_id!r}")
+    # resuming un-cancels: clear both the in-process flag and (via the
+    # RUNNING transition in _execute_workflow) the persisted CANCELED
+    with _canceled_lock:
+        _canceled.discard(workflow_id)
     dag, args = cloudpickle.loads(st.load_dag())
     return _execute_workflow(dag, st, args)
 

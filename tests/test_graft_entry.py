@@ -1,0 +1,32 @@
+"""The driver contract: __graft_entry__.dryrun_multichip must complete
+within the driver's budget on a clean interpreter with NO accelerator env
+prepared (the entry itself must force the CPU platform + virtual device
+count — VERDICT r1: the round-1 entry relied on the caller and timed out
+at 900 s).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUDGET_S = 300
+
+
+def test_dryrun_multichip_fits_budget():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS",
+                        "RAY_TPU_TEST_REAL_TPU")}
+    t0 = time.monotonic()
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "__graft_entry__.py"), "8"],
+        capture_output=True, text=True, timeout=BUDGET_S, env=env,
+        cwd=REPO)
+    dt = time.monotonic() - t0
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "DONE" in out.stdout, out.stdout
+    # headroom: the driver kills at ~900s; we demand <300 even cold
+    assert dt < BUDGET_S, f"dryrun took {dt:.0f}s"
