@@ -179,6 +179,171 @@ def _extras_main():
     print(json.dumps(gpt_extras), flush=True)
 
 
+# ---------------------------------------------------------------------------
+# Microbenchmark parity table (BASELINE.md core rows).  `python bench.py
+# --table` writes BENCH_TABLE.json mirroring the reference's
+# release/microbenchmark suite (reference numbers ran on 64 vCPUs;
+# host_cpus is recorded for per-core comparison).
+# ---------------------------------------------------------------------------
+
+BASELINES = {
+    "single_client_tasks_sync": 942.0,
+    "single_client_tasks_async": 7998.0,
+    "1_1_actor_calls_sync": 1935.0,
+    "1_1_actor_calls_async": 8761.0,
+    "1_1_actor_calls_concurrent": 5144.0,
+    "1_n_actor_calls_async": 8624.0,
+    "1_1_async_actor_calls_sync": 1401.0,
+    "1_1_async_actor_calls_async": 5005.0,
+    "single_client_get_calls": 10412.0,
+    "single_client_put_calls": 4962.0,
+    "single_client_wait_1k_refs": 5.19,
+    "placement_group_create_removal": 752.0,
+    "single_client_put_gigabytes": 17.8,
+}
+
+
+def _timed(n, fn):
+    t0 = time.perf_counter()
+    fn()
+    return n / (time.perf_counter() - t0)
+
+
+def bench_table() -> dict:
+    import numpy as np
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=max(4, (os.cpu_count() or 2)),
+                 ignore_reinit_error=True)
+    rows = {}
+
+    @ray_tpu.remote
+    def tiny():
+        return None
+
+    ray_tpu.get([tiny.remote() for _ in range(200)], timeout=120)  # warm
+
+    def sync_tasks():
+        for _ in range(300):
+            ray_tpu.get(tiny.remote(), timeout=60)
+    rows["single_client_tasks_sync"] = _timed(300, sync_tasks)
+
+    rows["single_client_tasks_async"] = _timed(
+        2000, lambda: ray_tpu.get([tiny.remote() for _ in range(2000)],
+                                  timeout=300))
+
+    @ray_tpu.remote
+    class Actor:
+        def m(self):
+            return None
+
+    a = Actor.remote()
+    ray_tpu.get(a.m.remote(), timeout=60)
+
+    def actor_sync():
+        for _ in range(500):
+            ray_tpu.get(a.m.remote(), timeout=60)
+    rows["1_1_actor_calls_sync"] = _timed(500, actor_sync)
+
+    rows["1_1_actor_calls_async"] = _timed(
+        2000, lambda: ray_tpu.get([a.m.remote() for _ in range(2000)],
+                                  timeout=300))
+
+    ac = Actor.options(max_concurrency=4).remote()
+    ray_tpu.get(ac.m.remote(), timeout=60)
+    rows["1_1_actor_calls_concurrent"] = _timed(
+        2000, lambda: ray_tpu.get([ac.m.remote() for _ in range(2000)],
+                                  timeout=300))
+
+    actors = [Actor.remote() for _ in range(4)]
+    ray_tpu.get([x.m.remote() for x in actors], timeout=60)
+    rows["1_n_actor_calls_async"] = _timed(
+        2000, lambda: ray_tpu.get(
+            [actors[i % 4].m.remote() for i in range(2000)], timeout=300))
+
+    @ray_tpu.remote
+    class AsyncActor:
+        async def m(self):
+            return None
+
+    aa = AsyncActor.remote()
+    ray_tpu.get(aa.m.remote(), timeout=60)
+
+    def async_actor_sync():
+        for _ in range(500):
+            ray_tpu.get(aa.m.remote(), timeout=60)
+    rows["1_1_async_actor_calls_sync"] = _timed(500, async_actor_sync)
+    rows["1_1_async_actor_calls_async"] = _timed(
+        2000, lambda: ray_tpu.get([aa.m.remote() for _ in range(2000)],
+                                  timeout=300))
+
+    small = np.zeros(16, np.uint8)
+    ref = ray_tpu.put(small)
+
+    def gets():
+        for _ in range(2000):
+            ray_tpu.get(ref)
+    rows["single_client_get_calls"] = _timed(2000, gets)
+
+    def puts():
+        for _ in range(1000):
+            ray_tpu.put(small)
+    rows["single_client_put_calls"] = _timed(1000, puts)
+
+    refs_1k = [tiny.remote() for _ in range(1000)]
+    ray_tpu.get(refs_1k, timeout=300)
+
+    def wait_1k():
+        for _ in range(10):
+            ray_tpu.wait(refs_1k, num_returns=len(refs_1k), timeout=60)
+    rows["single_client_wait_1k_refs"] = _timed(10, wait_1k)
+
+    def pg_churn():
+        for _ in range(20):
+            pg = ray_tpu.util.placement_group([{"CPU": 1}],
+                                              strategy="PACK")
+            ray_tpu.get(pg.ready(), timeout=60)
+            ray_tpu.util.remove_placement_group(pg)
+    rows["placement_group_create_removal"] = _timed(20, pg_churn)
+
+    ray_tpu.shutdown()
+    try:
+        rows["single_client_put_gigabytes"] = bench_put_bandwidth()
+    except Exception:
+        pass
+
+    # scaling curve: same async-task burst vs cluster width
+    curve = {}
+    for n_workers in (1, 2, 4):
+        ray_tpu.init(num_cpus=n_workers, ignore_reinit_error=True)
+
+        @ray_tpu.remote
+        def t2():
+            return None
+
+        ray_tpu.get([t2.remote() for _ in range(100)], timeout=120)
+        curve[str(n_workers)] = round(_timed(
+            1000, lambda: ray_tpu.get([t2.remote() for _ in range(1000)],
+                                      timeout=300)), 1)
+        ray_tpu.shutdown()
+
+    out = {
+        "host_cpus": os.cpu_count(),
+        "reference_host_cpus": 64,
+        "rows": {},
+        "tasks_async_vs_num_workers": curve,
+    }
+    for name, value in rows.items():
+        base = BASELINES.get(name)
+        out["rows"][name] = {
+            "value": round(value, 2),
+            "baseline_64cpu": base,
+            "vs_baseline": round(value / base, 4) if base else None,
+        }
+    return out
+
+
 def main():
     # headline FIRST and flushed: the device extras below can hang on a
     # broken accelerator runtime, and the one-JSON-line contract must
@@ -230,5 +395,12 @@ def main():
 if __name__ == "__main__":
     if "--extras-only" in sys.argv:
         _extras_main()
+    elif "--table" in sys.argv:
+        table = bench_table()
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_TABLE.json")
+        with open(path, "w") as f:
+            json.dump(table, f, indent=2)
+        print(json.dumps(table, indent=2))
     else:
         main()

@@ -27,7 +27,7 @@ from ._private.api import (ActorClass, ActorHandle, RemoteFunction, get_actor,
 from ._private.common import (ActorDiedError, GetTimeoutError, ObjectLostError,
                               RayTpuError, TaskCancelledError, TaskError,
                               WorkerCrashedError)
-from ._private.core import CoreWorker, ObjectRef
+from ._private.core import CoreWorker, ObjectRef, ObjectRefGenerator
 
 __version__ = "0.1.0"
 
@@ -47,6 +47,10 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
          resources: Optional[Dict[str, float]] = None,
          namespace: Optional[str] = None,
          ignore_reinit_error: bool = False,
+         log_to_driver: bool = True,
+         _tracing_startup_hook: Optional[str] = None,
+         _tracing_config: Optional[Dict[str, Any]] = None,
+         _system_config: Optional[Dict[str, Any]] = None,
          logging_level: int = logging.INFO) -> Dict[str, Any]:
     """Start (or connect to) a ray_tpu cluster and connect this driver.
 
@@ -61,6 +65,12 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
                 return connection_info()
             raise RuntimeError("ray_tpu.init() called twice; use "
                                "ignore_reinit_error=True to allow")
+        if _system_config:
+            # typed flag overrides, inherited by every daemon this init
+            # spawns (reference: _system_config through ray.init)
+            from ._private.config import set_system_config
+
+            set_system_config(_system_config)
         if address is None and os.environ.get("RAY_TPU_ADDRESS"):
             address = os.environ["RAY_TPU_ADDRESS"]
         if address and address.startswith("ray-tpu://"):
@@ -119,13 +129,21 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
             if os.path.isdir(info["store_root"]):
                 store_root = info["store_root"]
         _core = CoreWorker(control_addr, raylet_addr, mode="driver",
-                           namespace=namespace,
+                           namespace=namespace, log_to_driver=log_to_driver,
                            node_id=node_id, store_root=store_root)
         atexit.register(shutdown)
         # metrics created before a previous shutdown() flush again
         _metrics = sys.modules.get("ray_tpu.util.metrics")
         if _metrics is not None:
             _metrics._registry.restart_if_needed()
+        if _tracing_startup_hook:
+            # run locally + register in KV so every worker applies it
+            # (reference: ray.init(_tracing_startup_hook=...))
+            from .util import tracing as _tracing
+
+            _tracing.run_hook(_tracing_startup_hook, _tracing_config)
+            _tracing.register_hook(_core.control, _tracing_startup_hook,
+                                   _tracing_config)
         return connection_info()
 
 
@@ -180,13 +198,17 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
     return _require().wait(refs, num_returns=num_returns, timeout=timeout)
 
 
-def cancel(ref: "ObjectRef", *, force: bool = False) -> bool:
-    """Cancel the task that produces `ref` (reference: ray.cancel).
-    Queued tasks are dropped; running ones get TaskCancelledError
-    injected (force=True kills the worker process).  Getting the ref
-    afterwards raises TaskCancelledError.  Cancelled tasks never
-    retry."""
-    return _require().cancel(ref, force=force)
+def cancel(ref: "ObjectRef", *, force: bool = False,
+           recursive: bool = True) -> bool:
+    """Cancel the task that produces `ref` (reference: ray.cancel —
+    recursive defaults to True there too).  Works for normal AND actor
+    tasks: queued tasks are dropped; running ones get TaskCancelledError
+    injected (async actor methods get their coroutine cancelled).
+    force=True kills the worker process (normal tasks only).
+    recursive=True also cancels the tasks the cancelled task submitted.
+    Getting the ref afterwards raises TaskCancelledError.  Cancelled
+    tasks never retry."""
+    return _require().cancel(ref, force=force, recursive=recursive)
 
 
 def cluster_resources() -> Dict[str, float]:
@@ -234,7 +256,8 @@ __all__ = [
     "init", "shutdown", "is_initialized", "put", "get", "wait", "remote",
     "kill", "cancel", "get_actor", "cluster_resources",
     "available_resources", "nodes", "timeline", "profile",
-    "ObjectRef", "ActorHandle", "ActorClass", "RemoteFunction",
+    "ObjectRef", "ObjectRefGenerator", "ActorHandle", "ActorClass",
+    "RemoteFunction",
     "RayTpuError", "TaskError", "ActorDiedError", "WorkerCrashedError",
     "ObjectLostError", "GetTimeoutError", "TaskCancelledError",
 ]

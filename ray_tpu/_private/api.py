@@ -40,6 +40,8 @@ def _strategy_to_wire(scheduling_strategy) -> tuple:
         return {"kind": "node_affinity",
                 "node_id": scheduling_strategy.node_id,
                 "soft": scheduling_strategy.soft}, None, -1
+    if kind == "NodeLabelSchedulingStrategy":
+        return scheduling_strategy.to_wire(), None, -1
     raise ValueError(f"unknown scheduling strategy: {scheduling_strategy!r}")
 
 
@@ -60,17 +62,21 @@ class RemoteFunction:
         if pg is None and o.get("placement_group") is not None:
             pg = o["placement_group"].id
             bidx = o.get("placement_group_bundle_index", -1)
+        nr = o.get("num_returns", 1)
         refs = core.submit_task(
             self._fn, args, kwargs,
-            num_returns=o.get("num_returns", 1),
+            num_returns=nr,
             resources=_build_resources(o.get("num_cpus"), o.get("num_tpus"),
                                        o.get("resources")),
             max_retries=o.get("max_retries", 3),
             strategy=strategy, pg=pg, bundle_index=bidx,
             name=o.get("name", ""),
             runtime_env=o.get("runtime_env"),
+            generator_backpressure=o.get(
+                "_generator_backpressure_num_objects", 0) or 0,
         )
-        return refs[0] if o.get("num_returns", 1) == 1 else refs
+        # streaming tasks return one ObjectRefGenerator
+        return refs[0] if nr == 1 or nr == "streaming" else refs
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -99,7 +105,8 @@ class ActorMethod:
         refs = core.submit_actor_task(self._handle._actor_id, self._name,
                                       args, kwargs,
                                       num_returns=self._num_returns)
-        return refs[0] if self._num_returns == 1 else refs
+        # streaming methods return one ObjectRefGenerator
+        return refs[0] if self._num_returns in (1, "streaming") else refs
 
     def bind(self, *args, **kwargs):
         """Build a DAG node instead of executing (reference:
@@ -199,6 +206,7 @@ class ActorClass:
             detached=o.get("lifetime") == "detached",
             runtime_env=o.get("runtime_env"),
             namespace=o.get("namespace"),
+            strategy=strategy,
         )
         return ActorHandle(aid, self._cls.__name__,
                            is_owner=o.get("lifetime") != "detached")

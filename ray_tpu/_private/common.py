@@ -159,12 +159,31 @@ class TaskSpec:
     scheduling_strategy: Optional[Any] = None
     owner_id: str = ""
     owner_addr: Optional[Tuple[str, int]] = None
+    # task that submitted this one (same owner process), for
+    # ray.cancel(recursive=True) child propagation
+    parent_task_id: Optional[str] = None
+    # owning driver job — workers emit it as a log marker so worker
+    # stdout can be routed to the right driver (log_monitor.py)
+    job_id: str = ""
+    # OTel span context carrier (util/tracing.py; reference
+    # tracing_helper.py propagates the submit span to the executor)
+    trace_ctx: Optional[Dict[str, str]] = None
     # runtime env (env vars, working dir); materialized by the worker
     runtime_env: Optional[Dict[str, Any]] = None
     name: str = ""
+    # streaming generators: max unconsumed items before the producer
+    # pauses (0 = unbounded; reference _generator_backpressure_num_objects)
+    generator_backpressure: int = 0
 
     def return_ids(self) -> List[str]:
+        if self.num_returns == STREAMING_RETURNS:
+            return []
         return [object_id_for_return(self.task_id, i) for i in range(self.num_returns)]
+
+
+# num_returns sentinel for streaming-generator tasks (reference:
+# num_returns="streaming" -> ObjectRefGenerator, _raylet.pyx:281)
+STREAMING_RETURNS = -1
 
 
 class SerializedRef:

@@ -1,0 +1,152 @@
+"""Central typed flag table.
+
+Analog of the reference's ``RAY_CONFIG`` system (reference:
+src/ray/common/ray_config_def.h — 218 typed flags, each overridable via
+a ``RAY_<name>`` env var or the ``_system_config`` JSON handed to every
+process).  Here: a declarative table of (name, type, default, help); the
+resolved value for flag NAME comes from, in priority order,
+
+  1. the ``RAY_TPU_<NAME>`` environment variable,
+  2. the system-config JSON in ``RAY_TPU_SYSTEM_CONFIG`` (set by
+     ``ray_tpu.init(_system_config=...)`` and propagated by the
+     bootstrapper into every daemon it spawns),
+  3. the table default.
+
+Usage::
+
+    from ray_tpu._private.config import cfg
+    timeout = cfg().node_death_timeout_s
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+# (name, type, default, help) — name doubles as the env suffix
+CONFIG_DEFS: List[Tuple[str, type, Any, str]] = [
+    # -- control plane / failure detection
+    ("heartbeat_interval_s", float, 0.5,
+     "raylet -> control heartbeat period"),
+    ("node_death_timeout_s", float, 10.0,
+     "missed-heartbeat window before a node is declared dead"),
+    ("control_reconnect_s", float, 20.0,
+     "how long clients retry re-attaching to a restarted control plane"),
+    ("restore_owner_grace_s", float, 60.0,
+     "window for a driver job to re-register after a control restart "
+     "before its restored non-detached actors are reaped"),
+    # -- task submission
+    ("pipeline_depth", int, 4,
+     "tasks pushed per leased worker before waiting on replies"),
+    ("idle_lease_ttl_s", float, 1.0,
+     "idle time before a lease is returned to the raylet"),
+    ("delete_grace_s", float, 0.5,
+     "delay before a released object is reclaimed"),
+    ("inline_object_limit", int, 100 * 1024,
+     "max bytes for values carried inline instead of via the shm store"),
+    # -- object store / spilling
+    ("object_store_bytes", int, 0,
+     "shm arena capacity per node (0 = auto-size)"),
+    ("object_spilling", bool, True,
+     "spill primary copies to disk under memory pressure"),
+    ("spill_high", float, 0.8,
+     "store fullness fraction that triggers spilling"),
+    ("spill_low", float, 0.6,
+     "store fullness fraction spilling drains down to"),
+    ("memory_monitor_refresh_ms", int, 250,
+     "OOM watchdog poll period"),
+    # -- workers
+    ("worker_prestart", int, 1,
+     "warm workers each raylet keeps ready"),
+    ("native_sched", bool, True,
+     "use the native C++ scheduling policy engine"),
+    ("task_events", bool, True,
+     "export task lifecycle events to the control plane"),
+    ("max_task_events", int, 10000,
+     "task events retained by the control plane"),
+    # -- runtime env
+    ("rtenv_max_bytes", int, 256 * 1024 * 1024,
+     "max size of one runtime_env package"),
+    ("allow_pkg_install", bool, False,
+     "allow runtime_env pip/conda materialization"),
+    # -- misc
+    ("usage_stats_enabled", bool, True, "local usage tagging"),
+    ("log_to_driver_batch_lines", int, 200,
+     "worker-log lines per pubsub batch"),
+]
+
+_SYSTEM_CONFIG_ENV = "RAY_TPU_SYSTEM_CONFIG"
+
+
+def _coerce(typ: type, raw: Any) -> Any:
+    if typ is bool:
+        if isinstance(raw, str):
+            return raw.strip().lower() in ("1", "true", "yes", "on")
+        return bool(raw)
+    return typ(raw)
+
+
+class Config:
+    """Resolved flag values as attributes (see CONFIG_DEFS)."""
+
+    def __init__(self, system_config: Optional[Dict[str, Any]] = None):
+        sysconf = dict(system_config or {})
+        raw_env = os.environ.get(_SYSTEM_CONFIG_ENV)
+        if raw_env and not sysconf:
+            try:
+                sysconf = json.loads(raw_env)
+            except ValueError:
+                pass
+        unknown = set(sysconf) - {n for n, *_ in CONFIG_DEFS}
+        if unknown:
+            raise ValueError(f"unknown _system_config keys: {sorted(unknown)}")
+        for name, typ, default, _help in CONFIG_DEFS:
+            env = os.environ.get(f"RAY_TPU_{name.upper()}")
+            if env is not None:
+                val = _coerce(typ, env)
+            elif name in sysconf:
+                val = _coerce(typ, sysconf[name])
+            else:
+                val = default
+            setattr(self, name, val)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {n: getattr(self, n) for n, *_ in CONFIG_DEFS}
+
+
+_lock = threading.Lock()
+_current: Optional[Config] = None
+
+
+def cfg() -> Config:
+    """The process-wide resolved config (lazily built)."""
+    global _current
+    with _lock:
+        if _current is None:
+            _current = Config()
+        return _current
+
+
+def set_system_config(system_config: Optional[Dict[str, Any]]) -> None:
+    """Install a system-config dict (driver side) and export it so
+    spawned daemons inherit it (the reference propagates _system_config
+    from `ray.init` through the raylet to every worker)."""
+    global _current
+    with _lock:
+        _current = Config(system_config)
+        if system_config:
+            os.environ[_SYSTEM_CONFIG_ENV] = json.dumps(system_config)
+
+
+def describe() -> str:
+    """Human-readable flag table (`ray-tpu config`)."""
+    c = cfg()
+    lines = []
+    for name, typ, default, help_ in CONFIG_DEFS:
+        cur = getattr(c, name)
+        mark = "" if cur == default else "  [overridden]"
+        lines.append(f"{name:32s} {typ.__name__:5s} = {cur!r}{mark}\n"
+                     f"{'':40s}{help_}")
+    return "\n".join(lines)

@@ -40,12 +40,13 @@ from .protocol import Client, DaemonPool, Deferred, Server, ServerConn
 
 logger = logging.getLogger(__name__)
 
-HEARTBEAT_INTERVAL_S = 0.5
-# generous default: CI machines stall raylet heartbeat threads for seconds
-# during worker-spawn (jax import) storms (reference equivalent:
-# num_heartbeats_timeout / health check period, gcs_health_check_manager.h)
-NODE_DEATH_TIMEOUT_S = float(os.environ.get(
-    "RAY_TPU_NODE_DEATH_TIMEOUT_S", "10.0"))
+# typed flag table (reference: ray_config_def.h); RAY_TPU_* env or
+# _system_config overrides.  The generous death timeout absorbs raylet
+# heartbeat stalls during worker-spawn (jax import) storms.
+from .config import cfg as _cfg
+
+HEARTBEAT_INTERVAL_S = _cfg().heartbeat_interval_s
+NODE_DEATH_TIMEOUT_S = _cfg().node_death_timeout_s
 
 ALIVE, RESTARTING, DEAD, PENDING = "ALIVE", "RESTARTING", "DEAD", "PENDING"
 
@@ -79,6 +80,9 @@ class ActorRecord:
         # created from inside workers) — used to reap restored owned
         # actors whose driver never came back after a control restart
         self.job_id = job_id
+        # non-PG scheduling strategy dict (node_affinity / node_label /
+        # spread) honored at placement
+        self.strategy: Optional[Dict] = None
         self.actor_id = aid
         self.spec_blob = spec_blob
         self.name = name
@@ -264,7 +268,7 @@ class ControlServer:
                 "max_restarts": rec.max_restarts,
                 "owner_id": rec.owner_id, "pg_id": rec.pg_id,
                 "bundle_index": rec.bundle_index, "detached": rec.detached,
-                "job_id": rec.job_id,
+                "job_id": rec.job_id, "strategy": rec.strategy,
                 "state": rec.state, "restarts": rec.restarts,
                 "incarnation": rec.incarnation, "error": rec.error,
                 "class_name": rec.class_name,
@@ -296,7 +300,7 @@ class ControlServer:
         self.functions = self.pstore.load_table("function")
         self.jobs = self.pstore.load_table("job")
         n_actors = n_pgs = 0
-        grace = float(os.environ.get("RAY_TPU_RESTORE_OWNER_GRACE_S", "60"))
+        grace = _cfg().restore_owner_grace_s
         for aid, d in self.pstore.load_table("actor").items():
             rec = ActorRecord(aid, d["spec_blob"], d["name"], d["resources"],
                               d["max_restarts"], d["owner_id"], d["pg_id"],
@@ -304,6 +308,7 @@ class ControlServer:
                               namespace=d.get("namespace", "default"),
                               job_id=d.get("job_id", ""))
             rec.class_name = d.get("class_name", "")
+            rec.strategy = d.get("strategy")
             rec.restarts = d.get("restarts", 0)
             rec.incarnation = d.get("incarnation", 0)
             self.actors[aid] = rec
@@ -433,6 +438,20 @@ class ControlServer:
     def _alive_nodes(self) -> List[NodeRecord]:
         return [n for n in self.nodes.values() if n.state == ALIVE]
 
+    @staticmethod
+    def _match_one(labels: Dict[str, str], key: str, op: str,
+                   values) -> bool:
+        present = key in labels
+        if op == "exists":
+            return present
+        if op == "does_not_exist":
+            return not present
+        if op == "in":
+            return present and str(labels[key]) in values
+        if op == "not_in":
+            return present and str(labels[key]) not in values
+        return False
+
     def _pick_node_locked(self, demand: Dict[str, int], strategy=None) -> Optional[NodeRecord]:
         """Hybrid policy: pack onto the busiest node that fits (reference
         defaults to pack-then-spread, hybrid_scheduling_policy.h:61); honors
@@ -468,6 +487,27 @@ class ControlServer:
                     if n is not None and n.state == ALIVE:
                         return n
                 return None
+            elif kind == "node_label":
+                # label matching (reference: NodeLabelSchedulingStrategy,
+                # scheduling_strategies.py:135 + label scheduling policy)
+                hard = strategy.get("hard") or []
+                soft = strategy.get("soft") or []
+                def match_all(n, exprs):
+                    return all(self._match_one(n.labels or {}, k, op, vals)
+                               for (k, op, vals) in exprs)
+
+                cands = [n for n in nodes
+                         if fits(n.available, demand)
+                         and match_all(n, hard)]
+                if not cands:
+                    return None
+                preferred = [n for n in cands if match_all(n, soft)]
+                pool = preferred or cands
+
+                def util(n: NodeRecord) -> float:
+                    tot = sum(n.total.values()) or 1
+                    return 1.0 - sum(n.available.values()) / tot
+                return max(pool, key=util)  # pack among matching nodes
             elif kind == "spread":
                 n = self._native_pick(demand, spread=True)
                 if n is not None:
@@ -614,6 +654,7 @@ class ControlServer:
             job_id=p.get("job_id", ""),
         )
         rec.class_name = p.get("class_name", "")
+        rec.strategy = p.get("strategy")
         with self.lock:
             # idempotent on actor_id: clients retry blindly after a
             # control-plane reconnect, and the first attempt may have
@@ -667,7 +708,7 @@ class ControlServer:
     def _try_place_actor(self, rec: ActorRecord) -> bool:
         """One placement attempt; True if the actor left the queue
         (started on a node, or died)."""
-        strategy = None
+        strategy = rec.strategy
         if rec.pg_id:
             strategy = {"kind": "placement_group", "pg_id": rec.pg_id,
                         "bundle_index": rec.bundle_index}

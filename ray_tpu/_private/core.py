@@ -32,19 +32,23 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 import cloudpickle
 
 from . import common, serialization
-from .common import (INLINE_OBJECT_LIMIT, ActorDiedError, GetTimeoutError,
-                     ObjectLostError, SerializedRef, TaskCancelledError,
-                     TaskError, TaskSpec, WorkerCrashedError,
-                     normalize_resources)
+from .common import (INLINE_OBJECT_LIMIT, STREAMING_RETURNS, ActorDiedError,
+                     GetTimeoutError, ObjectLostError, RayTpuError,
+                     SerializedRef, TaskCancelledError, TaskError, TaskSpec,
+                     WorkerCrashedError, normalize_resources)
 from .protocol import (Client, ConnectionLost, DaemonPool, Deferred,
                        RpcError, Server, ServerConn)
 from .shm_store import ShmObjectStore
 
 logger = logging.getLogger(__name__)
 
-PIPELINE_DEPTH = 4          # tasks pushed per leased worker before waiting
-DELETE_GRACE_S = 0.5
-IDLE_LEASE_TTL_S = 1.0
+# typed flag table (reference: ray_config_def.h); RAY_TPU_* env or
+# _system_config overrides
+from .config import cfg as _cfg
+
+PIPELINE_DEPTH = _cfg().pipeline_depth  # pushes per lease before waiting
+DELETE_GRACE_S = _cfg().delete_grace_s
+IDLE_LEASE_TTL_S = _cfg().idle_lease_ttl_s
 
 
 # ---------------------------------------------------------------------------
@@ -120,6 +124,14 @@ class ObjectRef:
         core = current_core()
         return core.as_future(self)
 
+    def __await__(self):
+        """`await ref` / asyncio.gather(*refs) from async drivers and
+        async actors (reference: ObjectRef.__await__, _raylet.pyx +
+        async_compat.py)."""
+        import asyncio
+
+        return asyncio.wrap_future(current_core().as_future(self)).__await__()
+
 
 def _marker_to_ref(marker: SerializedRef) -> ObjectRef:
     core = _current_core
@@ -137,6 +149,97 @@ def _ref_to_marker(ref: ObjectRef) -> SerializedRef:
 
 
 serialization.install_ref_hooks(ObjectRef, _ref_to_marker, _marker_to_ref)
+
+# Execution attribution for code running inside a task: which task is
+# submitting (recursive-cancel parenting) and which driver job owns it
+# (log routing for nested submissions).  contextvars, not thread-locals:
+# async tasks/actor methods run as asyncio Tasks, each with its own
+# context, so interleaved coroutines attribute correctly
+# (worker_proc._execute / _finish set these).
+import contextvars
+
+EXECUTING_TASK_ID: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_executing_task_id", default=None)
+EXECUTING_JOB_ID: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_executing_job_id", default=None)
+
+
+class StreamState:
+    """Owner-side bookkeeping for one streaming-generator task
+    (reference: task_manager.h:355 HandleReportGeneratorItemReturns —
+    per-item returns with backpressure + idempotent retries)."""
+
+    __slots__ = ("spec", "cv", "ready", "produced", "consumed", "done",
+                 "total", "error", "waiters", "closed")
+
+    def __init__(self, spec: TaskSpec):
+        self.spec = spec
+        self.cv = threading.Condition()
+        self.ready: deque = deque()   # indices stored, not yet handed out
+        self.produced = 0             # next expected item index
+        self.consumed = 0             # items handed to the user
+        self.done = False
+        self.total: Optional[int] = None
+        self.error: Optional[BaseException] = None
+        self.waiters: List = []       # deferred producer acks (backpressure)
+        self.closed = False           # generator dropped by the user
+
+
+class ObjectRefGenerator:
+    """Iterator over the ObjectRefs of a streaming task's yields
+    (reference: _raylet.pyx:281 ObjectRefGenerator).  Each __next__
+    blocks until the worker reports the next item, then returns an
+    ObjectRef that is immediately gettable."""
+
+    def __init__(self, core: "CoreWorker", task_id: str):
+        self._core = core
+        self._task_id = task_id
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        ref = self._core._next_stream_item(self._task_id, timeout=None)
+        if ref is None:
+            raise StopIteration
+        return ref
+
+    def next_ready(self, timeout: Optional[float] = None) -> ObjectRef:
+        """Like __next__ but with a timeout (GetTimeoutError)."""
+        ref = self._core._next_stream_item(self._task_id, timeout=timeout)
+        if ref is None:
+            raise StopIteration
+        return ref
+
+    def completed(self) -> bool:
+        st = self._core.streams.get(self._task_id)
+        return st is None or (st.done and not st.ready)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> ObjectRef:
+        """Async iteration: blocks in an executor thread, not the loop."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        ref = await loop.run_in_executor(
+            None, self._core._next_stream_item, self._task_id, None)
+        if ref is None:
+            raise StopAsyncIteration
+        return ref
+
+    @property
+    def task_id(self) -> str:
+        return self._task_id
+
+    def __del__(self):
+        core = self._core
+        if core is not None and not core._shutdown:
+            try:
+                core._release_stream(self._task_id)
+            except Exception:
+                pass
 
 
 # ---------------------------------------------------------------------------
@@ -241,9 +344,10 @@ class CoreWorker:
     def __init__(self, control_addr, raylet_addr=None, mode: str = "driver",
                  job: Optional[str] = None, worker_id: Optional[str] = None,
                  node_id: Optional[str] = None, store_root: Optional[str] = None,
-                 namespace: Optional[str] = None):
+                 namespace: Optional[str] = None, log_to_driver: bool = True):
         global _current_core
         self.mode = mode
+        self.log_to_driver = log_to_driver and mode == "driver"
         self.namespace = (namespace
                           or os.environ.get("RAY_TPU_NAMESPACE")
                           or "default")
@@ -258,7 +362,11 @@ class CoreWorker:
         self.server.handle("get_object", self.h_get_object, deferred=True)
         self.server.handle("add_ref", self.h_add_ref)
         self.server.handle("del_ref", self.h_del_ref)
+        self.server.handle("generator_item", self.h_generator_item,
+                           deferred=True)
         self.server.handle("ping", lambda c, p: "pong")
+        # streaming-generator tasks owned by this process
+        self.streams: Dict[str, StreamState] = {}
         # on-demand profiling RPCs (reference: dashboard reporter agent's
         # py-spy/memray endpoints, profile_manager.py:82)
         from . import profiling
@@ -315,10 +423,18 @@ class CoreWorker:
         if mode == "driver":
             self.control.call("register_job", {"job_id": self.job_id,
                                                "driver_pid": os.getpid()})
-        self.control.call("subscribe", {"topics": ["actor", "node"]})
+        self.control.call("subscribe", {"topics": self._sub_topics()})
         self._reaper = threading.Thread(target=self._lease_reaper_loop,
                                         name="core-lease-reaper", daemon=True)
         self._reaper.start()
+        # as_future dispatcher (awaitable ObjectRefs)
+        self._future_lock = threading.Lock()
+        self._future_waiters: List[Tuple[ObjectEntry, Callable, Any]] = []
+        self._future_event = threading.Event()
+        self._future_thread = threading.Thread(
+            target=self._future_dispatch_loop, name="core-future-dispatch",
+            daemon=True)
+        self._future_thread.start()
         # single delayed-deletion reaper (a Timer thread per released
         # object dominates the tiny-task hot path otherwise)
         self._delete_queue: deque = deque()
@@ -352,7 +468,7 @@ class CoreWorker:
             # client closed — the caller's client is dead either way
             if failed_client is not None and self.control is not failed_client:
                 return  # someone else already re-attached
-        grace = float(os.environ.get("RAY_TPU_CONTROL_RECONNECT_S", "20"))
+        grace = _cfg().control_reconnect_s
         deadline = time.monotonic() + grace
         last: Optional[BaseException] = None
         while time.monotonic() < deadline and not self._shutdown:
@@ -364,7 +480,7 @@ class CoreWorker:
                 if self.mode == "driver":
                     cli.call("register_job", {"job_id": self.job_id,
                                               "driver_pid": os.getpid()})
-                cli.call("subscribe", {"topics": ["actor", "node"]})
+                cli.call("subscribe", {"topics": self._sub_topics()})
                 with self.lock:
                     old, self.control = self.control, cli
                 if hasattr(self.task_events, "_client"):
@@ -420,6 +536,17 @@ class CoreWorker:
         if self._shutdown:
             return
         self._shutdown = True
+        # fail pending awaited futures instead of hanging their loops
+        with self._future_lock:
+            waiters, self._future_waiters = self._future_waiters, []
+        for _entry, _run, fut in waiters:
+            if not fut.done():
+                try:
+                    fut.set_exception(
+                        RayTpuError("ray_tpu shut down while awaiting"))
+                except Exception:
+                    pass
+        self._future_event.set()
         global _current_core
         if _current_core is self:
             prev = self._prev_current_core
@@ -705,18 +832,57 @@ class CoreWorker:
                 [r for r in refs if r.id not in returned_ids])
 
     def as_future(self, ref: ObjectRef):
+        """concurrent.futures.Future resolving to the ref's value.  Local
+        refs park in a dispatcher (no thread held while pending — an
+        async driver may gather thousands); only ready values pay a pool
+        thread to materialize (shm reads can block)."""
         from concurrent.futures import Future
 
         fut: Future = Future()
 
         def run():
             try:
-                fut.set_result(self.get(ref))
+                res = self.get(ref)
+                if not fut.cancelled():
+                    fut.set_result(res)
             except BaseException as e:
-                fut.set_exception(e)
+                if not fut.cancelled():
+                    fut.set_exception(e)
 
-        self.pool_executor.submit(run)
+        with self.lock:
+            entry = self.objects.get(ref.id)
+        if entry is None:
+            # borrowed ref: the owner fetch blocks start-to-finish
+            self.pool_executor.submit(run)
+            return fut
+        with self._future_lock:
+            self._future_waiters.append((entry, run, fut))
+        self._future_event.set()
         return fut
+
+    def _future_dispatch_loop(self):
+        """Multiplexes pending as_future waiters over entry events."""
+        while not self._shutdown:
+            with self._future_lock:
+                pending = list(self._future_waiters)
+            if not pending:
+                self._future_event.wait(0.5)
+                self._future_event.clear()
+                continue
+            fired = [t for t in pending
+                     if t[0].event.is_set() or t[2].cancelled()]
+            if fired:
+                with self._future_lock:
+                    for t in fired:
+                        try:
+                            self._future_waiters.remove(t)
+                        except ValueError:
+                            pass
+                for _entry, run, fut in fired:
+                    if not fut.cancelled():
+                        self.pool_executor.submit(run)
+            else:
+                time.sleep(0.005)
 
     # ------------------------------------------------------------------
     # ref counting
@@ -919,7 +1085,9 @@ class CoreWorker:
 
     def submit_task(self, fn, args, kwargs, *, num_returns=1, resources=None,
                     max_retries=3, strategy=None, pg=None, bundle_index=-1,
-                    name="", runtime_env=None) -> List[ObjectRef]:
+                    name="", runtime_env=None, generator_backpressure=0):
+        if num_returns == "streaming":
+            num_returns = STREAMING_RETURNS
         if runtime_env:
             from . import runtime_env as rtenv
 
@@ -940,10 +1108,23 @@ class CoreWorker:
             owner_id=self.worker_id,
             owner_addr=self.addr,
             runtime_env=runtime_env,
+            parent_task_id=EXECUTING_TASK_ID.get(),
+            generator_backpressure=generator_backpressure,
+            # nested tasks keep the ROOT driver's job so their logs
+            # route to that driver (a worker core's own job_id is random)
+            job_id=EXECUTING_JOB_ID.get() or self.job_id,
         )
+        from ray_tpu.util import tracing
+
+        if tracing.is_enabled():
+            with tracing.submit_span("task", spec.function_name):
+                spec.trace_ctx = tracing.inject_context()
         return self._submit_spec(spec, retries_left=max_retries)
 
-    def _submit_spec(self, spec: TaskSpec, retries_left: int) -> List[ObjectRef]:
+    def _submit_spec(self, spec: TaskSpec, retries_left: int):
+        if spec.num_returns == STREAMING_RETURNS \
+                and spec.task_id not in self.streams:
+            self.streams[spec.task_id] = StreamState(spec)
         refs = []
         with self.lock:
             for oid in spec.return_ids():
@@ -970,6 +1151,8 @@ class CoreWorker:
             spec.task_id, "PENDING_ARGS_AVAIL", name=spec.function_name,
             extra={"type": "NORMAL_TASK"})
         self._pump(pool)
+        if spec.num_returns == STREAMING_RETURNS:
+            return [ObjectRefGenerator(self, spec.task_id)]
         return refs
 
     def _pool_key(self, spec: TaskSpec):
@@ -1032,6 +1215,18 @@ class CoreWorker:
                 best, best_n = lw, n
         return best
 
+    @staticmethod
+    def _strategy_is_hard(strategy) -> bool:
+        """True when the strategy forbids running on an arbitrary node."""
+        if not isinstance(strategy, dict):
+            return False
+        kind = strategy.get("kind")
+        if kind == "node_label":
+            return True
+        if kind == "node_affinity":
+            return not strategy.get("soft")
+        return False
+
     def _request_lease(self, pool: SchedPool):
         try:
             resources = dict(pool.key[0])
@@ -1050,6 +1245,21 @@ class CoreWorker:
                 "resources": common.denormalize_resources(dict(resources)),
                 "strategy": strategy,
             }, timeout=30.0)
+            if picked is None and self._strategy_is_hard(strategy):
+                # no node satisfies the hard constraint right now: stay
+                # pending and re-probe (falling back to the local raylet
+                # would violate the strategy — reference keeps such tasks
+                # queued as demand)
+                with self.lock:
+                    pool.pending_requests -= 1
+                    still_queued = bool(pool.queue)
+                if still_queued and not self._shutdown:
+                    def reprobe():
+                        time.sleep(0.5)
+                        self._pump(pool)
+
+                    self.pool_executor.submit(reprobe)
+                return
             raylet_addr = self.raylet_addr
             raylet_cli = self.raylet
             if picked is not None and tuple(picked["addr"]) != self.raylet_addr:
@@ -1133,8 +1343,148 @@ class CoreWorker:
                 TaskCancelledError(
                     f"task {rec.spec.function_name} was cancelled"))}
         self._store_results(rec.spec, reply)
+        if rec.spec.num_returns == STREAMING_RETURNS:
+            self._finish_stream(rec.spec.task_id, reply)
         self._pump(pool)
         self._maybe_return_idle_leases(pool)
+
+    # -- streaming generators (owner side) --------------------------------
+    # reference: task_manager.h:355 HandleReportGeneratorItemReturns +
+    # _raylet.pyx:281 ObjectRefGenerator
+
+    def h_generator_item(self, conn, p, d):
+        """A worker reports one yielded item of a streaming task.  The
+        reply is the producer's backpressure ack: deferred while too many
+        items sit unconsumed; {"stop": True} tells the producer to quit
+        (stream closed/cancelled/unknown)."""
+        tid, index = p["task_id"], p["index"]
+        st = self.streams.get(tid)
+        if st is None or st.closed:
+            d.resolve({"ok": False, "stop": True})
+            return
+        with st.cv:
+            if index < st.produced:
+                # duplicate from a retry attempt — already stored
+                d.resolve({"ok": True})
+                return
+            oid = common.object_id_for_return(tid, index)
+            with self.lock:
+                e = self.objects.get(oid) or self._new_entry(oid)
+                e.pins = max(e.pins, 1)
+                e.lineage = st.spec
+                self.local_ref_counts.setdefault(oid, 0)
+            self._store_one(e, p["result"])
+            st.produced = index + 1
+            st.ready.append(index)
+            st.cv.notify_all()
+            bp = st.spec.generator_backpressure
+            if bp and (st.produced - st.consumed) >= bp:
+                st.waiters.append(d)   # ack later, when consumed
+            else:
+                d.resolve({"ok": True})
+
+    def _next_stream_item(self, tid: str, timeout: Optional[float]):
+        """Blocking pop of the next stream index -> ObjectRef (None =
+        exhausted)."""
+        st = self.streams.get(tid)
+        if st is None:
+            return None
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with st.cv:
+            while True:
+                if st.ready:
+                    index = st.ready.popleft()
+                    st.consumed += 1
+                    # consumption opens backpressure windows
+                    waiters, st.waiters = st.waiters, []
+                    break
+                if st.error is not None and st.done:
+                    err = st.error
+                    raise_stored(err)
+                if st.done:
+                    self.streams.pop(tid, None)
+                    return None
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(
+                        f"streaming task {tid} produced no item in time")
+                st.cv.wait(remaining if remaining is not None else 0.5)
+        for w in waiters:
+            try:
+                w.resolve({"ok": True})
+            except Exception:
+                pass
+        oid = common.object_id_for_return(tid, index)
+        with self.lock:
+            self.local_ref_counts[oid] = \
+                self.local_ref_counts.get(oid, 0) + 1
+        return ObjectRef(oid, self.addr, self.worker_id)
+
+    def _finish_stream(self, tid: str, reply: Dict[str, Any]):
+        st = self.streams.get(tid)
+        if st is None:
+            return
+        with st.cv:
+            st.done = True
+            if reply.get("status") == "ok":
+                st.total = reply.get("streaming_done", st.produced)
+            else:
+                try:
+                    st.error = serialization.loads_inline(reply["error"])
+                except Exception as e:
+                    st.error = RayTpuError(f"stream failed: {e}")
+            waiters, st.waiters = st.waiters, []
+            st.cv.notify_all()
+        for w in waiters:
+            try:
+                w.resolve({"ok": False, "stop": True})
+            except Exception:
+                pass
+
+    def _fail_stream(self, tid: str, err: BaseException):
+        self._finish_stream(tid, {
+            "status": "error", "error": serialization.dumps_inline(err)})
+
+    def _release_stream(self, tid: str):
+        """Generator dropped by the user: stop the producer and release
+        never-consumed items."""
+        st = self.streams.pop(tid, None)
+        if st is None:
+            return
+        with st.cv:
+            st.closed = True
+            pending = list(st.ready)
+            st.ready.clear()
+            waiters, st.waiters = st.waiters, []
+            st.cv.notify_all()
+        for w in waiters:
+            try:
+                w.resolve({"ok": False, "stop": True})
+            except Exception:
+                pass
+        for index in pending:
+            oid = common.object_id_for_return(tid, index)
+            with self.lock:
+                if self.local_ref_counts.get(oid, 0) <= 0:
+                    self._unpin(oid)
+
+    def _store_one(self, e: ObjectEntry, result):
+        """Store one (kind, payload) wire result into an entry."""
+        kind, payload = result
+        if kind == "inline":
+            meta, bufs = payload
+            try:
+                e.value = serialization.loads_oob(
+                    meta, [memoryview(b) for b in bufs])
+                e.has_value = True
+            except BaseException as ex:
+                e.error = ex
+        else:  # shm
+            e.shm_node = payload["node_id"]
+            e.shm_addr = tuple(payload["addr"])
+            e.nbytes = payload.get("nbytes", 0)
+        e.event.set()
 
     def _store_results(self, spec: TaskSpec, reply: Dict[str, Any]):
         status = reply.get("status")
@@ -1145,23 +1495,11 @@ class CoreWorker:
                 if e is None:
                     continue
             if status == "ok":
-                kind, payload = results[i]
-                if kind == "inline":
-                    meta, bufs = payload
-                    try:
-                        e.value = serialization.loads_oob(
-                            meta, [memoryview(b) for b in bufs])
-                        e.has_value = True
-                    except BaseException as ex:
-                        e.error = ex
-                else:  # shm
-                    e.shm_node = payload["node_id"]
-                    e.shm_addr = tuple(payload["addr"])
-                    e.nbytes = payload.get("nbytes", 0)
+                self._store_one(e, results[i])
             else:
                 err = serialization.loads_inline(reply["error"])
                 e.error = err
-            e.event.set()
+                e.event.set()
 
     def _on_task_failure(self, pool, lw: LeasedWorker, rec: TaskRecord, exc):
         """Worker died or connection lost mid-task: retry or error out
@@ -1197,6 +1535,8 @@ class CoreWorker:
                 if e is not None:
                     e.error = err
                     e.event.set()
+            if rec.spec.num_returns == STREAMING_RETURNS:
+                self._fail_stream(rec.spec.task_id, err)
 
     def _on_worker_lost(self, pool: SchedPool, lw: LeasedWorker):
         with self.lock:
@@ -1242,7 +1582,7 @@ class CoreWorker:
     def create_actor(self, cls, args, kwargs, *, resources=None, name=None,
                      max_restarts=0, max_task_retries=0, max_concurrency=1,
                      pg=None, bundle_index=-1, detached=False,
-                     runtime_env=None, namespace=None) -> str:
+                     runtime_env=None, namespace=None, strategy=None) -> str:
         aid = common.actor_id()
         common._ensure_picklable_by_value(cls)
         if runtime_env:
@@ -1275,6 +1615,7 @@ class CoreWorker:
             "pg_id": pg,
             "bundle_index": bundle_index,
             "detached": detached,
+            "strategy": strategy,
         }, timeout=120.0)
         self.pool_executor.submit(self._resolve_actor, aid)
         return aid
@@ -1347,6 +1688,8 @@ class CoreWorker:
             ac.inflight.clear()
         e = ActorDiedError(err)
         for spec in pending:
+            if spec.num_returns == STREAMING_RETURNS:
+                self._fail_stream(spec.task_id, e)
             for oid in spec.return_ids():
                 with self.lock:
                     ent = self.objects.get(oid)
@@ -1356,6 +1699,8 @@ class CoreWorker:
 
     def submit_actor_task(self, actor_id: str, method_name: str, args, kwargs,
                           num_returns: int = 1) -> List[ObjectRef]:
+        if num_returns == "streaming":
+            num_returns = STREAMING_RETURNS
         ac = self._actor_conn(actor_id)
         with ac.lock:
             ac.seq += 1
@@ -1370,7 +1715,18 @@ class CoreWorker:
             seq_no=seq,
             owner_id=self.worker_id,
             owner_addr=self.addr,
+            parent_task_id=EXECUTING_TASK_ID.get(),
+            job_id=EXECUTING_JOB_ID.get() or self.job_id,
         )
+        from ray_tpu.util import tracing
+
+        if tracing.is_enabled():
+            with tracing.submit_span("actor_task", method_name):
+                spec.trace_ctx = tracing.inject_context()
+        streaming = spec.num_returns == STREAMING_RETURNS
+        task_id_for_stream = spec.task_id
+        if streaming and spec.task_id not in self.streams:
+            self.streams[spec.task_id] = StreamState(spec)
         refs = []
         with self.lock:
             for oid in spec.return_ids():
@@ -1378,6 +1734,8 @@ class CoreWorker:
                 e.pins = 1
                 self.local_ref_counts[oid] = 1
                 refs.append(ObjectRef(oid, self.addr, self.worker_id))
+        if streaming:
+            refs = [ObjectRefGenerator(self, spec.task_id)]
         self.task_events.record_status(
             spec.task_id, "PENDING_ARGS_AVAIL", name=method_name,
             actor_id=actor_id, extra={"type": "ACTOR_TASK"})
@@ -1414,6 +1772,9 @@ class CoreWorker:
                     need_resolve = False
         if dead:
             e = ActorDiedError(ac.dead_error or "actor is dead")
+            if streaming:
+                self._fail_stream(task_id_for_stream, e)
+                return refs
             for oid in [r.id for r in refs]:
                 with self.lock:
                     ent = self.objects.get(oid)
@@ -1446,6 +1807,8 @@ class CoreWorker:
             with ac.lock:
                 ac.inflight.pop(spec.task_id, None)
             self._store_results(spec, reply)
+            if spec.num_returns == STREAMING_RETURNS:
+                self._finish_stream(spec.task_id, reply)
 
         fut.add_done_callback(on_done)
 
@@ -1503,26 +1866,38 @@ class CoreWorker:
                     e.error = err
                     e.event.set()
 
-    def cancel(self, ref, force: bool = False) -> bool:
-        """Cancel the (normal) task producing `ref` (reference:
-        ray.cancel, core_worker CancelTask).  Queued tasks are dropped;
-        a running task gets TaskCancelledError injected into its thread
-        (force=True kills the worker process instead).  Cancelled tasks
-        are never retried.  Returns False if the task already finished
-        or isn't a cancellable normal task."""
+    def cancel(self, ref, force: bool = False,
+               recursive: bool = True) -> bool:
+        """Cancel the task producing `ref` (reference: ray.cancel,
+        core_worker CancelTask + HandleRemoteCancelTask).  Queued tasks
+        are dropped; a running task gets TaskCancelledError injected into
+        its thread (force=True kills the worker process instead; not
+        supported for actor tasks).  recursive=True also cancels the
+        tasks the cancelled task submitted.  Cancelled tasks are never
+        retried.  Returns False if the task already finished or isn't
+        cancellable."""
         tid = "tsk-" + ref.id[4:].rsplit("-", 1)[0] \
             if ref.id.startswith("obj-") else None
+        if tid is None:
+            return False
+        return self._cancel_task_id(tid, force, recursive)
+
+    def _cancel_task_id(self, tid: str, force: bool,
+                        recursive: bool) -> bool:
         with self.lock:
-            rec = self.task_records.get(tid) if tid else None
-            if rec is None or rec.done:
+            rec = self.task_records.get(tid)
+            if rec is not None and rec.done:
                 return False
-            rec.canceled = True
-            rec.retries_left = 0
-            pool = self.pools.get(rec.pool_key)
-            queued = pool is not None and rec in pool.queue
-            if queued:
-                pool.queue.remove(rec)
-                self.task_records.pop(tid, None)
+            if rec is not None:
+                rec.canceled = True
+                rec.retries_left = 0
+                pool = self.pools.get(rec.pool_key)
+                queued = pool is not None and rec in pool.queue
+                if queued:
+                    pool.queue.remove(rec)
+                    self.task_records.pop(tid, None)
+        if rec is None:
+            return self._cancel_actor_task(tid, force, recursive)
         if queued:
             err = TaskCancelledError(
                 f"task {rec.spec.function_name} was cancelled before it "
@@ -1536,8 +1911,11 @@ class CoreWorker:
                 if e is not None and not e.ready:
                     e.error = err
                     e.event.set()
+            if rec.spec.num_returns == STREAMING_RETURNS:
+                self._fail_stream(tid, err)
             return True
-        # pushed: tell the executing worker
+        # pushed: tell the executing worker (it propagates to children
+        # when recursive — they are owned by that worker, not us)
         with self.lock:
             lw = None
             if pool is not None and rec.pushed_to:
@@ -1545,10 +1923,79 @@ class CoreWorker:
         if lw is not None and lw.client is not None:
             try:
                 lw.client.notify("cancel_task", {"task_id": rec.spec.task_id,
-                                                 "force": force})
+                                                 "force": force,
+                                                 "recursive": recursive})
             except Exception:
                 pass
         return True
+
+    def _cancel_actor_task(self, tid: str, force: bool,
+                           recursive: bool) -> bool:
+        """Cancel an actor task: drop it if still buffered client-side,
+        else ask the actor's worker (reference: core_worker.cc
+        HandleCancelTask actor path; force-kill is not supported for
+        actor tasks, matching ray.cancel semantics)."""
+        with self.lock:
+            conns = list(self.actors.values())
+        for ac in conns:
+            with ac.lock:
+                buffered = next(
+                    (s for s in ac.buffer if s.task_id == tid), None)
+                if buffered is not None:
+                    ac.buffer.remove(buffered)
+                inflight = ac.inflight.get(tid)
+                client = ac.client
+            if buffered is not None:
+                err = TaskCancelledError(
+                    f"actor task {buffered.function_name} was cancelled "
+                    f"before it was sent")
+                self.task_events.record_status(
+                    tid, "FAILED", name=buffered.function_name,
+                    actor_id=ac.actor_id, error=str(err))
+                for oid in buffered.return_ids():
+                    with self.lock:
+                        e = self.objects.get(oid)
+                    if e is not None and not e.ready:
+                        e.error = err
+                        e.event.set()
+                return True
+            if inflight is not None:
+                if force:
+                    raise ValueError(
+                        "force=True is not supported for actor tasks")
+                if client is not None:
+                    try:
+                        client.notify("cancel_task",
+                                      {"task_id": tid, "force": False,
+                                       "recursive": recursive})
+                    except Exception:
+                        pass
+                return True
+        return False
+
+    def cancel_children(self, parent_tid: str, force: bool = False):
+        """Cancel every not-yet-finished task this process submitted on
+        behalf of `parent_tid` (reference: ray.cancel(recursive=True) —
+        each worker cancels the children it owns, recursing down)."""
+        child_tids = []
+        with self.lock:
+            child_tids += [rec.spec.task_id
+                           for rec in self.task_records.values()
+                           if rec.spec.parent_task_id == parent_tid
+                           and not rec.done]
+            conns = list(self.actors.values())
+        for ac in conns:
+            with ac.lock:
+                child_tids += [
+                    s.task_id
+                    for s in list(ac.buffer) + list(ac.inflight.values())
+                    if s.parent_task_id == parent_tid]
+        for tid in child_tids:
+            try:
+                self._cancel_task_id(tid, force, recursive=True)
+            except ValueError:
+                # actor child: force unsupported — plain cancel instead
+                self._cancel_task_id(tid, False, recursive=True)
 
     def kill_actor(self, actor_id: str, no_restart: bool = True):
         self._control_call("kill_actor", {"actor_id": actor_id,
@@ -1604,7 +2051,23 @@ class CoreWorker:
         with self.lock:
             self._push_handlers.setdefault(topic, []).append(fn)
 
+    def _sub_topics(self) -> List[str]:
+        topics = ["actor", "node"]
+        if self.log_to_driver:
+            topics.append("worker_logs")
+        return topics
+
     def _on_control_push(self, topic: str, payload):
+        if topic == "pub:worker_logs":
+            # worker stdout routed to this driver (reference:
+            # log_monitor.py -> pubsub -> driver console)
+            if self.log_to_driver and payload.get("job_id") == self.job_id:
+                import sys as _sys
+
+                wid = payload.get("worker_id", "?")
+                for line in payload.get("lines", ()):
+                    print(f"({wid}) {line}", file=_sys.stderr)
+            return
         if topic == "pub:actor":
             actor = payload.get("actor", {})
             aid = actor.get("actor_id")
@@ -1642,6 +2105,20 @@ class CoreWorker:
             else:
                 results.append(("inline", (meta, [b.raw().tobytes() for b in bufs])))
         return {"status": "ok", "results": results}
+
+    def store_stream_item(self, spec: TaskSpec, index: int, value):
+        """Producer-side: serialize one yielded item (shm for big values,
+        inline otherwise) into the wire (kind, payload) form."""
+        oid = common.object_id_for_return(spec.task_id, index)
+        meta, bufs = serialization.dumps_oob(value)
+        raw = [b.raw() for b in bufs]
+        total = len(meta) + sum(len(b) for b in raw)
+        if total > INLINE_OBJECT_LIMIT and self.store is not None:
+            self.store.create(oid, meta, raw)
+            return ("shm", {"node_id": self.node_id,
+                            "addr": self.raylet_addr,
+                            "nbytes": total})
+        return ("inline", (meta, [b.raw().tobytes() for b in bufs]))
 
     def resolve_args(self, spec: TaskSpec):
         args, kwargs = serialization.loads_inline(spec.args_blob)

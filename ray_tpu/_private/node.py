@@ -189,6 +189,23 @@ class Raylet:
         self._prestart_thread.start()
         if self._mem_thread is not None:
             self._mem_thread.start()
+        # worker-log tailer -> control pubsub -> driver stderr
+        # (reference: python/ray/_private/log_monitor.py)
+        from .log_monitor import LogMonitor
+
+        def _publish_logs(payload):
+            cli = self.control
+            if cli is not None and not cli.closed:
+                try:
+                    cli.notify("publish", {"topic": "worker_logs",
+                                           "payload": payload})
+                except Exception:
+                    pass
+
+        self.log_monitor = LogMonitor(
+            os.path.join(self.session_dir, "logs"), self.node_id,
+            _publish_logs)
+        self.log_monitor.start()
         logger.info("raylet %s up at %s resources=%s", self.node_id[:12],
                     self.server.addr, common.denormalize_resources(self.total))
         if block:
@@ -213,7 +230,9 @@ class Raylet:
             return
         if not self._reconnecting.acquire(blocking=False):
             return
-        grace = float(os.environ.get("RAY_TPU_CONTROL_RECONNECT_S", "20"))
+        from .config import cfg
+
+        grace = cfg().control_reconnect_s
         threading.Thread(target=self._reconnect_control, args=(grace,),
                          name="raylet-reconnect", daemon=True).start()
 
@@ -252,6 +271,8 @@ class Raylet:
         if self._stop.is_set():
             return
         self._stop.set()
+        if getattr(self, "log_monitor", None) is not None:
+            self.log_monitor.stop()
         with self.lock:
             workers = list(self.workers.values())
         for w in workers:
@@ -296,6 +317,9 @@ class Raylet:
         env["PYTHONPATH"] = _package_pythonpath()
         env["RAY_TPU_STARTUP_TOKEN"] = str(token)
         env["RAY_TPU_WORKER_ID"] = wid
+        # line-buffered stdout so task prints reach the log tailer (and
+        # the driver) promptly, not on buffer flushes
+        env["PYTHONUNBUFFERED"] = "1"
         env["RAY_TPU_NODE_ID"] = self.node_id
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
         if actor_id:

@@ -12,7 +12,16 @@ Supported fields:
   env_vars     {str: str}   applied around execution
   working_dir  path/zip     shipped, extracted, becomes cwd + sys.path[0]
   py_modules   [paths]      shipped, extracted, prepended to sys.path
-  pip / conda  rejected unless RAY_TPU_ALLOW_PKG_INSTALL=1 (the build
+  pip          [requirements]  content-addressed package env built once
+               per node (pip install --target into the shared cache) and
+               prepended to sys.path — the venv-equivalent for in-process
+               workers (reference: runtime_env/pip.py builds a virtualenv
+               and spawns the worker inside it; our workers already run,
+               so the env is import-path scoped instead).  Gated: rejected
+               unless RAY_TPU_ALLOW_PKG_INSTALL=1.  With
+               RAY_TPU_WHEELHOUSE=<dir> the install is fully offline
+               (--no-index --find-links), which is also how it is tested.
+  conda        rejected unless RAY_TPU_ALLOW_PKG_INSTALL=1 (the build
                forbids network installs; the hook exists for parity)
 """
 
@@ -139,7 +148,52 @@ def prepare(env: Optional[Dict[str, Any]], control) -> Optional[Dict[str, Any]]:
     if mods:
         out["py_modules"] = [m if str(m).startswith("pkg:")
                              else _upload_package(control, m) for m in mods]
+    if env.get("pip"):
+        # driver policy rides along so the worker installs the same way
+        out["_wheelhouse"] = os.environ.get("RAY_TPU_WHEELHOUSE")
     return out
+
+
+def _build_pip_env(requirements: List[str],
+                   wheelhouse: Optional[str]) -> str:
+    """Build (once per node) a content-addressed package dir for a pip
+    requirement list and return it for sys.path insertion (reference:
+    runtime_env/pip.py — virtualenv keyed by the requirements hash with a
+    node-shared cache).  ``pip install --target`` replaces the venv
+    because our workers insert import paths instead of re-exec'ing."""
+    import subprocess
+
+    reqs = sorted(str(r) for r in requirements)
+    py = f"py{sys.version_info[0]}.{sys.version_info[1]}"
+    digest = hashlib.sha256(
+        ("\n".join(reqs) + "\0" + py).encode()).hexdigest()[:20]
+    dest = os.path.join(CACHE_ROOT, f"pipenv-{digest}")
+    marker = os.path.join(dest, ".complete")
+    if os.path.exists(marker):
+        return dest
+    tmp = dest + f".tmp{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    cmd = [sys.executable, "-m", "pip", "install", "--quiet",
+           "--target", tmp]
+    if wheelhouse:
+        # fully offline: wheels (and their deps) come from the wheelhouse
+        cmd += ["--no-index", "--find-links", wheelhouse]
+    cmd += reqs
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise RuntimeError(
+            f"pip runtime_env build failed: {proc.stderr[-2000:]}")
+    open(os.path.join(tmp, ".complete"), "w").close()
+    try:
+        os.rename(tmp, dest)
+    except OSError:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)  # another worker won
+    return dest
 
 
 def _fetch_package(control, uri: str) -> str:
@@ -204,13 +258,20 @@ class Context:
             else:
                 os.environ[k] = old
         self._saved_env.clear()
-        # drop our sys.path entries so a reused worker's later tasks don't
-        # import this env's modules by accident
+        # drop our sys.path entries AND the modules imported from them so
+        # a reused worker's later tasks don't see this env's packages
+        # (sys.modules would otherwise cache them past the path removal)
         for p in self._inserted_paths:
             try:
                 sys.path.remove(p)
             except ValueError:
                 pass
+        if self._inserted_paths:
+            prefixes = tuple(p + os.sep for p in self._inserted_paths)
+            for mod_name, mod in list(sys.modules.items()):
+                f = getattr(mod, "__file__", None)
+                if f and f.startswith(prefixes):
+                    del sys.modules[mod_name]
         self._inserted_paths.clear()
         if self._saved_cwd:
             try:
@@ -239,4 +300,10 @@ def materialize(env: Optional[Dict[str, Any]], control) -> Context:
     for m in env.get("py_modules") or []:
         p = _fetch_package(control, m) if str(m).startswith("pkg:") else str(m)
         sys_paths.append(p)
+    pip_reqs = env.get("pip")
+    if pip_reqs:
+        if isinstance(pip_reqs, dict):  # reference: {"packages": [...]}
+            pip_reqs = pip_reqs.get("packages") or []
+        sys_paths.append(_build_pip_env(list(pip_reqs),
+                                        env.get("_wheelhouse")))
     return Context(dict(env.get("env_vars") or {}), sys_paths, cwd)

@@ -91,14 +91,49 @@ class FileObjectStore:
     # -- write path --------------------------------------------------------
 
     def create(self, object_id: str, meta: bytes, buffers: Sequence[memoryview]) -> int:
-        """Write + seal an object; returns its byte size."""
-        size = layout_size(len(meta), [len(b) for b in buffers])
+        """Write + seal an object; returns its byte size.
+
+        Uses writev() rather than mmap: on tmpfs a streaming write avoids
+        the per-page fault + TLB cost of populating a fresh mapping
+        (~1.5-2x the bandwidth on the put path; reads stay mmap
+        zero-copy)."""
+        lens = [len(b) for b in buffers]
+        size = layout_size(len(meta), lens)
         tmp = self._path(object_id) + ".tmp.%d" % os.getpid()
         fd = os.open(tmp, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
         try:
-            os.ftruncate(fd, max(size, 1))
-            with mmap.mmap(fd, max(size, 1)) as mm:
-                pack_into(memoryview(mm), meta, buffers)
+            header = bytearray(4 + 4 + 8 + 4 + 4 + 8 * len(lens))
+            struct.pack_into("<IIQII", header, 0, _MAGIC, 1, len(meta),
+                             len(lens), 0)
+            off = 4 + 4 + 8 + 4 + 4
+            for l in lens:
+                struct.pack_into("<Q", header, off, l)
+                off += 8
+            pad = b"\0" * _ALIGN
+            iov: List = [bytes(header), meta]
+            pos = len(header) + len(meta)
+            for b in buffers:
+                aligned = _pad(pos)
+                if aligned != pos:
+                    iov.append(pad[:aligned - pos])
+                    pos = aligned
+                mv = b.cast("B") if isinstance(b, memoryview) else memoryview(b)
+                iov.append(mv)
+                pos += len(mv)
+            if _pad(pos) != pos:
+                iov.append(pad[:_pad(pos) - pos])
+            written = 0
+            while iov:
+                n = os.writev(fd, iov[:1024])
+                written += n
+                # drop fully-written iovecs; split a partial one
+                while iov and n >= len(iov[0]):
+                    n -= len(iov[0])
+                    iov.pop(0)
+                if n and iov:
+                    iov[0] = memoryview(iov[0])[n:]
+            if written < 1:
+                os.ftruncate(fd, 1)
             os.rename(tmp, self._path(object_id))  # atomic seal
         except BaseException:
             try:

@@ -57,6 +57,7 @@ class WorkerMain:
         self._cancelled: set = set()
         self._cancel_lock = threading.Lock()
         self._running_task: dict = {}  # thread ident -> task_id
+        self._aio_tasks: dict = {}  # task_id -> asyncio.Task (async exec)
         self.actor_instance = None
         self.actor_concurrency = 1
         self._stop = threading.Event()
@@ -77,6 +78,12 @@ class WorkerMain:
         }, timeout=30.0)
         if not r.get("ok"):
             raise RuntimeError(f"worker registration rejected: {r}")
+
+        # apply the driver-registered tracing startup hook, if any
+        # (reference: tracing_helper.py hook runs in every worker)
+        from ray_tpu.util import tracing
+
+        tracing.apply_hook_from_kv(self.core.control)
 
         n_threads = 1
         self.exec_threads = [
@@ -160,26 +167,44 @@ class WorkerMain:
         cancel injects TaskCancelledError into the executing thread."""
         tid = p.get("task_id")
         force = p.get("force", False)
+        recursive = p.get("recursive", False)
         with self._cancel_lock:
-            running_thread = next(
-                (th for th, t in self._running_task.items() if t == tid),
-                None)
-            if running_thread is None:
-                self._cancelled.add(tid)
-                return True
-            if force:
-                os._exit(1)
-            import ctypes
+            # async task/actor-method first: looked up under _cancel_lock,
+            # the same lock _register_aio claims under — a cancel either
+            # finds the registered asyncio.Task or parks in _cancelled
+            # for _register_aio to observe before running the coroutine
+            aio_task = self._aio_tasks.get(tid)
+            if aio_task is not None:
+                loop = self._aio_loop
+                if loop is not None:
+                    loop.call_soon_threadsafe(aio_task.cancel)
+            else:
+                running_thread = next(
+                    (th for th, t in self._running_task.items()
+                     if t == tid), None)
+                if running_thread is None:
+                    self._cancelled.add(tid)
+                elif force:
+                    os._exit(1)
+                else:
+                    import ctypes
 
-            from .common import TaskCancelledError
+                    from .common import TaskCancelledError
 
-            # inject while still holding the lock: the exec loop clears
-            # _running_task under this same lock, so the exception can only
-            # be scheduled while the task is genuinely the current one (a
-            # late landing between tasks is absorbed by _exec_loop)
-            ctypes.pythonapi.PyThreadState_SetAsyncExc(
-                ctypes.c_ulong(running_thread),
-                ctypes.py_object(TaskCancelledError))
+                    # inject while still holding the lock: the exec loop
+                    # clears _running_task under this same lock, so the
+                    # exception can only be scheduled while the task is
+                    # genuinely the current one (a late landing between
+                    # tasks is absorbed by _exec_loop)
+                    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                        ctypes.c_ulong(running_thread),
+                        ctypes.py_object(TaskCancelledError))
+        if recursive:
+            # children submitted BY the cancelled task are owned by this
+            # process — cancel them off the server thread (they may need
+            # RPCs of their own)
+            self.core.pool_executor.submit(
+                self.core.cancel_children, tid, force)
         return True
 
     def _on_raylet_push(self, topic, payload):
@@ -238,7 +263,14 @@ class WorkerMain:
         reply = None
         try:
             try:
-                reply = self._execute(kind, spec, d)
+                from ray_tpu.util import tracing
+
+                with tracing.execute_span(
+                        "task" if kind == "normal" else "actor",
+                        spec.function_name,
+                        getattr(spec, "trace_ctx", None),
+                        task_id=spec.task_id, actor_id=spec.actor_id):
+                    reply = self._execute(kind, spec, d)
             except TaskCancelledError as e:
                 # injection landed inside _execute's own error handling;
                 # still owe the owner a reply
@@ -257,6 +289,24 @@ class WorkerMain:
                     break
                 except TaskCancelledError:
                     continue
+
+    def _register_aio(self, spec: TaskSpec) -> bool:
+        """First statement of every async execution coroutine: atomically
+        either claim the task (register its asyncio.Task for
+        cancellation) or observe a cancel that arrived before the loop
+        ran us.  Returns False when already cancelled.  Also stamps the
+        execution contextvars — each asyncio Task has its own context,
+        so interleaved async methods attribute children correctly."""
+        from .core import EXECUTING_JOB_ID, EXECUTING_TASK_ID
+
+        with self._cancel_lock:
+            if spec.task_id in self._cancelled:
+                self._cancelled.discard(spec.task_id)
+                return False
+            self._aio_tasks[spec.task_id] = asyncio.current_task()
+        EXECUTING_TASK_ID.set(spec.task_id)
+        EXECUTING_JOB_ID.set(getattr(spec, "job_id", "") or None)
+        return True
 
     def _get_aio_loop(self) -> asyncio.AbstractEventLoop:
         with self._aio_lock:
@@ -285,6 +335,63 @@ class WorkerMain:
                 t.start()
                 self._aio_loop = loop
             return self._aio_loop
+
+    WINDOW = 8  # in-flight unacked item reports per generator
+
+    def _run_generator(self, spec: TaskSpec, out, t0: float):
+        """Execute a streaming task: push each yielded item to the owner
+        as it is produced (reference: HandleReportGeneratorItemReturns,
+        task_manager.h:355).  The per-item acks double as backpressure —
+        the owner defers them while its unconsumed buffer is full."""
+        if not hasattr(out, "__iter__"):
+            raise TypeError(
+                f"task {spec.function_name} declared "
+                f'num_returns="streaming" but returned non-iterable '
+                f"{type(out).__name__}")
+        from collections import deque
+
+        owner = self.core._owner_client(tuple(spec.owner_addr))
+        outstanding = deque()
+        count = 0
+        stopped = False
+
+        def drain(limit: int):
+            nonlocal stopped
+            while len(outstanding) > limit:
+                ack = outstanding.popleft().result(timeout=600.0)
+                if ack and ack.get("stop"):
+                    stopped = True
+                    return
+
+        try:
+            for item in out:
+                result = self.core.store_stream_item(spec, count, item)
+                outstanding.append(owner.call_async(
+                    "generator_item",
+                    {"task_id": spec.task_id, "index": count,
+                     "result": result}))
+                count += 1
+                drain(self.WINDOW - 1)
+                if stopped:
+                    break
+        except BaseException:
+            # make sure every already-yielded item is acked by the owner
+            # BEFORE the error reply: the reply rides a different
+            # connection and must not overtake the items
+            try:
+                drain(0)
+            except Exception:
+                pass
+            raise
+        finally:
+            close = getattr(out, "close", None)
+            if stopped and close is not None:
+                close()
+        drain(0)
+        self.core.task_events.record_status(
+            spec.task_id, "FINISHED", name=spec.function_name)
+        return {"status": "ok", "streaming_done": count,
+                "exec_ms": (time.monotonic() - t0) * 1000.0}
 
     def _store_reply(self, spec: TaskSpec, out, t0: float):
         if spec.num_returns > 1:
@@ -316,8 +423,24 @@ class WorkerMain:
                           spec.function_name))
         return {"status": "error", "error": err_blob}
 
+    _last_job_marker: str = None
+
     def _execute(self, kind: str, spec: TaskSpec, d: Deferred = None):
+        from .core import EXECUTING_JOB_ID, EXECUTING_TASK_ID
+
         self.core._executing.active = True
+        # children submitted while this task runs carry it as parent
+        # (ray.cancel(recursive=True)) and keep the root driver's job
+        # (log routing); contextvars so async tasks attribute per-Task
+        EXECUTING_TASK_ID.set(spec.task_id)
+        EXECUTING_JOB_ID.set(getattr(spec, "job_id", "") or None)
+        # job marker: the raylet's log tailer attributes the stdout that
+        # follows to this job (workers are shared across jobs here,
+        # unlike the reference's per-job workers — log_monitor.py)
+        job = getattr(spec, "job_id", "") or ""
+        if job != self._last_job_marker:
+            self._last_job_marker = job
+            print(f"\x01RAYTPU-JOB {job}", flush=True)
         t0 = time.monotonic()
         self.core.task_events.record_status(
             spec.task_id, "RUNNING", name=spec.function_name,
@@ -366,13 +489,25 @@ class WorkerMain:
                     args, kwargs = self.core.resolve_args(spec)
 
                     async def _finish(spec=spec, t0=t0, d=d):
+                        if not self._register_aio(spec):
+                            d.resolve(self._error_reply(
+                                common.TaskCancelledError(
+                                    "cancelled before start"), spec))
+                            return
                         try:
                             out = fn(*args, **kwargs)
                             if inspect.iscoroutine(out):
                                 out = await out
                             reply = self._store_reply(spec, out, t0)
+                        except asyncio.CancelledError:
+                            reply = self._error_reply(
+                                common.TaskCancelledError(
+                                    f"actor task {spec.function_name} "
+                                    f"was cancelled"), spec)
                         except BaseException as e:
                             reply = self._error_reply(e, spec)
+                        finally:
+                            self._aio_tasks.pop(spec.task_id, None)
                         d.resolve(reply)
 
                     asyncio.run_coroutine_threadsafe(_finish(),
@@ -396,16 +531,36 @@ class WorkerMain:
                     ctx.__exit__(None, None, None)
                     ctx = None
                 raise
+            if spec.num_returns == common.STREAMING_RETURNS:
+                try:
+                    return self._run_generator(spec, out, t0)
+                finally:
+                    if ctx is not None:
+                        ctx.__exit__(None, None, None)
             if inspect.iscoroutine(out):
                 # async function task: run to completion on the loop; the
                 # env context stays open until the coroutine finishes
                 async def _finish(coro=out, spec=spec, t0=t0, d=d, ctx=ctx):
+                    if not self._register_aio(spec):
+                        coro.close()
+                        if ctx is not None:
+                            ctx.__exit__(None, None, None)
+                        d.resolve(self._error_reply(
+                            common.TaskCancelledError(
+                                "cancelled before start"), spec))
+                        return
                     try:
                         value = await coro
                         reply = self._store_reply(spec, value, t0)
+                    except asyncio.CancelledError:
+                        reply = self._error_reply(
+                            common.TaskCancelledError(
+                                f"task {spec.function_name} was "
+                                f"cancelled"), spec)
                     except BaseException as e:
                         reply = self._error_reply(e, spec)
                     finally:
+                        self._aio_tasks.pop(spec.task_id, None)
                         if ctx is not None:
                             ctx.__exit__(None, None, None)
                     d.resolve(reply)
@@ -420,6 +575,8 @@ class WorkerMain:
             return self._error_reply(e, spec)
         finally:
             self.core._executing.active = False
+            EXECUTING_TASK_ID.set(None)
+            EXECUTING_JOB_ID.set(None)
 
 
 def main():

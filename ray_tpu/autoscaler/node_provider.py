@@ -218,3 +218,15 @@ class GCPTpuNodeProvider(NodeProvider):
             rec = self._nodes.get(node_id)
         return rec is not None and \
             rec["tags"].get(TAG_NODE_STATUS) == "up-to-date"
+
+
+def make_node_provider(provider_config: Dict[str, Any],
+                       cluster_name: str) -> NodeProvider:
+    """Provider factory keyed by provider.type (reference:
+    autoscaler/_private/providers.py _get_node_provider)."""
+    kind = (provider_config or {}).get("type", "local")
+    if kind == "local":
+        return LocalNodeProvider(provider_config, cluster_name)
+    if kind in ("gcp_tpu", "gcp"):
+        return GCPTpuNodeProvider(provider_config, cluster_name)
+    raise ValueError(f"unknown node provider type {kind!r}")

@@ -14,6 +14,11 @@ Endpoints (mirroring the reference's dashboard REST surface):
   GET /api/actors               actor table
   GET /api/tasks                task events
   GET /api/jobs                 submitted jobs (reference: /api/jobs/)
+  POST /api/jobs                submit a job (reference:
+                                modules/job/job_head.py submit_job)
+  GET /api/jobs/<id>            one job's info
+  GET /api/jobs/<id>/logs       job driver logs
+  POST /api/jobs/<id>/stop      stop a job
   GET /api/placement_groups     placement groups
   GET /api/objects              object-store summary
   GET /metrics                  Prometheus exposition (reference: agent scrape)
@@ -49,6 +54,40 @@ class DashboardHead:
 
     def _state_dump(self) -> Dict[str, Any]:
         return self.control.call("state_dump", {}, timeout=10.0)
+
+    def _job_client(self):
+        """Lazy full driver connection for job submission (reference: the
+        job head submits through an internal JobSubmissionClient)."""
+        cli = getattr(self, "_jobs", None)
+        if cli is None:
+            from ray_tpu.job.job_manager import JobSubmissionClient
+
+            cli = self._jobs = JobSubmissionClient(self.control_address)
+        return cli
+
+    def route_post(self, path: str, body: Dict[str, Any]
+                   ) -> Tuple[int, str, str]:
+        """POST routes: job submission + stop (reference:
+        modules/job/job_head.py)."""
+        try:
+            if path in ("/api/jobs", "/api/jobs/"):
+                entrypoint = (body or {}).get("entrypoint")
+                if not entrypoint:
+                    return 400, "text/plain", "entrypoint required"
+                sid = self._job_client().submit_job(
+                    entrypoint=entrypoint,
+                    runtime_env=body.get("runtime_env"),
+                    submission_id=body.get("submission_id"),
+                    metadata=body.get("metadata"))
+                return self._json({"submission_id": sid})
+            if path.startswith("/api/jobs/") and path.endswith("/stop"):
+                sid = path[len("/api/jobs/"):-len("/stop")]
+                return self._json(
+                    {"stopped": self._job_client().stop_job(sid)})
+            return 404, "text/plain", f"no POST route {path}"
+        except Exception as e:
+            logger.exception("dashboard POST %s failed", path)
+            return 500, "text/plain", f"error: {e}"
 
     def route(self, path: str, query: Dict[str, Any]) -> Tuple[int, str, str]:
         """Returns (status, content_type, body)."""
@@ -89,6 +128,16 @@ class DashboardHead:
                     if raw:
                         jobs.append(json.loads(raw))
                 return self._json(jobs)
+            if path.startswith("/api/jobs/"):
+                rest = path[len("/api/jobs/"):]
+                if rest.endswith("/logs"):
+                    sid = rest[:-len("/logs")]
+                    return self._json(
+                        {"logs": self._job_client().get_job_logs(sid)})
+                info = self._job_client().get_job_info(rest)
+                if info is None:
+                    return 404, "text/plain", f"no job {rest}"
+                return self._json(info)
             if path == "/api/tasks":
                 limit = int(query.get("limit", ["1000"])[0])
                 out = self.control.call("list_task_events",
@@ -174,16 +223,29 @@ class DashboardHead:
         head = self
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):
-                parsed = urlparse(self.path)
-                status, ctype, body = head.route(parsed.path,
-                                                 parse_qs(parsed.query))
+            def _reply(self, status, ctype, body):
                 data = body.encode()
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                self._reply(*head.route(parsed.path,
+                                        parse_qs(parsed.query)))
+
+            def do_POST(self):
+                parsed = urlparse(self.path)
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b""
+                try:
+                    body = json.loads(raw) if raw else {}
+                except ValueError:
+                    self._reply(400, "text/plain", "invalid JSON body")
+                    return
+                self._reply(*head.route_post(parsed.path, body))
 
             def log_message(self, fmt, *args):
                 logger.debug("http: " + fmt, *args)

@@ -36,6 +36,14 @@ class DataContext:
     seed: Optional[int] = None
     # Extra resources to attach to data tasks.
     task_resources: Dict[str, float] = field(default_factory=dict)
+    # Stream blocks out of read/map tasks as they are produced instead of
+    # buffering whole task outputs (reference: streaming generator returns
+    # in the streaming executor); bounds per-task memory.
+    use_streaming_generators: bool = True
+    # Max unconsumed streamed items (block+meta pairs count as 2) before
+    # the producing task pauses (reference:
+    # _generator_backpressure_num_objects).
+    generator_backpressure_num_objects: int = 8
 
     _lock = threading.Lock()
     _current: Optional["DataContext"] = None

@@ -120,6 +120,41 @@ def _read_task(rt, chain: List[Dict]):
     return block, meta
 
 
+def _read_task_streaming(rt, chain: List[Dict]):
+    """Streaming variant of _read_task: each source block flows through
+    the fused stages and out of the task as soon as it is produced —
+    the task never holds the whole output (reference: the streaming
+    executor's generator-based block returns).  Yields block, meta,
+    block, meta, ..."""
+    for b in rt():
+        t0 = time.perf_counter()
+        out = [b]
+        for stage in chain:
+            out = _apply_stage(out, stage)
+        for ob in out:
+            meta = BlockAccessor(ob).get_metadata(
+                input_files=rt.metadata.input_files,
+                exec_stats={"wall_s": time.perf_counter() - t0})
+            yield ob
+            yield meta
+            t0 = time.perf_counter()
+
+
+def _map_task_streaming(chain: List[Dict], *blocks: Block):
+    """Streaming variant of _map_task: yields each output block (and its
+    metadata) without concatenating the task's whole output."""
+    t0 = time.perf_counter()
+    out = _apply_stage(list(blocks), chain[0])
+    for stage in chain[1:]:
+        out = _apply_stage(out, stage)
+    for ob in out:
+        meta = BlockAccessor(ob).get_metadata(
+            exec_stats={"wall_s": time.perf_counter() - t0})
+        yield ob
+        yield meta
+        t0 = time.perf_counter()
+
+
 def _slice_task(n: int, block: Block):
     acc = BlockAccessor(block)
     out = acc.slice(0, min(n, acc.num_rows()))
@@ -271,6 +306,16 @@ class _TaskRec:
     tag: Any = None
 
 
+@dataclass
+class _StreamRec:
+    """An in-flight streaming task: its generator yields block, meta,
+    block, meta, ...; the executor polls it and emits a bundle per
+    pair."""
+    gen: Any                  # ObjectRefGenerator
+    op: "PhysicalOperator"
+    pending: List[Any] = field(default_factory=list)
+
+
 class PhysicalOperator:
     def __init__(self, name: str, num_inputs: int = 1):
         self.name = name
@@ -348,10 +393,17 @@ class ReadOperator(PhysicalOperator):
         if not self._pending:
             return []
         rt = self._pending.popleft()
-        refs = submit(_read_task, (rt, self._chain), num_returns=2,
-                      resources=self._resources, name=f"data:{self.name}")
         self.active += 1
         self.stats["tasks"] += 1
+        ctx = DataContext.get_current()
+        if ctx.use_streaming_generators:
+            gen = submit(_read_task_streaming, (rt, self._chain),
+                         num_returns="streaming",
+                         resources=self._resources,
+                         name=f"data:{self.name}")
+            return [_StreamRec(gen, self)]
+        refs = submit(_read_task, (rt, self._chain), num_returns=2,
+                      resources=self._resources, name=f"data:{self.name}")
 
         def on_done(rec: _TaskRec):
             self.active -= 1
@@ -374,11 +426,19 @@ class MapOperator(PhysicalOperator):
         if not self.in_queues[0]:
             return []
         bundle: RefBundle = self.in_queues[0].popleft()
+        self.active += 1
+        self.stats["tasks"] += 1
+        ctx = DataContext.get_current()
+        if ctx.use_streaming_generators:
+            gen = submit(_map_task_streaming,
+                         (self._chain, bundle.block_ref),
+                         num_returns="streaming",
+                         resources=self._resources,
+                         name=f"data:{self.name}")
+            return [_StreamRec(gen, self)]
         refs = submit(_map_task, (self._chain, bundle.block_ref),
                       num_returns=2, resources=self._resources,
                       name=f"data:{self.name}")
-        self.active += 1
-        self.stats["tasks"] += 1
 
         def on_done(rec: _TaskRec):
             self.active -= 1
@@ -820,6 +880,7 @@ class StreamingExecutor:
         self.ops = all_ops
         self.ctx = DataContext.get_current()
         self._inflight: Dict[str, Tuple[_TaskRec, Any]] = {}
+        self._streams: List[_StreamRec] = []
         self._started = time.perf_counter()
         self.wall_s = 0.0
 
@@ -827,14 +888,47 @@ class StreamingExecutor:
         res = dict(self.ctx.task_resources or {})
         if resources:
             res.update(resources)  # per-operator demands win
-        remote_fn = ray_tpu.remote(fn).options(
-            num_returns=num_returns, name=name,
-            resources=res or None,
-            num_cpus=1)
+        opts = dict(num_returns=num_returns, name=name,
+                    resources=res or None, num_cpus=1)
+        if num_returns == "streaming":
+            opts["_generator_backpressure_num_objects"] = \
+                self.ctx.generator_backpressure_num_objects
+        remote_fn = ray_tpu.remote(fn).options(**opts)
         refs = remote_fn.remote(*args)
         if num_returns == 1:
             refs = [refs]
-        return refs
+        return refs  # an ObjectRefGenerator when streaming
+
+    def _track(self, rec, op: PhysicalOperator):
+        if isinstance(rec, _StreamRec):
+            self._streams.append(rec)
+        else:
+            self._inflight[rec.refs[0].id] = (rec, op)
+
+    def _poll_streams(self) -> bool:
+        from ray_tpu import GetTimeoutError
+
+        progressed = False
+        for srec in list(self._streams):
+            while True:
+                try:
+                    ref = srec.gen.next_ready(timeout=0)
+                except StopIteration:
+                    srec.op.active -= 1
+                    srec.op.maybe_finish()
+                    self._streams.remove(srec)
+                    progressed = True
+                    break
+                except GetTimeoutError:
+                    break
+                srec.pending.append(ref)
+                if len(srec.pending) == 2:
+                    block_ref, meta_ref = srec.pending
+                    srec.pending = []
+                    meta = ray_tpu.get(meta_ref, timeout=300)
+                    srec.op._emit(RefBundle(block_ref, meta))
+                    progressed = True
+        return progressed
 
     def _route_outputs(self, op: PhysicalOperator):
         while op.out_queue:
@@ -870,7 +964,8 @@ class StreamingExecutor:
         while True:
             progressed = False
             # 1. submissions
-            budget = self.ctx.max_concurrent_tasks - len(self._inflight)
+            budget = (self.ctx.max_concurrent_tasks - len(self._inflight)
+                      - len(self._streams))
             backpressured = (len(out_buffer)
                             >= self.ctx.max_buffered_output_bundles)
             if budget > 0 and not backpressured and not self._limit_reached():
@@ -883,8 +978,7 @@ class StreamingExecutor:
                     recs = op.try_submit(
                         lambda fn, args, **kw: self._submit(fn, args, **kw))
                     for rec in recs:
-                        key = rec.refs[0].id
-                        self._inflight[key] = (rec, op)
+                        self._track(rec, op)
                         budget -= 1
                         progressed = True
             else:
@@ -896,7 +990,7 @@ class StreamingExecutor:
                             lambda fn, args, **kw: self._submit(fn, args,
                                                                 **kw))
                         for rec in recs:
-                            self._inflight[rec.refs[0].id] = (rec, op)
+                            self._track(rec, op)
                             progressed = True
             # 2. completions
             if self._inflight:
@@ -908,6 +1002,11 @@ class StreamingExecutor:
                     rec, op = self._inflight.pop(r.id)
                     rec.on_done(rec)
                     progressed = True
+            # 2b. streamed items: a bundle per (block, meta) pair, as
+            # soon as the producer reports them (bounded memory — blocks
+            # never buffer inside tasks)
+            if self._poll_streams():
+                progressed = True
             # 3. route outputs downstream / to the consumer
             for op in self.ops:
                 for bundle in self._route_outputs(op):
@@ -918,7 +1017,7 @@ class StreamingExecutor:
             # 4. done propagation
             self._propagate_done()
             if self.sink.finished and not self._inflight and \
-                    not self.sink.out_queue:
+                    not self._streams and not self.sink.out_queue:
                 for op in self.ops:
                     for bundle in self._route_outputs(op):
                         yield bundle

@@ -142,6 +142,12 @@ class JobSubmissionClient:
     with None, uses the already-initialized driver connection."""
 
     def __init__(self, address: Optional[str] = None):
+        self._http: Optional[str] = None
+        if address and address.startswith("http"):
+            # REST mode against the dashboard job API (reference:
+            # JobSubmissionClient("http://...") -> job_head.py routes)
+            self._http = address.rstrip("/")
+            return
         if not ray_tpu.is_initialized():
             ray_tpu.init(address=address)
         from ray_tpu._private.api import current_core
@@ -150,12 +156,27 @@ class JobSubmissionClient:
         info = ray_tpu.connection_info()
         self._control_address = info["control_address"]
 
+    def _rest(self, method: str, path: str, body=None):
+        import json as _json
+        from urllib.request import Request, urlopen
+
+        data = _json.dumps(body).encode() if body is not None else None
+        req = Request(self._http + path, data=data, method=method,
+                      headers={"Content-Type": "application/json"})
+        with urlopen(req, timeout=60) as resp:
+            return _json.loads(resp.read().decode())
+
     # -- API ---------------------------------------------------------------
 
     def submit_job(self, *, entrypoint: str,
                    runtime_env: Optional[Dict[str, Any]] = None,
                    submission_id: Optional[str] = None,
                    metadata: Optional[Dict[str, str]] = None) -> str:
+        if self._http:
+            return self._rest("POST", "/api/jobs", {
+                "entrypoint": entrypoint, "runtime_env": runtime_env,
+                "submission_id": submission_id, "metadata": metadata,
+            })["submission_id"]
         submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:16]}"
         _kv_put_job(self._core, submission_id, {
             "submission_id": submission_id,
@@ -188,9 +209,21 @@ class JobSubmissionClient:
         return info["status"] if info else None
 
     def get_job_info(self, submission_id: str) -> Optional[Dict[str, Any]]:
+        if self._http:
+            from urllib.error import HTTPError
+
+            try:
+                return self._rest("GET", f"/api/jobs/{submission_id}")
+            except HTTPError as e:
+                if e.code == 404:
+                    return None
+                raise
         return _kv_get_job(self._core, submission_id)
 
     def get_job_logs(self, submission_id: str) -> str:
+        if self._http:
+            return self._rest("GET",
+                              f"/api/jobs/{submission_id}/logs")["logs"]
         try:
             return ray_tpu.get(
                 self._supervisor(submission_id).get_logs.remote(),
@@ -199,6 +232,9 @@ class JobSubmissionClient:
             return ""
 
     def stop_job(self, submission_id: str) -> bool:
+        if self._http:
+            return self._rest("POST",
+                              f"/api/jobs/{submission_id}/stop")["stopped"]
         try:
             return ray_tpu.get(
                 self._supervisor(submission_id).stop.remote(), timeout=30.0)
@@ -206,6 +242,8 @@ class JobSubmissionClient:
             return False
 
     def list_jobs(self) -> List[Dict[str, Any]]:
+        if self._http:
+            return self._rest("GET", "/api/jobs")
         keys = self._core.control.call("kv_keys", {"ns": JOB_NS, "prefix": ""})
         out = []
         for k in keys:

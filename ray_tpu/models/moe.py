@@ -122,17 +122,21 @@ def init(key, cfg: MoEConfig) -> Dict[str, Any]:
     return params
 
 
-def _moe_op(h, router_w, w_in, w_out, cfg: MoEConfig, mesh):
+def _moe_op(h, router_w, w_in, w_out, cfg: MoEConfig, mesh,
+            allow_manual: bool = True):
     """Routed MLP on [B, S, D] activations; returns (out, aux, z).
 
     With an ep axis on the mesh the expert computation runs in a
     partial-manual shard_map over {'ep'}: tokens stay sharded over the data
     axes automatically, experts are split manually, and dispatch is one
-    lax.all_to_all each way over ICI.
+    lax.all_to_all each way over ICI.  Inside the pp pipeline's manual
+    region (allow_manual=False) shardy cannot open another manual region,
+    so expert parallelism falls back to GSPMD auto-partitioning of the
+    dense routed-FFN einsums over the expert-sharded weights.
     """
     B, S, D = h.shape
     x2 = h.reshape(B * S, D)
-    if mesh is not None and mesh.shape.get("ep", 1) > 1:
+    if allow_manual and mesh is not None and mesh.shape.get("ep", 1) > 1:
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
@@ -161,9 +165,7 @@ def apply(params, tokens, cfg: MoEConfig, mesh=None
           ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Forward: tokens [B, S] -> (logits [B, S, V], {"aux","z"} losses)."""
     B, S = tokens.shape
-    if mesh is not None and mesh.shape.get("pp", 1) > 1:
-        raise NotImplementedError("MoE + pipeline parallelism: route the "
-                                  "dense model through pp instead")
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
     x = params["embed"][tokens].astype(cfg.dtype)
     if cfg.pos == "learned":
         x = x + params["pos_embed"][:S][None].astype(cfg.dtype)
@@ -184,14 +186,15 @@ def apply(params, tokens, cfg: MoEConfig, mesh=None
         q = _constrain(q, "batch", "heads", "seq", "head_dim")
         k = _constrain(k, "batch", "heads", "seq", "head_dim")
         v = _constrain(v, "batch", "heads", "seq", "head_dim")
-        o = _attention_op(q, k, v, cfg, mesh)
+        o = _attention_op(q, k, v, cfg, mesh, allow_manual=(pp == 1))
         att = jnp.einsum("bhsk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
         x = x + att
         h2 = _norm(x, layer["mlp_norm"], layer.get("mlp_norm_b"), cfg.norm)
         m, aux, z = _moe_op(h2.astype(cfg.dtype),
                             layer["router"].astype(cfg.dtype),
                             layer["w_in"].astype(cfg.dtype),
-                            layer["w_out"].astype(cfg.dtype), cfg, mesh)
+                            layer["w_out"].astype(cfg.dtype), cfg, mesh,
+                            allow_manual=(pp == 1))
         x = x + m
         return _constrain(x, "batch", "seq", "embed"), aux, z
 
@@ -203,9 +206,37 @@ def apply(params, tokens, cfg: MoEConfig, mesh=None
             x, aux, z = block(x, layer)
         return (x, aux_sum + aux, z_sum + z), None
 
-    zero = jnp.zeros((), jnp.float32)
-    (x, aux_sum, z_sum), _ = jax.lax.scan(
-        scan_body, (x, zero, zero), params["layers"])
+    if pp > 1:
+        # MoE through the pp pipeline: the (x, aux, z) triple rides the
+        # rotation as a pytree carry (parallel/pipeline.py), so router
+        # losses from every stage reach the output
+        from ray_tpu.parallel.pipeline import (merge_microbatches,
+                                               pipeline_apply,
+                                               split_microbatches)
+
+        if cfg.n_layers % pp:
+            raise ValueError(f"n_layers {cfg.n_layers} not divisible by "
+                             f"pp {pp}")
+        M = cfg.num_microbatches or pp
+
+        def stage_fn(stage_layers, carry):
+            (x, aux, z), _ = jax.lax.scan(scan_body, carry, stage_layers)
+            return (x, aux, z)
+
+        stacked = jax.tree.map(
+            lambda p: p.reshape(pp, cfg.n_layers // pp, *p.shape[1:]),
+            params["layers"])
+        zeros_mb = jnp.zeros((M,), jnp.float32)
+        x_out, aux_mb, z_mb = pipeline_apply(
+            stage_fn, stacked,
+            (split_microbatches(x, M), zeros_mb, zeros_mb), mesh)
+        x = merge_microbatches(x_out)
+        aux_sum = jnp.mean(aux_mb)
+        z_sum = jnp.mean(z_mb)
+    else:
+        zero = jnp.zeros((), jnp.float32)
+        (x, aux_sum, z_sum), _ = jax.lax.scan(
+            scan_body, (x, zero, zero), params["layers"])
     x = _norm(x, params["final_norm"], params.get("final_norm_b"), cfg.norm)
     unembed = (params["embed"].T if cfg.tie_embeddings
                else params["unembed"]).astype(cfg.dtype)

@@ -33,41 +33,51 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_mb, mesh: Mesh,
     stage_fn(local_params, x) -> x  : one stage's computation
     stage_params: pytree with a leading *stage* axis sized pp on every leaf
                   (sharded P(axis_name) outside)
-    x_mb: [M, mb, ...] microbatched input (replicated over pp)
-    returns: [M, mb, ...] outputs of the final stage (replicated over pp)
+    x_mb: pytree whose leaves are [M, mb, ...] microbatched inputs
+          (replicated over pp) — a bare array works as before; a tuple
+          lets side outputs (e.g. MoE router aux losses) ride the
+          rotation with the activations
+    returns: same pytree structure, leaves [M, mb, ...] from the final
+             stage (replicated over pp)
 
     Only `axis_name` goes manual; dp/fsdp/tp/sp stay automatic inside, so
     the stage_fn's own sharding constraints keep working.
     """
+    tmap = jax.tree.map
     n = mesh.shape[axis_name]
     if n == 1:
-        params_local = jax.tree.map(lambda p: p[0], stage_params)
+        params_local = tmap(lambda p: p[0], stage_params)
         return jax.lax.map(lambda mb: stage_fn(params_local, mb), x_mb)
 
-    M = x_mb.shape[0]
+    M = jax.tree.leaves(x_mb)[0].shape[0]
     fwd = [(i, (i + 1) % n) for i in range(n)]
 
     def body(params_local, x_local):
         r = jax.lax.axis_index(axis_name)
-        params_sq = jax.tree.map(lambda p: p[0], params_local)
-        state = jnp.zeros_like(x_local[0])
-        out_buf = jnp.zeros_like(x_local)
+        params_sq = tmap(lambda p: p[0], params_local)
+        state = tmap(lambda l: jnp.zeros_like(l[0]), x_local)
+        out_buf = tmap(jnp.zeros_like, x_local)
 
         def tick(carry, t):
             state, out_buf = carry
             # stage 0 picks up a fresh microbatch while the fill lasts
             mb_idx = jnp.minimum(t, M - 1)
-            fresh = jax.lax.dynamic_index_in_dim(x_local, mb_idx, 0,
-                                                 keepdims=False)
-            inp = jnp.where(r == 0, fresh, state)
+            fresh = tmap(lambda l: jax.lax.dynamic_index_in_dim(
+                l, mb_idx, 0, keepdims=False), x_local)
+            inp = tmap(lambda f, s: jnp.where(r == 0, f, s), fresh, state)
             out = stage_fn(params_sq, inp)
             # last stage banks its result for microbatch t-(n-1)
             done_idx = jnp.clip(t - (n - 1), 0, M - 1)
-            banked = jax.lax.dynamic_update_index_in_dim(
-                out_buf, out.astype(out_buf.dtype), done_idx, 0)
             take = jnp.logical_and(r == n - 1, t >= n - 1)
-            out_buf = jnp.where(take, banked, out_buf)
-            state = jax.lax.ppermute(out, axis_name, fwd)
+
+            def bank(buf, o):
+                upd = jax.lax.dynamic_update_index_in_dim(
+                    buf, o.astype(buf.dtype), done_idx, 0)
+                return jnp.where(take, upd, buf)
+
+            out_buf = tmap(bank, out_buf, out)
+            state = tmap(lambda o: jax.lax.ppermute(o, axis_name, fwd),
+                         out)
             return (state, out_buf), None
 
         (state, out_buf), _ = jax.lax.scan(tick, (state, out_buf),
@@ -76,8 +86,10 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_mb, mesh: Mesh,
         # miscompiles sub-f32 all-reduce in partial-manual regions, and on
         # TPU the f32 cast fuses into the collective anyway)
         mask = (jax.lax.axis_index(axis_name) == n - 1).astype(jnp.float32)
-        out = jax.lax.psum(out_buf.astype(jnp.float32) * mask, axis_name)
-        return out.astype(out_buf.dtype)
+        return tmap(
+            lambda b: jax.lax.psum(b.astype(jnp.float32) * mask,
+                                   axis_name).astype(b.dtype),
+            out_buf)
 
     return shard_map(
         body,

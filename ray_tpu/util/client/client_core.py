@@ -175,7 +175,12 @@ class ClientCore:
 
     def submit_task(self, fn, args, kwargs, *, num_returns=1, resources=None,
                     max_retries=3, strategy=None, pg=None, bundle_index=-1,
-                    name="", runtime_env=None) -> List[ObjectRef]:
+                    name="", runtime_env=None,
+                    generator_backpressure=0) -> List[ObjectRef]:
+        if num_returns == "streaming":
+            raise NotImplementedError(
+                "streaming generators are not yet proxied through "
+                "ray-tpu:// client mode")
         common._ensure_picklable_by_value(fn)
         if runtime_env:
             # package local dirs on the CLIENT machine; the server only
@@ -201,7 +206,8 @@ class ClientCore:
     def create_actor(self, cls, args, kwargs, *, resources=None, name=None,
                      max_restarts=0, max_task_retries=0, max_concurrency=1,
                      pg=None, bundle_index=-1, detached=False,
-                     runtime_env=None, namespace=None) -> str:
+                     runtime_env=None, namespace=None,
+                     strategy=None) -> str:
         common._ensure_picklable_by_value(cls)
         if runtime_env:
             from ray_tpu._private import runtime_env as rtenv
@@ -220,11 +226,16 @@ class ClientCore:
             "detached": detached,
             "runtime_env": runtime_env,
             "namespace": namespace,
+            "strategy": strategy,
         }
         return self._call("c_create_actor", payload, timeout=120.0)
 
     def submit_actor_task(self, actor_id: str, method_name: str, args,
                           kwargs, num_returns: int = 1) -> List[ObjectRef]:
+        if num_returns == "streaming":
+            raise NotImplementedError(
+                "streaming generators are not yet proxied through "
+                "ray-tpu:// client mode")
         payload = {
             "actor_id": actor_id,
             "method": method_name,

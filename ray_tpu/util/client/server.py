@@ -201,7 +201,8 @@ class ClientServer:
                 pg=p.get("pg"), bundle_index=p.get("bundle_index", -1),
                 detached=p.get("detached", False),
                 runtime_env=p.get("runtime_env"),
-                namespace=p.get("namespace"))
+                namespace=p.get("namespace"),
+                strategy=p.get("strategy"))
 
         self._deferred(d, run)
 

@@ -1,0 +1,187 @@
+"""Task/actor span tracing with W3C traceparent propagation.
+
+Reference: python/ray/util/tracing/tracing_helper.py — opt-in tracing
+that wraps task/actor submission (PRODUCER span) and execution (CONSUMER
+span) and propagates the span context inside the task spec, so a
+distributed trace stitches across processes.
+
+The recorder is native (this image ships only the opentelemetry API
+package, not the SDK): spans carry OTel-shaped fields (trace_id,
+span_id, parent_id, kind, ns timestamps) and context crosses processes
+as a standard ``traceparent`` header, so exported traces drop into any
+OTel pipeline.  Enable with
+``ray_tpu.init(_tracing_startup_hook="module:function")`` — the hook
+runs in the driver AND every worker (its name travels through the
+control KV) and must call ``configure(sink)`` (or use the built-in
+``setup_file_exporter`` hook, which appends finished spans as JSON
+lines to the configured ``trace_file``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+KV_NS = "_tracing"
+
+_enabled = False
+_sink: Optional[Callable[[Dict[str, Any]], None]] = None
+_tls = threading.local()
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def configure(sink: Callable[[Dict[str, Any]], None]) -> None:
+    """Install a span sink (called once per finished span) and enable."""
+    global _enabled, _sink
+    _sink = sink
+    _enabled = True
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def _new_id(nbytes: int) -> int:
+    return int.from_bytes(os.urandom(nbytes), "big") or 1
+
+
+def _current() -> Optional[Dict[str, int]]:
+    return getattr(_tls, "ctx", None)
+
+
+def inject_context() -> Optional[Dict[str, str]]:
+    """Current span context as a W3C traceparent carrier."""
+    ctx = _current()
+    if not _enabled or ctx is None:
+        return None
+    return {"traceparent":
+            f"00-{ctx['trace_id']:032x}-{ctx['span_id']:016x}-01"}
+
+
+def _extract(carrier: Optional[Dict[str, str]]
+             ) -> Optional[Dict[str, int]]:
+    tp = (carrier or {}).get("traceparent", "")
+    parts = tp.split("-")
+    if len(parts) != 4:
+        return None
+    try:
+        return {"trace_id": int(parts[1], 16), "span_id": int(parts[2], 16)}
+    except ValueError:
+        return None
+
+
+@contextlib.contextmanager
+def _span(name: str, kind: str,
+          parent: Optional[Dict[str, int]], **attrs):
+    if not _enabled:
+        yield None
+        return
+    parent = parent if parent is not None else _current()
+    span = {
+        "name": name,
+        "trace_id": parent["trace_id"] if parent else _new_id(16),
+        "span_id": _new_id(8),
+        "parent_id": parent["span_id"] if parent else None,
+        "kind": kind,
+        "start_ns": time.time_ns(),
+        "attributes": {k: v for k, v in attrs.items() if v is not None},
+    }
+    prev = _current()
+    _tls.ctx = {"trace_id": span["trace_id"], "span_id": span["span_id"]}
+    try:
+        yield span
+    finally:
+        _tls.ctx = prev
+        span["end_ns"] = time.time_ns()
+        record = dict(span)
+        record["trace_id"] = f"{span['trace_id']:032x}"
+        record["span_id"] = f"{span['span_id']:016x}"
+        if span["parent_id"] is not None:
+            record["parent_id"] = f"{span['parent_id']:016x}"
+        if _sink is not None:
+            try:
+                _sink(record)
+            except Exception:
+                logger.exception("span sink failed")
+
+
+def submit_span(kind: str, name: str):
+    """PRODUCER span around task/actor submission (driver side)."""
+    return _span(f"{kind} {name}", "PRODUCER", None)
+
+
+def execute_span(kind: str, name: str,
+                 carrier: Optional[Dict[str, str]], **attrs):
+    """CONSUMER span around task execution (worker side), linked to the
+    submitting span via the propagated traceparent."""
+    return _span(f"{kind}.execute {name}", "CONSUMER",
+                 _extract(carrier), **attrs)
+
+
+# -- built-in file exporter hook --------------------------------------------
+
+_file_lock = threading.Lock()
+
+
+def setup_file_exporter(config: Optional[Dict[str, Any]] = None) -> None:
+    """Startup hook: append finished spans as JSON lines to
+    ``config["trace_file"]``."""
+    path = (config or {}).get("trace_file")
+    if not path:
+        return
+
+    def sink(span: Dict[str, Any]) -> None:
+        with _file_lock, open(path, "a") as f:
+            f.write(json.dumps(span) + "\n")
+
+    configure(sink)
+
+
+def register_hook(control, hook: str,
+                  config: Optional[Dict[str, Any]] = None) -> None:
+    """Driver side: record the startup hook so workers apply it too."""
+    control.call("kv_put", {
+        "ns": KV_NS, "key": "hook",
+        "val": json.dumps({"hook": hook, "config": config or {}}).encode(),
+        "overwrite": True,
+    }, timeout=30.0)
+
+
+def apply_hook_from_kv(control) -> None:
+    """Worker side: pick up and run the registered startup hook."""
+    try:
+        raw = control.call("kv_get", {"ns": KV_NS, "key": "hook"},
+                           timeout=10.0)
+    except Exception:
+        return
+    if not raw:
+        return
+    try:
+        rec = json.loads(raw.decode() if isinstance(raw, bytes) else raw)
+        run_hook(rec["hook"], rec.get("config") or {})
+    except Exception:
+        logger.exception("tracing startup hook failed")
+
+
+def run_hook(hook: str, config: Optional[Dict[str, Any]] = None) -> None:
+    """Import and call a ``module:function`` hook, then enable tracing."""
+    import importlib
+
+    mod_name, _, fn_name = hook.partition(":")
+    fn = getattr(importlib.import_module(mod_name), fn_name)
+    try:
+        fn(config)
+    except TypeError:
+        fn()
+    enable()

@@ -1,0 +1,97 @@
+"""asyncio on the core API: `await ref`, asyncio.gather over refs, async
+iteration of streaming generators.
+
+Reference: ObjectRef.__await__ (_raylet.pyx) + _private/async_compat.py.
+(pytest-asyncio is not available in this image — tests drive their own
+event loops with asyncio.run.)
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_await_ref(ray_cluster):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    async def main():
+        return await f.remote(41)
+
+    assert asyncio.run(main()) == 42
+
+
+def test_gather_mixed_refs(ray_cluster):
+    @ray_tpu.remote
+    def fast(x):
+        return x
+
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(1.0)
+        return x
+
+    async def main():
+        refs = [fast.remote(1), slow.remote(2), fast.remote(3)]
+        return await asyncio.gather(*refs)
+
+    assert asyncio.run(main()) == [1, 2, 3]
+
+
+def test_await_surfaces_task_error(ray_cluster):
+    @ray_tpu.remote(max_retries=0)
+    def bad():
+        raise RuntimeError("kaboom")
+
+    async def main():
+        await bad.remote()
+
+    with pytest.raises(ray_tpu.RayTpuError):
+        asyncio.run(main())
+
+
+def test_await_actor_call(ray_cluster):
+    @ray_tpu.remote
+    class A:
+        def inc(self, x):
+            return x + 1
+
+    async def main():
+        a = A.remote()
+        return await a.inc.remote(9)
+
+    assert asyncio.run(main()) == 10
+
+
+def test_gather_many_refs_no_thread_exhaustion(ray_cluster):
+    """Awaiting many pending refs must not hold a thread each — the
+    dispatcher parks them (64 awaits >> the 8-thread core pool)."""
+    @ray_tpu.remote
+    def work(i):
+        time.sleep(0.1)
+        return i
+
+    async def main():
+        refs = [work.remote(i) for i in range(64)]
+        return await asyncio.gather(*refs)
+
+    assert asyncio.run(main()) == list(range(64))
+
+
+def test_async_iterate_streaming_generator(ray_cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    async def main():
+        out = []
+        async for ref in gen.remote(4):
+            out.append(await ref)
+        return out
+
+    assert asyncio.run(main()) == [0, 10, 20, 30]

@@ -1,0 +1,140 @@
+"""ray.cancel on actor tasks + recursive cancellation.
+
+Reference: core_worker.cc HandleCancelTask / HandleRemoteCancelTask actor
+paths and ray.cancel(recursive=...) semantics
+(python/ray/_private/worker.py ray.cancel).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import RayTpuError, TaskCancelledError
+
+
+def _is_cancel(err: BaseException) -> bool:
+    if isinstance(err, TaskCancelledError):
+        return True
+    return isinstance(getattr(err, "cause", None), TaskCancelledError)
+
+
+def test_cancel_running_actor_task(ray_cluster):
+    @ray_tpu.remote
+    class Spinner:
+        def spin(self):
+            t0 = time.time()
+            while time.time() - t0 < 30:
+                sum(range(1000))
+            return "finished"
+
+        def ping(self):
+            return "pong"
+
+    a = Spinner.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    ref = a.spin.remote()
+    time.sleep(1.0)
+    assert ray_tpu.cancel(ref)
+    t0 = time.time()
+    with pytest.raises(RayTpuError) as ei:
+        ray_tpu.get(ref, timeout=60)
+    assert time.time() - t0 < 30, "cancel did not interrupt the method"
+    assert _is_cancel(ei.value)
+    # the actor survives cancellation (only the task dies)
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+
+
+def test_cancel_queued_actor_task(ray_cluster):
+    @ray_tpu.remote
+    class Slow:
+        def block(self):
+            time.sleep(5)
+            return "blocked"
+
+        def quick(self):
+            return "q"
+
+    a = Slow.remote()
+    ray_tpu.get(a.quick.remote(), timeout=60)
+    blocker = a.block.remote()
+    victim = a.quick.remote()  # queued behind block() in the actor
+    time.sleep(0.2)
+    assert ray_tpu.cancel(victim)
+    with pytest.raises(RayTpuError) as ei:
+        ray_tpu.get(victim, timeout=60)
+    assert _is_cancel(ei.value)
+    assert ray_tpu.get(blocker, timeout=60) == "blocked"
+
+
+def test_cancel_async_actor_task(ray_cluster):
+    import asyncio
+
+    @ray_tpu.remote
+    class Async:
+        async def sleepy(self):
+            await asyncio.sleep(30)
+            return "woke"
+
+        async def ping(self):
+            return "pong"
+
+    a = Async.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    ref = a.sleepy.remote()
+    time.sleep(0.5)
+    assert ray_tpu.cancel(ref)
+    t0 = time.time()
+    with pytest.raises(RayTpuError) as ei:
+        ray_tpu.get(ref, timeout=60)
+    assert time.time() - t0 < 25, "coroutine cancel did not interrupt"
+    assert _is_cancel(ei.value)
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+
+
+def test_cancel_actor_task_force_raises(ray_cluster):
+    @ray_tpu.remote
+    class Spinner:
+        def spin(self):
+            time.sleep(10)
+            return "done"
+
+    a = Spinner.remote()
+    ref = a.spin.remote()
+    time.sleep(0.5)
+    with pytest.raises(ValueError):
+        ray_tpu.cancel(ref, force=True)
+    ray_tpu.cancel(ref)
+
+
+def test_cancel_recursive(ray_cluster):
+    """recursive=True cancels the children a task spawned (reference:
+    ray.cancel(recursive=True))."""
+    @ray_tpu.remote
+    def child():
+        # spin, not sleep: injected cancellation fires at bytecode
+        # boundaries (same limitation as the reference's ray.cancel)
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            sum(range(1000))
+        return "child-done"
+
+    @ray_tpu.remote
+    def parent():
+        refs = [child.remote() for _ in range(4)]
+        return ray_tpu.get(refs, timeout=60)
+
+    ref = parent.remote()
+    time.sleep(2.0)  # parent submits children, blocks in get
+    assert ray_tpu.cancel(ref, recursive=True)
+    t0 = time.time()
+    with pytest.raises(RayTpuError):
+        ray_tpu.get(ref, timeout=60)
+    # the 4 children saturated the 4-CPU cluster; a probe only runs this
+    # fast if recursive cancel actually killed them
+    @ray_tpu.remote
+    def probe():
+        return "ok"
+
+    assert ray_tpu.get(probe.remote(), timeout=25) == "ok"
+    assert time.time() - t0 < 25

@@ -1,0 +1,129 @@
+"""Chaos soak: WorkerKiller + RayletKiller active WHILE a lineage task
+tree, placement-group churn, and a JaxTrainer fit (with restarts) run
+concurrently — everything must complete correctly anyway.
+
+Reference: release/nightly_tests/setup_chaos.py (--chaos
+KillRaylet|KillWorker with kill-interval knobs) driving the killer
+actors of _private/test_utils.py (reference test_utils.py:1500-1630).
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.core import CoreWorker
+from ray_tpu._private.protocol import Client
+
+
+def _train_loop(config):
+    from ray_tpu import train
+
+    for step in range(config["steps"]):
+        time.sleep(0.2)
+        train.report({"step": step})
+
+
+def test_chaos_soak(multi_node_cluster, tmp_path):
+    from ray_tpu._private.test_utils import (RayletKiller, WorkerKiller,
+                                             get_and_run_killer)
+    from ray_tpu.train import (FailureConfig, JaxTrainer, RunConfig,
+                               ScalingConfig)
+
+    t_start = time.monotonic()
+    c = multi_node_cluster()
+    head = c.add_node(resources={"CPU": 4})
+    c.add_node(resources={"CPU": 2})
+    c.add_node(resources={"CPU": 2})
+    core = CoreWorker(c.control_addr, head.addr, mode="driver")
+    try:
+        probe = Client(head.addr)
+        head_id = probe.call("node_info", timeout=30.0)["node_id"]
+        probe.close()
+
+        wkiller = get_and_run_killer(WorkerKiller, kill_interval_s=1.0,
+                                     max_to_kill=5, seed=11)
+        rkiller = get_and_run_killer(RayletKiller, kill_interval_s=6.0,
+                                     max_to_kill=1, seed=13,
+                                     protect_node_ids=[head_id])
+
+        errors = []
+
+        # workload 1: lineage-dependent task tree (leaves -> combine)
+        def lineage_tree():
+            try:
+                @ray_tpu.remote(max_retries=8)
+                def leaf(i):
+                    time.sleep(0.1)
+                    return i
+
+                @ray_tpu.remote(max_retries=8)
+                def combine(*xs):
+                    return sum(xs)
+
+                total = 0
+                for round_ in range(4):
+                    leaves = [leaf.remote(i) for i in range(8)]
+                    mids = [combine.remote(*leaves[k:k + 4])
+                            for k in (0, 4)]
+                    total += ray_tpu.get(combine.remote(*mids),
+                                         timeout=240)
+                assert total == 4 * sum(range(8)), total
+            except Exception as e:  # noqa: BLE001
+                errors.append(("lineage", e))
+
+        # workload 2: placement-group churn
+        def pg_churn():
+            try:
+                for _ in range(6):
+                    pg = ray_tpu.util.placement_group(
+                        [{"CPU": 1}], strategy="PACK")
+                    try:
+                        assert pg.ready(timeout=120)
+                    finally:
+                        ray_tpu.util.remove_placement_group(pg)
+                    time.sleep(0.2)
+            except Exception as e:  # noqa: BLE001
+                errors.append(("pg", e))
+
+        threads = [threading.Thread(target=lineage_tree, daemon=True),
+                   threading.Thread(target=pg_churn, daemon=True)]
+        for t in threads:
+            t.start()
+
+        # workload 3 (foreground): a small trainer fit with restarts
+        trainer = JaxTrainer(
+            _train_loop, train_loop_config={"steps": 3},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                name="chaos", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=6)),
+        )
+        result = trainer.fit()
+        assert result.error is None, result.error
+        assert result.metrics["step"] == 2
+
+        for t in threads:
+            t.join(timeout=240)
+            assert not t.is_alive(), "workload thread hung"
+        assert not errors, errors
+
+        # chaos actually struck
+        killed = ray_tpu.get(wkiller.get_total_killed.remote(), timeout=60)
+        ray_tpu.get(wkiller.stop_run.remote(), timeout=30)
+        ray_tpu.get(rkiller.stop_run.remote(), timeout=30)
+        assert len(killed) >= 1, "no worker was ever killed"
+
+        # hygiene: the cluster still schedules fresh work cleanly
+        @ray_tpu.remote
+        def ok():
+            return "alive"
+
+        assert ray_tpu.get(ok.remote(), timeout=120) == "alive"
+        ray_tpu.kill(wkiller)
+        ray_tpu.kill(rkiller)
+    finally:
+        core.shutdown()
+    assert time.monotonic() - t_start < 300, "soak exceeded 5 minutes"

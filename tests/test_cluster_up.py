@@ -1,0 +1,67 @@
+"""`ray-tpu up <yaml>` / `down`: the cluster launcher driving
+LocalNodeProvider (reference: scripts.py:1337 `ray up` +
+autoscaler/_private/commands.py)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli(tmp_path, *argv, timeout=120):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["RAY_TPU_CLUSTER_FILE"] = str(tmp_path / "cluster.json")
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", *argv],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+def test_up_and_down(tmp_path):
+    cfg = tmp_path / "cluster.yaml"
+    cfg.write_text("""
+cluster_name: testup
+provider:
+  type: local
+  port: 0
+head_node:
+  resources: {CPU: 2}
+worker_nodes:
+  count: 1
+  resources: {CPU: 1}
+  labels: {pool: extra}
+""")
+    up = _cli(tmp_path, "up", str(cfg))
+    assert up.returncode == 0, up.stderr[-2000:]
+    assert "1 head + 1 workers" in up.stdout
+
+    info = json.loads((tmp_path / "cluster.json").read_text())
+    addr = info["control_address"]
+    try:
+        # the launched cluster serves work
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        drv = subprocess.run(
+            [sys.executable, "-c", f"""
+import ray_tpu
+ray_tpu.init(address={addr!r})
+
+@ray_tpu.remote
+def f():
+    return "up-works"
+
+assert ray_tpu.get(f.remote(), timeout=90) == "up-works"
+assert len([n for n in ray_tpu.nodes() if n["state"] == "ALIVE"]) == 2
+ray_tpu.shutdown()
+print("OK")
+"""],
+            capture_output=True, text=True, timeout=150, env=env)
+        assert drv.returncode == 0, drv.stderr[-2000:]
+        assert "OK" in drv.stdout
+    finally:
+        down = _cli(tmp_path, "down")
+        assert down.returncode == 0, down.stderr[-2000:]
+    assert not (tmp_path / "cluster.json").exists()

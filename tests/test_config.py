@@ -1,0 +1,57 @@
+"""Typed flag table (reference: ray_config_def.h RAY_CONFIG system —
+env override, _system_config JSON propagation to child processes)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ray_tpu._private.config import CONFIG_DEFS, Config, describe
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_defaults_and_env_override(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_SYSTEM_CONFIG", raising=False)
+    c = Config()
+    assert c.pipeline_depth == 4
+    monkeypatch.setenv("RAY_TPU_PIPELINE_DEPTH", "9")
+    monkeypatch.setenv("RAY_TPU_OBJECT_SPILLING", "false")
+    c = Config()
+    assert c.pipeline_depth == 9
+    assert c.object_spilling is False
+
+
+def test_env_beats_system_config(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_NODE_DEATH_TIMEOUT_S", "33")
+    c = Config({"node_death_timeout_s": 5})
+    assert c.node_death_timeout_s == 33.0
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ValueError, match="unknown _system_config"):
+        Config({"not_a_flag": 1})
+
+
+def test_system_config_propagates_to_children(monkeypatch):
+    """The exported JSON reaches a child process's cfg() — the analog of
+    the reference handing _system_config to every spawned daemon."""
+    monkeypatch.delenv("RAY_TPU_IDLE_LEASE_TTL_S", raising=False)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["RAY_TPU_SYSTEM_CONFIG"] = json.dumps({"idle_lease_ttl_s": 7.5})
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from ray_tpu._private.config import cfg; "
+         "print(cfg().idle_lease_ttl_s)"],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "7.5"
+
+
+def test_describe_lists_every_flag():
+    text = describe()
+    for name, *_ in CONFIG_DEFS:
+        assert name in text

@@ -1,0 +1,83 @@
+"""Worker stdout -> raylet log tailer -> pubsub -> driver stderr.
+
+Reference: python/ray/_private/log_monitor.py (print in a task appears on
+the driver console, filtered to the driver's own job).
+"""
+
+import os
+import re
+import subprocess
+import sys
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_driver(body: str, extra_env=None) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(body)],
+        capture_output=True, text=True, timeout=180, env=env)
+
+
+def test_task_print_reaches_driver():
+    out = _run_driver("""
+        import time
+        import ray_tpu
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def chatty():
+            print("HELLO-FROM-TASK-xyzzy")
+            return 1
+
+        assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+        time.sleep(2.0)  # let the tailer poll + pubsub deliver
+        ray_tpu.shutdown()
+    """)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "HELLO-FROM-TASK-xyzzy" in out.stderr, \
+        f"task print never reached driver stderr:\n{out.stderr[-2000:]}"
+
+
+def test_log_to_driver_false_suppresses():
+    out = _run_driver("""
+        import time
+        import ray_tpu
+        ray_tpu.init(num_cpus=2, log_to_driver=False)
+
+        @ray_tpu.remote
+        def chatty():
+            print("SILENT-TASK-xyzzy")
+            return 1
+
+        assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+        time.sleep(2.0)
+        ray_tpu.shutdown()
+    """)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "SILENT-TASK-xyzzy" not in out.stderr
+
+
+def test_actor_print_reaches_driver():
+    out = _run_driver("""
+        import time
+        import ray_tpu
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        class A:
+            def speak(self):
+                print("ACTOR-SAYS-xyzzy")
+                return "ok"
+
+        a = A.remote()
+        assert ray_tpu.get(a.speak.remote(), timeout=60) == "ok"
+        time.sleep(2.0)
+        ray_tpu.shutdown()
+    """)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "ACTOR-SAYS-xyzzy" in out.stderr

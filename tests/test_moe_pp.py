@@ -1,0 +1,58 @@
+"""MoE through pipeline parallelism: the (x, aux, z) pytree carry rides
+the pp rotation (parallel/pipeline.py), closing the round-1 gap where
+models/moe.py raised NotImplementedError for pp>1 meshes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import moe
+from ray_tpu.models.training import _use_mesh
+from ray_tpu.parallel import make_mesh
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    # capacity_factor high enough that no token ever overflows: capacity
+    # is per routing group, and pp microbatching legitimately regroups
+    # tokens — only the no-drop regime is bitwise comparable to flat
+    return moe.MoEConfig.mixtral_nano(dtype=jnp.float32, remat=False,
+                                      capacity_factor=8.0)
+
+
+def _logits(params, tokens, cfg, mesh):
+    with _use_mesh(mesh):
+        out, losses = jax.jit(
+            lambda p, t: moe.apply(p, t, cfg, mesh))(params, tokens)
+    return np.asarray(out), {k: float(v) for k, v in losses.items()}
+
+
+def test_moe_pp_matches_no_pp(cfg):
+    params = moe.init(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 16)))
+
+    flat = make_mesh(devices=jax.devices()[:8], dp=8)
+    pp = make_mesh(devices=jax.devices()[:8], pp=2, ep=2, dp=2)
+
+    base, base_losses = _logits(params, tokens, cfg, flat)
+    piped, piped_losses = _logits(params, tokens, cfg, pp)
+    assert np.all(np.isfinite(piped))
+    np.testing.assert_allclose(piped, base, rtol=2e-4, atol=2e-4)
+    # router aux losses flow out of the pipeline (nonzero, finite)
+    assert np.isfinite(piped_losses["aux"]) and piped_losses["aux"] > 0
+
+
+def test_moe_pp_grads_finite(cfg):
+    params = moe.init(jax.random.PRNGKey(1), cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(1).randint(0, cfg.vocab_size, (8, 17)))
+    mesh = make_mesh(devices=jax.devices()[:8], pp=2, dp=4)
+    with _use_mesh(mesh):
+        loss, grads = jax.jit(jax.value_and_grad(
+            lambda p: moe.loss_fn(p, {"tokens": tokens}, cfg, mesh)))(
+                params)
+    assert np.isfinite(float(loss))
+    gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in jax.tree.leaves(grads)))
+    assert np.isfinite(float(gnorm)) and float(gnorm) > 0

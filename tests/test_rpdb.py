@@ -1,0 +1,57 @@
+"""Distributed debugger: set_trace() in a task serves pdb over a socket
+registered in the control KV (reference: python/ray/util/rpdb.py +
+`ray debug`)."""
+
+import socket
+import time
+
+import ray_tpu
+from ray_tpu.util import rpdb
+
+
+def _recv_until(conn, marker: bytes, timeout: float = 30.0) -> bytes:
+    conn.settimeout(timeout)
+    buf = b""
+    while marker not in buf:
+        chunk = conn.recv(4096)
+        if not chunk:
+            break
+        buf += chunk
+    return buf
+
+
+def test_breakpoint_in_task(ray_cluster):
+    @ray_tpu.remote
+    def buggy():
+        x = 41
+        from ray_tpu.util import rpdb as _rpdb
+
+        _rpdb.set_trace()
+        return x + 1
+
+    ref = buggy.remote()
+
+    core = ray_tpu._require()
+    deadline = time.time() + 60
+    bps = []
+    while time.time() < deadline:
+        bps = rpdb.list_breakpoints(core.control)
+        if bps:
+            break
+        time.sleep(0.2)
+    assert bps, "breakpoint never registered"
+
+    conn = socket.create_connection(tuple(bps[0]["addr"]), timeout=10)
+    try:
+        out = _recv_until(conn, b"(Pdb)")
+        assert b"(Pdb)" in out
+        conn.sendall(b"p x\n")
+        out = _recv_until(conn, b"(Pdb)")
+        assert b"41" in out
+        conn.sendall(b"c\n")
+    finally:
+        conn.close()
+
+    assert ray_tpu.get(ref, timeout=60) == 42
+    # deregistered once a client attached
+    assert not rpdb.list_breakpoints(core.control)

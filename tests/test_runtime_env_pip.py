@@ -1,0 +1,84 @@
+"""Runtime env pip path: content-addressed package env from a local
+wheelhouse, fully offline (reference: _private/runtime_env/pip.py —
+requirements-hash-keyed env with a node-shared cache; the egress gate
+stays default-off)."""
+
+import base64
+import hashlib
+import os
+import zipfile
+
+import pytest
+
+import ray_tpu
+
+
+def _make_wheel(path: str, name: str = "tinymod_xyzzy",
+                version: str = "0.1"):
+    """Hand-build a minimal pure-python wheel (a zip with dist-info) so
+    the test needs no build tooling and no network."""
+    wheel = os.path.join(path, f"{name}-{version}-py3-none-any.whl")
+    code = "MAGIC = 'wheel-import-worked'\n"
+    meta = (f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n")
+    wheel_meta = ("Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib:"
+                  " true\nTag: py3-none-any\n")
+
+    def rec_line(arc, data):
+        h = base64.urlsafe_b64encode(
+            hashlib.sha256(data.encode()).digest()).rstrip(b"=").decode()
+        return f"{arc},sha256={h},{len(data)}"
+
+    di = f"{name}-{version}.dist-info"
+    entries = {
+        f"{name}/__init__.py": code,
+        f"{di}/METADATA": meta,
+        f"{di}/WHEEL": wheel_meta,
+    }
+    record = "\n".join(rec_line(a, d) for a, d in entries.items())
+    record += f"\n{di}/RECORD,,\n"
+    with zipfile.ZipFile(wheel, "w") as zf:
+        for arc, data in entries.items():
+            zf.writestr(arc, data)
+        zf.writestr(f"{di}/RECORD", record)
+    return wheel
+
+
+def test_pip_gate_default_off(ray_cluster, monkeypatch):
+    monkeypatch.delenv("RAY_TPU_ALLOW_PKG_INSTALL", raising=False)
+
+    @ray_tpu.remote(runtime_env={"pip": ["tinymod_xyzzy"]})
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="disabled"):
+        f.remote()
+
+
+def test_pip_env_from_local_wheel(ray_cluster, tmp_path, monkeypatch):
+    wheelhouse = tmp_path / "wheels"
+    wheelhouse.mkdir()
+    _make_wheel(str(wheelhouse))
+    monkeypatch.setenv("RAY_TPU_ALLOW_PKG_INSTALL", "1")
+    monkeypatch.setenv("RAY_TPU_WHEELHOUSE", str(wheelhouse))
+
+    @ray_tpu.remote(runtime_env={"pip": ["tinymod_xyzzy"]})
+    def use_wheel():
+        import tinymod_xyzzy
+
+        return tinymod_xyzzy.MAGIC
+
+    assert ray_tpu.get(use_wheel.remote(), timeout=180) == \
+        "wheel-import-worked"
+
+    # env is scoped: a plain task on the (possibly reused) worker must
+    # NOT see the package
+    @ray_tpu.remote
+    def plain():
+        try:
+            import tinymod_xyzzy  # noqa: F401
+
+            return "leaked"
+        except ImportError:
+            return "clean"
+
+    assert ray_tpu.get(plain.remote(), timeout=60) == "clean"

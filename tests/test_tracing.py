@@ -1,0 +1,51 @@
+"""OTel span tracing: submit (PRODUCER) and execute (CONSUMER) spans
+share a trace via context propagated in the task spec.
+
+Reference: python/ray/util/tracing/tracing_helper.py +
+ray.init(_tracing_startup_hook=...).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_task_spans_stitch_across_processes(tmp_path):
+    trace_file = str(tmp_path / "spans.jsonl")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    body = f"""
+        import time
+        import ray_tpu
+        ray_tpu.init(
+            num_cpus=2,
+            _tracing_startup_hook="ray_tpu.util.tracing:setup_file_exporter",
+            _tracing_config={{"trace_file": {trace_file!r}}})
+
+        @ray_tpu.remote
+        def traced_task():
+            return 42
+
+        assert ray_tpu.get(traced_task.remote(), timeout=90) == 42
+        time.sleep(0.5)
+        ray_tpu.shutdown()
+    """
+    out = subprocess.run([sys.executable, "-c", textwrap.dedent(body)],
+                         capture_output=True, text=True, timeout=180,
+                         env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+
+    spans = [json.loads(l) for l in open(trace_file) if l.strip()]
+    submits = [s for s in spans if s["name"] == "task traced_task"]
+    execs = [s for s in spans
+             if s["name"] == "task.execute traced_task"]
+    assert submits, f"no submit span in {[s['name'] for s in spans]}"
+    assert execs, f"no execute span in {[s['name'] for s in spans]}"
+    # cross-process stitching: same trace, executor parented under submit
+    assert execs[0]["trace_id"] == submits[0]["trace_id"]
+    assert execs[0]["parent_id"] == submits[0]["span_id"]
+    assert execs[0]["attributes"].get("task_id", "").startswith("tsk-")

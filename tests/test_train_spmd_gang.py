@@ -1,0 +1,41 @@
+"""Train's jax.distributed backend, actually multi-process: a 2-process
+coordinator/worker gang on the CPU backend (round-1 weak spot — the spmd
+path only ever ran single-process in tests).
+
+Reference: train/torch/xla config's process-gang setup; here
+jax.distributed.initialize across 2 Train worker actors.
+"""
+
+import time
+
+import ray_tpu
+from ray_tpu._private.protocol import free_port
+from ray_tpu.train import JaxConfig, JaxTrainer, RunConfig, ScalingConfig
+from ray_tpu import train
+
+
+def _loop_spmd(config):
+    import jax
+
+    train.report({
+        "step": 0,
+        "procs": jax.process_count(),
+        "devs": jax.device_count(),
+        "local_devs": jax.local_device_count(),
+        "rank": jax.process_index(),
+    })
+
+
+def test_two_process_jax_distributed_gang(ray_cluster, tmp_path):
+    trainer = JaxTrainer(
+        _loop_spmd,
+        scaling_config=ScalingConfig(num_workers=2),
+        backend_config=JaxConfig(mode="spmd",
+                                 coordinator_port=free_port()),
+        run_config=RunConfig(name="spmd-gang", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    # the reporting worker genuinely joined a 2-process gang
+    assert result.metrics["procs"] == 2
+    assert result.metrics["devs"] == 2 * result.metrics["local_devs"]
