@@ -37,7 +37,8 @@ CONFIG_DEFS: List[Tuple[str, type, Any, str]] = [
     ("restore_owner_grace_s", float, 60.0,
      "window for a driver job to re-register after a control restart "
      "before its restored non-detached actors are reaped"),
-    # -- task submission
+    # -- task submission (NOTE: bound at module import in the driver's
+    # own process — set via env or _system_config before daemons spawn)
     ("pipeline_depth", int, 4,
      "tasks pushed per leased worker before waiting on replies"),
     ("idle_lease_ttl_s", float, 1.0,
@@ -58,8 +59,8 @@ CONFIG_DEFS: List[Tuple[str, type, Any, str]] = [
     ("memory_monitor_refresh_ms", int, 250,
      "OOM watchdog poll period"),
     # -- workers
-    ("worker_prestart", int, 1,
-     "warm workers each raylet keeps ready"),
+    ("worker_prestart", int, 4,
+     "warm workers each raylet keeps ready (capped to the CPU slots)"),
     ("native_sched", bool, True,
      "use the native C++ scheduling policy engine"),
     ("task_events", bool, True,
@@ -121,12 +122,16 @@ _current: Optional[Config] = None
 
 
 def cfg() -> Config:
-    """The process-wide resolved config (lazily built)."""
-    global _current
+    """The process-wide resolved config.
+
+    Rebuilt from the environment on each call unless set_system_config
+    pinned an explicit config — env flags stay live for processes (and
+    tests) that set them after import; daemons resolve once at their
+    read sites anyway."""
     with _lock:
-        if _current is None:
-            _current = Config()
-        return _current
+        if _current is not None:
+            return _current
+        return Config()
 
 
 def set_system_config(system_config: Optional[Dict[str, Any]]) -> None:

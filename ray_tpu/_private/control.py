@@ -179,8 +179,7 @@ class ControlServer:
         self.task_records: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
         self.profile_events: List[Dict[str, Any]] = []
         self.task_events_dropped = 0
-        self.max_task_records = int(
-            os.environ.get("RAY_TPU_MAX_TASK_EVENTS", "10000"))
+        self.max_task_records = _cfg().max_task_events
         # pending-actor scheduler queue (reference: GcsActorScheduler)
         self.pending_actors: List[ActorRecord] = []
         self._sched_event = threading.Event()
@@ -189,7 +188,7 @@ class ControlServer:
         # Python keeps authoritative optimistic accounting and mirrors
         # availability into the native engine at every mutation
         self.nsched = None
-        if os.environ.get("RAY_TPU_NATIVE_SCHED", "1") != "0":
+        if _cfg().native_sched:
             try:
                 from ray_tpu.native.sched import try_create
                 self.nsched = try_create(spread_threshold=0.5, topk=1)

@@ -367,6 +367,9 @@ class CoreWorker:
         self.server.handle("ping", lambda c, p: "pong")
         # streaming-generator tasks owned by this process
         self.streams: Dict[str, StreamState] = {}
+        # recently user-dropped stream ids (bounded; membership tells a
+        # late producer report "stop" vs "recovery re-report, accept")
+        self._released_streams: deque = deque(maxlen=1024)
         # on-demand profiling RPCs (reference: dashboard reporter agent's
         # py-spy/memray endpoints, profile_manager.py:82)
         from . import profiling
@@ -413,7 +416,7 @@ class CoreWorker:
         # task-event export (reference: task_event_buffer.h:220)
         from .task_events import NULL_BUFFER, TaskEventBuffer
 
-        if os.environ.get("RAY_TPU_TASK_EVENTS", "1") == "1":
+        if _cfg().task_events:
             self.task_events = TaskEventBuffer(
                 self.control, worker_id=self.worker_id,
                 node_id=self.node_id or "", job_id=self.job_id)
@@ -734,7 +737,7 @@ class CoreWorker:
         entry.event.clear()
         entry.shm_node = None
         entry.shm_addr = None
-        self._submit_spec(entry.lineage, retries_left=1)
+        self._submit_spec(entry.lineage, retries_left=1, recovery=True)
         if not entry.event.wait(self._remaining(deadline)):
             raise GetTimeoutError(f"timed out reconstructing {oid}")
         if entry.error is not None:
@@ -1121,8 +1124,12 @@ class CoreWorker:
                 spec.trace_ctx = tracing.inject_context()
         return self._submit_spec(spec, retries_left=max_retries)
 
-    def _submit_spec(self, spec: TaskSpec, retries_left: int):
-        if spec.num_returns == STREAMING_RETURNS \
+    def _submit_spec(self, spec: TaskSpec, retries_left: int,
+                     recovery: bool = False):
+        # recovery resubmission of a streaming spec: the stream is long
+        # consumed — re-executed items land straight into their awaited
+        # object entries (h_generator_item fallback), no StreamState
+        if spec.num_returns == STREAMING_RETURNS and not recovery \
                 and spec.task_id not in self.streams:
             self.streams[spec.task_id] = StreamState(spec)
         refs = []
@@ -1151,7 +1158,7 @@ class CoreWorker:
             spec.task_id, "PENDING_ARGS_AVAIL", name=spec.function_name,
             extra={"type": "NORMAL_TASK"})
         self._pump(pool)
-        if spec.num_returns == STREAMING_RETURNS:
+        if spec.num_returns == STREAMING_RETURNS and not recovery:
             return [ObjectRefGenerator(self, spec.task_id)]
         return refs
 
@@ -1360,7 +1367,19 @@ class CoreWorker:
         tid, index = p["task_id"], p["index"]
         st = self.streams.get(tid)
         if st is None or st.closed:
-            d.resolve({"ok": False, "stop": True})
+            if tid in self._released_streams or (st and st.closed):
+                # the consumer explicitly dropped the generator: stop
+                d.resolve({"ok": False, "stop": True})
+                return
+            # no live stream but not released either: a lineage-recovery
+            # re-execution of a consumed stream — store items someone is
+            # still waiting on, ack the rest so the producer finishes
+            oid = common.object_id_for_return(tid, index)
+            with self.lock:
+                e = self.objects.get(oid)
+            if e is not None and not e.ready:
+                self._store_one(e, p["result"])
+            d.resolve({"ok": True})
             return
         with st.cv:
             if index < st.produced:
@@ -1452,6 +1471,9 @@ class CoreWorker:
         st = self.streams.pop(tid, None)
         if st is None:
             return
+        # remember the drop so late/retried item reports are told to stop
+        # (vs. lineage-recovery re-reports, which must be accepted)
+        self._released_streams.append(tid)
         with st.cv:
             st.closed = True
             pending = list(st.ready)
@@ -1876,6 +1898,9 @@ class CoreWorker:
         tasks the cancelled task submitted.  Cancelled tasks are never
         retried.  Returns False if the task already finished or isn't
         cancellable."""
+        if isinstance(ref, ObjectRefGenerator):
+            # cancelling a streaming task: the generator IS the handle
+            return self._cancel_task_id(ref.task_id, force, recursive)
         tid = "tsk-" + ref.id[4:].rsplit("-", 1)[0] \
             if ref.id.startswith("obj-") else None
         if tid is None:

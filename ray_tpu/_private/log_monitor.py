@@ -25,8 +25,10 @@ logger = logging.getLogger(__name__)
 # work for a different job; lines that follow belong to that job.
 JOB_MARKER = "\x01RAYTPU-JOB "
 
+from .config import cfg as _cfg
+
 POLL_INTERVAL_S = 0.25
-MAX_BATCH_LINES = 200
+MAX_BATCH_LINES = _cfg().log_to_driver_batch_lines
 MAX_LINE_LEN = 4000
 
 

@@ -26,8 +26,10 @@ logger = logging.getLogger(__name__)
 
 def DEFAULT_CAPACITY() -> int:
     # read at store-construction time so tests/daemons can size the arena
-    # through the environment
-    return int(os.environ.get("RAY_TPU_OBJECT_STORE_BYTES", 1 << 30))
+    # through the environment / _system_config (config.py flag table)
+    from .config import cfg
+
+    return cfg().object_store_bytes or (1 << 30)
 N_ENTRIES = 16384  # power of two
 
 _lib = None

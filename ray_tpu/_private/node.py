@@ -128,8 +128,9 @@ class Raylet:
         cpu_slots = max(1, int(sum(
             v for k, v in self.total.items() if k == common.CPU)
             / common._GRAN))
-        self.prestart_target = min(cpu_slots, int(os.environ.get(
-            "RAY_TPU_PRESTART_WORKERS", "4")))
+        from .config import cfg as _pcfg
+
+        self.prestart_target = min(cpu_slots, _pcfg().worker_prestart)
         self._prestart_thread = threading.Thread(
             target=self._prestart_loop, name="raylet-prestart", daemon=True)
         self._grant_thread = threading.Thread(target=self._grant_loop,
@@ -144,8 +145,10 @@ class Raylet:
         # local_object_manager.h:110, memory_monitor.h:52)
         from . import spilling
 
+        from .config import cfg as _ncfg
+
         self.spill: Optional[spilling.SpillManager] = None
-        if os.environ.get("RAY_TPU_OBJECT_SPILLING", "1") != "0":
+        if _ncfg().object_spilling:
             # spill to real disk — the session dir lives on /dev/shm, and
             # spilling tmpfs→tmpfs would free no memory.  Always suffix
             # with the node id: co-hosted raylets must not share (and on
@@ -155,12 +158,15 @@ class Raylet:
             self.spill = spilling.SpillManager(
                 self.store, os.path.join(spill_base, self.node_id))
         self.oom_killer: Optional[spilling.OomKiller] = None
-        refresh_ms = os.environ.get("RAY_TPU_MEMORY_MONITOR_REFRESH_MS")
-        if refresh_ms is None:
+        if os.environ.get("RAY_TPU_MEMORY_MONITOR_REFRESH_MS") is not None \
+                or "memory_monitor_refresh_ms" in \
+                   os.environ.get("RAY_TPU_SYSTEM_CONFIG", ""):
+            refresh_ms = _ncfg().memory_monitor_refresh_ms
+        else:
             # default on only inside a memory-limited cgroup, where the
             # limit is real and ours; on a shared host a high ambient
             # usage would make kills spurious
-            refresh_ms = "250" if spilling._cgroup_usage() else "0"
+            refresh_ms = 250 if spilling._cgroup_usage() else 0
         self._mem_refresh_s = max(int(refresh_ms), 0) / 1000.0
         if self._mem_refresh_s > 0:
             self.oom_killer = spilling.OomKiller(

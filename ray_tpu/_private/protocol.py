@@ -338,9 +338,24 @@ class Server:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._conns: Dict[socket.socket, ServerConn] = {}
+        # per-handler event-loop latency stats (reference: event_stats.h
+        # asio handler instrumentation): method -> [count, total_s, max_s].
+        # Handlers run ON the loop thread, so a slow one stalls every
+        # connection — these numbers find it.
+        self._handler_stats: Dict[str, list] = {}
+        self.handle("rpc_stats", lambda c, p: self.stats())
 
     def handle(self, method: str, fn: Callable, deferred: bool = False) -> None:
         self._handlers[method] = (fn, deferred)
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        """Snapshot of per-handler loop occupancy."""
+        out = {}
+        for m, (n, total, mx) in list(self._handler_stats.items()):
+            out[m] = {"count": n, "total_s": round(total, 6),
+                      "mean_us": round(total / n * 1e6, 1) if n else 0.0,
+                      "max_us": round(mx * 1e6, 1)}
+        return out
 
     def on_disconnect(self, fn: Callable[[ServerConn], None]) -> None:
         self._on_disconnect = fn
@@ -440,12 +455,22 @@ class Server:
             conn.reply_error(msg_id, f"no handler for {method!r}")
             return
         fn, wants_deferred = entry
+        t0 = time.perf_counter()
         try:
             if wants_deferred:
                 fn(conn, payload, Deferred(conn, msg_id))
             else:
                 result = fn(conn, payload)
                 conn.reply(msg_id, result)
+            dt = time.perf_counter() - t0
+            st = self._handler_stats.get(method)
+            if st is None:
+                self._handler_stats[method] = [1, dt, dt]
+            else:
+                st[0] += 1
+                st[1] += dt
+                if dt > st[2]:
+                    st[2] = dt
         except Exception as e:
             tb = traceback.format_exc()
             logger.debug("%s: handler %s raised: %s", self.name, method, e)

@@ -41,8 +41,9 @@ logger = logging.getLogger(__name__)
 KV_NS = "runtime_env_packages"
 CACHE_ROOT = os.environ.get("RAY_TPU_RTENV_CACHE",
                             "/dev/shm/ray_tpu/rtenv-cache")
-MAX_PACKAGE_BYTES = int(os.environ.get("RAY_TPU_RTENV_MAX_BYTES",
-                                       str(256 * 1024 * 1024)))
+from .config import cfg as _cfg
+
+MAX_PACKAGE_BYTES = _cfg().rtenv_max_bytes
 _EXCLUDE_DIRS = {".git", "__pycache__", ".venv", "node_modules"}
 
 _lock = threading.Lock()
@@ -56,7 +57,7 @@ def validate(env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     if unknown:
         raise ValueError(f"unsupported runtime_env fields: {sorted(unknown)}")
     if env.get("pip") or env.get("conda"):
-        if os.environ.get("RAY_TPU_ALLOW_PKG_INSTALL") != "1":
+        if not _cfg().allow_pkg_install:
             raise ValueError(
                 "runtime_env pip/conda installs are disabled in this "
                 "deployment (set RAY_TPU_ALLOW_PKG_INSTALL=1 to enable)")

@@ -30,8 +30,10 @@ from typing import Dict, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
-SPILL_HIGH_FRAC = float(os.environ.get("RAY_TPU_SPILL_HIGH", "0.80"))
-SPILL_LOW_FRAC = float(os.environ.get("RAY_TPU_SPILL_LOW", "0.60"))
+from .config import cfg as _cfg
+
+SPILL_HIGH_FRAC = _cfg().spill_high
+SPILL_LOW_FRAC = _cfg().spill_low
 
 
 class SpillManager:

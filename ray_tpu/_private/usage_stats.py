@@ -21,7 +21,9 @@ USAGE_NS = "__usage_stats__"
 
 
 def enabled() -> bool:
-    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") != "0"
+    from .config import cfg
+
+    return cfg().usage_stats_enabled
 
 
 def _core():

@@ -173,8 +173,14 @@ class WorkerMain:
             # the same lock _register_aio claims under — a cancel either
             # finds the registered asyncio.Task or parks in _cancelled
             # for _register_aio to observe before running the coroutine
-            aio_task = self._aio_tasks.get(tid)
-            if aio_task is not None:
+            entry = self._aio_tasks.get(tid)
+            if entry is not None:
+                aio_task, aio_kind = entry
+                if force and aio_kind == "normal":
+                    # force semantics are unchanged for normal tasks:
+                    # kill the process (a stuck/shielded coroutine never
+                    # observes a soft cancel)
+                    os._exit(1)
                 loop = self._aio_loop
                 if loop is not None:
                     loop.call_soon_threadsafe(aio_task.cancel)
@@ -290,7 +296,7 @@ class WorkerMain:
                 except TaskCancelledError:
                     continue
 
-    def _register_aio(self, spec: TaskSpec) -> bool:
+    def _register_aio(self, spec: TaskSpec, kind: str = "normal") -> bool:
         """First statement of every async execution coroutine: atomically
         either claim the task (register its asyncio.Task for
         cancellation) or observe a cancel that arrived before the loop
@@ -303,7 +309,7 @@ class WorkerMain:
             if spec.task_id in self._cancelled:
                 self._cancelled.discard(spec.task_id)
                 return False
-            self._aio_tasks[spec.task_id] = asyncio.current_task()
+            self._aio_tasks[spec.task_id] = (asyncio.current_task(), kind)
         EXECUTING_TASK_ID.set(spec.task_id)
         EXECUTING_JOB_ID.set(getattr(spec, "job_id", "") or None)
         return True
@@ -489,16 +495,34 @@ class WorkerMain:
                     args, kwargs = self.core.resolve_args(spec)
 
                     async def _finish(spec=spec, t0=t0, d=d):
-                        if not self._register_aio(spec):
+                        if not self._register_aio(spec, kind="actor"):
                             d.resolve(self._error_reply(
                                 common.TaskCancelledError(
                                     "cancelled before start"), spec))
                             return
+                        from ray_tpu.util import tracing
+
                         try:
-                            out = fn(*args, **kwargs)
-                            if inspect.iscoroutine(out):
-                                out = await out
-                            reply = self._store_reply(spec, out, t0)
+                            with tracing.execute_span(
+                                    "actor", spec.function_name,
+                                    getattr(spec, "trace_ctx", None),
+                                    task_id=spec.task_id,
+                                    actor_id=spec.actor_id):
+                                out = fn(*args, **kwargs)
+                                if inspect.iscoroutine(out):
+                                    out = await out
+                                if spec.num_returns == \
+                                        common.STREAMING_RETURNS:
+                                    # sync generator method on an async
+                                    # actor: stream from an executor
+                                    # thread, not the loop (acks block)
+                                    loop = asyncio.get_running_loop()
+                                    reply = await loop.run_in_executor(
+                                        None, self._run_generator,
+                                        spec, out, t0)
+                                else:
+                                    reply = self._store_reply(spec, out,
+                                                              t0)
                         except asyncio.CancelledError:
                             reply = self._error_reply(
                                 common.TaskCancelledError(
@@ -549,8 +573,14 @@ class WorkerMain:
                             common.TaskCancelledError(
                                 "cancelled before start"), spec))
                         return
+                    from ray_tpu.util import tracing
+
                     try:
-                        value = await coro
+                        with tracing.execute_span(
+                                "task", spec.function_name,
+                                getattr(spec, "trace_ctx", None),
+                                task_id=spec.task_id):
+                            value = await coro
                         reply = self._store_reply(spec, value, t0)
                     except asyncio.CancelledError:
                         reply = self._error_reply(

@@ -8,6 +8,9 @@ data volumes are controlplane-sized, and it keeps the daemon
 dependency-free.
 
 Endpoints (mirroring the reference's dashboard REST surface):
+  GET /                         live HTML dashboard (static.py — the
+                                dependency-free stand-in for the
+                                reference's React client)
   GET /api/version              build/version info
   GET /api/cluster_status       nodes + resource totals (reference: /api/cluster_status)
   GET /api/nodes                node table
@@ -49,6 +52,7 @@ class DashboardHead:
         self.host = host
         self.port = port
         self._server: Optional[ThreadingHTTPServer] = None
+        self._jobs_lock = threading.Lock()
 
     # -- data providers ----------------------------------------------------
 
@@ -57,13 +61,15 @@ class DashboardHead:
 
     def _job_client(self):
         """Lazy full driver connection for job submission (reference: the
-        job head submits through an internal JobSubmissionClient)."""
-        cli = getattr(self, "_jobs", None)
-        if cli is None:
-            from ray_tpu.job.job_manager import JobSubmissionClient
+        job head submits through an internal JobSubmissionClient).
+        Locked: handler threads race on first use."""
+        with self._jobs_lock:
+            cli = getattr(self, "_jobs", None)
+            if cli is None:
+                from ray_tpu.job.job_manager import JobSubmissionClient
 
-            cli = self._jobs = JobSubmissionClient(self.control_address)
-        return cli
+                cli = self._jobs = JobSubmissionClient(self.control_address)
+            return cli
 
     def route_post(self, path: str, body: Dict[str, Any]
                    ) -> Tuple[int, str, str]:
@@ -92,6 +98,10 @@ class DashboardHead:
     def route(self, path: str, query: Dict[str, Any]) -> Tuple[int, str, str]:
         """Returns (status, content_type, body)."""
         try:
+            if path in ("/", "/index.html"):
+                from .static import PAGE
+
+                return 200, "text/html", PAGE
             if path == "/healthz":
                 return 200, "text/plain", "success"
             if path == "/api/version":

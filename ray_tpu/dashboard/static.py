@@ -1,0 +1,103 @@
+"""Single-file dashboard UI served at ``/``.
+
+The reference ships a 21.5k-line React/TS frontend
+(reference: python/ray/dashboard/client/); this is the dependency-free
+equivalent for the same data: one HTML page that polls the head's JSON
+API (/api/cluster_status, /api/nodes, /api/actors, /api/jobs,
+/api/placement_groups, /api/tasks) and renders live tables — cluster
+overview, nodes, actors, jobs, placement groups, recent task events.
+"""
+
+PAGE = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  body { font-family: -apple-system, "Segoe UI", Roboto, sans-serif;
+         margin: 0; background: #f7f7f9; color: #1a1a2e; }
+  header { background: #1a1a2e; color: #fff; padding: 10px 24px;
+           display: flex; align-items: baseline; gap: 16px; }
+  header h1 { font-size: 18px; margin: 0; }
+  header .sub { color: #9aa; font-size: 12px; }
+  main { padding: 16px 24px; max-width: 1200px; margin: 0 auto; }
+  .cards { display: flex; gap: 12px; flex-wrap: wrap; margin: 12px 0; }
+  .card { background: #fff; border-radius: 8px; padding: 12px 18px;
+          box-shadow: 0 1px 3px rgba(0,0,0,.08); min-width: 130px; }
+  .card .v { font-size: 24px; font-weight: 600; }
+  .card .k { font-size: 12px; color: #667; }
+  h2 { font-size: 14px; margin: 18px 0 6px; color: #334; }
+  table { border-collapse: collapse; width: 100%; background: #fff;
+          border-radius: 8px; overflow: hidden; font-size: 13px;
+          box-shadow: 0 1px 3px rgba(0,0,0,.08); }
+  th { text-align: left; background: #eef; padding: 6px 10px;
+       font-size: 12px; }
+  td { padding: 5px 10px; border-top: 1px solid #f0f0f4;
+       font-family: ui-monospace, monospace; font-size: 12px; }
+  .ALIVE, .RUNNING, .SUCCEEDED, .FINISHED { color: #0a7d33; }
+  .DEAD, .FAILED { color: #c0262d; }
+  .PENDING, .RESTARTING { color: #b26a00; }
+  #err { color: #c0262d; font-size: 12px; }
+</style>
+</head>
+<body>
+<header><h1>ray_tpu</h1><span class="sub" id="addr"></span>
+<span class="sub" id="ts"></span><span id="err"></span></header>
+<main>
+  <div class="cards" id="cards"></div>
+  <h2>Nodes</h2><table id="nodes"></table>
+  <h2>Actors</h2><table id="actors"></table>
+  <h2>Jobs</h2><table id="jobs"></table>
+  <h2>Placement groups</h2><table id="pgs"></table>
+  <h2>Recent task events</h2><table id="tasks"></table>
+</main>
+<script>
+const fmt = (x) => x === null || x === undefined ? "" :
+  (typeof x === "object" ? JSON.stringify(x) : String(x));
+const esc = (s) => s.replace(/&/g, "&amp;").replace(/</g, "&lt;")
+  .replace(/>/g, "&gt;").replace(/"/g, "&quot;");
+function table(el, rows, cols) {
+  const t = document.getElementById(el);
+  if (!rows || !rows.length) { t.innerHTML = "<tr><td>none</td></tr>"; return; }
+  let h = "<tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("") + "</tr>";
+  for (const r of rows.slice(0, 50)) {
+    h += "<tr>" + cols.map(c => {
+      // escape BEFORE interpolation: entrypoints / actor names / error
+      // strings are workload-controlled (stored-XSS sink otherwise)
+      const v = fmt(r[c]);
+      const cls = /^(ALIVE|DEAD|PENDING|RESTARTING|RUNNING|SUCCEEDED|FAILED|FINISHED)$/.test(v) ? ` class="${v}"` : "";
+      return `<td${cls}>${esc(v.slice(0, 80))}</td>`;
+    }).join("") + "</tr>";
+  }
+  t.innerHTML = h;
+}
+async function j(path) { const r = await fetch(path); return r.json(); }
+async function tick() {
+  try {
+    const [cs, nodes, actors, jobs, pgs, tasks, ver] = await Promise.all([
+      j("/api/cluster_status"), j("/api/nodes"), j("/api/actors"),
+      j("/api/jobs"), j("/api/placement_groups"),
+      j("/api/tasks?limit=50"), j("/api/version")]);
+    document.getElementById("addr").textContent = ver.control_address;
+    const total = cs.total_resources || {}, avail = cs.available_resources || {};
+    const card = (k, v) => `<div class="card"><div class="v">${v}</div><div class="k">${k}</div></div>`;
+    document.getElementById("cards").innerHTML =
+      card("alive nodes", cs.alive_nodes) +
+      card("CPU free/total", `${avail.CPU ?? 0}/${total.CPU ?? 0}`) +
+      card("TPU free/total", `${avail.TPU ?? 0}/${total.TPU ?? 0}`) +
+      card("actors", actors.length) + card("jobs", jobs.length) +
+      card("placement groups", pgs.length);
+    table("nodes", nodes, ["node_id", "addr", "state", "total", "available", "labels"]);
+    table("actors", actors, ["actor_id", "class_name", "name", "state", "node_id", "restarts"]);
+    table("jobs", jobs, ["submission_id", "entrypoint", "status", "message"]);
+    table("pgs", pgs, ["pg_id", "name", "state", "bundles", "strategy"]);
+    table("tasks", tasks.records || [], ["task_id", "name", "state", "actor_id", "error"]);
+    document.getElementById("ts").textContent = new Date().toLocaleTimeString();
+    document.getElementById("err").textContent = "";
+  } catch (e) { document.getElementById("err").textContent = " " + e; }
+}
+tick(); setInterval(tick, 2000);
+</script>
+</body>
+</html>
+"""

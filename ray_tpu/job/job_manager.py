@@ -149,7 +149,9 @@ class JobSubmissionClient:
             self._http = address.rstrip("/")
             return
         if not ray_tpu.is_initialized():
-            ray_tpu.init(address=address)
+            # tolerate a concurrent initializer (dashboard handler
+            # threads race on first job request)
+            ray_tpu.init(address=address, ignore_reinit_error=True)
         from ray_tpu._private.api import current_core
 
         self._core = current_core()

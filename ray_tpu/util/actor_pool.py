@@ -15,10 +15,10 @@ import ray_tpu
 class ActorPool:
     def __init__(self, actors: Iterable):
         self._idle: List[Any] = list(actors)
-        self._future_to_actor = {}
-        self._index_to_future = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
+        self._inflight = {}
+        self._pending_by_seq = {}
+        self._submit_seq = 0
+        self._deliver_seq = 0
         self._pending_submits: List[tuple] = []
 
     # -- submission --------------------------------------------------------
@@ -28,9 +28,9 @@ class ActorPool:
         if self._idle:
             actor = self._idle.pop()
             future = fn(actor, value)
-            self._future_to_actor[future] = (self._next_task_index, actor)
-            self._index_to_future[self._next_task_index] = future
-            self._next_task_index += 1
+            self._inflight[future] = (self._submit_seq, actor)
+            self._pending_by_seq[self._submit_seq] = future
+            self._submit_seq += 1
         else:
             self._pending_submits.append((fn, value))
 
@@ -49,25 +49,25 @@ class ActorPool:
     # -- consumption -------------------------------------------------------
 
     def has_next(self) -> bool:
-        return bool(self._future_to_actor)
+        return bool(self._inflight)
 
     def get_next(self, timeout: Optional[float] = None):
         """Next result in submission order."""
         if not self.has_next():
             raise StopIteration("no more results")
         # skip holes left by earlier unordered consumption
-        while (self._next_return_index not in self._index_to_future
-               and self._next_return_index < self._next_task_index):
-            self._next_return_index += 1
-        future = self._index_to_future[self._next_return_index]
+        while (self._deliver_seq not in self._pending_by_seq
+               and self._deliver_seq < self._submit_seq):
+            self._deliver_seq += 1
+        future = self._pending_by_seq[self._deliver_seq]
         if timeout is not None:
             ready, _ = ray_tpu.wait([future], num_returns=1, timeout=timeout)
             if not ready:
                 # pool state untouched: the caller can retry
                 raise TimeoutError("timed out waiting for result")
-        del self._index_to_future[self._next_return_index]
-        self._next_return_index += 1
-        _, actor = self._future_to_actor.pop(future)
+        del self._pending_by_seq[self._deliver_seq]
+        self._deliver_seq += 1
+        _, actor = self._inflight.pop(future)
         self._return_actor(actor)
         # a task error propagates but the actor is back in the pool
         return ray_tpu.get(future)
@@ -76,17 +76,17 @@ class ActorPool:
         """Next result in completion order."""
         if not self.has_next():
             raise StopIteration("no more results")
-        ready, _ = ray_tpu.wait(list(self._future_to_actor), num_returns=1,
+        ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
                                 timeout=timeout)
         if not ready:
             raise TimeoutError("timed out waiting for result")
         future = ready[0]
-        i, actor = self._future_to_actor.pop(future)
-        del self._index_to_future[i]
+        i, actor = self._inflight.pop(future)
+        del self._pending_by_seq[i]
         # unordered consumption shifts the ordered cursor past holes
-        while (self._next_return_index not in self._index_to_future
-               and self._next_return_index < self._next_task_index):
-            self._next_return_index += 1
+        while (self._deliver_seq not in self._pending_by_seq
+               and self._deliver_seq < self._submit_seq):
+            self._deliver_seq += 1
         self._return_actor(actor)
         return ray_tpu.get(future)
 
@@ -99,7 +99,7 @@ class ActorPool:
             self.submit(fn, v)
 
     def push(self, actor) -> None:
-        busy = {a for _, a in self._future_to_actor.values()}
+        busy = {a for _, a in self._inflight.values()}
         if actor in self._idle or actor in busy:
             raise ValueError("actor already in pool")
         self._return_actor(actor)

@@ -26,8 +26,12 @@ def set_trace() -> None:
     from ray_tpu._private.core import current_core
 
     core = current_core()
+    # bind the interface this worker serves RPC on, not loopback — the
+    # attaching CLI may run on another node (reference rpdb binds the
+    # node ip)
+    host = core.addr[0] if getattr(core, "addr", None) else "127.0.0.1"
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    srv.bind(("127.0.0.1", 0))
+    srv.bind((host, 0))
     srv.listen(1)
     bp_id = f"bp-{uuid.uuid4().hex[:10]}"
     info = {

@@ -20,6 +20,7 @@ lines to the configured ``trace_file``).
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import json
 import logging
 import os
@@ -33,7 +34,10 @@ KV_NS = "_tracing"
 
 _enabled = False
 _sink: Optional[Callable[[Dict[str, Any]], None]] = None
-_tls = threading.local()
+# contextvar, not thread-local: spans opened inside asyncio Tasks must
+# attribute per-Task even though all coroutines share the loop thread
+_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_trace_ctx", default=None)
 
 
 def is_enabled() -> bool:
@@ -57,7 +61,7 @@ def _new_id(nbytes: int) -> int:
 
 
 def _current() -> Optional[Dict[str, int]]:
-    return getattr(_tls, "ctx", None)
+    return _ctx.get()
 
 
 def inject_context() -> Optional[Dict[str, str]]:
@@ -97,12 +101,12 @@ def _span(name: str, kind: str,
         "start_ns": time.time_ns(),
         "attributes": {k: v for k, v in attrs.items() if v is not None},
     }
-    prev = _current()
-    _tls.ctx = {"trace_id": span["trace_id"], "span_id": span["span_id"]}
+    token = _ctx.set({"trace_id": span["trace_id"],
+                      "span_id": span["span_id"]})
     try:
         yield span
     finally:
-        _tls.ctx = prev
+        _ctx.reset(token)
         span["end_ns"] = time.time_ns()
         record = dict(span)
         record["trace_id"] = f"{span['trace_id']:032x}"

@@ -1,0 +1,31 @@
+"""The dashboard serves a live HTML UI at / (the stand-in for the
+reference's React client, dashboard/client/)."""
+
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def dashboard(ray_cluster):
+    from ray_tpu.dashboard.head import DashboardHead
+
+    info = ray_tpu.connection_info()
+    head = DashboardHead(info["control_address"], port=0)
+    head.start()
+    yield head
+    head.stop()
+
+
+def test_root_serves_html_ui(dashboard):
+    with urllib.request.urlopen(dashboard.url + "/", timeout=30) as r:
+        assert r.status == 200
+        assert r.headers.get_content_type() == "text/html"
+        body = r.read().decode()
+    # the page drives the JSON API the head actually serves
+    for endpoint in ("/api/cluster_status", "/api/nodes", "/api/actors",
+                     "/api/jobs", "/api/placement_groups"):
+        assert endpoint in body
+    assert "ray_tpu" in body

@@ -1,0 +1,32 @@
+"""Event-loop handler instrumentation (reference: event_stats.h — asio
+handler latency accounting): every RPC server tracks per-handler loop
+occupancy, queryable over the wire via `rpc_stats`."""
+
+import ray_tpu
+from ray_tpu._private.protocol import Client
+
+
+def test_rpc_stats_surface(ray_cluster):
+    core = ray_tpu._require()
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote(), timeout=60) == 1
+
+    stats = core.control.call("rpc_stats", {}, timeout=30)
+    # the control plane served heartbeats/KV at minimum
+    assert stats, "no handler stats recorded"
+    some = next(iter(stats.values()))
+    assert {"count", "total_s", "mean_us", "max_us"} <= set(some)
+    assert any(v["count"] > 0 for v in stats.values())
+
+    # the worker core's own server exposes the same surface
+    own = Client(core.addr, name="stats-probe")
+    try:
+        mine = own.call("rpc_stats", {}, timeout=30)
+        assert "rpc_stats" not in ("",)  # structural smoke
+        assert isinstance(mine, dict)
+    finally:
+        own.close()

@@ -1,0 +1,54 @@
+"""Scalability envelope at CI scale (reference: release/benchmarks —
+10,000 args to one task, 3,000 returns, 10,000-object get, 1M queued
+tasks; here scaled to the 1-core test box but exercising the same
+mechanisms: arg fan-in resolution, wide num_returns, bulk get, deep
+queues)."""
+
+import numpy as np
+
+import ray_tpu
+
+
+def test_many_args_to_single_task(ray_cluster):
+    @ray_tpu.remote
+    def make(i):
+        return i
+
+    @ray_tpu.remote
+    def consume(*xs):
+        return sum(xs)
+
+    refs = [make.remote(i) for i in range(1000)]
+    assert ray_tpu.get(consume.remote(*refs), timeout=300) == \
+        sum(range(1000))
+
+
+def test_many_returns_from_single_task(ray_cluster):
+    n = 500
+
+    @ray_tpu.remote(num_returns=n)
+    def burst():
+        return list(range(n))
+
+    refs = burst.remote()
+    assert len(refs) == n
+    vals = ray_tpu.get(refs, timeout=300)
+    assert vals == list(range(n))
+
+
+def test_bulk_get(ray_cluster):
+    refs = [ray_tpu.put(np.full(8, i)) for i in range(2000)]
+    out = ray_tpu.get(refs, timeout=300)
+    assert len(out) == 2000
+    assert int(out[1234][0]) == 1234
+
+
+def test_deep_task_queue(ray_cluster):
+    @ray_tpu.remote
+    def tick(i):
+        return i
+
+    n = 10000
+    refs = [tick.remote(i) for i in range(n)]
+    out = ray_tpu.get(refs, timeout=600)
+    assert out == list(range(n))

@@ -117,6 +117,59 @@ def test_streaming_worker_death_mid_stream(ray_cluster):
             os.unlink(die_file)
 
 
+def test_cancel_streaming_task(ray_cluster):
+    """ray.cancel(generator) stops the producer (the generator is the
+    task handle for streaming tasks)."""
+    @ray_tpu.remote(num_returns="streaming", max_retries=0)
+    def slow_gen():
+        yield 0
+        t0 = time.time()
+        while time.time() - t0 < 30:  # spin: injectable
+            sum(range(1000))
+        yield 1
+
+    g = slow_gen.remote()
+    assert ray_tpu.get(next(g), timeout=60) == 0
+    time.sleep(0.5)
+    assert ray_tpu.cancel(g)
+    t0 = time.time()
+    with pytest.raises((RayTpuError, StopIteration)):
+        next(g)  # the stream surfaces the cancellation
+    assert time.time() - t0 < 25, "cancel did not interrupt the producer"
+
+
+def test_streamed_item_reconstruction(ray_cluster):
+    """A lost streamed item is rebuilt by re-executing the generator;
+    the re-reported item lands in the awaited entry even though the
+    stream itself is long consumed (h_generator_item recovery path)."""
+    from ray_tpu._private.api import current_core
+    from ray_tpu._private.protocol import Client
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        for i in range(3):
+            yield np.full(1 << 20, i, np.uint8)  # shm-sized items
+
+    refs = list(gen.remote())
+    assert len(refs) == 3
+    first = ray_tpu.get(refs[1], timeout=60)
+    assert first[0] == 1
+
+    core = current_core()
+    dropped = 0
+    for n in core.control.call("get_nodes", timeout=10.0):
+        cli = Client(tuple(n["addr"]), name="test-drop")
+        try:
+            dropped += cli.call("delete_objects",
+                                {"object_ids": [refs[1].id]}, timeout=10.0)
+        finally:
+            cli.close()
+    assert dropped >= 1, "streamed item was not in any node store"
+
+    again = ray_tpu.get(refs[1], timeout=120)
+    assert again[0] == 1 and again.shape == (1 << 20,)
+
+
 def test_streaming_actor_method(ray_cluster):
     """Actor methods stream too (reference: ObjectRefGenerator covers
     actor tasks)."""
@@ -137,6 +190,29 @@ def test_streaming_actor_method(ray_cluster):
     # ordered queue: a later plain call still works after the stream
     g2 = a.stream.options(num_returns="streaming").remote(2)
     assert [ray_tpu.get(r, timeout=60) for r in g2] == [100, 101]
+
+
+def test_streaming_method_on_async_actor(ray_cluster):
+    """A sync generator method on an ASYNC actor streams correctly (it
+    executes via the actor's event loop, generator drained in an
+    executor thread)."""
+    import asyncio
+
+    @ray_tpu.remote
+    class Hybrid:
+        async def aping(self):
+            await asyncio.sleep(0)
+            return "pong"
+
+        def stream(self, n):
+            for i in range(n):
+                yield i * 7
+
+    a = Hybrid.remote()
+    assert ray_tpu.get(a.aping.remote(), timeout=60) == "pong"
+    g = a.stream.options(num_returns="streaming").remote(3)
+    assert [ray_tpu.get(r, timeout=60) for r in g] == [0, 7, 14]
+    assert ray_tpu.get(a.aping.remote(), timeout=60) == "pong"
 
 
 def test_streaming_generator_drop_stops_producer(ray_cluster):
