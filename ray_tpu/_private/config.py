@@ -103,15 +103,23 @@ class Config:
         unknown = set(sysconf) - {n for n, *_ in CONFIG_DEFS}
         if unknown:
             raise ValueError(f"unknown _system_config keys: {sorted(unknown)}")
+        self._explicit = set()
         for name, typ, default, _help in CONFIG_DEFS:
             env = os.environ.get(f"RAY_TPU_{name.upper()}")
             if env is not None:
                 val = _coerce(typ, env)
+                self._explicit.add(name)
             elif name in sysconf:
                 val = _coerce(typ, sysconf[name])
+                self._explicit.add(name)
             else:
                 val = default
             setattr(self, name, val)
+
+    def is_set(self, name: str) -> bool:
+        """True when the flag was explicitly set (env or system config),
+        as opposed to carrying its table default."""
+        return name in self._explicit
 
     def to_dict(self) -> Dict[str, Any]:
         return {n: getattr(self, n) for n, *_ in CONFIG_DEFS}

@@ -367,9 +367,10 @@ class CoreWorker:
         self.server.handle("ping", lambda c, p: "pong")
         # streaming-generator tasks owned by this process
         self.streams: Dict[str, StreamState] = {}
-        # recently user-dropped stream ids (bounded; membership tells a
-        # late producer report "stop" vs "recovery re-report, accept")
-        self._released_streams: deque = deque(maxlen=1024)
+        # user-dropped stream ids whose producer task is still live —
+        # tells a late report "stop" vs "lineage-recovery re-report,
+        # accept"; entries clear when the producer's final reply lands
+        self._released_streams: Set[str] = set()
         # on-demand profiling RPCs (reference: dashboard reporter agent's
         # py-spy/memray endpoints, profile_manager.py:82)
         from . import profiling
@@ -1343,6 +1344,7 @@ class CoreWorker:
         rec.done = True
         with self.lock:
             self.task_records.pop(rec.spec.task_id, None)
+        self._released_streams.discard(rec.spec.task_id)
         if rec.canceled and reply.get("status") != "ok":
             # the worker raised out of the injected cancellation: surface
             # TaskCancelledError rather than the interrupt artifact
@@ -1367,13 +1369,20 @@ class CoreWorker:
         tid, index = p["task_id"], p["index"]
         st = self.streams.get(tid)
         if st is None or st.closed:
-            if tid in self._released_streams or (st and st.closed):
+            if (st is not None and st.closed) \
+                    or tid in self._released_streams:
                 # the consumer explicitly dropped the generator: stop
                 d.resolve({"ok": False, "stop": True})
                 return
-            # no live stream but not released either: a lineage-recovery
-            # re-execution of a consumed stream — store items someone is
-            # still waiting on, ack the rest so the producer finishes
+            with self.lock:
+                recovering = tid in self.task_records
+            if not recovering:
+                # not released, not recovering: a stray report from a
+                # long-finished stream — nothing wants it
+                d.resolve({"ok": False, "stop": True})
+                return
+            # lineage-recovery re-execution of a consumed stream — store
+            # items someone is waiting on, ack the rest to completion
             oid = common.object_id_for_return(tid, index)
             with self.lock:
                 e = self.objects.get(oid)
@@ -1383,7 +1392,14 @@ class CoreWorker:
             return
         with st.cv:
             if index < st.produced:
-                # duplicate from a retry attempt — already stored
+                # duplicate from a retry/recovery attempt: usually
+                # already stored, but a lost-and-reconstructing item's
+                # entry was reset (event cleared) — re-store those
+                oid = common.object_id_for_return(tid, index)
+                with self.lock:
+                    e = self.objects.get(oid)
+                if e is not None and not e.ready:
+                    self._store_one(e, p["result"])
                 d.resolve({"ok": True})
                 return
             oid = common.object_id_for_return(tid, index)
@@ -1472,8 +1488,9 @@ class CoreWorker:
         if st is None:
             return
         # remember the drop so late/retried item reports are told to stop
-        # (vs. lineage-recovery re-reports, which must be accepted)
-        self._released_streams.append(tid)
+        # (vs. lineage-recovery re-reports, which must be accepted);
+        # cleared when the producer's final reply arrives
+        self._released_streams.add(tid)
         with st.cv:
             st.closed = True
             pending = list(st.ready)
@@ -1542,6 +1559,7 @@ class CoreWorker:
         else:
             with self.lock:
                 self.task_records.pop(rec.spec.task_id, None)
+            self._released_streams.discard(rec.spec.task_id)
             if rec.canceled:
                 err: BaseException = TaskCancelledError(
                     f"task {rec.spec.function_name} was cancelled")
@@ -1940,19 +1958,67 @@ class CoreWorker:
                 self._fail_stream(tid, err)
             return True
         # pushed: tell the executing worker (it propagates to children
-        # when recursive — they are owned by that worker, not us)
+        # when recursive — they are owned by that worker, not us).
+        # Delivery is CONFIRMED off-thread: if the worker never handles
+        # the cancel (conn hiccup, handler fault), the owner resolves the
+        # refs itself — a cancelled task never retries, so nobody else
+        # ever would, and get() must not hang forever.
         with self.lock:
             lw = None
             if pool is not None and rec.pushed_to:
                 lw = pool.leases.get(rec.pushed_to)
-        if lw is not None and lw.client is not None:
+        client = lw.client if lw is not None else None
+        if client is None:
+            # no live lease to deliver to: resolve immediately
+            self._fail_canceled_entries(rec)
+            return True
+
+        # async confirmation — never parks a shared pool thread: the ack
+        # resolves via callback; a timer catches a worker that never
+        # replies at all
+        def fallback(rec=rec):
+            if not rec.done:
+                logger.warning(
+                    "cancel of %s not confirmed by worker; resolving "
+                    "owner-side", rec.spec.task_id[:12])
+                self._fail_canceled_entries(rec)
+
+        timer = threading.Timer(15.0, fallback)
+        timer.daemon = True
+
+        def on_ack(f):
             try:
-                lw.client.notify("cancel_task", {"task_id": rec.spec.task_id,
-                                                 "force": force,
-                                                 "recursive": recursive})
+                f.result()
+                timer.cancel()
             except Exception:
-                pass
+                timer.cancel()
+                fallback()
+
+        try:
+            fut = client.call_async(
+                "cancel_task", {"task_id": rec.spec.task_id,
+                                "force": force, "recursive": recursive})
+        except Exception:
+            fallback()
+            return True
+        timer.start()
+        fut.add_done_callback(on_ack)
         return True
+
+    def _fail_canceled_entries(self, rec: TaskRecord):
+        err = TaskCancelledError(
+            f"task {rec.spec.function_name} was cancelled")
+        for oid in rec.spec.return_ids():
+            with self.lock:
+                e = self.objects.get(oid)
+            if e is not None and not e.ready:
+                e.error = err
+                e.event.set()
+        if rec.spec.num_returns == STREAMING_RETURNS:
+            self._fail_stream(rec.spec.task_id, err)
+            # and CLOSE it: an unconfirmed producer that later wakes must
+            # be told to stop, not have its items stored and pinned
+            self._release_stream(rec.spec.task_id)
 
     def _cancel_actor_task(self, tid: str, force: bool,
                            recursive: bool) -> bool:

@@ -158,9 +158,7 @@ class Raylet:
             self.spill = spilling.SpillManager(
                 self.store, os.path.join(spill_base, self.node_id))
         self.oom_killer: Optional[spilling.OomKiller] = None
-        if os.environ.get("RAY_TPU_MEMORY_MONITOR_REFRESH_MS") is not None \
-                or "memory_monitor_refresh_ms" in \
-                   os.environ.get("RAY_TPU_SYSTEM_CONFIG", ""):
+        if _ncfg().is_set("memory_monitor_refresh_ms"):
             refresh_ms = _ncfg().memory_monitor_refresh_ms
         else:
             # default on only inside a memory-limited cgroup, where the
