@@ -39,7 +39,7 @@ CONFIG_DEFS: List[Tuple[str, type, Any, str]] = [
      "before its restored non-detached actors are reaped"),
     # -- task submission (NOTE: bound at module import in the driver's
     # own process — set via env or _system_config before daemons spawn)
-    ("pipeline_depth", int, 4,
+    ("pipeline_depth", int, 8,
      "tasks pushed per leased worker before waiting on replies"),
     ("idle_lease_ttl_s", float, 1.0,
      "idle time before a lease is returned to the raylet"),

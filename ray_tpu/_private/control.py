@@ -31,7 +31,7 @@ import os
 import pickle
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from . import common
@@ -180,6 +180,14 @@ class ControlServer:
         self.profile_events: List[Dict[str, Any]] = []
         self.task_events_dropped = 0
         self.max_task_records = _cfg().max_task_events
+        # ingestion is queue + dedicated merge thread (own lock — event
+        # merging must never contend with the scheduler's global lock)
+        self._event_queue: deque = deque()
+        self._event_signal = threading.Event()
+        self._events_lock = threading.Lock()
+        self._event_thread = threading.Thread(
+            target=self._event_merge_loop, name="control-task-events",
+            daemon=True)
         # pending-actor scheduler queue (reference: GcsActorScheduler)
         self.pending_actors: List[ActorRecord] = []
         self._sched_event = threading.Event()
@@ -350,6 +358,7 @@ class ControlServer:
 
     def start(self, block: bool = False):
         self.health_thread.start()
+        self._event_thread.start()
         self._actor_sched_thread = threading.Thread(
             target=self._actor_sched_loop, name="control-actor-sched",
             daemon=True)
@@ -1215,9 +1224,35 @@ class ControlServer:
     # -- task events (reference: GcsTaskManager) --------------------------
 
     def h_report_task_events(self, conn, p):
-        with self.lock:
+        """Ingest is decoupled from the RPC loop: batches land in a
+        queue and a dedicated thread merges them.  At high task rates
+        the merge is the control plane's biggest CPU item — doing it on
+        the event loop under the global lock stalled lease scheduling
+        (measured ~40% of headline tasks/s)."""
+        self._event_queue.append(p)
+        self._event_signal.set()
+        return True
+
+    def _event_merge_loop(self):
+        while not self._stop.is_set():
+            self._event_signal.wait(0.5)
+            self._event_signal.clear()
+            self._drain_event_queue()
+
+    def _drain_event_queue(self):
+        while self._event_queue:
+            try:
+                self._merge_task_events(self._event_queue.popleft())
+            except Exception:
+                logger.exception("task-event merge failed")
+
+    def _merge_task_events(self, p):
+        with self._events_lock:
             self.task_events_dropped += p.get("dropped", 0)
+            common_fields = p.get("common") or {}
             for ev in p.get("events", []):
+                if common_fields:
+                    ev = {**common_fields, **ev}
                 if ev.get("kind") == "profile":
                     self.profile_events.append(ev)
                     if len(self.profile_events) > self.max_task_records:
@@ -1243,13 +1278,13 @@ class ControlServer:
                     if not terminal or state in ("FINISHED", "FAILED"):
                         rec["state"] = state
                     rec["state_ts"][state] = ev["ts"]
-        return True
 
     def h_list_task_events(self, conn, p):
         filters = p.get("filters") or {}
         limit = p.get("limit", 1000)
         out = []
-        with self.lock:
+        self._drain_event_queue()  # readers see everything reported
+        with self._events_lock:
             for rec in reversed(self.task_records.values()):
                 if all(rec.get(k) == v for k, v in filters.items()):
                     out.append(dict(rec, state_ts=dict(rec["state_ts"])))
@@ -1260,7 +1295,8 @@ class ControlServer:
 
     def h_list_profile_events(self, conn, p):
         limit = p.get("limit", 10000)
-        with self.lock:
+        self._drain_event_queue()
+        with self._events_lock:
             return list(self.profile_events[-limit:])
 
 

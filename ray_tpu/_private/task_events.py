@@ -46,15 +46,14 @@ class TaskEventBuffer:
                       name: str = "", actor_id: Optional[str] = None,
                       error: Optional[str] = None,
                       extra: Optional[Dict[str, Any]] = None):
+        # per-process constants (job/node/worker) ride once per batch as
+        # the flush header, not per event — the control merges them back
         ev = {
             "kind": "status",
             "task_id": task_id,
             "state": state,
             "name": name,
             "actor_id": actor_id,
-            "job_id": self._job_id,
-            "node_id": self._node_id,
-            "worker_id": self._worker_id,
             "ts": time.time(),
         }
         if error:
@@ -72,9 +71,6 @@ class TaskEventBuffer:
             "event_name": event_name,
             "start_ts": start_ts,
             "end_ts": end_ts,
-            "job_id": self._job_id,
-            "node_id": self._node_id,
-            "worker_id": self._worker_id,
         }
         if extra:
             ev.update(extra)
@@ -101,7 +97,10 @@ class TaskEventBuffer:
             dropped, self._dropped = self._dropped, 0
         try:
             self._client.call("report_task_events",
-                              {"events": batch, "dropped": dropped},
+                              {"events": batch, "dropped": dropped,
+                               "common": {"job_id": self._job_id,
+                                          "node_id": self._node_id,
+                                          "worker_id": self._worker_id}},
                               timeout=5.0)
         except Exception:
             # control plane unreachable: re-queue (bounded) so a blip
