@@ -183,8 +183,10 @@ class ControlServer:
         # ingestion is queue + dedicated merge thread (own lock — event
         # merging must never contend with the scheduler's global lock)
         self._event_queue: deque = deque()
+        self._event_queue_cap = 4096  # batches; overflow drops oldest
         self._event_signal = threading.Event()
         self._events_lock = threading.Lock()
+        self._drain_lock = threading.Lock()  # one drainer at a time
         self._event_thread = threading.Thread(
             target=self._event_merge_loop, name="control-task-events",
             daemon=True)
@@ -236,8 +238,9 @@ class ControlServer:
         s.handle("cluster_resources", self.h_cluster_resources)
         s.handle("state_dump", self.h_state_dump)
         s.handle("report_task_events", self.h_report_task_events)
-        s.handle("list_task_events", self.h_list_task_events)
-        s.handle("list_profile_events", self.h_list_profile_events)
+        s.handle("list_task_events", self.h_list_task_events, deferred=True)
+        s.handle("list_profile_events", self.h_list_profile_events,
+                 deferred=True)
         s.on_disconnect(self.h_disconnect)
 
         self.health_thread = threading.Thread(
@@ -367,6 +370,9 @@ class ControlServer:
 
     def stop(self):
         self._stop.set()
+        self._event_signal.set()
+        if self._event_thread.is_alive():
+            self._event_thread.join(timeout=2.0)
         self.server.stop()
         self.pool.shutdown(wait=False)
         if self.pstore is not None:
@@ -1223,13 +1229,37 @@ class ControlServer:
 
     # -- task events (reference: GcsTaskManager) --------------------------
 
+    def _defer(self, d: Deferred, fn):
+        def run():
+            try:
+                d.resolve(fn())
+            except Exception as e:
+                logger.exception("deferred control handler failed")
+                try:
+                    d.reject(f"{type(e).__name__}: {e}")
+                except Exception:
+                    pass
+
+        self.pool.submit(run)
+
     def h_report_task_events(self, conn, p):
         """Ingest is decoupled from the RPC loop: batches land in a
         queue and a dedicated thread merges them.  At high task rates
         the merge is the control plane's biggest CPU item — doing it on
         the event loop under the global lock stalled lease scheduling
-        (measured ~40% of headline tasks/s)."""
-        self._event_queue.append(p)
+        (measured ~40% of headline tasks/s).  The queue is bounded: if
+        the merge thread falls behind the oldest batch is dropped with
+        accounting (the reference's TaskEventBuffer does the same)."""
+        q = self._event_queue
+        q.append(p)
+        if len(q) > self._event_queue_cap:
+            try:
+                old = q.popleft()
+                with self._events_lock:
+                    self.task_events_dropped += \
+                        len(old.get("events", ())) + old.get("dropped", 0)
+            except IndexError:
+                pass
         self._event_signal.set()
         return True
 
@@ -1238,13 +1268,20 @@ class ControlServer:
             self._event_signal.wait(0.5)
             self._event_signal.clear()
             self._drain_event_queue()
+        self._drain_event_queue()  # final drain: don't lose pre-stop batches
 
     def _drain_event_queue(self):
-        while self._event_queue:
-            try:
-                self._merge_task_events(self._event_queue.popleft())
-            except Exception:
-                logger.exception("task-event merge failed")
+        # single drainer: the merge thread and deferred readers race here;
+        # batches must merge in report order and a reader that got True
+        # from report_task_events must then see those events
+        with self._drain_lock:
+            while self._event_queue:
+                try:
+                    self._merge_task_events(self._event_queue.popleft())
+                except IndexError:
+                    break
+                except Exception:
+                    logger.exception("task-event merge failed")
 
     def _merge_task_events(self, p):
         with self._events_lock:
@@ -1279,25 +1316,33 @@ class ControlServer:
                         rec["state"] = state
                     rec["state_ts"][state] = ev["ts"]
 
-    def h_list_task_events(self, conn, p):
-        filters = p.get("filters") or {}
-        limit = p.get("limit", 1000)
-        out = []
-        self._drain_event_queue()  # readers see everything reported
-        with self._events_lock:
-            for rec in reversed(self.task_records.values()):
-                if all(rec.get(k) == v for k, v in filters.items()):
-                    out.append(dict(rec, state_ts=dict(rec["state_ts"])))
-                    if len(out) >= limit:
-                        break
-        return {"records": out, "dropped": self.task_events_dropped,
-                "total": len(self.task_records)}
+    def h_list_task_events(self, conn, p, d):
+        # deferred: the drain + snapshot is O(backlog + records) and must
+        # not run on the RPC event loop (protocol handlers must not block)
+        def run():
+            filters = p.get("filters") or {}
+            limit = p.get("limit", 1000)
+            out = []
+            self._drain_event_queue()  # readers see everything reported
+            with self._events_lock:
+                for rec in reversed(self.task_records.values()):
+                    if all(rec.get(k) == v for k, v in filters.items()):
+                        out.append(dict(rec, state_ts=dict(rec["state_ts"])))
+                        if len(out) >= limit:
+                            break
+                return {"records": out, "dropped": self.task_events_dropped,
+                        "total": len(self.task_records)}
 
-    def h_list_profile_events(self, conn, p):
-        limit = p.get("limit", 10000)
-        self._drain_event_queue()
-        with self._events_lock:
-            return list(self.profile_events[-limit:])
+        self._defer(d, run)
+
+    def h_list_profile_events(self, conn, p, d):
+        def run():
+            limit = p.get("limit", 10000)
+            self._drain_event_queue()
+            with self._events_lock:
+                return list(self.profile_events[-limit:])
+
+        self._defer(d, run)
 
 
 def main():

@@ -104,9 +104,14 @@ class TaskEventBuffer:
                               timeout=5.0)
         except Exception:
             # control plane unreachable: re-queue (bounded) so a blip
-            # doesn't lose the whole window
+            # doesn't lose the whole window; anything truncated off the
+            # front counts as dropped, and the unsent dropped-count is
+            # restored so it reaches control on the next success
             with self._lock:
-                self._events = (batch + self._events)[-MAX_BUFFERED:]
+                merged = batch + self._events
+                cut = max(0, len(merged) - MAX_BUFFERED)
+                self._events = merged[cut:]
+                self._dropped += dropped + cut
 
     def stop(self):
         self._stop.set()

@@ -24,6 +24,105 @@ from ray_tpu._private.protocol import Client, ConnectionLost
 
 CLIENT_SCHEME = "ray-tpu://"
 
+_STREAM_POLL_SLICE = 30.0  # server-side bounded wait per poll
+
+
+class ClientObjectRefGenerator:
+    """Client-mode stand-in for ObjectRefGenerator: each item is fetched
+    with bounded server polls (c_stream_next) so a silent stream never
+    wedges a server pool thread.  Mirrors the direct-mode surface:
+    __next__/next_ready/completed/async iteration/task_id."""
+
+    def __init__(self, cc: "ClientCore", task_id: str):
+        self._cc = cc
+        self._task_id = task_id
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        ref = self._next(timeout=None)
+        if ref is None:
+            raise StopIteration
+        return ref
+
+    def next_ready(self, timeout: Optional[float] = None) -> ObjectRef:
+        ref = self._next(timeout=timeout)
+        if ref is None:
+            raise StopIteration
+        return ref
+
+    def _next(self, timeout: Optional[float]) -> Optional[ObjectRef]:
+        if self._done:
+            return None
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        first = True
+        while True:
+            remaining = None if deadline is None \
+                else deadline - _time.monotonic()
+            if remaining is not None and remaining <= 0:
+                # poll at least once: next_ready(0) must return an
+                # already-buffered item (direct-mode _next_stream_item
+                # checks st.ready before the deadline)
+                if not first:
+                    raise GetTimeoutError(
+                        f"streaming task {self._task_id} produced no item "
+                        f"in time")
+                remaining = 0.0
+            first = False
+            poll = _STREAM_POLL_SLICE if remaining is None \
+                else min(_STREAM_POLL_SLICE, remaining)
+            r = self._cc._call(
+                "c_stream_next",
+                {"task_id": self._task_id, "timeout": poll},
+                timeout=poll + 30.0)
+            if r.get("done"):
+                self._done = True
+                return None
+            if r.get("timeout"):
+                continue
+            return self._cc._mk_ref(r["ref"])
+
+    def completed(self) -> bool:
+        if self._done:
+            return True
+        # non-consuming server check: direct-mode completed() is True as
+        # soon as the task is done and the buffer drained, even before
+        # the user observes StopIteration
+        try:
+            return bool(self._cc._call(
+                "c_stream_done", {"task_id": self._task_id}, timeout=30.0))
+        except Exception:
+            return self._done
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> ObjectRef:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        ref = await loop.run_in_executor(None, self._next, None)
+        if ref is None:
+            raise StopAsyncIteration
+        return ref
+
+    @property
+    def task_id(self) -> str:
+        return self._task_id
+
+    def __del__(self):
+        cc = self._cc
+        if cc is not None and not self._done and not cc._shutdown:
+            try:
+                cc._client.notify("c_stream_release",
+                                  {"task_id": self._task_id})
+            except OSError:
+                pass
+
 
 def parse_client_address(address: str) -> Tuple[str, int]:
     hostport = address[len(CLIENT_SCHEME):]
@@ -177,10 +276,6 @@ class ClientCore:
                     max_retries=3, strategy=None, pg=None, bundle_index=-1,
                     name="", runtime_env=None,
                     generator_backpressure=0) -> List[ObjectRef]:
-        if num_returns == "streaming":
-            raise NotImplementedError(
-                "streaming generators are not yet proxied through "
-                "ray-tpu:// client mode")
         common._ensure_picklable_by_value(fn)
         if runtime_env:
             # package local dirs on the CLIENT machine; the server only
@@ -199,8 +294,11 @@ class ClientCore:
             "bundle_index": bundle_index,
             "name": name,
             "runtime_env": runtime_env,
+            "generator_backpressure": generator_backpressure,
         }
         wires = self._call("c_submit_task", payload, timeout=120.0)
+        if isinstance(wires, dict) and "streaming" in wires:
+            return [ClientObjectRefGenerator(self, wires["streaming"])]
         return [self._mk_ref(w) for w in wires]
 
     def create_actor(self, cls, args, kwargs, *, resources=None, name=None,
@@ -232,10 +330,6 @@ class ClientCore:
 
     def submit_actor_task(self, actor_id: str, method_name: str, args,
                           kwargs, num_returns: int = 1) -> List[ObjectRef]:
-        if num_returns == "streaming":
-            raise NotImplementedError(
-                "streaming generators are not yet proxied through "
-                "ray-tpu:// client mode")
         payload = {
             "actor_id": actor_id,
             "method": method_name,
@@ -243,6 +337,8 @@ class ClientCore:
             "num_returns": num_returns,
         }
         wires = self._call("c_submit_actor_task", payload, timeout=120.0)
+        if isinstance(wires, dict) and "streaming" in wires:
+            return [ClientObjectRefGenerator(self, wires["streaming"])]
         return [self._mk_ref(w) for w in wires]
 
     def kill_actor(self, actor_id: str, no_restart: bool = True):

@@ -68,6 +68,8 @@ class ClientServer:
         self.lock = threading.Lock()
         # conn -> {object_id: ObjectRef} pins keeping client refs alive
         self.pins: Dict[ServerConn, Dict[str, ObjectRef]] = {}
+        # conn -> {task_id: ObjectRefGenerator} live proxied streams
+        self.streams: Dict[ServerConn, Dict[str, Any]] = {}
 
         s = self.server = Server(host, port, name="client-server")
         s.handle("c_hello", self.h_hello)
@@ -83,6 +85,9 @@ class ClientServer:
         s.handle("c_get_actor_by_name", self.h_get_actor_by_name,
                  deferred=True)
         s.handle("c_release", self.h_release)
+        s.handle("c_stream_next", self.h_stream_next, deferred=True)
+        s.handle("c_stream_done", self.h_stream_done)
+        s.handle("c_stream_release", self.h_stream_release)
         s.handle("c_control", self.h_control, deferred=True)
         s.handle("c_control_notify", self.h_control_notify)
         s.on_disconnect(self._drop_conn)
@@ -103,6 +108,13 @@ class ClientServer:
     def _drop_conn(self, conn: ServerConn):
         with self.lock:
             self.pins.pop(conn, None)  # refs GC -> server releases objects
+            gens = self.streams.pop(conn, None)
+        if gens:
+            for gen in gens.values():
+                try:
+                    self.core._release_stream(gen.task_id)
+                except Exception:
+                    pass
 
     def _pin(self, conn: ServerConn, refs):
         with self.lock:
@@ -182,11 +194,19 @@ class ClientServer:
                 strategy=p.get("strategy"), pg=p.get("pg"),
                 bundle_index=p.get("bundle_index", -1),
                 name=p.get("name", ""),
-                runtime_env=p.get("runtime_env"))
+                runtime_env=p.get("runtime_env"),
+                generator_backpressure=p.get("generator_backpressure", 0))
+            if p.get("num_returns") == "streaming":
+                return self._register_stream(conn, refs[0])
             self._pin(conn, refs)
             return [_wire(r) for r in refs]
 
         self._deferred(d, run)
+
+    def _register_stream(self, conn, gen):
+        with self.lock:
+            self.streams.setdefault(conn, {})[gen.task_id] = gen
+        return {"streaming": gen.task_id}
 
     def h_create_actor(self, conn, p, d: Deferred):
         def run():
@@ -212,10 +232,61 @@ class ClientServer:
             refs = self.core.submit_actor_task(
                 p["actor_id"], p["method"], args, kwargs,
                 num_returns=p.get("num_returns", 1))
+            if p.get("num_returns") == "streaming":
+                return self._register_stream(conn, refs[0])
             self._pin(conn, refs)
             return [_wire(r) for r in refs]
 
         self._deferred(d, run)
+
+    def h_stream_next(self, conn, p, d: Deferred):
+        """One bounded poll for the next stream item: {"ref": wire} |
+        {"done": True} | {"timeout": True}.  Runs on a dedicated thread
+        (not the shared DaemonPool): a stream's 30 s wait slices would
+        otherwise occupy pool workers at ~100% steady state and starve
+        get/wait/submit deferreds once streams ≈ pool size."""
+
+        def run():
+            try:
+                with self.lock:
+                    gen = self.streams.get(conn, {}).get(p["task_id"])
+                if gen is None:
+                    d.resolve({"done": True})
+                    return
+                try:
+                    ref = gen.next_ready(timeout=p.get("timeout", 30.0))
+                except StopIteration:
+                    with self.lock:
+                        self.streams.get(conn, {}).pop(p["task_id"], None)
+                    d.resolve({"done": True})
+                    return
+                except GetTimeoutError:
+                    d.resolve({"timeout": True})
+                    return
+                self._pin(conn, [ref])
+                d.resolve({"ref": _wire(ref)})
+            except BaseException as e:
+                d.resolve(_error_reply(e))
+
+        threading.Thread(target=run, daemon=True,
+                         name="client-stream-next").start()
+
+    def h_stream_done(self, conn, p):
+        """Non-consuming completed() check (direct-mode semantics: True
+        once the task finished and the buffer drained)."""
+        with self.lock:
+            gen = self.streams.get(conn, {}).get(p["task_id"])
+        return True if gen is None else gen.completed()
+
+    def h_stream_release(self, conn, p):
+        with self.lock:
+            gen = self.streams.get(conn, {}).pop(p["task_id"], None)
+        if gen is not None:
+            try:
+                self.core._release_stream(gen.task_id)
+            except Exception:
+                pass
+        return True
 
     def h_kill_actor(self, conn, p, d: Deferred):
         self._deferred(d, lambda: self.core.kill_actor(

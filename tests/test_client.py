@@ -122,3 +122,60 @@ def test_client_control_plane_passthrough(client_cluster):
     assert ray_tpu.get(
         where.options(placement_group=pg).remote(), timeout=60) == 1
     remove_placement_group(pg)
+
+
+def test_client_streaming_generator(client_cluster):
+    """num_returns="streaming" proxied through ray-tpu:// (direct-mode
+    counterpart: tests/test_streaming_generator.py)."""
+    ray_tpu.init(client_cluster)
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    g = gen.remote(5)
+    got = [ray_tpu.get(ref, timeout=60) for ref in g]
+    assert got == [0, 10, 20, 30, 40]
+    assert g.completed()
+
+    # next_ready timeout semantics
+    @ray_tpu.remote(num_returns="streaming")
+    def slow():
+        import time
+        time.sleep(30)
+        yield 1
+
+    g2 = slow.remote()
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        g2.next_ready(timeout=0.5)
+
+
+def test_client_streaming_actor_method(client_cluster):
+    ray_tpu.init(client_cluster)
+
+    @ray_tpu.remote
+    class Gen:
+        def items(self, n):
+            for i in range(n):
+                yield i + 100
+
+    a = Gen.remote()
+    g = a.items.options(num_returns="streaming").remote(3)
+    got = [ray_tpu.get(ref, timeout=60) for ref in g]
+    assert got == [100, 101, 102]
+
+
+def test_client_streaming_error_propagates(client_cluster):
+    ray_tpu.init(client_cluster)
+
+    @ray_tpu.remote(num_returns="streaming")
+    def bad():
+        yield 1
+        raise RuntimeError("stream-boom")
+
+    g = bad.remote()
+    assert ray_tpu.get(next(g), timeout=60) == 1
+    with pytest.raises(Exception, match="stream-boom"):
+        for ref in g:
+            ray_tpu.get(ref, timeout=60)

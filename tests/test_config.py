@@ -16,7 +16,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def test_defaults_and_env_override(monkeypatch):
     monkeypatch.delenv("RAY_TPU_SYSTEM_CONFIG", raising=False)
     c = Config()
-    assert c.pipeline_depth == 4
+    assert c.pipeline_depth == 8  # shipped default (bumped from 4 for perf)
     monkeypatch.setenv("RAY_TPU_PIPELINE_DEPTH", "9")
     monkeypatch.setenv("RAY_TPU_OBJECT_SPILLING", "false")
     c = Config()
