@@ -120,7 +120,7 @@ class ClientObjectRefGenerator:
             try:
                 cc._client.notify("c_stream_release",
                                   {"task_id": self._task_id})
-            except OSError:
+            except Exception:  # incl. ConnectionLost; never raise in __del__
                 pass
 
 
