@@ -1,0 +1,37 @@
+"""Build helper for the C++ user API (reference analog: cpp/ built by
+bazel; here a direct g++ invocation cached by source mtime, same policy
+as ray_tpu/native/build.py)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+_CPP_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_CPP_DIR, "_build")
+
+SOURCES = [os.path.join(_CPP_DIR, "src", "client.cc")]
+HEADERS = [os.path.join(_CPP_DIR, "src", "pickle_lite.h"),
+           os.path.join(_CPP_DIR, "include", "ray_tpu", "api.h")]
+
+
+def build_smoke() -> str:
+    """Compile the smoke example against the client lib; returns the
+    binary path (cached until any source/header changes)."""
+    out = os.path.join(_BUILD_DIR, "smoke")
+    srcs = SOURCES + [os.path.join(_CPP_DIR, "examples", "smoke.cc")]
+    deps = srcs + HEADERS
+    if os.path.exists(out):
+        mtime = os.path.getmtime(out)
+        if all(os.path.getmtime(s) <= mtime for s in deps):
+            return out
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = f"{out}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O2", "-g", "-std=c++17",
+           "-I", os.path.join(_CPP_DIR, "include"),
+           "-o", tmp, *srcs, "-lpthread"]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=180)
+    if proc.returncode != 0:
+        raise RuntimeError(f"cpp build failed:\n{proc.stderr[-4000:]}")
+    os.replace(tmp, out)
+    return out

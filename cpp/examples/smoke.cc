@@ -1,0 +1,107 @@
+// End-to-end smoke test for the ray_tpu C++ user API: connects to a
+// client server, round-trips objects, calls a Python task + actor by
+// descriptor, checks error propagation.  Exits 0 printing CPP_SMOKE_OK.
+//
+// Usage: smoke <host> <port> [descriptor_module]
+#include <cassert>
+#include <cstdio>
+#include <cstdlib>
+#include <stdexcept>
+#include <string>
+
+#include "ray_tpu/api.h"
+
+using ray_tpu::ActorHandle;
+using ray_tpu::ObjectRef;
+using ray_tpu::SubmitOptions;
+using ray_tpu::Value;
+using ray_tpu::ValueList;
+
+static void Check(bool ok, const char* what) {
+  if (!ok) {
+    std::fprintf(stderr, "FAIL: %s\n", what);
+    std::exit(1);
+  }
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: smoke <host> <port> [module]\n");
+    return 2;
+  }
+  std::string host = argv[1];
+  int port = std::atoi(argv[2]);
+  std::string mod = argc > 3 ? argv[3] : "xlang_mod";
+
+  auto client = ray_tpu::Client::Connect(host, port);
+  Check(!client->job_id().empty(), "job id from hello");
+
+  // put/get round trip across the type domain
+  Value v = Value::Dict(
+      {{Value::Str("ints"), Value::List({Value::Int(1), Value::Int(70000),
+                                         Value::Int(-5),
+                                         Value::Int(1LL << 40)})},
+       {Value::Str("pi"), Value::Float(3.25)},
+       {Value::Str("s"), Value::Str("héllo")},
+       {Value::Str("b"), Value::Bytes(std::string("\x00\x01\xff", 3))},
+       {Value::Str("t"), Value::Tuple({Value::Bool(true), Value::None()})}});
+  ObjectRef pref = client->Put(v);
+  Value back = client->Get(pref);
+  Check(back == v, "put/get round trip");
+
+  // task by descriptor
+  ObjectRef r =
+      client->Submit(mod + ":add", {Value::Int(2), Value::Int(3)});
+  Check(client->Get(r).as_int() == 5, "task add(2,3) == 5");
+
+  // nested plain structures through a task
+  ObjectRef r2 = client->Submit(
+      mod + ":echo", {Value::List({Value::Str("a"), Value::Int(1)})});
+  Value echoed = client->Get(r2);
+  Check(echoed.items().size() == 2 && echoed.items()[0].as_str() == "a",
+        "echo preserves structure");
+
+  // shared containers decode populated at every memo reference
+  Value sh = client->Get(client->Submit(mod + ":shared", {}));
+  Check(sh.items().size() == 2 &&
+            sh.items()[0].items().size() == 2 &&
+            sh.items()[1].items().size() == 2 &&
+            sh.items()[1].items()[1].as_int() == 2,
+        "memo-shared list decodes populated");
+
+  // wait
+  ObjectRef r3 = client->Submit(mod + ":add", {Value::Int(1), Value::Int(1)});
+  auto ready = client->Wait({r3}, 1, 60.0);
+  Check(ready.size() == 1 && ready[0] == r3.id, "wait returns ready id");
+
+  // actor create + method calls keep state
+  ActorHandle counter = client->CreateActor(mod + ":Counter", {Value::Int(10)});
+  Check(client->Get(client->CallActor(counter, "inc", {})).as_int() == 11,
+        "counter inc -> 11");
+  Check(client->Get(client->CallActor(counter, "inc", {Value::Int(5)}))
+            .as_int() == 16,
+        "counter inc(5) -> 16");
+  client->KillActor(counter);
+
+  // remote errors surface as exceptions with the message
+  bool threw = false;
+  try {
+    client->Get(client->Submit(mod + ":boom", {}));
+  } catch (const std::exception& e) {
+    threw = std::string(e.what()).find("xlang-boom") != std::string::npos;
+  }
+  Check(threw, "remote error propagates message");
+
+  // unknown descriptor rejects cleanly
+  threw = false;
+  try {
+    client->Submit("no_such_module_xyz:fn", {});
+  } catch (const std::exception& e) {
+    threw = true;
+  }
+  Check(threw, "bad descriptor rejected");
+
+  client->Close();
+  std::printf("CPP_SMOKE_OK\n");
+  return 0;
+}

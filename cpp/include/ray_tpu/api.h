@@ -1,0 +1,98 @@
+// ray_tpu C++ user API (reference analog: cpp/include/ray/api/*.h —
+// the user-facing C++ worker API; ours is a cross-language client that
+// drives a cluster through the client server, calling Python functions
+// and actors by "module:qualname" descriptor with plain-value args, the
+// same restriction the reference places on cross-language calls in
+// python/ray/cross_language.py).
+//
+// Usage:
+//   auto client = ray_tpu::Client::Connect("127.0.0.1", 10001);
+//   auto ref = client.Submit("my_pkg.my_mod:add", {Value::Int(2),
+//                                                  Value::Int(3)});
+//   int64_t five = client.Get(ref).as_int();
+//   auto actor = client.CreateActor("my_pkg.my_mod:Counter", {});
+//   client.Get(client.CallActor(actor, "inc", {}));
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "../../src/pickle_lite.h"
+
+namespace ray_tpu {
+
+// A server-side handler raised; the connection remains usable.
+class RemoteError : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+
+struct ObjectRef {
+  std::string id;
+  Value owner_addr;  // (host, port) tuple
+  std::string owner_id;
+};
+
+struct ActorHandle {
+  std::string actor_id;
+};
+
+struct SubmitOptions {
+  int num_returns = 1;
+  int max_retries = 3;
+  ValueDict resources;  // e.g. {{Value::Str("CPU"), Value::Float(1)}}
+  std::string name;
+};
+
+class Client {
+ public:
+  // Connects to a ray_tpu client server ("ray-tpu://host:port" target).
+  static std::unique_ptr<Client> Connect(const std::string& host, int port,
+                                         double timeout_s = 30.0);
+  ~Client();
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  const std::string& job_id() const { return job_id_; }
+
+  ObjectRef Put(const Value& v);
+  Value Get(const ObjectRef& ref, double timeout_s = 60.0);
+  std::vector<Value> Get(const std::vector<ObjectRef>& refs,
+                         double timeout_s = 60.0);
+  // Submit a task calling the Python function named by descriptor
+  // ("pkg.mod:func"); args must be plain values.  Submit() is the
+  // single-return convenience; use SubmitN for num_returns > 1.
+  ObjectRef Submit(const std::string& descriptor, ValueList args,
+                   const SubmitOptions& opts = {});
+  std::vector<ObjectRef> SubmitN(const std::string& descriptor,
+                                 ValueList args,
+                                 const SubmitOptions& opts = {});
+  ActorHandle CreateActor(const std::string& descriptor, ValueList args,
+                          const SubmitOptions& opts = {});
+  ObjectRef CallActor(const ActorHandle& actor, const std::string& method,
+                      ValueList args);
+  void KillActor(const ActorHandle& actor, bool no_restart = true);
+  // Returns the ids of the refs that are ready.
+  std::vector<std::string> Wait(const std::vector<ObjectRef>& refs,
+                                int num_returns, double timeout_s);
+  void Release(const ObjectRef& ref);
+  void Close();
+
+ private:
+  Client() = default;
+  Value Call(const std::string& method, const Value& payload,
+             double timeout_s);
+  ObjectRef RefFromWire(const Value& wire);
+
+  int fd_ = -1;
+  std::mutex mu_;
+  uint64_t next_msg_id_ = 1;
+  std::string job_id_;
+  bool closed_ = false;
+};
+
+}  // namespace ray_tpu

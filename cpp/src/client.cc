@@ -1,0 +1,305 @@
+// ray_tpu C++ client implementation: framed-pickle RPC to the client
+// server (wire format: ray_tpu/_private/protocol.py:13 — 4-byte LE
+// length + pickle of (msg_id, kind, method, payload); kind 0=request
+// 1=reply 2=error 3=push).  Synchronous: one outstanding RPC at a time
+// under a mutex; pushes are drained and ignored.
+#include "../include/ray_tpu/api.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstring>
+#include <stdexcept>
+
+namespace ray_tpu {
+
+namespace {
+
+constexpr uint8_t kRequest = 0;
+constexpr uint8_t kReply = 1;
+constexpr uint8_t kError = 2;
+constexpr uint8_t kPush = 3;
+
+void SendAll(int fd, const char* data, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t w = ::send(fd, data + off, n - off, MSG_NOSIGNAL);
+    if (w <= 0) throw std::runtime_error("ray_tpu: connection lost (send)");
+    off += static_cast<size_t>(w);
+  }
+}
+
+void RecvAll(int fd, char* data, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t r = ::recv(fd, data + off, n - off, 0);
+    if (r <= 0) throw std::runtime_error("ray_tpu: connection lost (recv)");
+    off += static_cast<size_t>(r);
+  }
+}
+
+void SetRecvTimeout(int fd, double timeout_s) {
+  struct timeval tv;
+  tv.tv_sec = static_cast<long>(timeout_s);
+  tv.tv_usec = static_cast<long>((timeout_s - tv.tv_sec) * 1e6);
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
+}  // namespace
+
+std::unique_ptr<Client> Client::Connect(const std::string& host, int port,
+                                        double timeout_s) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) throw std::runtime_error("ray_tpu: socket() failed");
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+
+  struct sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    struct hostent* he = ::gethostbyname(host.c_str());
+    if (he == nullptr || he->h_addrtype != AF_INET) {
+      ::close(fd);
+      throw std::runtime_error("ray_tpu: cannot resolve host " + host);
+    }
+    std::memcpy(&addr.sin_addr, he->h_addr_list[0], sizeof(addr.sin_addr));
+  }
+  if (::connect(fd, reinterpret_cast<struct sockaddr*>(&addr),
+                sizeof(addr)) != 0) {
+    ::close(fd);
+    throw std::runtime_error("ray_tpu: connect to " + host + ":" +
+                             std::to_string(port) + " failed");
+  }
+  auto c = std::unique_ptr<Client>(new Client());
+  c->fd_ = fd;
+  Value hello = c->Call(
+      "c_hello",
+      Value::Dict({{Value::Str("client_id"), Value::Str("cpp-client")}}),
+      timeout_s);
+  const Value* job = hello.get("job_id");
+  if (job != nullptr) c->job_id_ = job->as_str();
+  return c;
+}
+
+Client::~Client() { Close(); }
+
+void Client::Close() {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (closed_) return;
+  closed_ = true;
+  if (fd_ >= 0) {
+    // best-effort goodbye: (0, REQUEST, "c_bye", {}) — notify, no reply
+    try {
+      std::string body = PickleEncoder::Dumps(Value::Tuple(
+          {Value::Int(0), Value::Int(kRequest), Value::Str("c_bye"),
+           Value::Dict({})}));
+      uint32_t len = static_cast<uint32_t>(body.size());
+      char hdr[4];
+      std::memcpy(hdr, &len, 4);
+      SendAll(fd_, hdr, 4);
+      SendAll(fd_, body.data(), body.size());
+    } catch (...) {
+    }
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+Value Client::Call(const std::string& method, const Value& payload,
+                   double timeout_s) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (closed_) throw std::runtime_error("ray_tpu: client closed");
+  uint64_t msg_id = next_msg_id_++;
+  std::string body = PickleEncoder::Dumps(Value::Tuple(
+      {Value::Int(static_cast<int64_t>(msg_id)), Value::Int(kRequest),
+       Value::Str(method), payload}));
+  // Any stream-level failure (send/recv error, timeout mid-frame)
+  // leaves the byte stream desynchronized — poison the connection so
+  // later calls fail cleanly instead of parsing garbage.
+  try {
+    uint32_t len = static_cast<uint32_t>(body.size());
+    char hdr[4];
+    std::memcpy(hdr, &len, 4);  // little-endian on every supported target
+    SendAll(fd_, hdr, 4);
+    SendAll(fd_, body.data(), body.size());
+
+    SetRecvTimeout(fd_, timeout_s + 30.0);
+    while (true) {
+      char lenbuf[4];
+      RecvAll(fd_, lenbuf, 4);
+      uint32_t n;
+      std::memcpy(&n, lenbuf, 4);
+      std::string frame(n, '\0');
+      RecvAll(fd_, frame.data(), n);
+      Value msg = PickleDecoder::Loads(frame);
+      const auto& t = msg.items();
+      if (t.size() != 4)
+        throw std::runtime_error("ray_tpu: malformed frame");
+      int64_t kind = t[1].as_int();
+      if (kind == kPush) continue;  // pubsub pushes: not ours
+      if (static_cast<uint64_t>(t[0].as_int()) != msg_id)
+        continue;  // stale reply from an abandoned call
+      if (kind == kError) {
+        // protocol-level handler error: the stream itself is intact
+        std::string emsg =
+            t[3].kind() == Value::Kind::kStr ? t[3].as_str()
+                                             : std::string("<non-string>");
+        throw RemoteError("ray_tpu remote error: " + emsg);
+      }
+      return t[3];
+    }
+  } catch (const RemoteError&) {
+    throw;
+  } catch (...) {
+    closed_ = true;
+    if (fd_ >= 0) {
+      ::close(fd_);
+      fd_ = -1;
+    }
+    throw;
+  }
+}
+
+ObjectRef Client::RefFromWire(const Value& wire) {
+  const auto& t = wire.items();
+  ObjectRef r;
+  r.id = t[0].as_str();
+  r.owner_addr = t[1];
+  r.owner_id = t[2].as_str();
+  return r;
+}
+
+ObjectRef Client::Put(const Value& v) {
+  Value wire = Call("c_xput", Value::Dict({{Value::Str("value"), v}}), 300.0);
+  return RefFromWire(wire);
+}
+
+Value Client::Get(const ObjectRef& ref, double timeout_s) {
+  std::vector<Value> out = Get(std::vector<ObjectRef>{ref}, timeout_s);
+  return out[0];
+}
+
+std::vector<Value> Client::Get(const std::vector<ObjectRef>& refs,
+                               double timeout_s) {
+  ValueList ids;
+  for (const auto& r : refs) ids.push_back(Value::Str(r.id));
+  Value reply = Call("c_xget",
+                     Value::Dict({{Value::Str("ids"), Value::List(ids)},
+                                  {Value::Str("timeout"),
+                                   Value::Float(timeout_s)}}),
+                     timeout_s);
+  const Value* to = reply.get("timeout");
+  if (to != nullptr && to->kind() == Value::Kind::kBool && to->as_bool())
+    throw std::runtime_error("ray_tpu: get() timed out");
+  const Value* vals = reply.get("values");
+  if (vals == nullptr) throw std::runtime_error("ray_tpu: malformed reply");
+  std::vector<Value> out;
+  for (const auto& v : vals->items()) out.push_back(v);
+  return out;
+}
+
+ObjectRef Client::Submit(const std::string& descriptor, ValueList args,
+                         const SubmitOptions& opts) {
+  if (opts.num_returns != 1)
+    throw std::runtime_error(
+        "ray_tpu: Submit() is single-return; use SubmitN for "
+        "num_returns > 1");
+  return SubmitN(descriptor, std::move(args), opts)[0];
+}
+
+std::vector<ObjectRef> Client::SubmitN(const std::string& descriptor,
+                                       ValueList args,
+                                       const SubmitOptions& opts) {
+  Value resources = opts.resources.empty()
+                        ? Value::None()
+                        : Value::Dict(opts.resources);
+  Value reply = Call(
+      "c_xsubmit_task",
+      Value::Dict({{Value::Str("descriptor"), Value::Str(descriptor)},
+                   {Value::Str("args"), Value::List(args)},
+                   {Value::Str("num_returns"), Value::Int(opts.num_returns)},
+                   {Value::Str("max_retries"), Value::Int(opts.max_retries)},
+                   {Value::Str("resources"), resources},
+                   {Value::Str("name"), Value::Str(opts.name)}}),
+      120.0);
+  std::vector<ObjectRef> out;
+  for (const auto& w : reply.items()) out.push_back(RefFromWire(w));
+  return out;
+}
+
+ActorHandle Client::CreateActor(const std::string& descriptor, ValueList args,
+                                const SubmitOptions& opts) {
+  Value resources = opts.resources.empty()
+                        ? Value::None()
+                        : Value::Dict(opts.resources);
+  Value reply = Call(
+      "c_xcreate_actor",
+      Value::Dict({{Value::Str("descriptor"), Value::Str(descriptor)},
+                   {Value::Str("args"), Value::List(args)},
+                   {Value::Str("resources"), resources},
+                   {Value::Str("name"), Value::Str(opts.name)}}),
+      120.0);
+  ActorHandle h;
+  h.actor_id = reply.as_str();
+  return h;
+}
+
+ObjectRef Client::CallActor(const ActorHandle& actor,
+                            const std::string& method, ValueList args) {
+  Value reply = Call(
+      "c_xsubmit_actor_task",
+      Value::Dict({{Value::Str("actor_id"), Value::Str(actor.actor_id)},
+                   {Value::Str("method"), Value::Str(method)},
+                   {Value::Str("args"), Value::List(args)}}),
+      120.0);
+  return RefFromWire(reply.items()[0]);
+}
+
+void Client::KillActor(const ActorHandle& actor, bool no_restart) {
+  Call("c_xkill_actor",
+       Value::Dict({{Value::Str("actor_id"), Value::Str(actor.actor_id)},
+                    {Value::Str("no_restart"), Value::Bool(no_restart)}}),
+       60.0);
+}
+
+std::vector<std::string> Client::Wait(const std::vector<ObjectRef>& refs,
+                                      int num_returns, double timeout_s) {
+  ValueList ids;
+  for (const auto& r : refs) ids.push_back(Value::Str(r.id));
+  Value reply = Call(
+      "c_xwait",
+      Value::Dict({{Value::Str("ids"), Value::List(ids)},
+                   {Value::Str("num_returns"), Value::Int(num_returns)},
+                   {Value::Str("timeout"), Value::Float(timeout_s)}}),
+      timeout_s + 30.0);
+  const Value* ready = reply.get("ready");
+  std::vector<std::string> out;
+  if (ready != nullptr)
+    for (const auto& v : ready->items()) out.push_back(v.as_str());
+  return out;
+}
+
+void Client::Release(const ObjectRef& ref) {
+  // (0, REQUEST, c_release, ...) notify — no reply expected
+  std::lock_guard<std::mutex> lk(mu_);
+  if (closed_) return;
+  std::string body = PickleEncoder::Dumps(Value::Tuple(
+      {Value::Int(0), Value::Int(kRequest), Value::Str("c_release"),
+       Value::Dict({{Value::Str("ids"),
+                     Value::List({Value::Str(ref.id)})}})}));
+  uint32_t len = static_cast<uint32_t>(body.size());
+  char hdr[4];
+  std::memcpy(hdr, &len, 4);
+  SendAll(fd_, hdr, 4);
+  SendAll(fd_, body.data(), body.size());
+}
+
+}  // namespace ray_tpu

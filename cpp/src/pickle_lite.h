@@ -1,0 +1,526 @@
+// Minimal pickle codec for the ray_tpu C++ client (reference analog:
+// cpp/ user API + msgpack cross-language serialization in
+// python/ray/cross_language.py — foreign languages exchange only plain
+// values; we use the pickle subset those values need since the wire
+// protocol is pickle-framed, see ray_tpu/_private/protocol.py:13).
+//
+// Encodes protocol-4 pickles of plain values (None/bool/int/float/
+// str/bytes/list/tuple/dict) and decodes the opcode subset CPython's
+// pickle.dumps(protocol=5) emits for such values (incl. FRAME,
+// MEMOIZE/BINGET back-references and sets).  Anything outside the plain
+// domain (GLOBAL/REDUCE/...) fails decode with a clear error.
+#pragma once
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace ray_tpu {
+
+class Value;
+using ValueList = std::vector<Value>;
+using ValueDict = std::vector<std::pair<Value, Value>>;
+
+class Value {
+ public:
+  enum class Kind { kNone, kBool, kInt, kFloat, kStr, kBytes, kList,
+                    kTuple, kDict };
+
+  Value() : kind_(Kind::kNone) {}
+  static Value None() { return Value(); }
+  static Value Bool(bool b) { Value v; v.kind_ = Kind::kBool; v.i_ = b; return v; }
+  static Value Int(int64_t i) { Value v; v.kind_ = Kind::kInt; v.i_ = i; return v; }
+  static Value Float(double f) { Value v; v.kind_ = Kind::kFloat; v.f_ = f; return v; }
+  static Value Str(std::string s) { Value v; v.kind_ = Kind::kStr; v.s_ = std::move(s); return v; }
+  static Value Bytes(std::string b) { Value v; v.kind_ = Kind::kBytes; v.s_ = std::move(b); return v; }
+  static Value List(ValueList items) { Value v; v.kind_ = Kind::kList; v.items_ = std::move(items); return v; }
+  static Value Tuple(ValueList items) { Value v; v.kind_ = Kind::kTuple; v.items_ = std::move(items); return v; }
+  static Value Dict(ValueDict d) { Value v; v.kind_ = Kind::kDict; v.dict_ = std::move(d); return v; }
+
+  Kind kind() const { return kind_; }
+  bool is_none() const { return kind_ == Kind::kNone; }
+  bool as_bool() const { check(Kind::kBool); return i_ != 0; }
+  int64_t as_int() const {
+    if (kind_ == Kind::kBool) return i_;
+    check(Kind::kInt);
+    return i_;
+  }
+  double as_float() const {
+    if (kind_ == Kind::kInt) return static_cast<double>(i_);
+    check(Kind::kFloat);
+    return f_;
+  }
+  const std::string& as_str() const { check(Kind::kStr); return s_; }
+  const std::string& as_bytes() const { check(Kind::kBytes); return s_; }
+  const ValueList& items() const {
+    if (kind_ != Kind::kList && kind_ != Kind::kTuple)
+      throw std::runtime_error("pickle_lite: not a sequence");
+    return items_;
+  }
+  const ValueDict& dict() const { check(Kind::kDict); return dict_; }
+
+  // dict["key"] lookup; returns nullptr when missing
+  const Value* get(const std::string& key) const {
+    if (kind_ != Kind::kDict) return nullptr;
+    for (const auto& kv : dict_) {
+      if (kv.first.kind() == Kind::kStr && kv.first.s_ == key)
+        return &kv.second;
+    }
+    return nullptr;
+  }
+
+  bool operator==(const Value& o) const {
+    if (kind_ != o.kind_) return false;
+    switch (kind_) {
+      case Kind::kNone: return true;
+      case Kind::kBool:
+      case Kind::kInt: return i_ == o.i_;
+      case Kind::kFloat: return f_ == o.f_;
+      case Kind::kStr:
+      case Kind::kBytes: return s_ == o.s_;
+      case Kind::kList:
+      case Kind::kTuple: return items_ == o.items_;
+      case Kind::kDict: return dict_ == o.dict_;
+    }
+    return false;
+  }
+
+ private:
+  void check(Kind k) const {
+    if (kind_ != k) throw std::runtime_error("pickle_lite: wrong value kind");
+  }
+  Kind kind_;
+  int64_t i_ = 0;
+  double f_ = 0.0;
+  std::string s_;
+  ValueList items_;
+  ValueDict dict_;
+};
+
+// ---------------------------------------------------------------------------
+// Encoder: protocol-4 pickle of a plain Value (no memoization — the plain
+// value domain has no shared/self references worth preserving).
+// ---------------------------------------------------------------------------
+
+class PickleEncoder {
+ public:
+  static std::string Dumps(const Value& v) {
+    PickleEncoder e;
+    e.out_.push_back('\x80');  // PROTO
+    e.out_.push_back(4);
+    e.Emit(v);
+    e.out_.push_back('.');  // STOP
+    return e.out_;
+  }
+
+ private:
+  void Emit(const Value& v) {
+    switch (v.kind()) {
+      case Value::Kind::kNone:
+        out_.push_back('N');
+        break;
+      case Value::Kind::kBool:
+        out_.push_back(v.as_bool() ? '\x88' : '\x89');
+        break;
+      case Value::Kind::kInt: {
+        int64_t i = v.as_int();
+        if (i >= 0 && i < 256) {
+          out_.push_back('K');
+          out_.push_back(static_cast<char>(i));
+        } else if (i >= 0 && i < 65536) {
+          out_.push_back('M');
+          PutLE(static_cast<uint16_t>(i));
+        } else if (i >= INT32_MIN && i <= INT32_MAX) {
+          out_.push_back('J');
+          PutLE(static_cast<uint32_t>(static_cast<int32_t>(i)));
+        } else {
+          out_.push_back('\x8a');  // LONG1
+          uint8_t buf[9];
+          int n = 0;
+          int64_t x = i;
+          // little-endian two's-complement, minimal length
+          do {
+            buf[n++] = static_cast<uint8_t>(x & 0xff);
+            x >>= 8;
+          } while (x != 0 && x != -1);
+          if ((i > 0 && (buf[n - 1] & 0x80)) ) buf[n++] = 0;
+          if (i < 0 && !(buf[n - 1] & 0x80)) buf[n++] = 0xff;
+          out_.push_back(static_cast<char>(n));
+          out_.append(reinterpret_cast<char*>(buf), n);
+        }
+        break;
+      }
+      case Value::Kind::kFloat: {
+        out_.push_back('G');  // BINFLOAT: big-endian double
+        double d = v.as_float();
+        uint64_t bits;
+        std::memcpy(&bits, &d, 8);
+        for (int b = 7; b >= 0; --b)
+          out_.push_back(static_cast<char>((bits >> (8 * b)) & 0xff));
+        break;
+      }
+      case Value::Kind::kStr: {
+        const std::string& s = v.as_str();
+        if (s.size() < 256) {
+          out_.push_back('\x8c');  // SHORT_BINUNICODE
+          out_.push_back(static_cast<char>(s.size()));
+        } else {
+          out_.push_back('X');  // BINUNICODE
+          PutLE(static_cast<uint32_t>(s.size()));
+        }
+        out_.append(s);
+        break;
+      }
+      case Value::Kind::kBytes: {
+        const std::string& s = v.as_bytes();
+        if (s.size() < 256) {
+          out_.push_back('C');  // SHORT_BINBYTES
+          out_.push_back(static_cast<char>(s.size()));
+        } else {
+          out_.push_back('B');  // BINBYTES
+          PutLE(static_cast<uint32_t>(s.size()));
+        }
+        out_.append(s);
+        break;
+      }
+      case Value::Kind::kList: {
+        out_.push_back(']');  // EMPTY_LIST
+        if (!v.items().empty()) {
+          out_.push_back('(');  // MARK
+          for (const auto& it : v.items()) Emit(it);
+          out_.push_back('e');  // APPENDS
+        }
+        break;
+      }
+      case Value::Kind::kTuple: {
+        const auto& its = v.items();
+        if (its.empty()) {
+          out_.push_back(')');
+        } else if (its.size() <= 3) {
+          for (const auto& it : its) Emit(it);
+          out_.push_back(static_cast<char>('\x85' + its.size() - 1));
+        } else {
+          out_.push_back('(');
+          for (const auto& it : its) Emit(it);
+          out_.push_back('t');  // TUPLE
+        }
+        break;
+      }
+      case Value::Kind::kDict: {
+        out_.push_back('}');  // EMPTY_DICT
+        if (!v.dict().empty()) {
+          out_.push_back('(');
+          for (const auto& kv : v.dict()) {
+            Emit(kv.first);
+            Emit(kv.second);
+          }
+          out_.push_back('u');  // SETITEMS
+        }
+        break;
+      }
+    }
+  }
+
+  template <typename T>
+  void PutLE(T x) {
+    for (size_t b = 0; b < sizeof(T); ++b)
+      out_.push_back(static_cast<char>((x >> (8 * b)) & 0xff));
+  }
+
+  std::string out_;
+};
+
+// ---------------------------------------------------------------------------
+// Decoder for CPython pickle protocol <=5 output restricted to plain values.
+// ---------------------------------------------------------------------------
+
+class PickleDecoder {
+ public:
+  static Value Loads(const std::string& data) {
+    PickleDecoder d(data);
+    return d.Run();
+  }
+
+ private:
+  explicit PickleDecoder(const std::string& data) : data_(data) {}
+
+  // Stack/memo slots share ownership of values: MEMOIZE snapshots the
+  // top-of-stack *object*, which APPENDS/SETITEMS later mutate in place
+  // — a shared container (x=[1]; [x, x]) must decode populated at every
+  // reference, exactly like CPython's memo.
+  struct Slot {
+    bool is_mark = false;
+    std::shared_ptr<Value> v;
+  };
+
+  Value Run() {
+    while (true) {
+      uint8_t op = U8();
+      switch (op) {
+        case 0x80:  // PROTO
+          U8();
+          break;
+        case 0x95:  // FRAME (8-byte length, advisory)
+          Skip(8);
+          break;
+        case 0x94:  // MEMOIZE
+          memo_.push_back(Top().v);  // shares the object, not a copy
+          break;
+        case 'h': {  // BINGET
+          uint8_t idx = U8();
+          PushP(MemoAt(idx));
+          break;
+        }
+        case 'j': {  // LONG_BINGET
+          uint32_t idx = LE32();
+          PushP(MemoAt(idx));
+          break;
+        }
+        case 'q':  // BINPUT (protocols <=3)
+          MemoPut(U8());
+          break;
+        case 'r':  // LONG_BINPUT
+          MemoPut(LE32());
+          break;
+        case 'N':
+          PushV(Value::None());
+          break;
+        case 0x88:
+          PushV(Value::Bool(true));
+          break;
+        case 0x89:
+          PushV(Value::Bool(false));
+          break;
+        case 'K':
+          PushV(Value::Int(U8()));
+          break;
+        case 'M':
+          PushV(Value::Int(LE16()));
+          break;
+        case 'J':
+          PushV(Value::Int(static_cast<int32_t>(LE32())));
+          break;
+        case 0x8a: {  // LONG1
+          uint8_t n = U8();
+          if (n > 8)
+            throw std::runtime_error("pickle_lite: LONG1 too wide");
+          int64_t x = 0;
+          for (int b = 0; b < n; ++b)
+            x |= static_cast<int64_t>(U8()) << (8 * b);
+          if (n > 0 && n < 8 && (x & (1LL << (8 * n - 1))))
+            x -= 1LL << (8 * n);  // sign-extend
+          PushV(Value::Int(x));
+          break;
+        }
+        case 'G': {  // BINFLOAT big-endian
+          uint64_t bits = 0;
+          for (int b = 0; b < 8; ++b) bits = (bits << 8) | U8();
+          double d;
+          std::memcpy(&d, &bits, 8);
+          PushV(Value::Float(d));
+          break;
+        }
+        case 0x8c: {  // SHORT_BINUNICODE
+          uint8_t n = U8();
+          PushV(Value::Str(Read(n)));
+          break;
+        }
+        case 'X':
+          PushV(Value::Str(Read(LE32())));
+          break;
+        case 0x8d:  // BINUNICODE8
+          PushV(Value::Str(Read(LE64())));
+          break;
+        case 'C': {  // SHORT_BINBYTES
+          uint8_t n = U8();
+          PushV(Value::Bytes(Read(n)));
+          break;
+        }
+        case 'B':
+          PushV(Value::Bytes(Read(LE32())));
+          break;
+        case 0x8e:  // BINBYTES8
+          PushV(Value::Bytes(Read(LE64())));
+          break;
+        case 0x96: {  // BYTEARRAY8 -> bytes
+          PushV(Value::Bytes(Read(LE64())));
+          break;
+        }
+        case ']':
+          PushV(Value::List({}));
+          break;
+        case ')':
+          PushV(Value::Tuple({}));
+          break;
+        case '}':
+          PushV(Value::Dict({}));
+          break;
+        case 0x8f:  // EMPTY_SET -> list
+          PushV(Value::List({}));
+          break;
+        case '(':  // MARK
+          stack_.push_back(Slot{true, nullptr});
+          break;
+        case 'a': {  // APPEND
+          Value item = PopV();
+          AppendTo(*Top().v, {item});
+          break;
+        }
+        case 'e': {  // APPENDS
+          ValueList items = PopToMark();
+          AppendTo(*Top().v, items);
+          break;
+        }
+        case 0x90: {  // ADDITEMS (set) -> list
+          ValueList items = PopToMark();
+          AppendTo(*Top().v, items);
+          break;
+        }
+        case 's': {  // SETITEM
+          Value val = PopV();
+          Value key = PopV();
+          SetItems(*Top().v, {key, val});
+          break;
+        }
+        case 'u': {  // SETITEMS
+          ValueList kvs = PopToMark();
+          SetItems(*Top().v, kvs);
+          break;
+        }
+        case 0x85: {  // TUPLE1
+          Value a = PopV();
+          PushV(Value::Tuple({a}));
+          break;
+        }
+        case 0x86: {  // TUPLE2
+          Value b = PopV();
+          Value a = PopV();
+          PushV(Value::Tuple({a, b}));
+          break;
+        }
+        case 0x87: {  // TUPLE3
+          Value c = PopV();
+          Value b = PopV();
+          Value a = PopV();
+          PushV(Value::Tuple({a, b, c}));
+          break;
+        }
+        case 't': {  // TUPLE
+          ValueList items = PopToMark();
+          PushV(Value::Tuple(items));
+          break;
+        }
+        case '.':  // STOP
+          return PopV();
+        default:
+          throw std::runtime_error(
+              "pickle_lite: unsupported opcode 0x" + Hex(op) +
+              " (non-plain value in cross-language payload?)");
+      }
+    }
+  }
+
+  // -- stack helpers --------------------------------------------------------
+  void PushV(Value v) {
+    stack_.push_back(Slot{false, std::make_shared<Value>(std::move(v))});
+  }
+  void PushP(std::shared_ptr<Value> p) {
+    stack_.push_back(Slot{false, std::move(p)});
+  }
+  Slot& Top() {
+    if (stack_.empty() || stack_.back().is_mark || !stack_.back().v)
+      throw std::runtime_error("pickle_lite: stack underflow");
+    return stack_.back();
+  }
+  Value PopV() {
+    if (stack_.empty()) throw std::runtime_error("pickle_lite: stack underflow");
+    Slot s = stack_.back();
+    if (s.is_mark) throw std::runtime_error("pickle_lite: unexpected MARK");
+    stack_.pop_back();
+    return *s.v;  // copy out: containers snapshot fully-built members
+  }
+  ValueList PopToMark() {
+    ValueList out;
+    while (!stack_.empty() && !stack_.back().is_mark) {
+      out.push_back(*stack_.back().v);
+      stack_.pop_back();
+    }
+    if (stack_.empty()) throw std::runtime_error("pickle_lite: missing MARK");
+    stack_.pop_back();  // the mark
+    std::reverse(out.begin(), out.end());
+    return out;
+  }
+  static void AppendTo(Value& target, const ValueList& items) {
+    if (target.kind() != Value::Kind::kList)
+      throw std::runtime_error("pickle_lite: APPEND to non-list");
+    ValueList merged = target.items();
+    merged.insert(merged.end(), items.begin(), items.end());
+    target = Value::List(std::move(merged));  // in place: memo sees it
+  }
+  static void SetItems(Value& target, const ValueList& kvs) {
+    if (target.kind() != Value::Kind::kDict)
+      throw std::runtime_error("pickle_lite: SETITEMS on non-dict");
+    if (kvs.size() % 2)
+      throw std::runtime_error("pickle_lite: odd SETITEMS");
+    ValueDict d = target.dict();
+    for (size_t i = 0; i < kvs.size(); i += 2)
+      d.emplace_back(kvs[i], kvs[i + 1]);
+    target = Value::Dict(std::move(d));
+  }
+  std::shared_ptr<Value> MemoAt(size_t i) {
+    if (i >= memo_.size() || !memo_[i])
+      throw std::runtime_error("pickle_lite: memo miss");
+    return memo_[i];
+  }
+  void MemoPut(size_t i) {
+    if (memo_.size() <= i) memo_.resize(i + 1);
+    memo_[i] = Top().v;
+  }
+
+  // -- input helpers --------------------------------------------------------
+  uint8_t U8() {
+    if (pos_ >= data_.size())
+      throw std::runtime_error("pickle_lite: truncated pickle");
+    return static_cast<uint8_t>(data_[pos_++]);
+  }
+  uint16_t LE16() { uint16_t x = U8(); x |= static_cast<uint16_t>(U8()) << 8; return x; }
+  uint32_t LE32() {
+    uint32_t x = 0;
+    for (int b = 0; b < 4; ++b) x |= static_cast<uint32_t>(U8()) << (8 * b);
+    return x;
+  }
+  uint64_t LE64() {
+    uint64_t x = 0;
+    for (int b = 0; b < 8; ++b) x |= static_cast<uint64_t>(U8()) << (8 * b);
+    return x;
+  }
+  std::string Read(uint64_t n) {
+    if (pos_ + n > data_.size())
+      throw std::runtime_error("pickle_lite: truncated string");
+    std::string s = data_.substr(pos_, n);
+    pos_ += n;
+    return s;
+  }
+  void Skip(size_t n) {
+    if (pos_ + n > data_.size())
+      throw std::runtime_error("pickle_lite: truncated pickle");
+    pos_ += n;
+  }
+  static std::string Hex(uint8_t b) {
+    static const char* digits = "0123456789abcdef";
+    return std::string(1, digits[b >> 4]) + std::string(1, digits[b & 0xf]);
+  }
+
+  const std::string& data_;
+  size_t pos_ = 0;
+  std::vector<Slot> stack_;
+  std::vector<std::shared_ptr<Value>> memo_;
+};
+
+}  // namespace ray_tpu

@@ -88,6 +88,17 @@ class ClientServer:
         s.handle("c_stream_next", self.h_stream_next, deferred=True)
         s.handle("c_stream_done", self.h_stream_done)
         s.handle("c_stream_release", self.h_stream_release)
+        # cross-language surface (C++ client, cpp/): descriptor-named
+        # functions, plain-value args/results — the same restriction the
+        # reference places on cross-language calls (cross_language.py)
+        s.handle("c_xput", self.h_xput, deferred=True)
+        s.handle("c_xget", self.h_xget, deferred=True)
+        s.handle("c_xsubmit_task", self.h_xsubmit_task, deferred=True)
+        s.handle("c_xcreate_actor", self.h_xcreate_actor, deferred=True)
+        s.handle("c_xsubmit_actor_task", self.h_xsubmit_actor_task,
+                 deferred=True)
+        s.handle("c_xwait", self.h_xwait, deferred=True)
+        s.handle("c_xkill_actor", self.h_xkill_actor, deferred=True)
         s.handle("c_control", self.h_control, deferred=True)
         s.handle("c_control_notify", self.h_control_notify)
         s.on_disconnect(self._drop_conn)
@@ -303,6 +314,141 @@ class ClientServer:
                 for oid in p.get("ids", ()):
                     table.pop(oid, None)
         return True
+
+    # -- cross-language handlers (C++ user API, cpp/) ----------------------
+
+    def _xdeferred(self, d: Deferred, fn):
+        """Like _deferred but errors travel as protocol-level ERROR
+        frames (plain strings) — foreign clients can't unpickle an
+        exception blob."""
+
+        def run():
+            try:
+                d.resolve(fn())
+            except BaseException as e:
+                d.reject(f"{type(e).__name__}: {e}")
+
+        self.pool.submit(run)
+
+    @staticmethod
+    def _resolve_descriptor(descriptor: str):
+        """ "pkg.mod:qualname" (or dotted fallback) -> Python object."""
+        import importlib
+
+        if ":" in descriptor:
+            mod_name, qual = descriptor.split(":", 1)
+        else:
+            mod_name, _, qual = descriptor.rpartition(".")
+            if not mod_name:
+                raise ValueError(
+                    f"bad cross-language descriptor {descriptor!r}; "
+                    f"expected 'pkg.mod:qualname'")
+        obj = importlib.import_module(mod_name)
+        for part in qual.split("."):
+            obj = getattr(obj, part)
+        return obj
+
+    @staticmethod
+    def _check_plain(value, where: str):
+        """Cross-language values must survive a foreign decoder."""
+        if value is None or isinstance(value, (bool, int, float, str,
+                                               bytes)):
+            return
+        if isinstance(value, (list, tuple, set)):
+            for v in value:
+                ClientServer._check_plain(v, where)
+            return
+        if isinstance(value, dict):
+            for k, v in value.items():
+                ClientServer._check_plain(k, where)
+                ClientServer._check_plain(v, where)
+            return
+        raise TypeError(
+            f"cross-language {where} must be plain "
+            f"(None/bool/int/float/str/bytes/list/tuple/dict), "
+            f"got {type(value).__name__}")
+
+    def h_xput(self, conn, p, d: Deferred):
+        def run():
+            self._check_plain(p["value"], "put value")
+            ref = self.core.put(p["value"])
+            self._pin(conn, [ref])
+            return _wire(ref)
+
+        self._xdeferred(d, run)
+
+    def h_xget(self, conn, p, d: Deferred):
+        def run():
+            refs = self._refs_from_ids(conn, p["ids"])
+            try:
+                values = self.core.get(refs, timeout=p.get("timeout"))
+            except GetTimeoutError:
+                return {"timeout": True}
+            self._check_plain(values, "task result")
+            return {"values": values}
+
+        self._xdeferred(d, run)
+
+    def h_xsubmit_task(self, conn, p, d: Deferred):
+        def run():
+            fn = self._resolve_descriptor(p["descriptor"])
+            args = tuple(p.get("args") or ())
+            self._check_plain(list(args), "task args")
+            resources = p.get("resources")
+            refs = self.core.submit_task(
+                fn, args, {},
+                num_returns=p.get("num_returns", 1),
+                resources=dict(resources) if resources else None,
+                max_retries=p.get("max_retries", 3),
+                name=p.get("name") or "")
+            self._pin(conn, refs)
+            return [_wire(r) for r in refs]
+
+        self._xdeferred(d, run)
+
+    def h_xcreate_actor(self, conn, p, d: Deferred):
+        def run():
+            cls = self._resolve_descriptor(p["descriptor"])
+            args = tuple(p.get("args") or ())
+            self._check_plain(list(args), "actor args")
+            resources = p.get("resources")
+            return self.core.create_actor(
+                cls, args, {},
+                resources=dict(resources) if resources else None,
+                name=p.get("name") or None)
+
+        self._xdeferred(d, run)
+
+    def h_xsubmit_actor_task(self, conn, p, d: Deferred):
+        def run():
+            args = tuple(p.get("args") or ())
+            self._check_plain(list(args), "actor task args")
+            refs = self.core.submit_actor_task(
+                p["actor_id"], p["method"], args, {})
+            self._pin(conn, refs)
+            return [_wire(r) for r in refs]
+
+        self._xdeferred(d, run)
+
+    def h_xwait(self, conn, p, d: Deferred):
+        """Like c_wait, but failures travel as ERROR frames a foreign
+        client can read (c_wait's _error_reply is an unpicklable-to-C++
+        blob that would read as an empty ready list)."""
+
+        def run():
+            refs = self._refs_from_ids(conn, p["ids"])
+            num_returns = p.get("num_returns", 1)
+            if num_returns > len(refs):
+                raise ValueError("num_returns > len(refs)")
+            ready, _ = self.core.wait(refs, num_returns=num_returns,
+                                      timeout=p.get("timeout"))
+            return {"ready": [r.id for r in ready]}
+
+        self._xdeferred(d, run)
+
+    def h_xkill_actor(self, conn, p, d: Deferred):
+        self._xdeferred(d, lambda: self.core.kill_actor(
+            p["actor_id"], no_restart=p.get("no_restart", True)))
 
     def h_control(self, conn, p, d: Deferred):
         self._deferred(d, lambda: self.core.control.call(

@@ -20,7 +20,6 @@ partitions in threads).
 from __future__ import annotations
 
 import os
-import socket
 import subprocess
 import sys
 import threading
@@ -31,15 +30,14 @@ from typing import Dict, Optional, Tuple
 MAX_NUM_WORKER_NODES = -1
 
 _active_cluster: Optional["RayClusterOnSpark"] = None
+_setup_in_progress = False
 _lock = threading.Lock()
 
 
 def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    from ray_tpu._private.protocol import free_port
+
+    return free_port()
 
 
 def _driver_host() -> str:
@@ -140,7 +138,11 @@ class RayClusterOnSpark:
             except Exception:
                 pass
         self._job_thread.join(timeout=30.0)
-        os.environ.pop("RAY_TPU_ADDRESS", None)
+        # only clear the env we exported: a failed setup (or a user
+        # pointing at some other cluster) must not lose their address
+        if os.environ.get("RAY_TPU_ADDRESS") == self.client_address \
+                and self.client_address:
+            os.environ.pop("RAY_TPU_ADDRESS", None)
 
 
 def _spawn_head(host: str, num_cpus_head_node: Optional[float],
@@ -216,12 +218,48 @@ def setup_ray_cluster(
     num_tpus_worker_node is the TPU-native analog of the reference's
     num_gpus_worker_node — it becomes each worker raylet's TPU resource.
     """
-    global _active_cluster
+    global _active_cluster, _setup_in_progress
     with _lock:
-        if _active_cluster is not None and not _active_cluster._shutdown:
+        if _setup_in_progress or (
+                _active_cluster is not None
+                and not _active_cluster._shutdown):
             raise RuntimeError(
-                "an active ray_tpu-on-spark cluster exists; call "
-                "shutdown_ray_cluster() first")
+                "an active ray_tpu-on-spark cluster (or setup in "
+                "progress) exists; call shutdown_ray_cluster() first")
+        _setup_in_progress = True
+    try:
+        return _setup_ray_cluster_locked(
+            max_worker_nodes=max_worker_nodes,
+            min_worker_nodes=min_worker_nodes,
+            num_cpus_worker_node=num_cpus_worker_node,
+            num_cpus_head_node=num_cpus_head_node,
+            num_tpus_worker_node=num_tpus_worker_node,
+            head_node_options=head_node_options,
+            worker_node_options=worker_node_options,
+            ray_temp_root_dir=ray_temp_root_dir,
+            strict_mode=strict_mode,
+            collect_log_to_path=collect_log_to_path,
+            spark=spark)
+    finally:
+        with _lock:
+            _setup_in_progress = False
+
+
+def _setup_ray_cluster_locked(
+    *,
+    max_worker_nodes: int,
+    min_worker_nodes: Optional[int],
+    num_cpus_worker_node: Optional[float],
+    num_cpus_head_node: Optional[float],
+    num_tpus_worker_node: Optional[float],
+    head_node_options: Optional[Dict],
+    worker_node_options: Optional[Dict],
+    ray_temp_root_dir: Optional[str],
+    strict_mode: bool,
+    collect_log_to_path: Optional[str],
+    spark,
+) -> Tuple[str, str]:
+    global _active_cluster
     if spark is None:
         try:
             from pyspark.sql import SparkSession

@@ -1,0 +1,96 @@
+"""C++ user API (cpp/) end-to-end: pickle-lite wire interop + the
+cross-language handlers on the client server (reference analog: cpp/
+user API tests over the C++ worker; ours is a cross-language client
+speaking the framed protocol directly)."""
+
+import os
+import pickle
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS_DIR = os.path.join(REPO, "tests")
+
+
+@pytest.fixture(scope="module")
+def smoke_bin():
+    sys.path.insert(0, os.path.join(REPO, "cpp"))
+    try:
+        from build import build_smoke  # type: ignore
+    finally:
+        sys.path.pop(0)
+    return build_smoke()
+
+
+@pytest.fixture()
+def xlang_cluster(monkeypatch):
+    """Cluster + ClientServer whose workers can import tests/xlang_mod."""
+    from ray_tpu._private import core as core_mod
+    from ray_tpu._private.bootstrap import Cluster
+    from ray_tpu.util.client import ClientServer
+
+    existing = os.environ.get("PYTHONPATH", "")
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        TESTS_DIR + (os.pathsep + existing if existing else ""))
+    sys.path.insert(0, TESTS_DIR)
+
+    prev_core = ray_tpu._core
+    prev_cur = core_mod._current_core
+
+    c = Cluster()
+    c.start_control()
+    c.add_node(resources={"CPU": 2})
+    srv = ClientServer(c.control_addr, port=0)
+    srv.start()
+    yield srv.addr
+    srv.stop()
+    c.shutdown()
+    sys.path.remove(TESTS_DIR)
+    ray_tpu._core = prev_core
+    core_mod._current_core = prev_cur
+
+
+def test_pickle_lite_interop(smoke_bin):
+    """The binary exists => pickle_lite compiled; verify Python-side
+    decode of what our encoder-equivalent produces by loading protocol-4
+    pickles of the domain values (the smoke binary itself exercises the
+    C++ side of both directions against the live server)."""
+    # values whose pickles the C++ decoder must parse (protocol 5 output)
+    domain = [None, True, False, 0, 255, 65535, -5, 1 << 40, -(1 << 40),
+              3.25, "héllo", b"\x00\x01\xff", [1, [2, 3]], (1, "a", None),
+              {"k": [1, 2], "n": {"x": b"b"}}, [], (), {}]
+    for v in domain:
+        blob = pickle.dumps(v, protocol=5)
+        assert pickle.loads(blob) == v  # sanity; C++ parse is in smoke
+
+
+def test_cpp_smoke_end_to_end(smoke_bin, xlang_cluster):
+    host, port = xlang_cluster
+    proc = subprocess.run(
+        [smoke_bin, host, str(port), "xlang_mod"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (
+        f"stdout={proc.stdout!r} stderr={proc.stderr!r}")
+    assert "CPP_SMOKE_OK" in proc.stdout
+
+
+def test_xlang_handlers_reject_non_plain(xlang_cluster):
+    """A Python driver putting a non-plain object then a foreign c_xget
+    must get a clean error, not an undecodable pickle."""
+    from ray_tpu.util.client.server import ClientServer
+
+    assert ClientServer._resolve_descriptor("xlang_mod:add")(2, 2) == 4
+    with pytest.raises(Exception):
+        ClientServer._resolve_descriptor("xlang_mod")  # no qualname
+    with pytest.raises(TypeError, match="plain"):
+        ClientServer._check_plain(object(), "task args")
+    # numpy arrays are not plain either
+    import numpy as np
+
+    with pytest.raises(TypeError, match="plain"):
+        ClientServer._check_plain(np.zeros(3), "task result")

@@ -1,0 +1,31 @@
+"""Functions/classes the C++ client calls by descriptor ("xlang_mod:add").
+Importable by the client server (driver) and by worker processes via
+PYTHONPATH (the test fixture exports this directory)."""
+
+
+def add(a, b):
+    return a + b
+
+
+def echo(x):
+    return x
+
+
+def boom():
+    raise ValueError("xlang-boom")
+
+
+class Counter:
+    def __init__(self, start=0):
+        self.v = start
+
+    def inc(self, n=1):
+        self.v += n
+        return self.v
+
+
+def shared():
+    """Same list referenced twice: its pickle uses memo back-references
+    (BINGET), the case the C++ decoder must share, not copy-empty."""
+    x = [1, 2]
+    return [x, x]
