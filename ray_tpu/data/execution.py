@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import collections
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -32,10 +32,17 @@ from .context import DataContext
 @dataclass
 class RefBundle:
     """A block reference + its metadata (reference:
-    _internal/execution/interfaces/ref_bundle.py)."""
+    _internal/execution/interfaces/ref_bundle.py).
+
+    `order` is the bundle's logical position (lexicographic): assigned
+    at sources, carried 1:1 through maps, re-based by Union/AllToAll.
+    Tasks complete out of order under load, so any operator whose
+    semantics depend on row order (Zip) must sort by it — buffering in
+    arrival order silently mispairs rows."""
 
     block_ref: Any  # ObjectRef[Block]
     metadata: BlockMetadata
+    order: Tuple[int, ...] = ()
 
 
 # ---------------------------------------------------------------------------
@@ -314,6 +321,8 @@ class _StreamRec:
     gen: Any                  # ObjectRefGenerator
     op: "PhysicalOperator"
     pending: List[Any] = field(default_factory=list)
+    base_order: Tuple[int, ...] = ()  # prefix for yielded bundles' order
+    item_idx: int = 0
 
 
 class PhysicalOperator:
@@ -368,8 +377,11 @@ class InputOperator(PhysicalOperator):
 
     def __init__(self, bundles: List[RefBundle]):
         super().__init__("Input", num_inputs=0)
-        for b in bundles:
-            self.out_queue.append(b)
+        for i, b in enumerate(bundles):
+            # list position IS the logical order here; re-base every
+            # bundle (copies: callers own these objects, and carried
+            # keys from a prior execution must not mix with fresh ones)
+            self.out_queue.append(replace(b, order=(i,)))
         self.finished = True
 
     def all_inputs_done(self):
@@ -382,6 +394,7 @@ class ReadOperator(PhysicalOperator):
         self._pending = collections.deque(read_tasks)
         self._chain = chain
         self._resources = resources
+        self._next_idx = 0
 
     def all_inputs_done(self):
         return True
@@ -393,6 +406,8 @@ class ReadOperator(PhysicalOperator):
         if not self._pending:
             return []
         rt = self._pending.popleft()
+        task_idx = self._next_idx
+        self._next_idx += 1
         self.active += 1
         self.stats["tasks"] += 1
         ctx = DataContext.get_current()
@@ -401,14 +416,14 @@ class ReadOperator(PhysicalOperator):
                          num_returns="streaming",
                          resources=self._resources,
                          name=f"data:{self.name}")
-            return [_StreamRec(gen, self)]
+            return [_StreamRec(gen, self, base_order=(task_idx,))]
         refs = submit(_read_task, (rt, self._chain), num_returns=2,
                       resources=self._resources, name=f"data:{self.name}")
 
         def on_done(rec: _TaskRec):
             self.active -= 1
             meta = ray_tpu.get(rec.refs[1], timeout=300)
-            self._emit(RefBundle(rec.refs[0], meta))
+            self._emit(RefBundle(rec.refs[0], meta, order=(task_idx, 0)))
             self.maybe_finish()
 
         return [_TaskRec(refs, on_done)]
@@ -426,6 +441,7 @@ class MapOperator(PhysicalOperator):
         if not self.in_queues[0]:
             return []
         bundle: RefBundle = self.in_queues[0].popleft()
+        order = bundle.order
         self.active += 1
         self.stats["tasks"] += 1
         ctx = DataContext.get_current()
@@ -435,7 +451,7 @@ class MapOperator(PhysicalOperator):
                          num_returns="streaming",
                          resources=self._resources,
                          name=f"data:{self.name}")
-            return [_StreamRec(gen, self)]
+            return [_StreamRec(gen, self, base_order=order)]
         refs = submit(_map_task, (self._chain, bundle.block_ref),
                       num_returns=2, resources=self._resources,
                       name=f"data:{self.name}")
@@ -443,7 +459,7 @@ class MapOperator(PhysicalOperator):
         def on_done(rec: _TaskRec):
             self.active -= 1
             meta = ray_tpu.get(rec.refs[1], timeout=300)
-            self._emit(RefBundle(rec.refs[0], meta))
+            self._emit(RefBundle(rec.refs[0], meta, order=order))
             self.maybe_finish()
 
         return [_TaskRec(refs, on_done)]
@@ -465,6 +481,7 @@ class LimitOperator(PhysicalOperator):
                 continue
             take = self._remaining
             self._remaining = 0
+            order = bundle.order
             refs = submit(_slice_task, (take, bundle.block_ref),
                           num_returns=2, name=f"data:{self.name}")
             self.active += 1
@@ -473,7 +490,7 @@ class LimitOperator(PhysicalOperator):
             def on_done(rec: _TaskRec):
                 self.active -= 1
                 meta = ray_tpu.get(rec.refs[1], timeout=300)
-                self._emit(RefBundle(rec.refs[0], meta))
+                self._emit(RefBundle(rec.refs[0], meta, order=order))
                 self.maybe_finish()
 
             recs.append(_TaskRec(refs, on_done))
@@ -502,9 +519,13 @@ class UnionOperator(PhysicalOperator):
         super().__init__("Union", num_inputs=n)
 
     def try_submit(self, submit) -> List[_TaskRec]:
-        for q in self.in_queues:
+        for side, q in enumerate(self.in_queues):
             while q:
-                self._emit(q.popleft())
+                b = q.popleft()
+                # re-base a COPY: side-0 rows precede side-1 rows; the
+                # original object may be shared with another consumer
+                # (diamond DAG) whose sort keys must not change
+                self._emit(replace(b, order=(side,) + b.order))
         self.maybe_finish()
         return []
 
@@ -518,6 +539,13 @@ class ZipOperator(PhysicalOperator):
         self._right: List[RefBundle] = []
         self._planned = False
 
+    def has_work(self) -> bool:
+        # buffered-but-unplanned bundles are work: without this the
+        # done-propagation sweep sees empty in_queues + active==0 and
+        # finishes the op before it ever plans (zip returned 0 rows)
+        return super().has_work() or (
+            bool(self._left or self._right) and not self._planned)
+
     def try_submit(self, submit) -> List[_TaskRec]:
         while self.in_queues[0]:
             self._left.append(self.in_queues[0].popleft())
@@ -527,6 +555,10 @@ class ZipOperator(PhysicalOperator):
             self.maybe_finish()
             return []
         self._planned = True
+        # arrival order is completion order; row alignment needs logical
+        # order (the flake: zip under load paired id 5-9 with other 100-104)
+        self._left.sort(key=lambda b: b.order)
+        self._right.sort(key=lambda b: b.order)
         lrows = sum(b.metadata.num_rows for b in self._left)
         rrows = sum(b.metadata.num_rows for b in self._right)
         if lrows != rrows:
@@ -567,10 +599,10 @@ class ZipOperator(PhysicalOperator):
             self.active += 1
             self.stats["tasks"] += 1
 
-            def on_done(rec: _TaskRec):
+            def on_done(rec: _TaskRec, order=lb.order):
                 self.active -= 1
                 meta = ray_tpu.get(rec.refs[1], timeout=300)
-                self._emit(RefBundle(rec.refs[0], meta))
+                self._emit(RefBundle(rec.refs[0], meta, order=order))
                 self.maybe_finish()
 
             recs.append(_TaskRec(refs, on_done))
@@ -674,7 +706,7 @@ class AllToAllOperator(PhysicalOperator):
             if self.kind == "shuffle" and self.shuffle_blocks:
                 rng = np.random.RandomState(self.seed)
                 rng.shuffle(order)
-            for j in order:
+            for rank, j in enumerate(order):
                 part_refs = [self._parts[i][j]
                              for i in range(len(self._bundles))]
                 refs = submit(_reduce_task, (rspec, *part_refs),
@@ -683,10 +715,12 @@ class AllToAllOperator(PhysicalOperator):
                 self.active += 1
                 self.stats["tasks"] += 1
 
-                def on_done(rec: _TaskRec):
+                def on_done(rec: _TaskRec, rank=rank):
                     self.active -= 1
                     meta = ray_tpu.get(rec.refs[1], timeout=300)
-                    self._emit(RefBundle(rec.refs[0], meta))
+                    # rank is the output's logical position (sorted range
+                    # j for sort; the shuffled sequence for shuffle)
+                    self._emit(RefBundle(rec.refs[0], meta, order=(rank,)))
                     if self.active == 0 and self._phase == "done_wait":
                         self.finished = True
 
@@ -926,7 +960,10 @@ class StreamingExecutor:
                     block_ref, meta_ref = srec.pending
                     srec.pending = []
                     meta = ray_tpu.get(meta_ref, timeout=300)
-                    srec.op._emit(RefBundle(block_ref, meta))
+                    srec.op._emit(RefBundle(
+                        block_ref, meta,
+                        order=srec.base_order + (srec.item_idx,)))
+                    srec.item_idx += 1
                     progressed = True
         return progressed
 

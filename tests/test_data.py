@@ -321,3 +321,37 @@ def test_iter_torch_batches(ray_cluster):
     b = next(iter(rd.range(8).iter_torch_batches(
         batch_size=8, dtypes={"id": torch.float32})))
     assert b["id"].dtype == torch.float32
+
+
+def test_zip_pairs_despite_out_of_order_completion(ray_cluster):
+    """Tasks finish out of order under load; zip must align rows by
+    logical block order, not arrival order (regression: full-suite flake
+    where id 5-9 paired with other 100-104)."""
+    import time as _t
+
+    a = rd.range(10, override_num_blocks=2)
+
+    def slow_first(batch):
+        if 0 in list(batch["id"]):
+            _t.sleep(1.5)  # first block completes last
+        return {"other": batch["id"] + 100}
+
+    b = rd.range(10, override_num_blocks=2).map_batches(slow_first)
+    rows = sorted(a.zip(b).take_all(), key=lambda r: r["id"])
+    assert len(rows) == 10
+    assert [r["other"] for r in rows] == [100 + i for i in range(10)]
+
+
+def test_diamond_zip_out_of_order(ray_cluster):
+    import time as _t
+
+    base = rd.range(32, override_num_blocks=4).random_shuffle()
+
+    def jitter(r):
+        if r["id"] % 7 == 0:
+            _t.sleep(0.05)
+        return {"id2": r["id"] * 10}
+
+    rows = base.zip(base.map(jitter)).take_all()
+    assert len(rows) == 32
+    assert all(r["id"] * 10 == r["id2"] for r in rows)
