@@ -12,7 +12,7 @@ from __future__ import annotations
 import os
 import pickle
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, fields as dataclass_fields
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -180,10 +180,18 @@ class TaskSpec:
             return []
         return [object_id_for_return(self.task_id, i) for i in range(self.num_returns)]
 
+    def __reduce__(self):
+        # positional-tuple pickling: specs cross the wire once per task,
+        # and the default dataclass reduce re-pickles all 20+ field-name
+        # strings in every frame
+        return (TaskSpec, tuple(getattr(self, n) for n in _SPEC_FIELDS))
+
 
 # num_returns sentinel for streaming-generator tasks (reference:
 # num_returns="streaming" -> ObjectRefGenerator, _raylet.pyx:281)
 STREAMING_RETURNS = -1
+
+_SPEC_FIELDS = tuple(f.name for f in dataclass_fields(TaskSpec))
 
 
 class SerializedRef:

@@ -1084,7 +1084,11 @@ class CoreWorker:
     # normal task submission
     # ------------------------------------------------------------------
 
+    _EMPTY_ARGS_BLOB = serialization.dumps_inline(((), {}))
+
     def serialize_args(self, args, kwargs) -> bytes:
+        if not args and not kwargs:
+            return self._EMPTY_ARGS_BLOB  # no-arg calls skip pickling
         return serialization.dumps_inline((args, kwargs))
 
     def submit_task(self, fn, args, kwargs, *, num_returns=1, resources=None,
@@ -1320,17 +1324,13 @@ class CoreWorker:
                 self._pump(pool)
 
     def _push_task(self, lw: LeasedWorker, rec: TaskRecord, pool: SchedPool):
-        fut = lw.client.call_async("push_task", rec.spec)
-
-        def on_done(f):
-            try:
-                reply = f.result()
-            except (ConnectionLost, RpcError) as e:
-                self._on_task_failure(pool, lw, rec, e)
+        def on_reply(reply, exc):
+            if exc is not None:
+                self._on_task_failure(pool, lw, rec, exc)
                 return
             self._on_task_reply(pool, lw, rec, reply)
 
-        fut.add_done_callback(on_done)
+        lw.client.call_cb("push_task", rec.spec, on_reply)
 
     def _on_task_reply(self, pool, lw: LeasedWorker, rec: TaskRecord, reply):
         with self.lock:

@@ -40,6 +40,22 @@ class RpcError(Exception):
     """Remote handler raised; carries the remote traceback string."""
 
 
+class _CbSlot:
+    """Raw-callback inflight slot (call_cb); lighter than a Future."""
+
+    __slots__ = ("fn",)
+
+    def __init__(self, fn):
+        self.fn = fn
+
+
+def _invoke(cb, value, exc) -> None:
+    try:
+        cb(value, exc)
+    except Exception:
+        logger.exception("reply callback failed")
+
+
 class ConnectionLost(Exception):
     """Peer went away before replying."""
 
@@ -175,22 +191,45 @@ class Client:
 
     def call_async(self, method: str, payload: Any = None) -> Future:
         fut: Future = Future()
+
+        def fill(value, exc):
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(value)
+
+        self.call_cb(method, payload, fill)
+        return fut
+
+    def call_cb(self, method: str, payload: Any,
+                cb: Callable[[Any, Optional[BaseException]], None]) -> None:
+        """Request whose reply invokes cb(payload, exc) directly on the
+        read thread — the task-push hot path uses this to skip a Future
+        allocation + lock round + done-callback machinery per task.
+
+        Exactly-once delivery: every completion path (reply, error frame,
+        send failure, teardown) pops the slot from _inflight first, so a
+        send failure racing reader teardown cannot invoke cb twice."""
+        closed = False
         with self._lock:
             if self._closed:
-                fut.set_exception(ConnectionLost(f"client to {self.addr} closed"))
-                return fut
-            self._next_id += 1
-            msg_id = self._next_id
-            self._inflight[msg_id] = fut
+                closed = True  # invoke outside the lock: cb may re-enter
+            else:
+                self._next_id += 1
+                msg_id = self._next_id
+                self._inflight[msg_id] = _CbSlot(cb)
+        if closed:
+            _invoke(cb, None, ConnectionLost(f"client to {self.addr} closed"))
+            return
         try:
             data = _dumps((msg_id, REQUEST, method, payload))
             with self._send_lock:
                 send_frame(self._sock, data)
         except OSError as e:
             with self._lock:
-                self._inflight.pop(msg_id, None)
-            fut.set_exception(ConnectionLost(str(e)))
-        return fut
+                slot = self._inflight.pop(msg_id, None)
+            if slot is not None:  # reader teardown may have delivered it
+                _invoke(cb, None, ConnectionLost(str(e)))
 
     def notify(self, method: str, payload: Any = None) -> None:
         """One-way message; no reply expected (msg_id 0)."""
@@ -221,13 +260,13 @@ class Client:
                 frame = recv_frame(self._sock)
                 msg_id, kind, method, payload = pickle.loads(frame)
                 if kind == REPLY:
-                    fut = self._inflight.pop(msg_id, None)
-                    if fut is not None:
-                        fut.set_result(payload)
+                    slot = self._inflight.pop(msg_id, None)
+                    if slot is not None:
+                        _invoke(slot.fn, payload, None)
                 elif kind == ERROR:
-                    fut = self._inflight.pop(msg_id, None)
-                    if fut is not None:
-                        fut.set_exception(RpcError(payload))
+                    slot = self._inflight.pop(msg_id, None)
+                    if slot is not None:
+                        _invoke(slot.fn, None, RpcError(payload))
                 elif kind == PUSH:
                     if self._on_push is not None:
                         try:
@@ -240,9 +279,9 @@ class Client:
             with self._lock:
                 self._closed = True
                 inflight, self._inflight = self._inflight, {}
-            for fut in inflight.values():
-                if not fut.done():
-                    fut.set_exception(ConnectionLost(f"connection to {self.addr} lost"))
+            lost = ConnectionLost(f"connection to {self.addr} lost")
+            for slot in inflight.values():
+                _invoke(slot.fn, None, lost)
             if self._on_disconnect is not None:
                 try:
                     self._on_disconnect()

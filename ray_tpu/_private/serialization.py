@@ -89,8 +89,26 @@ def _deserialize_marker(marker: SerializedRef):
     return _marker_to_ref(marker)
 
 
+# Exact-type primitives can never hit reducer_override (no ObjectRef
+# markers, no device arrays, no closures) — plain pickle is safe and
+# skips a CloudPickler construction per value on the task hot path.
+_PRIMITIVE_TYPES = frozenset({type(None), bool, int, float, str, bytes})
+
+
+def _is_primitive(value: Any) -> bool:
+    t = type(value)
+    if t in _PRIMITIVE_TYPES:
+        return True
+    if t is tuple or t is list:
+        return len(value) <= 8 and \
+            all(type(v) in _PRIMITIVE_TYPES for v in value)
+    return False
+
+
 def dumps_oob(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
     """Serialize with out-of-band buffers (zero-copy for numpy/bytes)."""
+    if _is_primitive(value):
+        return pickle.dumps(value, protocol=5), []
     buffers: List[pickle.PickleBuffer] = []
     f = io.BytesIO()
     p = _Pickler(f, buffer_callback=buffers.append)
@@ -104,6 +122,8 @@ def loads_oob(meta: bytes, buffers: List[memoryview]) -> Any:
 
 def dumps_inline(value: Any) -> bytes:
     """Serialize fully in-band (for RPC messages)."""
+    if _is_primitive(value):
+        return pickle.dumps(value, protocol=5)
     f = io.BytesIO()
     _Pickler(f).dump(value)
     return f.getvalue()

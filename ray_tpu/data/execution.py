@@ -182,6 +182,15 @@ def _partition_task(spec: Dict, block: Block):
         assign = rng.randint(0, n, size=nrows)
     elif how == "round_robin":
         assign = np.arange(nrows) % n
+    elif how == "contig":
+        # contiguous global ranges: row with global index g goes to the
+        # output whose [cuts[j], cuts[j+1]) contains g — repartition
+        # preserves row order (reference: shuffle=False repartition)
+        start = spec["start"]
+        cuts = np.asarray(spec["cuts"])  # n+1 absolute boundaries
+        assign = np.searchsorted(cuts, start + np.arange(nrows),
+                                 side="right") - 1
+        assign = np.clip(assign, 0, n - 1)
     elif how == "hash":
         key = spec["key"]
         col = acc.to_numpy([key])[key]
@@ -653,6 +662,11 @@ class AllToAllOperator(PhysicalOperator):
         if not self.all_inputs_done():
             return []
         if self._phase == "collect":
+            # logical order, not arrival order: repartition concatenates
+            # part j of every input in _bundles order, so row order must
+            # match the upstream's (sort/shuffle are insensitive but
+            # repartition-then-zip is not)
+            self._bundles.sort(key=lambda b: b.order)
             if self.kind in ("sort", "groupby_sort"):
                 self._phase = "sample"
             else:
@@ -683,9 +697,19 @@ class AllToAllOperator(PhysicalOperator):
                 self.finished = True
                 return recs
             spec = self._partition_spec(n)
+            starts = None
+            if spec["how"] == "contig":
+                total = sum(b.metadata.num_rows for b in self._bundles)
+                spec["cuts"] = [round(total * j / n) for j in range(n + 1)]
+                starts, off = [], 0
+                for b in self._bundles:
+                    starts.append(off)
+                    off += b.metadata.num_rows
             self._parts = [None] * len(self._bundles)
             for i, b in enumerate(self._bundles):
-                refs = submit(_partition_task, (spec, b.block_ref),
+                bspec = spec if starts is None else dict(spec,
+                                                        start=starts[i])
+                refs = submit(_partition_task, (bspec, b.block_ref),
                               num_returns=n, name=f"data:{self.name}:part")
                 self.active += 1
                 self.stats["tasks"] += 1
@@ -741,7 +765,7 @@ class AllToAllOperator(PhysicalOperator):
         if self.kind == "shuffle":
             return {"how": "random", "n": n, "seed": self.seed}
         if self.kind == "repartition":
-            return {"how": "round_robin", "n": n}
+            return {"how": "contig", "n": n}  # cuts/start added at phase
         if self.kind in ("groupby", "map_groups"):
             if self.key is None:
                 return {"how": "round_robin", "n": 1}

@@ -355,3 +355,22 @@ def test_diamond_zip_out_of_order(ray_cluster):
     rows = base.zip(base.map(jitter)).take_all()
     assert len(rows) == 32
     assert all(r["id"] * 10 == r["id2"] for r in rows)
+
+
+def test_repartition_zip_out_of_order(ray_cluster):
+    """Repartition concatenates input parts in collect order; that must
+    be logical order or a downstream zip pairs wrong rows."""
+    import time as _t
+
+    def jittered(batch):
+        if 0 in list(batch["id"]):
+            _t.sleep(1.0)  # first block collected last
+        return batch
+
+    a = rd.range(20, override_num_blocks=4).map_batches(
+        jittered).repartition(2)
+    b = rd.range(20, override_num_blocks=2).map_batches(
+        lambda x: {"other": x["id"] + 500})
+    rows = sorted(a.zip(b).take_all(), key=lambda r: r["id"])
+    assert len(rows) == 20
+    assert [r["other"] for r in rows] == [500 + i for i in range(20)]
