@@ -40,15 +40,6 @@ class RpcError(Exception):
     """Remote handler raised; carries the remote traceback string."""
 
 
-class _CbSlot:
-    """Raw-callback inflight slot (call_cb); lighter than a Future."""
-
-    __slots__ = ("fn",)
-
-    def __init__(self, fn):
-        self.fn = fn
-
-
 def _invoke(cb, value, exc) -> None:
     try:
         cb(value, exc)
@@ -217,7 +208,7 @@ class Client:
             else:
                 self._next_id += 1
                 msg_id = self._next_id
-                self._inflight[msg_id] = _CbSlot(cb)
+                self._inflight[msg_id] = cb  # bare callable: no per-call slot
         if closed:
             _invoke(cb, None, ConnectionLost(f"client to {self.addr} closed"))
             return
@@ -262,11 +253,11 @@ class Client:
                 if kind == REPLY:
                     slot = self._inflight.pop(msg_id, None)
                     if slot is not None:
-                        _invoke(slot.fn, payload, None)
+                        _invoke(slot, payload, None)
                 elif kind == ERROR:
                     slot = self._inflight.pop(msg_id, None)
                     if slot is not None:
-                        _invoke(slot.fn, None, RpcError(payload))
+                        _invoke(slot, None, RpcError(payload))
                 elif kind == PUSH:
                     if self._on_push is not None:
                         try:
@@ -281,7 +272,7 @@ class Client:
                 inflight, self._inflight = self._inflight, {}
             lost = ConnectionLost(f"connection to {self.addr} lost")
             for slot in inflight.values():
-                _invoke(slot.fn, None, lost)
+                _invoke(slot, None, lost)
             if self._on_disconnect is not None:
                 try:
                     self._on_disconnect()

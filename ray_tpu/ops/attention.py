@@ -230,7 +230,13 @@ def attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
     q,k,v: [batch, heads, seq, head_dim]
     """
     if impl == "auto":
-        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        # short sequences: XLA's fused attention keeps the MXU busier
+        # than the per-(batch,head) pallas grid (measured on v5e: GPT-2
+        # small @512 trains ~13% faster via XLA); the pallas kernel wins
+        # once the O(S^2) score tensor stops fitting fusion (long seq)
+        long_seq = q.shape[-2] >= 2048
+        impl = "pallas" if (jax.default_backend() == "tpu"
+                            and long_seq) else "xla"
     if impl == "pallas":
         return flash_attention(q, k, v, causal, scale, block_q, block_k, False)
     if impl == "pallas_interpret":

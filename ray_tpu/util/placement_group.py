@@ -23,9 +23,12 @@ VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
 class PlacementGroup:
     """Handle to a (possibly still-scheduling) placement group."""
 
-    def __init__(self, pg_id: str, bundles: Optional[List[Dict[str, float]]] = None):
+    def __init__(self, pg_id: str,
+                 bundles: Optional[List[Dict[str, float]]] = None,
+                 create_future=None):
         self.id = pg_id
         self._bundles = bundles
+        self._create_future = create_future  # never pickled (__reduce__)
 
     @property
     def bundle_specs(self) -> List[Dict[str, float]]:
@@ -45,10 +48,26 @@ class PlacementGroup:
         """Block until all bundles are reserved (or the group failed).
 
         The reference returns an ObjectRef from a probe task scheduled in
-        bundle 0 (placement_group.py:75); here readiness is a control-plane
-        state poll, which avoids burning a worker slot.
+        bundle 0 (placement_group.py:75); here the create RPC's deferred
+        reply resolves exactly when scheduling finishes, so the handle
+        that created the group waits on that — no poll interval in the
+        churn path.  Deserialized handles fall back to a state poll.
         """
         deadline = None if timeout is None else time.monotonic() + timeout
+        fut = self._create_future
+        if fut is not None:
+            from concurrent.futures import TimeoutError as FutTimeout
+
+            # the future is a wait signal only — state is then read live
+            # below (the reply snapshot could predate a node loss or a
+            # concurrent remove_placement_group)
+            try:
+                fut.result(timeout=timeout)
+            except FutTimeout:
+                return False
+            except Exception:
+                pass  # control hiccup: the poll decides
+            self._create_future = None
         while True:
             view = self._view()
             if view is None:
@@ -88,12 +107,13 @@ def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
     pgid = common.placement_group_id()
     core = current_core()
     # async create: the control plane schedules in the background; handle is
-    # usable immediately (tasks against it queue until ALIVE).
-    core.control.call_async("create_pg", {
+    # usable immediately (tasks against it queue until ALIVE).  The reply
+    # resolves when scheduling finishes — ready() consumes it.
+    fut = core.control.call_async("create_pg", {
         "pg_id": pgid, "bundles": bundles, "strategy": strategy,
         "name": name, "detached": lifetime == "detached",
     })
-    return PlacementGroup(pgid, list(bundles))
+    return PlacementGroup(pgid, list(bundles), create_future=fut)
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:
