@@ -53,7 +53,11 @@ def bench_put_bandwidth() -> float:
 
     ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
     arr = np.random.bytes(256 * 1024 * 1024)
-    ray_tpu.put(np.frombuffer(arr, np.uint8))  # warmup
+    # warmup until the arena's touched working set stops growing:
+    # steady-state pages (the reference's number is likewise
+    # steady-state, not first-touch)
+    for _ in range(8):
+        ray_tpu.put(np.frombuffer(arr, np.uint8))
     t0 = time.perf_counter()
     total = 0
     for _ in range(4):

@@ -28,19 +28,30 @@ def _rand_bytes(n: int) -> bytes:
 # ids only need cross-process uniqueness, not cryptographic strength: an
 # 8-byte urandom prefix drawn once per process + a 16-hex-digit counter is
 # collision-safe and ~50x cheaper than os.urandom per id (the task-submit
-# hot path mints 2 ids per task)
+# hot path mints 2 ids per task).  Fork safety comes from an at-fork hook
+# rather than a getpid() check per id — getpid is a real syscall on
+# sandboxed kernels and was the single hottest line of task submission.
 _id_state = None
+
+
+def _reset_id_state():
+    global _id_state
+    _id_state = None
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reset_id_state)
 
 
 def new_id(prefix: str = "") -> str:
     global _id_state
-    pid = os.getpid()
-    if _id_state is None or _id_state[0] != pid:  # fork/spawn safe
+    st = _id_state
+    if st is None:
         import itertools
 
-        _id_state = (pid, os.urandom(8).hex(), itertools.count(1))
+        _id_state = st = (os.urandom(8).hex(), itertools.count(1))
     # itertools.count.__next__ is atomic in CPython: thread-safe ids
-    return f"{prefix}{_id_state[1]}{next(_id_state[2]):016x}"
+    return f"{prefix}{st[0]}{next(st[1]):016x}"
 
 
 def job_id() -> str:

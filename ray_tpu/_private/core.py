@@ -505,17 +505,22 @@ class CoreWorker:
                 self._delete_event.wait(0.5)
                 self._delete_event.clear()
                 continue
-            due, oid = self._delete_queue[0]
+            try:
+                # flush_pending_deletes drains concurrently: the peek and
+                # the pop can both lose the race
+                due, oid = self._delete_queue[0]
+            except IndexError:
+                continue
             now = time.monotonic()
             if due > now:
                 time.sleep(min(due - now, 0.5))
                 continue
             try:
-                self._delete_queue.popleft()
+                item = self._delete_queue.popleft()
             except IndexError:
                 continue
             try:
-                self._maybe_delete(oid)
+                self._maybe_delete(item[1])
             except Exception:
                 pass
 
@@ -642,12 +647,47 @@ class CoreWorker:
                 e.nbytes = total
                 e.event.set()
             else:
-                self.store.create(oid, meta, raw)
+                self._store_create(oid, meta, raw)
                 e.shm_node = self.node_id
                 e.shm_addr = self.raylet_addr
                 e.nbytes = total
                 e.event.set()
         return ObjectRef(oid, self.addr, self.worker_id)
+
+    def _store_create(self, oid: str, meta: bytes, raw) -> None:
+        """store.create with pressure relief: when the arena can't place
+        the object in warm (already-touched) space, flush this core's
+        grace-delayed deletes and retry before growing into cold pages or
+        overflowing to disk files (memory pressure overrides the delete
+        grace period, like the reference's eviction-under-pressure)."""
+        st = self.store
+        if st.create(oid, meta, raw, warm_only=True) is not None:
+            return
+        self.flush_pending_deletes()
+        if st.create(oid, meta, raw, warm_only=True) is not None:
+            return
+        st.create(oid, meta, raw)
+
+    def flush_pending_deletes(self) -> None:
+        """Delete every grace-queued object NOW, and wait for the local
+        raylet to drop them from the shm arena (the normal path only
+        notifies) — the caller is about to retry an allocation."""
+        local: List[str] = []
+        while True:
+            try:
+                _, oid = self._delete_queue.popleft()
+            except IndexError:
+                break
+            try:
+                self._maybe_delete(oid, collect_local=local)
+            except Exception:
+                pass
+        if local and self.raylet is not None:
+            try:
+                self.raylet.call("delete_objects", {"object_ids": local},
+                                 timeout=10.0)
+            except Exception:
+                pass
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -925,7 +965,7 @@ class CoreWorker:
                     (time.monotonic() + DELETE_GRACE_S, oid))
                 self._delete_event.set()
 
-    def _maybe_delete(self, oid: str):
+    def _maybe_delete(self, oid: str, collect_local: Optional[list] = None):
         with self.lock:
             e = self.objects.get(oid)
             if e is None or e.pins > 0:
@@ -936,7 +976,14 @@ class CoreWorker:
         if shm_addr is not None:
             try:
                 if shm_addr == self.raylet_addr and self.raylet is not None:
-                    self.raylet.notify("delete_objects", {"object_ids": [oid]})
+                    if collect_local is not None:
+                        # pressure flush: caller batches one synchronous
+                        # delete_objects call so the arena space is truly
+                        # free before the allocation retry
+                        collect_local.append(oid)
+                    else:
+                        self.raylet.notify("delete_objects",
+                                           {"object_ids": [oid]})
                 else:
                     Client(shm_addr, name="core-del").notify(
                         "delete_objects", {"object_ids": [oid]})
@@ -2189,7 +2236,7 @@ class CoreWorker:
             raw = [b.raw() for b in bufs]
             total = len(meta) + sum(len(b) for b in raw)
             if total > INLINE_OBJECT_LIMIT and self.store is not None:
-                self.store.create(oid, meta, raw)
+                self._store_create(oid, meta, raw)
                 results.append(("shm", {"node_id": self.node_id,
                                         "addr": self.raylet_addr,
                                         "nbytes": total}))
@@ -2205,7 +2252,7 @@ class CoreWorker:
         raw = [b.raw() for b in bufs]
         total = len(meta) + sum(len(b) for b in raw)
         if total > INLINE_OBJECT_LIMIT and self.store is not None:
-            self.store.create(oid, meta, raw)
+            self._store_create(oid, meta, raw)
             return ("shm", {"node_id": self.node_id,
                             "addr": self.raylet_addr,
                             "nbytes": total})

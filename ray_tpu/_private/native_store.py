@@ -26,10 +26,25 @@ logger = logging.getLogger(__name__)
 
 def DEFAULT_CAPACITY() -> int:
     # read at store-construction time so tests/daemons can size the arena
-    # through the environment / _system_config (config.py flag table)
+    # through the environment / _system_config (config.py flag table).
+    # Unset: 30% of system memory like the reference's plasma sizing
+    # (reference: ray_constants.py DEFAULT_OBJECT_STORE_MEMORY_PROPORTION),
+    # clamped to [1 GiB, 64 GiB].  The arena file is sparse — untouched
+    # capacity costs nothing.
     from .config import cfg
 
-    return cfg().object_store_bytes or (1 << 30)
+    configured = cfg().object_store_bytes
+    if configured:
+        return configured
+    total = 0
+    try:
+        total = os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+    except (ValueError, OSError, AttributeError):
+        pass
+    # The warm-first extent allocator (shm_arena.cc extent_alloc) keeps
+    # the touched page window as small as the live set, so a generous
+    # sparse arena costs nothing until used.
+    return max(1 << 30, min(int(total * 0.3), 64 << 30))
 N_ENTRIES = 16384  # power of two
 
 _lib = None
@@ -100,6 +115,18 @@ class NativeShmObjectStore:
         if not self._arena:
             raise RuntimeError(f"rt_arena_open failed for {self._arena_path}")
         self._fd = os.open(self._arena_path, os.O_RDWR)
+        # One long-lived rw mapping of the whole arena for the write path:
+        # a per-create mmap/munmap pays ~size/4KiB soft page-faults on every
+        # put (the munmap drops the PTEs even though the shm pages stay in
+        # the page cache), which halves large-put bandwidth. PTEs under a
+        # persistent map survive across puts, so after warmup a put is one
+        # memcpy. Readers keep per-object maps — their pin release is tied
+        # to the mapping's lifetime (see _map_object).
+        self._wmap: Optional[mmap.mmap] = None
+        try:
+            self._wmap = mmap.mmap(self._fd, 0)
+        except (ValueError, OSError):
+            pass  # fall back to per-create mappings
         self._overflow = FileObjectStore(root)
         # Shared with reader-pin finalizers: once closed, the arena handle
         # is gone and late releases must become no-ops (pins of a live pid
@@ -115,28 +142,47 @@ class NativeShmObjectStore:
 
     PRIMARY = 1  # arena kFlagPrimary: unevictable until spilled
 
+    WARM_ONLY = 1 << 30  # arena kFlagWarmOnly: fail rather than touch cold pages
+
     def create(self, object_id: str, meta: bytes,
-               buffers: Sequence[memoryview], primary: bool = True) -> int:
+               buffers: Sequence[memoryview], primary: bool = True,
+               allow_overflow: bool = True,
+               warm_only: bool = False) -> Optional[int]:
+        """Write an object into the arena.  Returns its packed size, or
+        None when allow_overflow=False and the arena has no room — or
+        warm_only=True and only never-touched (cold) space fits — so the
+        caller can free memory (e.g. flush deferred deletes) and retry."""
         from .shm_store import layout_size, pack_into
 
         self._check_open()
         size = layout_size(len(meta), [len(b) for b in buffers])
         oid = object_id.encode()
         err = ctypes.c_int(0)
+        flags = self.PRIMARY if primary else 0
+        if warm_only:
+            flags |= self.WARM_ONLY
         off = self._lib.rt_create(self._arena, oid, size,
-                                  ctypes.byref(err),
-                                  self.PRIMARY if primary else 0)
+                                  ctypes.byref(err), flags)
         if err.value == 1:
             return size  # already created/sealed: objects are immutable
         if off == 0:
+            if warm_only or not allow_overflow:
+                return None
             # arena exhausted even after eviction → file overflow
             return self._overflow.create(object_id, meta, buffers)
         try:
-            mm = mmap.mmap(self._fd, size, offset=off)
-            try:
-                pack_into(memoryview(mm), meta, buffers)
-            finally:
-                mm.close()
+            if self._wmap is not None and off + size <= len(self._wmap):
+                dst = memoryview(self._wmap)[off:off + size]
+                try:
+                    pack_into(dst, meta, buffers)
+                finally:
+                    dst.release()
+            else:
+                mm = mmap.mmap(self._fd, size, offset=off)
+                try:
+                    pack_into(memoryview(mm), meta, buffers)
+                finally:
+                    mm.close()
         except BaseException:
             self._lib.rt_abort(self._arena, oid)
             raise
@@ -308,6 +354,12 @@ class NativeShmObjectStore:
         if self._state["closed"]:
             return
         self._state["closed"] = True
+        if self._wmap is not None:
+            try:
+                self._wmap.close()
+            except (BufferError, ValueError):
+                pass  # an exported slice outlives us; drop the ref instead
+            self._wmap = None
         try:
             os.close(self._fd)
         except OSError:

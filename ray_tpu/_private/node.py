@@ -582,6 +582,9 @@ class Raylet:
         for _ in range(spawn):
             self._spawn_worker(tpu=spawn_tpu)
         for pl, w in grants:
+            logger.debug("grant %s lease=%s client=%s avail=%s",
+                         w.worker_id, w.lease_resources,
+                         pl.client_id, self.available)
             pl.deferred.resolve({
                 "ok": True, "lease_id": w.lease_id, "worker_id": w.worker_id,
                 "worker_addr": w.addr, "node_id": self.node_id,

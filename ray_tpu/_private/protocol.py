@@ -71,6 +71,26 @@ def send_frame(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(_HEADER.pack(len(payload)) + payload)
 
 
+if hasattr(socket.socket, "sendmsg"):
+
+    def send_vec(sock: socket.socket, bufs: list) -> None:
+        """Scatter-gather send of a buffer list: one syscall per ~1MB of
+        frames instead of one per frame, and no join() copy."""
+        views = [memoryview(b) for b in bufs]
+        i = 0
+        while i < len(views):
+            n = sock.sendmsg(views[i:])
+            while i < len(views) and n >= len(views[i]):
+                n -= len(views[i])
+                i += 1
+            if i < len(views) and n:
+                views[i] = views[i][n:]
+else:  # pragma: no cover - non-POSIX fallback
+
+    def send_vec(sock: socket.socket, bufs: list) -> None:
+        sock.sendall(b"".join(bufs))
+
+
 def recv_frame(sock: socket.socket) -> bytes:
     (n,) = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
     if n > MAX_FRAME:
@@ -168,7 +188,20 @@ class Client:
                 time.sleep(0.05)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock.settimeout(None)
-        self._send_lock = threading.Lock()
+        # Combining writer: callers enqueue framed payloads and a dedicated
+        # thread drains the queue with one scatter-gather sendmsg per
+        # batch.  Under bursts (pipelined task pushes) dozens of frames
+        # ride one syscall; a lone sync call costs one ~15us handoff in
+        # place of its ~40us sendall.  Order is strictly FIFO — actor-task
+        # ordering depends on per-connection frame order.
+        import collections
+
+        self._outq: "collections.deque" = collections.deque()
+        self._out_cv = threading.Condition()
+        self._writer = threading.Thread(
+            target=self._write_loop, name=f"rpc-client-writer-{name}", daemon=True
+        )
+        self._writer.start()
         self._reader = threading.Thread(
             target=self._read_loop, name=f"rpc-client-reader-{name}", daemon=True
         )
@@ -214,25 +247,77 @@ class Client:
             return
         try:
             data = _dumps((msg_id, REQUEST, method, payload))
-            with self._send_lock:
-                send_frame(self._sock, data)
-        except OSError as e:
+        except BaseException:
+            with self._lock:
+                self._inflight.pop(msg_id, None)
+            raise
+        try:
+            self._enqueue(data)
+        except ConnectionLost as e:
             with self._lock:
                 slot = self._inflight.pop(msg_id, None)
-            if slot is not None:  # reader teardown may have delivered it
-                _invoke(cb, None, ConnectionLost(str(e)))
+            if slot is not None:  # teardown may have delivered it already
+                _invoke(cb, None, e)
 
     def notify(self, method: str, payload: Any = None) -> None:
         """One-way message; no reply expected (msg_id 0)."""
-        data = _dumps((0, REQUEST, method, payload))
-        with self._send_lock:
-            send_frame(self._sock, data)
+        self._enqueue(_dumps((0, REQUEST, method, payload)))
+
+    def _enqueue(self, data: bytes) -> None:
+        # after close/teardown the writer is gone — surface the failure
+        # like the old synchronous send did instead of queueing forever
+        if self._closed:
+            raise ConnectionLost(f"client to {self.addr} closed")
+        with self._out_cv:
+            self._outq.append(data)
+            self._out_cv.notify()
+
+    def _write_loop(self) -> None:
+        # 2 iovecs per frame, UIO_MAXIOV=1024 → cap well below it
+        MAX_BATCH, MAX_BYTES = 256, 1 << 20
+        sent_error = False
+        try:
+            while True:
+                with self._out_cv:
+                    while not self._outq and not self._closed:
+                        self._out_cv.wait()
+                    # graceful close: drain everything already enqueued
+                    # (one-shot clients notify() then close() immediately —
+                    # dropping those frames loses lease returns / object
+                    # frees); a dead socket aborts us via OSError instead
+                    if self._closed and not self._outq:
+                        return
+                    batch, nbytes = [], 0
+                    while self._outq and len(batch) < MAX_BATCH \
+                            and nbytes < MAX_BYTES:
+                        d = self._outq.popleft()
+                        batch.append(d)
+                        nbytes += len(d)
+                bufs = []
+                for d in batch:
+                    bufs.append(_HEADER.pack(len(d)))
+                    bufs.append(d)
+                send_vec(self._sock, bufs)
+        except OSError:
+            sent_error = True
+        finally:
+            if sent_error:
+                # the reader owns teardown; make it notice
+                try:
+                    self._sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
 
     def close(self) -> None:
         with self._lock:
             if self._closed:
                 return
             self._closed = True
+        with self._out_cv:
+            self._out_cv.notify_all()
+        if threading.current_thread() is not self._writer:
+            # let queued frames flush before tearing the socket down
+            self._writer.join(timeout=5.0)
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -246,30 +331,41 @@ class Client:
     # -- internals ---------------------------------------------------------
 
     def _read_loop(self) -> None:
+        # Buffered framing: one recv per kernel burst instead of two per
+        # frame (header + payload) — syscalls dominate small-RPC cost on
+        # sandboxed kernels, and reply bursts arrive coalesced anyway.
+        buf = bytearray()
+        want = -1  # payload length being assembled; -1 = reading header
+        hsize = _HEADER.size
         try:
             while True:
-                frame = recv_frame(self._sock)
-                msg_id, kind, method, payload = pickle.loads(frame)
-                if kind == REPLY:
-                    slot = self._inflight.pop(msg_id, None)
-                    if slot is not None:
-                        _invoke(slot, payload, None)
-                elif kind == ERROR:
-                    slot = self._inflight.pop(msg_id, None)
-                    if slot is not None:
-                        _invoke(slot, None, RpcError(payload))
-                elif kind == PUSH:
-                    if self._on_push is not None:
-                        try:
-                            self._on_push(method, payload)
-                        except Exception:
-                            logger.exception("push handler failed for %s", method)
-        except (ConnectionLost, OSError, EOFError, pickle.UnpicklingError):
+                chunk = self._sock.recv(1 << 20)
+                if not chunk:
+                    raise ConnectionLost("socket closed")
+                buf += chunk
+                while True:
+                    if want < 0:
+                        if len(buf) < hsize:
+                            break
+                        (want,) = _HEADER.unpack(bytes(buf[:hsize]))
+                        if want > MAX_FRAME:
+                            raise RpcError(f"frame too large: {want}")
+                        del buf[:hsize]
+                    if len(buf) < want:
+                        break
+                    frame = bytes(buf[:want])
+                    del buf[:want]
+                    want = -1
+                    self._handle_frame(frame)
+        except (ConnectionLost, OSError, EOFError, pickle.UnpicklingError,
+                RpcError):
             pass
         finally:
             with self._lock:
                 self._closed = True
                 inflight, self._inflight = self._inflight, {}
+            with self._out_cv:
+                self._out_cv.notify_all()  # release the writer thread
             lost = ConnectionLost(f"connection to {self.addr} lost")
             for slot in inflight.values():
                 _invoke(slot, None, lost)
@@ -278,6 +374,23 @@ class Client:
                     self._on_disconnect()
                 except Exception:
                     logger.exception("disconnect handler failed")
+
+    def _handle_frame(self, frame: bytes) -> None:
+        msg_id, kind, method, payload = pickle.loads(frame)
+        if kind == REPLY:
+            slot = self._inflight.pop(msg_id, None)
+            if slot is not None:
+                _invoke(slot, payload, None)
+        elif kind == ERROR:
+            slot = self._inflight.pop(msg_id, None)
+            if slot is not None:
+                _invoke(slot, None, RpcError(payload))
+        elif kind == PUSH:
+            if self._on_push is not None:
+                try:
+                    self._on_push(method, payload)
+                except Exception:
+                    logger.exception("push handler failed for %s", method)
 
 
 # ---------------------------------------------------------------------------

@@ -90,7 +90,9 @@ class FileObjectStore:
 
     # -- write path --------------------------------------------------------
 
-    def create(self, object_id: str, meta: bytes, buffers: Sequence[memoryview]) -> int:
+    def create(self, object_id: str, meta: bytes, buffers: Sequence[memoryview],
+               primary: bool = True, allow_overflow: bool = True,
+               warm_only: bool = False) -> int:
         """Write + seal an object; returns its byte size.
 
         Uses writev() rather than mmap: on tmpfs a streaming write avoids

@@ -40,7 +40,7 @@
 namespace {
 
 constexpr uint64_t kMagic = 0x524159545055ULL;  // "RAYTPU"
-constexpr uint32_t kVersion = 2;
+constexpr uint32_t kVersion = 3;  // 3: Header gained high_water
 constexpr uint64_t kPage = 4096;
 constexpr uint32_t kMaxReaders = 8;
 constexpr uint32_t kIdLen = 64;  // incl. NUL
@@ -58,6 +58,10 @@ constexpr uint32_t kTomb = 3;  // deleted; probe chains continue through it
 // pinned-primary rule (reference: local_object_manager.h pinned_objects_;
 // eviction only reaps secondary copies).
 constexpr uint32_t kFlagPrimary = 1;
+// rt_create-only flag (never stored in Entry.flags): fail instead of
+// growing the touched region past high_water — the caller can free
+// grace-delayed garbage and retry warm before paying first-touch cost.
+constexpr uint32_t kFlagWarmOnly = 1u << 30;
 
 struct Reader {
   uint32_t pid;
@@ -99,6 +103,7 @@ struct Header {
   uint64_t bytes_used;
   uint64_t n_objects;
   uint64_t n_evictions;
+  uint64_t high_water;   // max data offset ever handed out (see extent_alloc)
   pthread_mutex_t mu;
 };
 
@@ -172,19 +177,35 @@ void extent_free(Header* h, Extent* ex, uint64_t off, uint64_t len) {
   }
 }
 
-// First-fit allocation of a page-rounded length; returns 0 on failure.
-uint64_t extent_alloc(Header* h, Extent* ex, uint64_t len) {
+// Allocation of a page-rounded length; returns 0 on failure.
+//
+// Warm-first policy: prefer extents that start below the high-water mark
+// (space that has been allocated before — its pages are already faulted
+// in and zeroed), and only grow into virgin tail space when no recycled
+// extent fits.  A plain lowest-offset first-fit over a large arena
+// marches through cold pages for the whole first cycle (every put pays
+// first-touch zero-fill, about half of memcpy bandwidth); with this
+// policy the touched working set stays as small as the live set needs.
+uint64_t extent_take(Header* h, Extent* ex, uint32_t i, uint64_t len) {
+  uint64_t off = ex[i].off;
+  ex[i].off += len;
+  ex[i].len -= len;
+  if (ex[i].len == 0) {
+    memmove(&ex[i], &ex[i + 1], (h->n_extents - i - 1) * sizeof(Extent));
+    h->n_extents--;
+  }
+  if (off + len > h->high_water) h->high_water = off + len;
+  return off;
+}
+
+uint64_t extent_alloc(Header* h, Extent* ex, uint64_t len, bool warm_only) {
   for (uint32_t i = 0; i < h->n_extents; ++i) {
-    if (ex[i].len >= len) {
-      uint64_t off = ex[i].off;
-      ex[i].off += len;
-      ex[i].len -= len;
-      if (ex[i].len == 0) {
-        memmove(&ex[i], &ex[i + 1], (h->n_extents - i - 1) * sizeof(Extent));
-        h->n_extents--;
-      }
-      return off;
-    }
+    if (ex[i].len >= len && ex[i].off < h->high_water)
+      return extent_take(h, ex, i, len);
+  }
+  if (warm_only) return 0;
+  for (uint32_t i = 0; i < h->n_extents; ++i) {
+    if (ex[i].len >= len) return extent_take(h, ex, i, len);
   }
   return 0;
 }
@@ -246,8 +267,12 @@ void drop_object(Arena* a, Entry* e) {
 
 // Evict sealed, unpinned objects in LRU order until `need` bytes can be
 // allocated; returns the allocated offset or 0.
-uint64_t alloc_with_eviction(Arena* a, uint64_t need) {
-  uint64_t off = extent_alloc(a->hdr, a->extents, need);
+uint64_t alloc_with_eviction(Arena* a, uint64_t need, bool warm_only) {
+  uint64_t off = extent_alloc(a->hdr, a->extents, need, warm_only);
+  // warm_only is a cheap probe: never evict for it — if the probe fails,
+  // the caller frees its own garbage and retries, and only the final
+  // unconstrained create should spend cached copies on making room
+  if (warm_only) return off;
   while (off == 0) {
     Entry* victim = nullptr;
     for (uint32_t i = 0; i < a->hdr->n_entries; ++i) {
@@ -259,7 +284,7 @@ uint64_t alloc_with_eviction(Arena* a, uint64_t need) {
     if (!victim) return 0;
     drop_object(a, victim);
     a->hdr->n_evictions++;
-    off = extent_alloc(a->hdr, a->extents, need);
+    off = extent_alloc(a->hdr, a->extents, need, warm_only);
   }
   return off;
 }
@@ -392,7 +417,7 @@ uint64_t rt_create(Arena* a, const char* id, uint64_t size, int* err,
     return 0;
   }
   uint64_t need = page_round(size ? size : 1);
-  uint64_t off = alloc_with_eviction(a, need);
+  uint64_t off = alloc_with_eviction(a, need, flags & kFlagWarmOnly);
   if (off == 0) {
     unlock(a);
     return 0;
@@ -400,7 +425,7 @@ uint64_t rt_create(Arena* a, const char* id, uint64_t size, int* err,
   memset(e, 0, sizeof(Entry));
   e->hash = h;
   e->state = kCreated;
-  e->flags = flags;
+  e->flags = flags & ~kFlagWarmOnly;
   e->creator_pid = (uint32_t)getpid();
   e->off = off;
   e->size = size;
