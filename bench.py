@@ -68,6 +68,38 @@ def bench_put_bandwidth() -> float:
     return total / dt / (1 << 30)
 
 
+def bench_put_bandwidth_multi(n_threads: int = 4) -> float:
+    """Aggregate GiB/s with several submitters putting 128MiB objects
+    concurrently (reference: multi_client_put_gigabytes)."""
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    blob = np.random.bytes(128 * 1024 * 1024)
+    arrs = [np.frombuffer(blob, np.uint8) for _ in range(n_threads)]
+    for a in arrs:  # warm the arena's working set (steady state)
+        ray_tpu.put(a)
+        ray_tpu.put(a)
+
+    per_thread = 3
+    def body(t):
+        for _ in range(per_thread):
+            ray_tpu.put(arrs[t])
+
+    ts = [threading.Thread(target=body, args=(t,)) for t in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    dt = time.perf_counter() - t0
+    ray_tpu.shutdown()
+    return n_threads * per_thread * len(blob) / dt / (1 << 30)
+
+
 # peak dense bf16 FLOP/s per chip by device kind (public specs); used for
 # MFU = achieved model FLOP/s / peak
 _TPU_PEAK_FLOPS = {
@@ -207,6 +239,13 @@ BASELINES = {
     "single_client_wait_1k_refs": 5.19,
     "placement_group_create_removal": 752.0,
     "single_client_put_gigabytes": 17.8,
+    "multi_client_tasks_async": 22223.0,
+    "n_n_actor_calls_async": 27090.0,
+    "n_n_actor_calls_with_arg_async": 2665.0,
+    "n_n_async_actor_calls_async": 23929.0,
+    "multi_client_put_calls": 14828.0,
+    "multi_client_put_gigabytes": 46.3,
+    "single_client_get_object_containing_10k_refs": 12.6,
 }
 
 
@@ -232,6 +271,29 @@ def bench_table() -> dict:
     ray_tpu.init(num_cpus=max(1, (os.cpu_count() or 1)),
                  ignore_reinit_error=True)
     rows = {}
+
+    # n:n / multi_client rows — the reference drives these from multiple
+    # concurrent clients; threads play that role here (each thread is an
+    # independent submitter hammering its own slice of the actor set)
+    import threading as _th
+
+    def _concurrent(n_threads, per_thread, fn):
+        def run():
+            errs = []
+
+            def body(t):
+                try:
+                    fn(t, per_thread)
+                except Exception as e:  # pragma: no cover - surfaced below
+                    errs.append(e)
+            ts = [_th.Thread(target=body, args=(t,)) for t in range(n_threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if errs:
+                raise errs[0]
+        return _timed(n_threads * per_thread, run)
 
     @ray_tpu.remote
     def tiny():
@@ -299,6 +361,37 @@ def bench_table() -> dict:
         2000, lambda: ray_tpu.get([aa.m.remote() for _ in range(2000)],
                                   timeout=300))
 
+    nn_async = [AsyncActor.remote() for _ in range(4)]
+    ray_tpu.get([x.m.remote() for x in nn_async], timeout=60)
+    rows["n_n_async_actor_calls_async"] = _concurrent(
+        4, 500, lambda t, n: ray_tpu.get(
+            [nn_async[(t + i) % 4].m.remote() for i in range(n)],
+            timeout=300))
+
+    rows["multi_client_tasks_async"] = _concurrent(
+        4, 500, lambda t, n: ray_tpu.get(
+            [tiny.remote() for _ in range(n)], timeout=300))
+
+    nn_actors = [Actor.remote() for _ in range(4)]
+    ray_tpu.get([x.m.remote() for x in nn_actors], timeout=60)
+    rows["n_n_actor_calls_async"] = _concurrent(
+        4, 500, lambda t, n: ray_tpu.get(
+            [nn_actors[(t + i) % 4].m.remote() for i in range(n)],
+            timeout=300))
+
+    @ray_tpu.remote
+    class ArgActor:
+        def m(self, x):
+            return None
+
+    arg_actors = [ArgActor.remote() for _ in range(4)]
+    arg = np.zeros(10 * 1024, np.uint8)  # reference passes a small array
+    ray_tpu.get([x.m.remote(arg) for x in arg_actors], timeout=60)
+    rows["n_n_actor_calls_with_arg_async"] = _concurrent(
+        4, 250, lambda t, n: ray_tpu.get(
+            [arg_actors[(t + i) % 4].m.remote(arg) for i in range(n)],
+            timeout=300))
+
     small = np.zeros(16, np.uint8)
     ref = ray_tpu.put(small)
 
@@ -311,6 +404,21 @@ def bench_table() -> dict:
         for _ in range(1000):
             ray_tpu.put(small)
     rows["single_client_put_calls"] = _timed(1000, puts)
+
+    rows["multi_client_put_calls"] = _concurrent(
+        4, 250, lambda t, n: [ray_tpu.put(small) for _ in range(n)])
+
+    # an object whose value is a list of 10k refs (reference:
+    # single_client_get_object_containing_10k_refs, 12.6/s on 64 cores)
+    inner = [ray_tpu.put(i) for i in range(10_000)]
+    holder = ray_tpu.put(inner)
+
+    def get_10k():
+        for _ in range(5):
+            got = ray_tpu.get(holder, timeout=120)
+            assert len(got) == 10_000
+    rows["single_client_get_object_containing_10k_refs"] = _timed(5, get_10k)
+    del inner, holder
 
     refs_1k = [tiny.remote() for _ in range(1000)]
     ray_tpu.get(refs_1k, timeout=300)
@@ -340,6 +448,10 @@ def bench_table() -> dict:
     ray_tpu.shutdown()
     try:
         rows["single_client_put_gigabytes"] = bench_put_bandwidth()
+    except Exception:
+        pass
+    try:
+        rows["multi_client_put_gigabytes"] = bench_put_bandwidth_multi()
     except Exception:
         pass
 
