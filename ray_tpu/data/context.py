@@ -40,6 +40,11 @@ class DataContext:
     # buffering whole task outputs (reference: streaming generator returns
     # in the streaming executor); bounds per-task memory.
     use_streaming_generators: bool = True
+    # Emit output bundles in dataset order (take/iter_rows return the
+    # FIRST rows; tasks still run fully parallel — only the final yield
+    # is sequenced).  False trades order for lower first-output latency
+    # (reference: ExecutionOptions.preserve_order).
+    preserve_order: bool = True
     # Max unconsumed streamed items (block+meta pairs count as 2) before
     # the producing task pauses (reference:
     # _generator_backpressure_num_objects).

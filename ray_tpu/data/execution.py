@@ -334,6 +334,37 @@ class _StreamRec:
     item_idx: int = 0
 
 
+class _OrderStager:
+    """Heap of bundles keyed by logical order; releases only those no
+    in-flight work can still precede (`bound` = out_min_pending)."""
+
+    def __init__(self):
+        self._heap: List[Tuple[Tuple[int, ...], int, RefBundle]] = []
+        self._seq = 0
+
+    def push(self, bundle: RefBundle) -> None:
+        import heapq
+
+        self._seq += 1
+        heapq.heappush(self._heap, (bundle.order, self._seq, bundle))
+
+    def pop_ready(self, bound: Optional[Tuple[int, ...]]
+                  ) -> Iterator[RefBundle]:
+        import heapq
+
+        while self._heap and (bound is None or self._heap[0][0] < bound):
+            yield heapq.heappop(self._heap)[2]
+
+    def orders(self) -> List[Tuple[int, ...]]:
+        return [o for o, _, _ in self._heap]
+
+    def clear(self) -> None:
+        self._heap.clear()
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+
 class PhysicalOperator:
     def __init__(self, name: str, num_inputs: int = 1):
         self.name = name
@@ -346,10 +377,49 @@ class PhysicalOperator:
         self.stats = {"tasks": 0, "rows_out": 0, "blocks_out": 0,
                       "wall_s": 0.0}
         self.downstream: List[Tuple["PhysicalOperator", int]] = []
+        self.upstream: List[Optional["PhysicalOperator"]] = \
+            [None] * num_inputs
+        # `order` markers of in-flight work whose outputs are not yet in
+        # out_queue — the ordered-consumption protocol's lower bound
+        self._pending_orders: set = set()
 
     # -- wiring
     def connect(self, downstream: "PhysicalOperator", index: int = 0):
         self.downstream.append((downstream, index))
+        downstream.upstream[index] = self
+
+    # -- ordered consumption (reference: bundles are iterated in block
+    # order; tasks complete in any order, so consumers need a lower bound
+    # on what can still arrive)
+    def out_min_pending(self) -> Optional[Tuple[int, ...]]:
+        """Smallest `order` any output this operator has not yet handed
+        downstream could carry; None = nothing more will ever come.
+
+        Base implementation is conservative for barrier-style operators
+        (AllToAll/Zip): while unfinished they may emit any order."""
+        if not self.finished:
+            return ()
+        if self.out_queue:
+            return min(b.order for b in self.out_queue)
+        return None
+
+    def _streaming_min_pending(
+            self, extra=()) -> Optional[Tuple[int, ...]]:
+        """min over queued inputs, in-flight work, upstream's bound and
+        undelivered outputs — for operators that preserve input order."""
+        cands = list(extra)
+        if self.out_queue:
+            cands.append(min(b.order for b in self.out_queue))
+        cands.extend(self._pending_orders)
+        for q in self.in_queues:
+            for b in q:
+                cands.append(b.order)
+        for up in self.upstream:
+            if up is not None:
+                m = up.out_min_pending()
+                if m is not None:
+                    cands.append(m)
+        return min(cands) if cands else None
 
     def _emit(self, bundle: RefBundle):
         self.stats["rows_out"] += bundle.metadata.num_rows
@@ -411,6 +481,10 @@ class ReadOperator(PhysicalOperator):
     def has_work(self):
         return bool(self._pending)
 
+    def out_min_pending(self) -> Optional[Tuple[int, ...]]:
+        extra = [(self._next_idx,)] if self._pending else []
+        return self._streaming_min_pending(extra)
+
     def try_submit(self, submit) -> List[_TaskRec]:
         if not self._pending:
             return []
@@ -425,14 +499,17 @@ class ReadOperator(PhysicalOperator):
                          num_returns="streaming",
                          resources=self._resources,
                          name=f"data:{self.name}")
+            self._pending_orders.add((task_idx, 0))
             return [_StreamRec(gen, self, base_order=(task_idx,))]
         refs = submit(_read_task, (rt, self._chain), num_returns=2,
                       resources=self._resources, name=f"data:{self.name}")
+        self._pending_orders.add((task_idx, 0))
 
         def on_done(rec: _TaskRec):
             self.active -= 1
             meta = ray_tpu.get(rec.refs[1], timeout=300)
             self._emit(RefBundle(rec.refs[0], meta, order=(task_idx, 0)))
+            self._pending_orders.discard((task_idx, 0))
             self.maybe_finish()
 
         return [_TaskRec(refs, on_done)]
@@ -445,6 +522,9 @@ class MapOperator(PhysicalOperator):
         super().__init__(name)
         self._chain = chain
         self._resources = resources
+
+    def out_min_pending(self) -> Optional[Tuple[int, ...]]:
+        return self._streaming_min_pending()
 
     def try_submit(self, submit) -> List[_TaskRec]:
         if not self.in_queues[0]:
@@ -460,29 +540,49 @@ class MapOperator(PhysicalOperator):
                          num_returns="streaming",
                          resources=self._resources,
                          name=f"data:{self.name}")
+            self._pending_orders.add(order + (0,))
             return [_StreamRec(gen, self, base_order=order)]
         refs = submit(_map_task, (self._chain, bundle.block_ref),
                       num_returns=2, resources=self._resources,
                       name=f"data:{self.name}")
+        self._pending_orders.add(order)
 
         def on_done(rec: _TaskRec):
             self.active -= 1
             meta = ray_tpu.get(rec.refs[1], timeout=300)
             self._emit(RefBundle(rec.refs[0], meta, order=order))
+            self._pending_orders.discard(order)
             self.maybe_finish()
 
         return [_TaskRec(refs, on_done)]
 
 
 class LimitOperator(PhysicalOperator):
+    """Row-limit in DATASET order: blocks complete out of order, so input
+    is staged in an order-heap and consumed only once no earlier block can
+    still arrive (upstream.out_min_pending) — limit(5) must keep the first
+    5 rows of the dataset, not of whichever task finished first."""
+
     def __init__(self, limit: int):
         super().__init__(f"Limit({limit})")
         self._remaining = limit
+        self._buf = _OrderStager()
+
+    def has_work(self) -> bool:
+        return any(self.in_queues) or bool(len(self._buf))
+
+    def out_min_pending(self) -> Optional[Tuple[int, ...]]:
+        return self._streaming_min_pending(self._buf.orders())
 
     def try_submit(self, submit) -> List[_TaskRec]:
+        while self.in_queues[0]:
+            self._buf.push(self.in_queues[0].popleft())
+        up = self.upstream[0]
+        upmin = up.out_min_pending() if up is not None else None
         recs = []
-        while self.in_queues[0] and self._remaining > 0:
-            bundle: RefBundle = self.in_queues[0].popleft()
+        for bundle in self._buf.pop_ready(upmin):
+            if self._remaining <= 0:
+                break
             n = bundle.metadata.num_rows
             if n <= self._remaining:
                 self._remaining -= n
@@ -495,11 +595,13 @@ class LimitOperator(PhysicalOperator):
                           num_returns=2, name=f"data:{self.name}")
             self.active += 1
             self.stats["tasks"] += 1
+            self._pending_orders.add(order)
 
             def on_done(rec: _TaskRec):
                 self.active -= 1
                 meta = ray_tpu.get(rec.refs[1], timeout=300)
                 self._emit(RefBundle(rec.refs[0], meta, order=order))
+                self._pending_orders.discard(order)
                 self.maybe_finish()
 
             recs.append(_TaskRec(refs, on_done))
@@ -507,6 +609,7 @@ class LimitOperator(PhysicalOperator):
             # drop any remaining input; upstream stops via executor check
             for q in self.in_queues:
                 q.clear()
+            self._buf.clear()
             if self.active == 0:
                 self.finished = True
         else:
@@ -526,6 +629,20 @@ class LimitOperator(PhysicalOperator):
 class UnionOperator(PhysicalOperator):
     def __init__(self, n: int):
         super().__init__("Union", num_inputs=n)
+
+    def out_min_pending(self) -> Optional[Tuple[int, ...]]:
+        cands = []
+        if self.out_queue:
+            cands.append(min(b.order for b in self.out_queue))
+        for side in range(self.num_inputs):
+            side_c = [(side,) + b.order for b in self.in_queues[side]]
+            up = self.upstream[side]
+            if up is not None:
+                m = up.out_min_pending()
+                if m is not None:
+                    side_c.append((side,) + m)
+            cands.extend(side_c)
+        return min(cands) if cands else None
 
     def try_submit(self, submit) -> List[_TaskRec]:
         for side, q in enumerate(self.in_queues):
@@ -973,6 +1090,8 @@ class StreamingExecutor:
                     ref = srec.gen.next_ready(timeout=0)
                 except StopIteration:
                     srec.op.active -= 1
+                    srec.op._pending_orders.discard(
+                        srec.base_order + (srec.item_idx,))
                     srec.op.maybe_finish()
                     self._streams.remove(srec)
                     progressed = True
@@ -987,7 +1106,11 @@ class StreamingExecutor:
                     srec.op._emit(RefBundle(
                         block_ref, meta,
                         order=srec.base_order + (srec.item_idx,)))
+                    srec.op._pending_orders.discard(
+                        srec.base_order + (srec.item_idx,))
                     srec.item_idx += 1
+                    srec.op._pending_orders.add(
+                        srec.base_order + (srec.item_idx,))
                     progressed = True
         return progressed
 
@@ -1021,12 +1144,21 @@ class StreamingExecutor:
             self.wall_s = time.perf_counter() - self._started
 
     def _run_loop(self) -> Iterator[RefBundle]:
+        # preserve_order: outputs stage in an order-heap and yield only
+        # when no smaller order can still arrive (sink.out_min_pending)
+        ordered = self.ctx.preserve_order
         out_buffer: collections.deque = collections.deque()
+        out_heap = _OrderStager()
         while True:
             progressed = False
             # 1. submissions
             budget = (self.ctx.max_concurrent_tasks - len(self._inflight)
                       - len(self._streams))
+            # out_heap is NOT counted: its bundles are held back waiting
+            # for a straggler's smaller order — counting them would freeze
+            # submissions (including the straggler's) into a deadlock.
+            # Bundles are ref+metadata handles; block memory is bounded by
+            # the object store, not this buffer.
             backpressured = (len(out_buffer)
                             >= self.ctx.max_buffered_output_bundles)
             if budget > 0 and not backpressured and not self._limit_reached():
@@ -1071,17 +1203,26 @@ class StreamingExecutor:
             # 3. route outputs downstream / to the consumer
             for op in self.ops:
                 for bundle in self._route_outputs(op):
-                    out_buffer.append(bundle)
+                    if ordered:
+                        out_heap.push(bundle)
+                    else:
+                        out_buffer.append(bundle)
             while out_buffer:
                 progressed = True
                 yield out_buffer.popleft()
+            if len(out_heap):
+                for bundle in out_heap.pop_ready(
+                        self.sink.out_min_pending()):
+                    progressed = True
+                    yield bundle
             # 4. done propagation
             self._propagate_done()
             if self.sink.finished and not self._inflight and \
                     not self._streams and not self.sink.out_queue:
                 for op in self.ops:
                     for bundle in self._route_outputs(op):
-                        yield bundle
+                        out_heap.push(bundle)
+                yield from out_heap.pop_ready(None)
                 return
             if self._limit_reached() and not self._inflight:
                 self.sink.maybe_finish()

@@ -374,3 +374,18 @@ def test_repartition_zip_out_of_order(ray_cluster):
     rows = sorted(a.zip(b).take_all(), key=lambda r: r["id"])
     assert len(rows) == 20
     assert [r["other"] for r in rows] == [500 + i for i in range(20)]
+
+
+def test_take_order_with_straggler_block(ray_cluster):
+    """take/iter are in DATASET order even when block 0 finishes LAST
+    (regression: limit used to keep the first-completed rows, so a busy
+    scheduler returned rows 50-54 for take(5))."""
+    import time as _t
+
+    ds = rd.range(80, override_num_blocks=4).map(
+        lambda row: (_t.sleep(0.4 if row["id"] == 0 else 0.0), row)[1])
+    rows = ds.take(5)
+    assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+    ds2 = rd.range(80, override_num_blocks=4).map(
+        lambda row: (_t.sleep(0.4 if row["id"] == 0 else 0.0), row)[1])
+    assert [r["id"] for r in ds2.take_all()] == list(range(80))
