@@ -117,6 +117,173 @@ def read_numpy(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
                            override_num_blocks=override_num_blocks)
 
 
+def read_tfrecords(paths, *,
+                   override_num_blocks: Optional[int] = None) -> Dataset:
+    """reference: python/ray/data/read_api.py read_tfrecords (tf.train.Example
+    records; decoded with a dependency-free proto/container codec)."""
+    from .datasource import TFRecordsDatasource
+
+    return read_datasource(TFRecordsDatasource(paths),
+                           override_num_blocks=override_num_blocks)
+
+
+def read_images(paths, *, size=None, mode=None, include_paths: bool = False,
+                override_num_blocks: Optional[int] = None) -> Dataset:
+    """reference: python/ray/data/read_api.py read_images (PIL-decoded)."""
+    from .datasource import ImagesDatasource
+
+    return read_datasource(
+        ImagesDatasource(paths, size=size, mode=mode,
+                         include_paths=include_paths),
+        override_num_blocks=override_num_blocks)
+
+
+def read_sql(sql: str, connection_factory, *,
+             override_num_blocks: Optional[int] = None) -> Dataset:
+    """reference: python/ray/data/read_api.py read_sql — any DB-API
+    connection factory (sqlite3.connect closure, psycopg2, ...)."""
+    from .datasource import SQLDatasource
+
+    return read_datasource(SQLDatasource(sql, connection_factory),
+                           override_num_blocks=override_num_blocks)
+
+
+def read_parquet_bulk(paths, *, columns: Optional[List[str]] = None,
+                      override_num_blocks: Optional[int] = None) -> Dataset:
+    """reference: read_parquet_bulk — one file per read unit, skipping
+    metadata consolidation (for many small files)."""
+    return read_datasource(ParquetDatasource(paths, columns=columns),
+                           override_num_blocks=override_num_blocks
+                           or 200)
+
+
+def from_blocks(blocks: List[Block]) -> Dataset:
+    return read_datasource(BlocksDatasource(list(blocks)),
+                           override_num_blocks=len(blocks) or 1)
+
+
+def _from_refs(refs, to_block) -> Dataset:
+    """Dataset over already-stored objects: each read task resolves its
+    ref on a worker (the owner keeps them pinned via the closure)."""
+    from .datasource import BlockMetadata, Datasource, ReadTask
+
+    class _RefsDatasource(Datasource):
+        def get_read_tasks(self, parallelism):
+            tasks = []
+            for r in refs:
+                def read(r=r):
+                    yield to_block(ray_tpu.get(r, timeout=600))
+
+                tasks.append(ReadTask(read, BlockMetadata(num_rows=0,
+                                                          size_bytes=0)))
+            return tasks
+
+    return read_datasource(_RefsDatasource(),
+                           override_num_blocks=len(refs) or 1)
+
+
+def from_arrow_refs(refs) -> Dataset:
+    return _from_refs(list(refs), lambda t: t)
+
+
+def _df_to_table(df):
+    import pyarrow as pa
+
+    return pa.Table.from_pandas(df)
+
+
+def from_pandas_refs(refs) -> Dataset:
+    return _from_refs(list(refs), _df_to_table)
+
+
+def from_numpy_refs(refs, column: str = "data") -> Dataset:
+    from .block import batch_to_block
+
+    return _from_refs(list(refs), lambda a: batch_to_block({column: a}))
+
+
+def from_huggingface(hf_dataset) -> Dataset:
+    """reference: read_api.py from_huggingface (datasets.Dataset holds an
+    arrow table; split it into row-group blocks)."""
+    table = hf_dataset.data.table if hasattr(hf_dataset, "data") else None
+    if table is None:
+        import pyarrow as pa
+
+        table = pa.Table.from_pydict(hf_dataset.to_dict())
+    return from_arrow(table.combine_chunks())
+
+
+def from_torch(torch_dataset) -> Dataset:
+    """reference: read_api.py from_torch (map-style torch dataset).
+    Lazy: each read task materializes its own index range on a worker —
+    the dataset object (not its contents) travels in the task closure."""
+    import builtins
+
+    from .block import rows_to_block
+    from .datasource import BlockMetadata, Datasource, ReadTask
+
+    n = len(torch_dataset)
+
+    class _TorchDatasource(Datasource):
+        def get_read_tasks(self, parallelism):
+            parallelism = max(1, min(parallelism, n or 1))
+            chunk = (n + parallelism - 1) // parallelism if n else 0
+            tasks = []
+            for start in builtins.range(0, n, max(chunk, 1)):
+                end = min(start + chunk, n)
+
+                def read(start=start, end=end):
+                    yield rows_to_block(
+                        [{"item": torch_dataset[i]}
+                         for i in builtins.range(start, end)])
+
+                tasks.append(ReadTask(read, BlockMetadata(
+                    num_rows=end - start, size_bytes=0)))
+            return tasks
+
+    return read_datasource(_TorchDatasource(),
+                           override_num_blocks=min(n, 8) or 1)
+
+
+def from_tf(tf_dataset) -> Dataset:
+    """reference: read_api.py from_tf (finite tf.data.Dataset)."""
+    rows = []
+    for el in tf_dataset.as_numpy_iterator():
+        if isinstance(el, dict):
+            rows.append(el)
+        elif isinstance(el, tuple):
+            rows.append({f"f{i}": v for i, v in enumerate(el)})
+        else:
+            rows.append({"item": el})
+    return from_items(rows)
+
+
+def _unavailable(name: str, dep: str):
+    def fn(*a, **kw):
+        raise ImportError(
+            f"ray_tpu.data.{name} requires {dep}, which is not available "
+            "in this environment (external-service connectors are gated)")
+    fn.__name__ = name
+    return fn
+
+
+# external-service connectors: present for API parity, gated on their
+# client libraries exactly like the reference gates them
+read_bigquery = _unavailable("read_bigquery", "google-cloud-bigquery")
+read_mongo = _unavailable("read_mongo", "pymongo")
+read_databricks_tables = _unavailable("read_databricks_tables",
+                                      "databricks-sql-connector")
+read_delta_sharing_tables = _unavailable("read_delta_sharing_tables",
+                                         "delta-sharing")
+read_iceberg = _unavailable("read_iceberg", "pyiceberg")
+read_lance = _unavailable("read_lance", "lance")
+read_avro = _unavailable("read_avro", "fastavro")
+from_spark = _unavailable("from_spark", "pyspark")
+from_dask = _unavailable("from_dask", "dask")
+from_mars = _unavailable("from_mars", "mars")
+from_modin = _unavailable("from_modin", "modin")
+
+
 __all__ = [
     "Dataset", "MaterializedDataset", "DataContext", "GroupedData",
     "Datasource", "ReadTask", "Block", "BlockAccessor", "BlockMetadata",
@@ -124,4 +291,7 @@ __all__ = [
     "read_datasource", "range", "range_tensor", "from_items", "from_numpy",
     "from_pandas", "from_arrow", "read_parquet", "read_csv", "read_json",
     "read_text", "read_binary_files", "read_numpy", "aggregate",
+    "read_tfrecords", "read_images", "read_sql", "read_parquet_bulk",
+    "from_blocks", "from_arrow_refs", "from_pandas_refs", "from_numpy_refs",
+    "from_huggingface", "from_torch", "from_tf",
 ]

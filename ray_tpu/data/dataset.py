@@ -393,6 +393,182 @@ class Dataset:
     def write_numpy(self, path: str, **kw) -> List[str]:
         return self._write(path, "npy", **kw)
 
+    def write_tfrecords(self, path: str, **kw) -> List[str]:
+        """reference: dataset.py write_tfrecords (tf.train.Example files,
+        written with the dependency-free codec in datasource.py)."""
+        return self._write(path, "tfrecords", **kw)
+
+    # -- additional consumption / conversion surface ----------------------
+
+    def take_batch(self, batch_size: int = 20,
+                   batch_format: Optional[str] = None) -> Dict[str, Any]:
+        """reference: dataset.py take_batch — first `batch_size` rows as
+        one batch."""
+        from .context import DataContext
+
+        fmt = batch_format or DataContext.get_current().default_batch_format
+        for b in self.limit(batch_size).iter_batches(
+                batch_size=batch_size, batch_format=fmt):
+            return b
+        raise ValueError("dataset is empty")
+
+    def _split_rows_at(self, rows: List[Dict[str, Any]],
+                       indices: List[int]) -> List["MaterializedDataset"]:
+        bounds = [0] + list(indices) + [len(rows)]
+        return [from_rows_materialized(rows[s:e])
+                for s, e in zip(bounds[:-1], bounds[1:])]
+
+    def train_test_split(self, test_size, *, shuffle: bool = False,
+                         seed: Optional[int] = None):
+        """reference: dataset.py train_test_split."""
+        if isinstance(test_size, float):
+            if not 0 < test_size < 1:
+                raise ValueError(
+                    f"test_size fraction must be in (0, 1), got {test_size}")
+        elif not isinstance(test_size, int) or test_size <= 0:
+            raise ValueError(
+                f"test_size must be a positive int or a fraction in (0, 1), "
+                f"got {test_size!r}")
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        rows = ds.take_all()  # one execution: count + split share it
+        n_test = (int(len(rows) * test_size)
+                  if isinstance(test_size, float) else test_size)
+        if n_test > len(rows):
+            raise ValueError(
+                f"test_size {test_size} exceeds dataset size {len(rows)}")
+        return tuple(self._split_rows_at(rows, [len(rows) - n_test]))
+
+    def split_proportionately(self, proportions: List[float]
+                              ) -> List["MaterializedDataset"]:
+        """reference: dataset.py split_proportionately — len(p)+1 splits,
+        the last taking the remainder."""
+        if not proportions or sum(proportions) >= 1.0 \
+                or any(p <= 0 for p in proportions):
+            raise ValueError("proportions must be positive and sum to < 1")
+        rows = self.take_all()  # one execution
+        indices, acc = [], 0
+        for p in proportions:
+            acc += int(len(rows) * p)
+            indices.append(acc)
+        return self._split_rows_at(rows, indices)
+
+    def randomize_block_order(self, *, seed: Optional[int] = None
+                              ) -> "Dataset":
+        """reference: dataset.py randomize_block_order — permute blocks
+        without touching rows (cheap approximate shuffle; blocks stay in
+        the object store, only their refs are reordered)."""
+        import random as _random
+
+        refs = [b.block_ref for b in self._execute()]
+        rng = _random.Random(seed)
+        rng.shuffle(refs)
+        from . import from_arrow_refs
+
+        return from_arrow_refs(refs)
+
+    def size_bytes(self) -> int:
+        """reference: dataset.py size_bytes."""
+        total = 0
+        for b in self._execute():
+            n = b.metadata.size_bytes
+            if not n:
+                blk = ray_tpu.get(b.block_ref, timeout=600)
+                n = BlockAccessor(blk).to_arrow().nbytes
+            total += n
+        return total
+
+    def input_files(self) -> List[str]:
+        """reference: dataset.py input_files."""
+        files: List[str] = []
+        for b in self._execute():
+            for f in (b.metadata.input_files or []):
+                if f not in files:
+                    files.append(f)
+        return files
+
+    def to_arrow_refs(self) -> List[Any]:
+        """reference: dataset.py to_arrow_refs — blocks ARE arrow tables."""
+        return [b.block_ref for b in self._execute()]
+
+    def to_pandas_refs(self) -> List[Any]:
+        to_df = ray_tpu.remote(
+            lambda b: BlockAccessor(b).to_arrow().to_pandas())
+        return [to_df.remote(r) for r in self.to_arrow_refs()]
+
+    def to_numpy_refs(self) -> List[Any]:
+        to_np = ray_tpu.remote(lambda b: BlockAccessor(b).to_numpy())
+        return [to_np.remote(r) for r in self.to_arrow_refs()]
+
+    def to_torch(self, *, label_column: Optional[str] = None,
+                 batch_size: int = 256, drop_last: bool = False):
+        """reference: dataset.py to_torch — torch IterableDataset of
+        (features, label) (or feature-dict) batches."""
+        import torch
+
+        outer = self
+
+        class _TorchIterable(torch.utils.data.IterableDataset):
+            def __iter__(self):
+                for b in outer.iter_torch_batches(batch_size=batch_size,
+                                                  drop_last=drop_last):
+                    if label_column is not None:
+                        label = b.pop(label_column)
+                        feats = (next(iter(b.values()))
+                                 if len(b) == 1 else b)
+                        yield feats, label
+                    else:
+                        yield b
+
+        return _TorchIterable()
+
+    def iter_tf_batches(self, *, batch_size: Optional[int] = 256,
+                        drop_last: bool = False, prefetch_batches: int = 2):
+        """reference: iterator.py iter_tf_batches — dict of tf tensors."""
+        import tensorflow as tf
+
+        for b in self.iter_batches(batch_size=batch_size,
+                                   batch_format="numpy",
+                                   drop_last=drop_last,
+                                   prefetch_batches=prefetch_batches):
+            yield {k: tf.convert_to_tensor(v) for k, v in b.items()}
+
+    def to_tf(self, feature_columns, label_columns, *,
+              batch_size: int = 256, drop_last: bool = False):
+        """reference: dataset.py to_tf — tf.data.Dataset of
+        (features, labels) tensors."""
+        import tensorflow as tf
+
+        f_cols = ([feature_columns] if isinstance(feature_columns, str)
+                  else list(feature_columns))
+        l_cols = ([label_columns] if isinstance(label_columns, str)
+                  else list(label_columns))
+
+        def pick(b, cols):
+            if len(cols) == 1:
+                return b[cols[0]]
+            return {c: b[c] for c in cols}
+
+        first = self.take_batch(1, batch_format="numpy")
+
+        def sig(cols):
+            if len(cols) == 1:
+                a = np.asarray(first[cols[0]])
+                return tf.TensorSpec(shape=(None,) + a.shape[1:],
+                                     dtype=tf.as_dtype(a.dtype))
+            return {c: tf.TensorSpec(
+                shape=(None,) + np.asarray(first[c]).shape[1:],
+                dtype=tf.as_dtype(np.asarray(first[c]).dtype))
+                for c in cols}
+
+        def gen():
+            for b in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+                yield pick(b, f_cols), pick(b, l_cols)
+
+        return tf.data.Dataset.from_generator(
+            gen, output_signature=(sig(f_cols), sig(l_cols)))
+
     def __repr__(self):
         return f"Dataset(dag={self._dag!r})"
 

@@ -255,6 +255,248 @@ def write_block(block: Block, path: str, file_format: str,
             np.save(fname, next(iter(cols.values())))
         else:
             np.save(fname, cols, allow_pickle=True)
+    elif file_format == "tfrecords":
+        from .block import BlockAccessor
+
+        with open(fname, "wb") as f:
+            for row in BlockAccessor(block).iter_rows():
+                _tfrecord_write(f, _example_encode(row))
     else:
         raise ValueError(f"unknown write format {file_format}")
     return fname
+
+
+# ---------------------------------------------------------------------------
+# TFRecord container format (pure python — the format is tiny: each record
+# is len(u64 LE) + masked-crc32c(len) + payload + masked-crc32c(payload)).
+# Reference: python/ray/data/datasource/tfrecords_datasource.py (which
+# delegates to tf.io); payloads are tf.train.Example protos, which we
+# encode/decode with a minimal hand-rolled proto codec (wire format only —
+# Example = {1: Features{1: map<string, Feature>}}, Feature is a oneof of
+# bytes_list(1)/float_list(2)/int64_list(3)).
+
+_CRC_TABLE = None
+
+
+def _crc32c(data: bytes) -> int:
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        table = []
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (0x82F63B78 ^ (c >> 1)) if c & 1 else c >> 1
+            table.append(c)
+        _CRC_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF)
+
+
+def _tfrecord_read(f) -> "Iterable[bytes]":
+    import struct as _s
+
+    while True:
+        head = f.read(12)
+        if len(head) < 12:
+            return
+        (length,), _ = _s.unpack("<Q", head[:8]), head[8:]
+        payload = f.read(length)
+        f.read(4)  # payload crc (not verified on read, like tf by default)
+        yield payload
+
+
+def _tfrecord_write(f, payload: bytes) -> None:
+    import struct as _s
+
+    head = _s.pack("<Q", len(payload))
+    f.write(head)
+    f.write(_s.pack("<I", _masked_crc(head)))
+    f.write(payload)
+    f.write(_s.pack("<I", _masked_crc(payload)))
+
+
+# -- minimal protobuf wire helpers for tf.train.Example ---------------------
+
+def _pb_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _pb_field(tag: int, payload: bytes) -> bytes:
+    return _pb_varint((tag << 3) | 2) + _pb_varint(len(payload)) + payload
+
+
+def _pb_read_varint(buf: bytes, i: int):
+    n = shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, i
+        shift += 7
+
+
+def _example_encode(row: "Dict[str, Any]") -> bytes:
+    import struct as _s
+
+    feats = b""
+    for name, value in row.items():
+        arr = np.asarray(value)
+        if arr.dtype.kind in "SUO" or isinstance(value, (bytes, str)):
+            vals = arr.reshape(-1).tolist() if arr.ndim else [arr.item()]
+            payload = b"".join(
+                _pb_field(1, v.encode() if isinstance(v, str) else bytes(v))
+                for v in vals)
+            feature = _pb_field(1, payload)          # bytes_list = field 1
+        elif arr.dtype.kind == "f":
+            # float_list(field 2) { packed floats(field 1) }
+            vals = arr.reshape(-1).astype("<f4")
+            feature = _pb_field(2, _pb_field(1, vals.tobytes()))
+        else:
+            # int64_list(field 3) { packed varints(field 1) }
+            ints = b"".join(_pb_varint(int(v) & 0xFFFFFFFFFFFFFFFF)
+                            for v in arr.reshape(-1).tolist() or [])
+            feature = _pb_field(3, _pb_field(1, ints))
+        entry = _pb_field(1, name.encode()) + _pb_field(2, feature)
+        feats += _pb_field(1, entry)                 # map entry
+    return _pb_field(1, feats)                        # Example.features
+
+
+def _example_decode(payload: bytes) -> "Dict[str, Any]":
+    def read_fields(buf):
+        i = 0
+        while i < len(buf):
+            key, i = _pb_read_varint(buf, i)
+            tag, wire = key >> 3, key & 7
+            if wire == 2:
+                ln, i = _pb_read_varint(buf, i)
+                yield tag, buf[i:i + ln]
+                i += ln
+            elif wire == 0:
+                v, i = _pb_read_varint(buf, i)
+                yield tag, v
+            elif wire == 5:
+                yield tag, buf[i:i + 4]
+                i += 4
+            elif wire == 1:
+                yield tag, buf[i:i + 8]
+                i += 8
+            else:
+                raise ValueError(f"bad wire type {wire}")
+
+    row: Dict[str, Any] = {}
+    for tag, features in read_fields(payload):
+        if tag != 1:
+            continue
+        for etag, entry in read_fields(features):
+            if etag != 1:
+                continue
+            name, feature = None, None
+            for ftag, fval in read_fields(entry):
+                if ftag == 1:
+                    name = fval.decode()
+                elif ftag == 2:
+                    feature = fval
+            if name is None or feature is None:
+                continue
+            for kind, lst in read_fields(feature):
+                vals: List[Any] = []
+                if kind == 1:      # bytes_list
+                    vals = [v for t, v in read_fields(lst) if t == 1]
+                elif kind == 2:    # float_list: packed bytes OR repeated
+                    for t, v in read_fields(lst):   # fixed32 (unpacked)
+                        if t != 1:
+                            continue
+                        if isinstance(v, (bytes, bytearray)):
+                            vals.extend(np.frombuffer(v, "<f4").tolist())
+                        else:
+                            vals.append(v)
+                elif kind == 3:    # int64_list: packed varints OR unpacked
+                    for t, v in read_fields(lst):
+                        if t != 1:
+                            continue
+                        if isinstance(v, (bytes, bytearray)):
+                            i = 0
+                            while i < len(v):
+                                n, i = _pb_read_varint(v, i)
+                                vals.append(n)
+                        else:
+                            vals.append(v)
+                    vals = [n - (1 << 64) if n >= 1 << 63 else n
+                            for n in vals]
+                row[name] = vals[0] if len(vals) == 1 else vals
+    return row
+
+
+class TFRecordsDatasource(FileBasedDatasource):
+    _suffixes = [".tfrecords", ".tfrecord"]
+
+    def _read_file(self, path: str, **kw) -> Block:
+        rows = []
+        with open(path, "rb") as f:
+            for payload in _tfrecord_read(f):
+                rows.append(_example_decode(payload))
+        return rows_to_block(rows)
+
+
+class ImagesDatasource(FileBasedDatasource):
+    """reference: python/ray/data/datasource/image_datasource.py"""
+
+    _suffixes = [".png", ".jpg", ".jpeg", ".bmp", ".gif"]
+
+    def _read_file(self, path: str, size=None, mode=None,
+                   include_paths=False, **kw) -> Block:
+        from PIL import Image
+
+        from .block import batch_to_block
+
+        img = Image.open(path)
+        if mode:
+            img = img.convert(mode)
+        if size:
+            img = img.resize((size[1], size[0]))
+        arr = np.asarray(img)
+        batch = {"image": arr[None]}
+        if include_paths:
+            batch["path"] = np.array([path])
+        return batch_to_block(batch)
+
+
+class SQLDatasource(Datasource):
+    """reference: python/ray/data/datasource/sql_datasource.py — any DB-API
+    connection factory (sqlite3, psycopg2, ...)."""
+
+    def __init__(self, sql: str, connection_factory):
+        self._sql = sql
+        self._factory = connection_factory
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        sql, factory = self._sql, self._factory
+
+        def read():
+            conn = factory()
+            try:
+                cur = conn.cursor()
+                cur.execute(sql)
+                cols = [d[0] for d in cur.description]
+                rows = [dict(zip(cols, r)) for r in cur.fetchall()]
+            finally:
+                conn.close()
+            yield rows_to_block(rows)
+
+        return [ReadTask(read, BlockMetadata(num_rows=0, size_bytes=0))]
