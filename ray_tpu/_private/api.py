@@ -118,10 +118,24 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: str, class_name: str = "Actor",
-                 is_owner: bool = False):
+                 is_owner: bool = False, owner_addr=None,
+                 _register_borrow: bool = False):
         self._actor_id = actor_id
         self._class_name = class_name
         self._is_owner = is_owner
+        self._owner_addr = tuple(owner_addr) if owner_addr else None
+        self._borrow_registered = False
+        if _register_borrow and not is_owner:
+            # deserialized handle: register as a borrower with the owner
+            # so the actor outlives the owner's handles while we exist
+            # (reference: distributed actor-handle reference counting)
+            try:
+                core = current_core()
+                if core is not None and not core._shutdown:
+                    self._borrow_registered = core.on_actor_handle_borrowed(
+                        actor_id, self._owner_addr)
+            except Exception:
+                pass
 
     def __getattr__(self, name):
         if name.startswith("_"):
@@ -137,21 +151,38 @@ class ActorHandle:
         return ActorMethod(self, "__apply__").remote(fn, *args, **kwargs)
 
     def __reduce__(self):
-        # deserialized handles are borrowed: they never own the lifetime
-        return (ActorHandle, (self._actor_id, self._class_name))
+        # deserialized handles are borrowed: they don't own the lifetime
+        # but DO extend it (the serializing core takes a transit hold so
+        # the actor survives the pickling->registration gap)
+        try:
+            core = current_core()
+            if core is not None and not core._shutdown:
+                core.on_actor_handle_serialized(self._actor_id,
+                                                self._owner_addr)
+        except Exception:
+            pass
+        return (ActorHandle, (self._actor_id, self._class_name, False,
+                              self._owner_addr, True))
 
     def __del__(self):
-        # the owner handle going out of scope terminates the actor
+        # the last owner handle going out of scope terminates the actor
         # gracefully — queued behind in-flight calls, so
         # `Actor.remote().method.remote()` temporaries don't kill the
         # actor under their own call (reference semantics: actors are
-        # GC'd with their original handle unless detached, via a
-        # __ray_terminate__ marker task)
+        # GC'd when no handle remains, via a __ray_terminate__ marker
+        # task); borrowed handles deregister with the owner instead
         if getattr(self, "_is_owner", False):
             try:
                 core = current_core()
                 if not core._shutdown:
                     core.release_actor(self._actor_id)
+            except Exception:
+                pass
+        elif getattr(self, "_borrow_registered", False):
+            try:
+                core = current_core()
+                if core is not None and not core._shutdown:
+                    core.on_actor_handle_dropped(self._actor_id)
             except Exception:
                 pass
 
@@ -209,7 +240,8 @@ class ActorClass:
             strategy=strategy,
         )
         return ActorHandle(aid, self._cls.__name__,
-                           is_owner=o.get("lifetime") != "detached")
+                           is_owner=o.get("lifetime") != "detached",
+                           owner_addr=core.addr)
 
     def __call__(self, *a, **k):
         raise TypeError(f"actor class {self._cls.__name__} cannot be "
@@ -232,6 +264,10 @@ def remote(*args, **opts):
 
 
 def get_actor(name: str, namespace: str = None) -> ActorHandle:
+    """Named-actor lookup.  The returned handle is WEAK (owner_addr-less):
+    it neither owns nor extends the actor's lifetime, matching the
+    reference — a named non-detached actor still dies when its creator's
+    handles drop; use lifetime="detached" to outlive the creator."""
     core = current_core()
     view = core.get_actor_by_name(name, namespace=namespace)
     if view is None or view["state"] == "DEAD":

@@ -362,6 +362,10 @@ class CoreWorker:
         self.server.handle("get_object", self.h_get_object, deferred=True)
         self.server.handle("add_ref", self.h_add_ref)
         self.server.handle("del_ref", self.h_del_ref)
+        self.server.handle("actor_add_ref", self.h_actor_add_ref)
+        self.server.handle("actor_del_ref", self.h_actor_del_ref)
+        self.server.handle("actor_transit", self.h_actor_transit)
+        self.server.handle("actor_borrow_check", self.h_actor_borrow_check)
         self.server.handle("generator_item", self.h_generator_item,
                            deferred=True)
         self.server.handle("ping", lambda c, p: "pong")
@@ -398,6 +402,24 @@ class CoreWorker:
         self.objects: Dict[str, ObjectEntry] = {}
         self.local_ref_counts: Dict[str, int] = {}
         self.borrowed: Dict[str, SerializedRef] = {}
+
+        # actor-handle borrow protocol (reference: distributed actor handle
+        # reference counting — an actor lives while ANY handle exists, not
+        # just the creator's):
+        #   owner side: borrower worker-ids + in-transit serialization
+        #   holds; release defers until both clear.
+        #   borrower side: local handle counts per borrowed actor.
+        # owner side: borrower worker-id -> [count, addr].  A COUNT, not
+        # a set: add/del notifications ride the borrower's FIFO connection,
+        # so counting makes a drop-to-zero racing a re-borrow on the same
+        # worker net out correctly.  addr is probed for liveness while a
+        # release is pending — a crashed borrower never sends actor_del_ref.
+        self._actor_borrowers: Dict[str, Dict[str, list]] = {}
+        # one hold deadline per in-flight serialized copy of a handle
+        self._actor_transit: Dict[str, List[float]] = {}
+        self._actor_pending_release: Set[str] = set()
+        self._actor_probe_scheduled: Set[str] = set()
+        self._borrowed_actors: Dict[str, list] = {}  # aid -> [count, owner]
 
         # task submission
         self.pools: Dict[Any, SchedPool] = {}
@@ -544,6 +566,23 @@ class CoreWorker:
     def shutdown(self):
         if self._shutdown:
             return
+        # tell owners this core's borrowed actor handles are gone (a
+        # crashed borrower instead leaks its registration until the owner
+        # core exits; actors die with their job regardless)
+        with self.lock:
+            borrowed_actors = {aid: tuple(rec[1])
+                               for aid, rec in self._borrowed_actors.items()}
+            self._borrowed_actors.clear()
+        for aid, owner_addr in borrowed_actors.items():
+            if owner_addr == self.addr:
+                continue
+            try:
+                self._owner_client(owner_addr).notify(
+                    "actor_del_ref", {"actor_id": aid,
+                                      "borrower": self.worker_id,
+                                      "all": True})
+            except Exception:
+                pass
         self._shutdown = True
         # fail pending awaited futures instead of hanging their loops
         with self._future_lock:
@@ -2139,12 +2178,227 @@ class CoreWorker:
         self._control_call("kill_actor", {"actor_id": actor_id,
                                          "no_restart": no_restart}, timeout=30.0)
 
+    # -- actor-handle borrow protocol --------------------------------------
+    # reference: actor handles are reference-counted cluster-wide; the
+    # actor is GC'd when no handle (owned or borrowed) remains.  Borrowed
+    # handles register with the owner at deserialization; serialization
+    # itself takes a time-bounded "transit" hold that bridges the gap
+    # between pickling a handle and the receiver registering its borrow
+    # (the window in which the old implementation killed the actor).
+
+    ACTOR_TRANSIT_S = 60.0
+
+    def on_actor_handle_serialized(self, actor_id: str, owner_addr):
+        if owner_addr is None:
+            # a weak handle (get_actor lookup): extends nothing, matching
+            # the reference — named lookups don't own or pin lifetime
+            return
+        if tuple(owner_addr) == self.addr:
+            with self.lock:
+                self._actor_transit.setdefault(actor_id, []).append(
+                    time.monotonic() + self.ACTOR_TRANSIT_S)
+            return
+        try:
+            self._owner_client(tuple(owner_addr)).notify(
+                "actor_transit", {"actor_id": actor_id})
+        except Exception:
+            pass
+
+    def on_actor_handle_borrowed(self, actor_id: str, owner_addr) -> bool:
+        if owner_addr is None:
+            return False
+        owner_addr = tuple(owner_addr)
+        if owner_addr == self.addr:
+            # a handle round-tripped back to its owner: count it like any
+            # other borrower (loopback entry, no RPC) and retire one
+            # in-transit hold like h_actor_add_ref would
+            with self.lock:
+                ent = self._actor_borrowers.setdefault(actor_id, {}) \
+                    .setdefault(self.worker_id, [0, self.addr])
+                ent[0] += 1
+                self._borrowed_actors.setdefault(
+                    actor_id, [0, owner_addr])[0] += 1
+                holds = self._actor_transit.get(actor_id)
+                if holds:
+                    holds.pop(0)
+                    if not holds:
+                        self._actor_transit.pop(actor_id, None)
+            return True
+        with self.lock:
+            rec = self._borrowed_actors.setdefault(actor_id, [0, owner_addr])
+            rec[0] += 1
+        # notify on EVERY deserialization, not just the first: the owner
+        # retires one per-pickle transit hold per add_ref, and a warm
+        # worker deserializing the same handle twice must retire both
+        # (the borrower set on the owner is idempotent)
+        try:
+            self._owner_client(owner_addr).notify(
+                "actor_add_ref", {"actor_id": actor_id,
+                                  "borrower": self.worker_id,
+                                  "borrower_addr": self.addr})
+        except Exception:
+            pass
+        return True
+
+    def on_actor_handle_dropped(self, actor_id: str):
+        # symmetric with on_actor_handle_borrowed: one del notification
+        # per dropped handle (the owner counts adds per deserialization)
+        with self.lock:
+            rec = self._borrowed_actors.get(actor_id)
+            if rec is None:
+                return
+            rec[0] -= 1
+            if rec[0] <= 0:
+                self._borrowed_actors.pop(actor_id, None)
+            owner_addr = tuple(rec[1])
+        if owner_addr == self.addr:
+            with self.lock:
+                bs = self._actor_borrowers.get(actor_id)
+                ent = bs.get(self.worker_id) if bs else None
+                if ent is not None:
+                    ent[0] -= 1
+                    if ent[0] <= 0:
+                        bs.pop(self.worker_id, None)
+                    if not bs:
+                        self._actor_borrowers.pop(actor_id, None)
+            self._maybe_release_actor(actor_id)
+            return
+        try:
+            self._owner_client(owner_addr).notify(
+                "actor_del_ref", {"actor_id": actor_id,
+                                  "borrower": self.worker_id})
+        except Exception:
+            pass
+
+    def h_actor_add_ref(self, conn, p):
+        aid = p["actor_id"]
+        with self.lock:
+            addr = tuple(p.get("borrower_addr") or ()) or None
+            ent = self._actor_borrowers.setdefault(aid, {}) \
+                .setdefault(p["borrower"], [0, addr])
+            ent[0] += 1
+            ent[1] = addr or ent[1]
+            # one in-flight serialized copy arrived: retire its hold (one
+            # entry per serialization, so other still-in-flight pickles of
+            # the same handle keep their own protection)
+            holds = self._actor_transit.get(aid)
+            if holds:
+                holds.pop(0)
+                if not holds:
+                    self._actor_transit.pop(aid, None)
+        return True
+
+    def h_actor_del_ref(self, conn, p):
+        aid = p["actor_id"]
+        with self.lock:
+            bs = self._actor_borrowers.get(aid)
+            ent = bs.get(p["borrower"]) if bs else None
+            if ent is not None:
+                ent[0] -= 1
+                if ent[0] <= 0 or p.get("all"):
+                    bs.pop(p["borrower"], None)
+                if not bs:
+                    self._actor_borrowers.pop(aid, None)
+        self._maybe_release_actor(aid)
+        return True
+
+    def h_actor_transit(self, conn, p):
+        with self.lock:
+            self._actor_transit.setdefault(p["actor_id"], []).append(
+                time.monotonic() + self.ACTOR_TRANSIT_S)
+        return True
+
+    ACTOR_BORROW_PROBE_S = 20.0
+
+    def _probe_actor_borrowers(self, actor_id: str):
+        """A release is pending but borrowers block it: verify they are
+        still alive and still hold the handle — a crashed borrower never
+        sends actor_del_ref and would block release forever."""
+        with self.lock:
+            self._actor_probe_scheduled.discard(actor_id)
+            if actor_id not in self._actor_pending_release:
+                return
+            borrowers = dict(self._actor_borrowers.get(actor_id) or {})
+        stale = []
+        for bid, ent in borrowers.items():
+            addr = ent[1] if isinstance(ent, list) else ent
+            if bid == self.worker_id:
+                continue  # loopback entries validated by local state
+            alive = False
+            if addr:
+                try:
+                    cli = Client(tuple(addr), name="core-borrow-probe",
+                                 connect_timeout=5.0)
+                    alive = bool(cli.call("actor_borrow_check",
+                                          {"actor_id": actor_id},
+                                          timeout=10.0))
+                    cli.close()
+                except Exception:
+                    alive = False
+            if not alive:
+                stale.append(bid)
+        if stale:
+            with self.lock:
+                bs = self._actor_borrowers.get(actor_id)
+                for bid in stale:
+                    if bs is not None:
+                        bs.pop(bid, None)
+                if bs is not None and not bs:
+                    self._actor_borrowers.pop(actor_id, None)
+        self._maybe_release_actor(actor_id)
+
+    def h_actor_borrow_check(self, conn, p):
+        with self.lock:
+            return p["actor_id"] in self._borrowed_actors
+
+    def _maybe_release_actor(self, actor_id: str):
+        with self.lock:
+            if actor_id not in self._actor_pending_release:
+                return
+            if self._actor_borrowers.get(actor_id):
+                # a borrower still holds a handle; schedule a liveness
+                # probe in case it crashed without deregistering
+                if actor_id not in self._actor_probe_scheduled:
+                    self._actor_probe_scheduled.add(actor_id)
+                    t = threading.Timer(
+                        self.ACTOR_BORROW_PROBE_S,
+                        lambda: self.pool_executor.submit(
+                            self._probe_actor_borrowers, actor_id))
+                    t.daemon = True
+                    t.start()
+                return
+            now = time.monotonic()
+            holds = [h for h in self._actor_transit.get(actor_id, [])
+                     if h > now]
+            if holds:
+                self._actor_transit[actor_id] = holds
+                delay = min(holds) - now
+            else:
+                self._actor_pending_release.discard(actor_id)
+                self._actor_transit.pop(actor_id, None)
+                delay = None
+        if delay is not None:
+            t = threading.Timer(delay + 0.05,
+                                self._maybe_release_actor, (actor_id,))
+            t.daemon = True
+            t.start()
+            return
+        self._terminate_actor(actor_id)
+
     def release_actor(self, actor_id: str):
-        """Owner handle went out of scope: terminate gracefully.  The
-        __ray_terminate__ marker rides the ordered actor queue, so calls
-        already submitted finish first (reference: ActorHandle.__del__ ->
-        __ray_terminate__ semantics); a hard kill_actor is the fallback
-        when the actor has no live connection to drain.
+        """Every owner handle went out of scope: terminate — unless a
+        borrowed handle (or an in-transit serialized copy) still exists,
+        in which case the release defers until they clear."""
+        with self.lock:
+            self._actor_pending_release.add(actor_id)
+        self._maybe_release_actor(actor_id)
+
+    def _terminate_actor(self, actor_id: str):
+        """Terminate gracefully.  The __ray_terminate__ marker rides the
+        ordered actor queue, so calls already submitted finish first
+        (reference: ActorHandle.__del__ -> __ray_terminate__ semantics); a
+        hard kill_actor is the fallback when the actor has no live
+        connection to drain.
 
         Runs off-thread: __del__ may fire inside GC while this thread
         holds an ActorConn lock the submit path needs."""

@@ -51,6 +51,7 @@ class WorkerRecord:
         self.blocked = False
         self.lease_resources: Dict[str, int] = {}
         self.lease_retriable = True  # OOM-victim hint from the owner
+        self.lease_client_id: Optional[str] = None  # whose core holds us
         self.bundle_key: Optional[Tuple[str, int]] = None
         self.tpu = False  # spawned with TPU device visibility
 
@@ -379,6 +380,8 @@ class Raylet:
             self.workers.pop(rec.worker_id, None)
             self.workers_by_token.pop(rec.token, None)
         self._kill_worker(rec)
+        # its core may have held leases on other workers for nested tasks
+        self._reclaim_leases_of_dead_client(rec.worker_id)
         self._try_grant()
         return True
 
@@ -390,26 +393,36 @@ class Raylet:
             rec = self.workers.get(wid)
             if rec is None:
                 return
+            # single critical section (the lock is re-entrant, so the
+            # reclaim below may re-acquire it): no TOCTOU window between
+            # classifying the record and retiring it
             if rec.state == "dead":
                 # killed via a kill path that already handled resources —
                 # the record must still leave the table, or it counts
                 # against max_workers forever and eventually starves all
-                # worker spawning
+                # worker spawning.  Leases ITS core held on other workers
+                # still need reclaiming (below).
                 self.workers.pop(wid, None)
                 self.workers_by_token.pop(rec.token, None)
-                return
-            was = rec.state
-            actor_id = rec.actor_id
-            if rec.lease_resources:
-                self._free_lease_resources(rec)
-            if rec in self.idle:
-                try:
-                    self.idle.remove(rec)
-                except ValueError:
-                    pass
-            rec.state = "dead"
-            self.workers.pop(wid, None)
-            self.workers_by_token.pop(rec.token, None)
+                was = actor_id = None
+                killed_path = True
+            else:
+                killed_path = False
+                was = rec.state
+                actor_id = rec.actor_id
+                if rec.lease_resources:
+                    self._free_lease_resources(rec)
+                if rec in self.idle:
+                    try:
+                        self.idle.remove(rec)
+                    except ValueError:
+                        pass
+                rec.state = "dead"
+                self.workers.pop(wid, None)
+                self.workers_by_token.pop(rec.token, None)
+        if killed_path:
+            self._reclaim_leases_of_dead_client(wid)
+            return
         if actor_id and self.control is not None and not self._stop.is_set():
             try:
                 self.control.notify("actor_failed", {
@@ -418,6 +431,39 @@ class Raylet:
                 })
             except OSError:
                 pass
+        self._reclaim_leases_of_dead_client(wid)
+
+    def _reclaim_leases_of_dead_client(self, dead_worker_id: str):
+        """A local worker (whose core may have leased OTHER workers for
+        nested tasks — e.g. an actor running data tasks) died: free the
+        leases it held, or they stay 'leased' forever and the node starves
+        (reference: raylet lease cleanup on client disconnect).  The
+        leased workers are KILLED, not recycled — they may still be
+        executing the dead client's task, and a stale task queued ahead
+        would stall the next lessee's work indefinitely."""
+        reclaimed = []
+        with self.lock:
+            for rec in list(self.workers.values()):
+                if rec.state == "leased" \
+                        and rec.lease_client_id == dead_worker_id:
+                    self._free_lease_resources(rec)
+                    rec.blocked = False
+                    rec.lease_id = None
+                    rec.lease_client_id = None
+                    self.workers.pop(rec.worker_id, None)
+                    self.workers_by_token.pop(rec.token, None)
+                    reclaimed.append(rec)
+        for rec in reclaimed:
+            self._kill_worker(rec)
+        if reclaimed:
+            logger.info("reclaimed %d lease(s) of dead client %s",
+                        len(reclaimed), dead_worker_id[:12])
+            # a reclaimed worker's own core may have leased further
+            # workers (depth-2 nesting); its disconnect handler will
+            # no-op (record already popped), so recurse here
+            for rec in reclaimed:
+                self._reclaim_leases_of_dead_client(rec.worker_id)
+            self._try_grant()
 
     def _reap_loop(self):
         while not self._stop.is_set():
@@ -578,6 +624,7 @@ class Raylet:
                 w.lease_id = common.new_id("lease-")
                 w.lease_resources = pl.demand
                 w.lease_retriable = pl.retriable
+                w.lease_client_id = pl.client_id
                 grants.append((pl, w))
         for _ in range(spawn):
             self._spawn_worker(tpu=spawn_tpu)

@@ -259,21 +259,14 @@ class Dataset:
                      local_shuffle_buffer_size: Optional[int] = None,
                      local_shuffle_seed: Optional[int] = None,
                      prefetch_batches: Optional[int] = None) -> Iterator:
-        ctx = DataContext.get_current()
-        fmt = batch_format or ctx.default_batch_format
-
-        def blocks():
-            for bundle in self._execute():
-                yield ray_tpu.get(bundle.block_ref, timeout=600)
-
-        it = iter_block_batches(
-            blocks(), batch_size=batch_size, batch_format=fmt,
+        # single implementation lives on DataIterator (reference shape:
+        # Dataset.iter_batches delegates to Dataset.iterator())
+        return self.iterator().iter_batches(
+            batch_size=batch_size, batch_format=batch_format,
             drop_last=drop_last,
             local_shuffle_buffer_size=local_shuffle_buffer_size,
-            seed=local_shuffle_seed)
-        depth = ctx.prefetch_batches if prefetch_batches is None \
-            else prefetch_batches
-        return prefetch_iter(it, depth)
+            local_shuffle_seed=local_shuffle_seed,
+            prefetch_batches=prefetch_batches)
 
     def iter_jax_batches(self, *, batch_size: Optional[int] = 256,
                          sharding=None, dtypes=None, drop_last: bool = True,
@@ -366,12 +359,7 @@ class Dataset:
 
     def split_at_indices(self, indices: List[int]
                          ) -> List["MaterializedDataset"]:
-        rows = self.take_all()
-        bounds = [0] + list(indices) + [len(rows)]
-        out = []
-        for s, e in zip(bounds[:-1], bounds[1:]):
-            out.append(from_rows_materialized(rows[s:e]))
-        return out
+        return self._split_rows_at(self.take_all(), indices)
 
     def _write(self, path: str, fmt: str, **writer_args) -> List[str]:
         def write(block: Block, _path=path, _fmt=fmt, _wa=writer_args):
@@ -568,6 +556,24 @@ class Dataset:
 
         return tf.data.Dataset.from_generator(
             gen, output_signature=(sig(f_cols), sig(l_cols)))
+
+    def iterator(self) -> "Any":
+        """reference: dataset.py iterator() -> DataIterator."""
+        from .iterator import _DatasetIterator
+
+        return _DatasetIterator(self)
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List[Any]:
+        """reference: dataset.py streaming_split — n DataIterators served
+        by one coordinator actor executing the stream once; the iterators
+        serialize into Train worker tasks.  equal=True pre-splits into
+        exact equal-row shards (SPMD workers must step in lockstep);
+        equal=False streams blocks first-come-first-served."""
+        from .iterator import _SplitCoordinator, _StreamSplitIterator
+
+        coord = ray_tpu.remote(_SplitCoordinator).remote(self, n, equal)
+        return [_StreamSplitIterator(coord, i) for i in range(n)]
 
     def __repr__(self):
         return f"Dataset(dag={self._dag!r})"

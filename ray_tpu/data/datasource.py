@@ -304,10 +304,16 @@ def _tfrecord_read(f) -> "Iterable[bytes]":
 
     while True:
         head = f.read(12)
-        if len(head) < 12:
+        if not head:
             return
+        if len(head) < 12:
+            raise ValueError("truncated tfrecord file (partial header)")
         (length,), _ = _s.unpack("<Q", head[:8]), head[8:]
         payload = f.read(length)
+        if len(payload) < length:
+            raise ValueError(
+                f"truncated tfrecord file (record claims {length} bytes, "
+                f"got {len(payload)})")
         f.read(4)  # payload crc (not verified on read, like tf by default)
         yield payload
 
@@ -359,6 +365,13 @@ def _example_encode(row: "Dict[str, Any]") -> bytes:
         arr = np.asarray(value)
         if arr.dtype.kind in "SUO" or isinstance(value, (bytes, str)):
             vals = arr.reshape(-1).tolist() if arr.ndim else [arr.item()]
+            for v in vals:
+                if not isinstance(v, (bytes, str, np.bytes_, np.str_)):
+                    # bytes(int) would write zero-filled garbage silently
+                    raise ValueError(
+                        f"tfrecords column {name!r}: unsupported value "
+                        f"{type(v).__name__} (want int/float/bytes/str or "
+                        "uniform lists thereof)")
             payload = b"".join(
                 _pb_field(1, v.encode() if isinstance(v, str) else bytes(v))
                 for v in vals)

@@ -170,3 +170,193 @@ def iter_jax_batches(batch_iter: Iterator[Dict[str, np.ndarray]], *,
         buf.append(nxt)
     while buf:
         yield buf.popleft()
+
+
+# ---------------------------------------------------------------------------
+# DataIterator: a shardable batch-iteration handle (reference:
+# python/ray/data/iterator.py DataIterator + _StreamingIterator). Train
+# workers receive these — they must serialize into actor tasks.
+
+
+class DataIterator:
+    """Batch iteration over a stream of blocks; see Dataset.iterator()
+    and Dataset.streaming_split()."""
+
+    def _block_iter(self):
+        raise NotImplementedError
+
+    def iter_rows(self):
+        for block in self._block_iter():
+            yield from BlockAccessor(block).iter_rows()
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: Optional[str] = None,
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None,
+                     prefetch_batches: Optional[int] = None):
+        ctx = DataContext.get_current()
+        fmt = batch_format or ctx.default_batch_format
+        it = iter_block_batches(
+            self._block_iter(), batch_size=batch_size, batch_format=fmt,
+            drop_last=drop_last,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            seed=local_shuffle_seed)
+        depth = (ctx.prefetch_batches if prefetch_batches is None
+                 else prefetch_batches)
+        return prefetch_iter(it, depth) if depth else it
+
+    def iter_torch_batches(self, **kw):
+        import torch
+
+        for b in self.iter_batches(batch_format="numpy", **kw):
+            yield {k: torch.as_tensor(v) for k, v in b.items()}
+
+    def iter_jax_batches(self, **kw):
+        sharding = kw.pop("sharding", None)
+        dtypes = kw.pop("dtypes", None)
+        prefetch = kw.pop("prefetch", 2)
+        return iter_jax_batches(
+            self.iter_batches(batch_format="numpy", **kw),
+            sharding=sharding, dtypes=dtypes, prefetch=prefetch)
+
+
+class _DatasetIterator(DataIterator):
+    """Iterator over a full Dataset (Dataset.iterator())."""
+
+    def __init__(self, ds):
+        self._ds = ds
+
+    def _block_iter(self):
+        for bundle in self._ds.iter_internal_ref_bundles():
+            yield ray_tpu.get(bundle.block_ref, timeout=600)
+
+
+class _SplitCoordinator:
+    """Actor executing one Dataset stream per epoch and serving its output
+    blocks to n consumers (reference: _internal/execution/streaming_executor
+    -> StreamSplitDataIterator coordinator actor).  Iterating a shard again
+    is a new epoch: the stream re-executes once EVERY split finished the
+    previous epoch (SPMD consumers iterate in lockstep, like the
+    reference's split coordinator epoch barrier)."""
+
+    def __init__(self, ds, n: int, equal: bool):
+        import asyncio
+
+        self._ds = ds
+        self._n = n
+        self._equal = equal
+        self._epoch = -1      # no epoch started yet
+        self._done = set()    # splits finished with the current epoch
+        self._gen = None
+        self._gen_lock = asyncio.Lock()
+        self._start_task = None
+        self._static = None   # equal=True: per-split block ref deques
+        # pin only a bounded in-flight window of served refs: consumers
+        # fetch a block right after receiving its ref, and pinning the
+        # whole epoch would hold the entire dataset in the object store
+        self._served = collections.deque(maxlen=64)
+
+    PARK_S = 20.0  # max server-side park per call (client just re-calls)
+
+    def _materialize_epoch(self):
+        """Runs in a worker thread (to_thread): equal=True materializes
+        the whole dataset; streaming just builds the generator."""
+        if self._equal:
+            splits = self._ds.split(self._n, equal=True)
+            return [collections.deque(s.get_internal_block_refs())
+                    for s in splits]
+        return iter(self._ds.iter_internal_ref_bundles())
+
+    async def next_block_ref(self, split_idx: int, epoch: int):
+        """{"ref": r} | {"end": True} | {"wait": True}.  Barrier and
+        epoch-start waits park HERE on the actor's event loop (async
+        actor: calls interleave at awaits) for up to PARK_S — the client
+        re-calls on {"wait"}, so no per-call timeout ever has to cover an
+        unboundedly slow peer or a long epoch materialization.  State is
+        loop-thread-confined; mutations only between awaits."""
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        t0 = loop.time()
+        while True:
+            if epoch > self._epoch:
+                if self._epoch >= 0 and len(self._done) < self._n:
+                    # some split is still consuming the previous epoch
+                    if loop.time() - t0 > self.PARK_S:
+                        return {"wait": True}
+                    await asyncio.sleep(0.02)
+                    continue
+                if self._start_task is None:
+                    self._start_task = asyncio.ensure_future(
+                        asyncio.to_thread(self._materialize_epoch))
+                if not self._start_task.done():
+                    if loop.time() - t0 > self.PARK_S:
+                        return {"wait": True}
+                    await asyncio.sleep(0.02)
+                    continue
+                task, self._start_task = self._start_task, None
+                payload = task.result()  # raises the materialization error
+                self._epoch = epoch
+                self._done = set()
+                self._served = collections.deque(maxlen=64)
+                if self._equal:
+                    self._static = payload
+                else:
+                    self._gen = payload
+            elif epoch < self._epoch or split_idx in self._done:
+                return {"end": True}
+            if self._equal:
+                q = self._static[split_idx]
+                if not q:
+                    self._done.add(split_idx)
+                    return {"end": True}
+                ref = q.popleft()
+                self._served.append(ref)
+                return {"ref": ref}
+            async with self._gen_lock:
+                gen = self._gen
+                # sentinel form: a raw StopIteration cannot cross an
+                # executor Future boundary
+                bundle = await asyncio.to_thread(next, gen, None)
+            if bundle is None:
+                self._done.add(split_idx)
+                return {"end": True}
+            self._served.append(bundle)
+            return {"ref": bundle.block_ref}
+
+    async def finish_epoch(self, split_idx: int, epoch: int):
+        """Consumer stopped iterating (exhausted OR abandoned mid-epoch) —
+        count it toward the epoch barrier either way."""
+        if epoch == self._epoch:
+            self._done.add(split_idx)
+        return True
+
+
+class _StreamSplitIterator(DataIterator):
+    """One shard of Dataset.streaming_split; safe to ship to an actor.
+    Each full iteration is one epoch of the underlying stream."""
+
+    def __init__(self, coordinator, split_idx: int):
+        self._coord = coordinator
+        self._idx = split_idx
+        self._epoch = 0
+
+    def _block_iter(self):
+        epoch = self._epoch
+        self._epoch += 1
+        try:
+            while True:
+                r = ray_tpu.get(
+                    self._coord.next_block_ref.remote(self._idx, epoch),
+                    timeout=600)
+                if r.get("wait"):
+                    continue  # server parked PARK_S; just ask again
+                if r.get("end"):
+                    return
+                yield ray_tpu.get(r["ref"], timeout=600)
+        finally:
+            try:
+                self._coord.finish_epoch.remote(self._idx, epoch)
+            except Exception:
+                pass

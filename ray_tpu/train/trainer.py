@@ -69,13 +69,21 @@ class JaxTrainer:
     # -- dataset sharding --------------------------------------------------
 
     def _shard_datasets(self, n: int) -> Optional[List[Dict[str, Any]]]:
+        """Per-worker dataset shards.  ray_tpu.data Datasets shard via
+        streaming_split (one coordinator actor streams blocks; workers get
+        serializable DataIterators — reference: get_dataset_shard returns
+        a DataIterator backed by streaming_split(equal=True)); anything
+        else is replicated."""
         if not self._datasets:
             return None
         per_worker: List[Dict[str, Any]] = [dict() for _ in range(n)]
         for name, ds in self._datasets.items():
             shards = None
+            streaming_split = getattr(ds, "streaming_split", None)
             split = getattr(ds, "split", None)  # ray_tpu.data Dataset
-            if callable(split):
+            if callable(streaming_split):
+                shards = streaming_split(n, equal=True)
+            elif callable(split):
                 try:
                     shards = split(n, equal=True)
                 except TypeError:

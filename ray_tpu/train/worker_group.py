@@ -95,9 +95,14 @@ class WorkerGroup:
         self._pg = placement_group(bundles, strategy=placement_strategy)
         if not self._pg.ready(timeout=60.0):
             remove_placement_group(self._pg)
+            try:
+                state = (f"cluster={ray_tpu.cluster_resources()} "
+                         f"available={ray_tpu.available_resources()}")
+            except Exception:
+                state = "(cluster state unavailable)"
             raise RuntimeError(
                 f"could not reserve {bundles} for {num_workers} training "
-                f"workers (cluster too small?)")
+                f"workers (cluster too small?); {state}")
         remote_cls = ray_tpu.remote(actor_cls)
         self.workers: List[Worker] = []
         handles = []

@@ -115,6 +115,7 @@ class ClientServer:
     def stop(self):
         self.server.stop()
         self.core.shutdown()
+        self.pool.shutdown()  # or its 32 worker threads outlive the server
 
     def _drop_conn(self, conn: ServerConn):
         with self.lock:

@@ -30,9 +30,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
 
+# debugging aid for wedged runs: `kill -USR1 <pytest pid>` dumps every
+# thread's stack to /tmp/pytest_stacks.txt
+import faulthandler  # noqa: E402
+import signal  # noqa: E402
+
+try:
+    faulthandler.register(signal.SIGUSR1,
+                          file=open("/tmp/pytest_stacks.txt", "w"))
+except (AttributeError, OSError):
+    pass
+
 
 @pytest.fixture(autouse=True)
-def _collect_cycles_after_test():
+def _collect_cycles_after_test(request):
     """Actor handles caught in exception-traceback cycles (pytest.raises,
     try/except in tests) are only finalized by the cycle collector; run it
     so out-of-scope actors release their resources before the next test
@@ -41,6 +52,14 @@ def _collect_cycles_after_test():
     import gc
 
     gc.collect()
+    if os.environ.get("RAY_TPU_TEST_THREAD_CENSUS"):
+        import threading
+        from collections import Counter
+
+        names = Counter(t.name.split("-")[0] for t in threading.enumerate())
+        with open("/tmp/thread_census.txt", "a") as f:
+            f.write(f"{threading.active_count():4d} "
+                    f"{request.node.nodeid}  {dict(names)}\n")
 
 
 @pytest.fixture(scope="session")

@@ -222,3 +222,54 @@ def test_actor_namespaces(ray_cluster):
         ray_tpu.get_actor("ns-holder")
     ray_tpu.kill(a)
     ray_tpu.kill(b)
+
+
+def test_borrowed_handle_keeps_actor_alive(ray_cluster):
+    """reference: distributed actor-handle refcounting — an actor lives
+    while ANY handle exists, incl. one borrowed by an in-flight task
+    (regression: the owner's __del__ used to kill it immediately)."""
+    import time as _t
+
+    @ray_tpu.remote
+    class Holder:
+        def ping(self):
+            return "alive"
+
+    @ray_tpu.remote
+    def use_later(h):
+        _t.sleep(1.5)  # the driver's handle is gone by now
+        return ray_tpu.get(h.ping.remote(), timeout=30)
+
+    h = Holder.remote()
+    fut = use_later.remote(h)
+    del h
+    assert ray_tpu.get(fut, timeout=60) == "alive"
+
+
+def test_dead_actor_client_leases_reclaimed(ray_cluster):
+    """An actor that leased workers for nested tasks dies -> the raylet
+    returns those leases (regression: they stayed 'leased' forever and
+    the shared cluster starved)."""
+    import time as _t
+
+    @ray_tpu.remote
+    class Submitter:
+        def spin(self):
+            @ray_tpu.remote
+            def child():
+                return 1
+
+            # lease a worker via a nested task, then die without
+            # returning it
+            return ray_tpu.get(child.remote(), timeout=60)
+
+    a = Submitter.remote()
+    assert ray_tpu.get(a.spin.remote(), timeout=120) == 1
+    ray_tpu.kill(a)
+    deadline = _t.monotonic() + 30
+    while _t.monotonic() < deadline:
+        avail = ray_tpu.available_resources().get("CPU", 0)
+        if avail >= 4.0:
+            break
+        _t.sleep(0.5)
+    assert ray_tpu.available_resources().get("CPU", 0) >= 4.0
