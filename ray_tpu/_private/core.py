@@ -2186,6 +2186,12 @@ class CoreWorker:
     # between pickling a handle and the receiver registering its borrow
     # (the window in which the old implementation killed the actor).
 
+    # Approximation bound: a pickled handle neither deserialized nor
+    # dropped within this window (e.g. queued in task args behind >60s of
+    # work) stops protecting the actor — acceptable because the owner
+    # handle usually outlives submission, and exact tracking would need
+    # per-copy acks.  Raise via subclassing if a deployment queues cold
+    # tasks for minutes.
     ACTOR_TRANSIT_S = 60.0
 
     def on_actor_handle_serialized(self, actor_id: str, owner_addr):
@@ -2210,19 +2216,11 @@ class CoreWorker:
         owner_addr = tuple(owner_addr)
         if owner_addr == self.addr:
             # a handle round-tripped back to its owner: count it like any
-            # other borrower (loopback entry, no RPC) and retire one
-            # in-transit hold like h_actor_add_ref would
+            # other borrower (loopback entry, no RPC)
+            self._register_actor_borrow(actor_id, self.worker_id, self.addr)
             with self.lock:
-                ent = self._actor_borrowers.setdefault(actor_id, {}) \
-                    .setdefault(self.worker_id, [0, self.addr])
-                ent[0] += 1
                 self._borrowed_actors.setdefault(
                     actor_id, [0, owner_addr])[0] += 1
-                holds = self._actor_transit.get(actor_id)
-                if holds:
-                    holds.pop(0)
-                    if not holds:
-                        self._actor_transit.pop(actor_id, None)
             return True
         with self.lock:
             rec = self._borrowed_actors.setdefault(actor_id, [0, owner_addr])
@@ -2252,15 +2250,7 @@ class CoreWorker:
                 self._borrowed_actors.pop(actor_id, None)
             owner_addr = tuple(rec[1])
         if owner_addr == self.addr:
-            with self.lock:
-                bs = self._actor_borrowers.get(actor_id)
-                ent = bs.get(self.worker_id) if bs else None
-                if ent is not None:
-                    ent[0] -= 1
-                    if ent[0] <= 0:
-                        bs.pop(self.worker_id, None)
-                    if not bs:
-                        self._actor_borrowers.pop(actor_id, None)
+            self._deregister_actor_borrow(actor_id, self.worker_id)
             self._maybe_release_actor(actor_id)
             return
         try:
@@ -2270,36 +2260,43 @@ class CoreWorker:
         except Exception:
             pass
 
-    def h_actor_add_ref(self, conn, p):
-        aid = p["actor_id"]
+    def _register_actor_borrow(self, aid: str, borrower: str, addr):
+        """Owner side: count one borrowed handle and retire one in-transit
+        hold (one hold per serialization, so other still-in-flight pickles
+        of the same handle keep their own protection)."""
         with self.lock:
-            addr = tuple(p.get("borrower_addr") or ()) or None
             ent = self._actor_borrowers.setdefault(aid, {}) \
-                .setdefault(p["borrower"], [0, addr])
+                .setdefault(borrower, [0, addr])
             ent[0] += 1
             ent[1] = addr or ent[1]
-            # one in-flight serialized copy arrived: retire its hold (one
-            # entry per serialization, so other still-in-flight pickles of
-            # the same handle keep their own protection)
             holds = self._actor_transit.get(aid)
             if holds:
                 holds.pop(0)
                 if not holds:
                     self._actor_transit.pop(aid, None)
+
+    def _deregister_actor_borrow(self, aid: str, borrower: str,
+                                 drop_all: bool = False):
+        with self.lock:
+            bs = self._actor_borrowers.get(aid)
+            ent = bs.get(borrower) if bs else None
+            if ent is not None:
+                ent[0] -= 1
+                if ent[0] <= 0 or drop_all:
+                    bs.pop(borrower, None)
+                if not bs:
+                    self._actor_borrowers.pop(aid, None)
+
+    def h_actor_add_ref(self, conn, p):
+        self._register_actor_borrow(
+            p["actor_id"], p["borrower"],
+            tuple(p.get("borrower_addr") or ()) or None)
         return True
 
     def h_actor_del_ref(self, conn, p):
-        aid = p["actor_id"]
-        with self.lock:
-            bs = self._actor_borrowers.get(aid)
-            ent = bs.get(p["borrower"]) if bs else None
-            if ent is not None:
-                ent[0] -= 1
-                if ent[0] <= 0 or p.get("all"):
-                    bs.pop(p["borrower"], None)
-                if not bs:
-                    self._actor_borrowers.pop(aid, None)
-        self._maybe_release_actor(aid)
+        self._deregister_actor_borrow(p["actor_id"], p["borrower"],
+                                      drop_all=bool(p.get("all")))
+        self._maybe_release_actor(p["actor_id"])
         return True
 
     def h_actor_transit(self, conn, p):

@@ -53,6 +53,8 @@ class WorkerRecord:
         self.lease_retriable = True  # OOM-victim hint from the owner
         self.lease_client_id: Optional[str] = None  # whose core holds us
         self.bundle_key: Optional[Tuple[str, int]] = None
+        self.bundle_demand: Dict[str, int] = {}  # PG actors: placed demand
+        self.lent: Dict[str, int] = {}  # CPUs lent to the pool while blocked
         self.tpu = False  # spawned with TPU device visibility
 
 
@@ -410,7 +412,7 @@ class Raylet:
                 killed_path = False
                 was = rec.state
                 actor_id = rec.actor_id
-                if rec.lease_resources:
+                if rec.lease_resources or rec.bundle_demand or rec.lent:
                     self._free_lease_resources(rec)
                 if rec in self.idle:
                     try:
@@ -643,14 +645,33 @@ class Raylet:
         logger.info("free_lease %s lease=%s blocked=%s bundle=%s avail=%s",
                     rec.worker_id[:12], rec.lease_resources, rec.blocked,
                     rec.bundle_key, self.available)
-        if rec.bundle_key is not None:
-            if not rec.blocked:  # blocked leases already gave resources back
-                b = self.bundles.get(rec.bundle_key)
+        if rec.bundle_key is not None or rec.bundle_demand:
+            held = rec.lease_resources or rec.bundle_demand
+            # blocked TASK leases released their bundle slot at block
+            # time; bundle ACTORS (bundle_demand) keep theirs until death
+            if not rec.blocked or rec.bundle_demand:
+                b = self.bundles.get(rec.bundle_key) \
+                    if rec.bundle_key is not None else None
                 if b is not None:
-                    subtract(b.setdefault("used", {}), rec.lease_resources)
+                    subtract(b.setdefault("used", {}), held)
+            if rec.blocked and rec.lent:
+                # bundle-backed: the general-pool loan was an EXTRA credit
+                # on top of the PG's reservation; dying without unblocking
+                # means it must be revoked (non-bundle loans simply stay —
+                # the dead worker's CPU is genuinely free)
+                subtract(self.available, rec.lent)
             rec.bundle_key = None
+            rec.bundle_demand = {}
         elif not rec.blocked:
             add(self.available, rec.lease_resources)
+        else:
+            # blocked non-bundle lease: the CPU portion (rec.lent) already
+            # went back at block time, but non-CPU resources (devices)
+            # stayed booked — return them now or they leak forever
+            rest = {k: v for k, v in rec.lease_resources.items()
+                    if k not in rec.lent}
+            add(self.available, rest)
+        rec.lent = {}
         rec.lease_resources = {}
 
     def h_return_lease(self, conn, p):
@@ -683,18 +704,29 @@ class Raylet:
         return len(canceled)
 
     def h_task_blocked(self, conn, p):
+        """A worker blocked in get() lends its CPUs (CPU only — never a
+        physical device its process still holds) to the GENERAL pool, and
+        a bundle-backed worker also releases its PG slot for nested
+        same-bundle leases.  Crediting only the bundle deadlocks the
+        canonical Train shape: PG-bound train workers block on a
+        streaming-data coordinator whose read tasks need general-pool
+        CPUs (reference: blocked workers release CPUs for any work).  A
+        bundle worker's slot is thus transiently usable from BOTH pools —
+        bounded oversubscription, same as the unblock path's."""
         wid = p.get("worker_id")
         with self.lock:
             rec = self.workers.get(wid)
             if rec is not None and rec.state in ("leased", "actor") \
                     and not rec.blocked:
                 rec.blocked = True
-                if rec.bundle_key is not None:
+                base = rec.lease_resources or rec.bundle_demand
+                rec.lent = {k: v for k, v in base.items() if k == common.CPU}
+                if rec.bundle_key is not None and rec.lease_resources:
                     b = self.bundles.get(rec.bundle_key)
                     if b is not None:
-                        subtract(b.setdefault("used", {}), rec.lease_resources)
-                else:
-                    add(self.available, rec.lease_resources)
+                        subtract(b.setdefault("used", {}),
+                                 rec.lease_resources)
+                add(self.available, rec.lent)
         self._try_grant()
         return True
 
@@ -704,13 +736,13 @@ class Raylet:
             rec = self.workers.get(wid)
             if rec is not None and rec.blocked:
                 rec.blocked = False
-                if rec.bundle_key is not None:
+                if rec.bundle_key is not None and rec.lease_resources:
                     b = self.bundles.get(rec.bundle_key)
                     if b is not None:
                         add(b.setdefault("used", {}), rec.lease_resources)
-                else:
-                    # may go negative transiently: oversubscription by design
-                    subtract(self.available, rec.lease_resources)
+                # may go negative transiently: oversubscription by design
+                subtract(self.available, rec.lent)
+                rec.lent = {}
         return True
 
     # -- actors ------------------------------------------------------------
@@ -719,12 +751,30 @@ class Raylet:
         demand = normalize_resources(p.get("resources"))
         with self.lock:
             bundle_key = (p.get("pg_id"), p.get("bundle_index", -1))
-            from_bundle = p.get("pg_id") and self.bundles.get(bundle_key, {}).get("state") == "committed"
+            if p.get("pg_id") and bundle_key[1] == -1:
+                # "any bundle of this group": resolve to a committed one
+                # WITH room, like _resolve_bundle_locked does for task
+                # leases — otherwise the actor wrongly competes for
+                # general-pool CPUs its own PG already reserved
+                for k in self.bundles:
+                    if k[0] == p["pg_id"] \
+                            and self._bundle_free_fits_locked(k, demand):
+                        bundle_key = k
+                        break
+            from_bundle = (p.get("pg_id")
+                           and self.bundles.get(bundle_key, {}).get("state")
+                           == "committed"
+                           and self._bundle_free_fits_locked(bundle_key,
+                                                             demand))
             if not from_bundle:
                 if not fits(self.available, demand):
                     d.resolve({"ok": False, "error": "insufficient resources"})
                     return
                 subtract(self.available, demand)
+            else:
+                # PG actors draw from their bundle's reservation — charge
+                # it so admission is bounded by the bundle's capacity
+                add(self.bundles[bundle_key].setdefault("used", {}), demand)
         # prefer a prestarted idle worker: assign_actor turns it into the
         # actor's dedicated process with zero spawn latency (reference:
         # WorkerPool::PopWorker worker_pool.h:366).  TPU actors need a
@@ -741,6 +791,9 @@ class Raylet:
                 w.state = "actor"
                 w.actor_id = p["actor_id"]
                 w.lease_resources = demand if not from_bundle else {}
+                w.bundle_demand = demand if from_bundle else {}
+                if from_bundle:
+                    w.bundle_key = bundle_key
         if w is not None:
             ok = w.conn.push("assign_actor", {
                 "actor_id": p["actor_id"],
@@ -760,6 +813,9 @@ class Raylet:
         rec = self._spawn_worker(actor_id=p["actor_id"], env_extra=env,
                                  tpu=wants_tpu)
         rec.lease_resources = demand if not from_bundle else {}
+        rec.bundle_demand = demand if from_bundle else {}
+        if from_bundle:
+            rec.bundle_key = bundle_key
 
         def waiter():
             deadline = time.monotonic() + 60.0
@@ -799,7 +855,7 @@ class Raylet:
             time.sleep(0.05)
             self._kill_worker(rec)
             with self.lock:
-                if rec.lease_resources:
+                if rec.lease_resources or rec.bundle_demand or rec.lent:
                     self._free_lease_resources(rec)
 
         threading.Thread(target=do_kill, daemon=True).start()
@@ -1056,6 +1112,9 @@ class Raylet:
             for rec in self.workers.values():
                 if rec.state != "dead" and rec.lease_resources:
                     subtract(self.available, rec.lease_resources)
+                    if rec.blocked and rec.lent:
+                        # its CPU loan is live: re-credit it
+                        add(self.available, rec.lent)
         try:
             self.control.call("register_node", {
                 "node_id": self.node_id,

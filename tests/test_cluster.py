@@ -154,3 +154,30 @@ def test_object_pull_across_nodes(multi_node_cluster):
         assert core.get(out_ref, timeout=120) == 300_000 * 7.0
     finally:
         core.shutdown()
+
+
+def test_blocked_pg_actor_lends_cpu(ray_cluster):
+    """A PG actor blocked in get() lends its CPUs to the general pool so
+    non-PG tasks can run — otherwise a PG that reserves the whole node
+    deadlocks the canonical Train+streaming-data shape (regression)."""
+    import ray_tpu
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    pg = placement_group([{"CPU": 4}])
+    assert pg.ready(timeout=60)
+
+    @ray_tpu.remote
+    def plain():
+        return 7
+
+    @ray_tpu.remote
+    class Consumer:
+        def go(self):
+            # blocks this PG-bound worker; the general-pool task below
+            # can only run on the lent CPUs
+            return ray_tpu.get(plain.remote(), timeout=120)
+
+    c = Consumer.options(placement_group=pg, num_cpus=4).remote()
+    assert ray_tpu.get(c.go.remote(), timeout=120) == 7
+    ray_tpu.kill(c)
+    remove_placement_group(pg)
