@@ -1109,6 +1109,17 @@ class CoreWorker:
     # blocked notifications (nested-get deadlock avoidance)
     # ------------------------------------------------------------------
 
+    def in_task_context(self) -> bool:
+        """True on a thread currently executing (or adopted into) a task."""
+        return bool(getattr(self._executing, "active", False))
+
+    def adopt_task_context(self) -> None:
+        """Mark THIS thread as part of the running task.  Helper threads a
+        task spawns (e.g. data prefetchers) must call this, or their
+        blocking get() never notifies the raylet and the worker's CPUs
+        are not lent out while it waits (the Train+streaming deadlock)."""
+        self._executing.active = True
+
     def _mark_blocked(self, blocked: bool):
         if self.mode != "worker" or self.raylet is None:
             return

@@ -646,14 +646,17 @@ class Raylet:
                     rec.worker_id[:12], rec.lease_resources, rec.blocked,
                     rec.bundle_key, self.available)
         if rec.bundle_key is not None or rec.bundle_demand:
-            held = rec.lease_resources or rec.bundle_demand
-            # blocked TASK leases released their bundle slot at block
-            # time; bundle ACTORS (bundle_demand) keep theirs until death
-            if not rec.blocked or rec.bundle_demand:
+            # bundle 'used' is charged only for TASK leases; a blocked
+            # task already released its CPU slot at block time, so only
+            # the non-lent remainder comes back here
+            if rec.lease_resources:
                 b = self.bundles.get(rec.bundle_key) \
                     if rec.bundle_key is not None else None
                 if b is not None:
-                    subtract(b.setdefault("used", {}), held)
+                    rest = ({k: v for k, v in rec.lease_resources.items()
+                             if k not in rec.lent}
+                            if rec.blocked else rec.lease_resources)
+                    subtract(b.setdefault("used", {}), rest)
             if rec.blocked and rec.lent:
                 # bundle-backed: the general-pool loan was an EXTRA credit
                 # on top of the PG's reservation; dying without unblocking
@@ -724,8 +727,9 @@ class Raylet:
                 if rec.bundle_key is not None and rec.lease_resources:
                     b = self.bundles.get(rec.bundle_key)
                     if b is not None:
-                        subtract(b.setdefault("used", {}),
-                                 rec.lease_resources)
+                        # release only the CPU slot — the process still
+                        # owns any device the lease carried
+                        subtract(b.setdefault("used", {}), rec.lent)
                 add(self.available, rec.lent)
         self._try_grant()
         return True
@@ -739,7 +743,7 @@ class Raylet:
                 if rec.bundle_key is not None and rec.lease_resources:
                     b = self.bundles.get(rec.bundle_key)
                     if b is not None:
-                        add(b.setdefault("used", {}), rec.lease_resources)
+                        add(b.setdefault("used", {}), rec.lent)
                 # may go negative transiently: oversubscription by design
                 subtract(self.available, rec.lent)
                 rec.lent = {}
@@ -752,29 +756,22 @@ class Raylet:
         with self.lock:
             bundle_key = (p.get("pg_id"), p.get("bundle_index", -1))
             if p.get("pg_id") and bundle_key[1] == -1:
-                # "any bundle of this group": resolve to a committed one
-                # WITH room, like _resolve_bundle_locked does for task
-                # leases — otherwise the actor wrongly competes for
-                # general-pool CPUs its own PG already reserved
-                for k in self.bundles:
-                    if k[0] == p["pg_id"] \
-                            and self._bundle_free_fits_locked(k, demand):
+                # "any bundle of this group": resolve to a committed one —
+                # otherwise the actor wrongly competes for general-pool
+                # CPUs its own PG already reserved (admission inside a
+                # bundle is not re-gated: the PG reserved the capacity and
+                # the control plane assigns actors to bundles)
+                for k, b in self.bundles.items():
+                    if k[0] == p["pg_id"] and b.get("state") == "committed":
                         bundle_key = k
                         break
-            from_bundle = (p.get("pg_id")
-                           and self.bundles.get(bundle_key, {}).get("state")
-                           == "committed"
-                           and self._bundle_free_fits_locked(bundle_key,
-                                                             demand))
+            from_bundle = p.get("pg_id") and self.bundles.get(
+                bundle_key, {}).get("state") == "committed"
             if not from_bundle:
                 if not fits(self.available, demand):
                     d.resolve({"ok": False, "error": "insufficient resources"})
                     return
                 subtract(self.available, demand)
-            else:
-                # PG actors draw from their bundle's reservation — charge
-                # it so admission is bounded by the bundle's capacity
-                add(self.bundles[bundle_key].setdefault("used", {}), demand)
         # prefer a prestarted idle worker: assign_actor turns it into the
         # actor's dedicated process with zero spawn latency (reference:
         # WorkerPool::PopWorker worker_pool.h:366).  TPU actors need a
@@ -807,6 +804,8 @@ class Raylet:
                 if not from_bundle:
                     add(self.available, w.lease_resources)
                 w.lease_resources = {}
+                w.bundle_demand = {}
+                w.bundle_key = None
         env = {}
         if p.get("incarnation") is not None:
             env["RAY_TPU_ACTOR_INCARNATION"] = str(p["incarnation"])
@@ -979,6 +978,9 @@ class Raylet:
                 "node_id": self.node_id,
                 "tpu": r.tpu,
                 "addr": r.addr,  # core server: get_object + profiling RPCs
+                "blocked": r.blocked,
+                "lease_client_id": r.lease_client_id,
+                "lease_resources": dict(r.lease_resources),
             } for r in self.workers.values()]
 
     def h_list_logs(self, conn, p):

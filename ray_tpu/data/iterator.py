@@ -108,7 +108,25 @@ def prefetch_iter(it: Iterator, depth: int) -> Iterator:
     DONE = object()
     err: List[BaseException] = []
 
+    # propagate task context: the spawning thread may be executing a task
+    # (e.g. a Train worker's loop); the prefetcher does that task's
+    # blocking get()s, so it must count as the task for the raylet's
+    # blocked-CPU lending or a fully-reserved node deadlocks
+    adopt = False
+    try:
+        from ray_tpu._private.core import current_core
+
+        core = current_core()
+        adopt = core is not None and core.in_task_context()
+    except Exception:
+        core = None
+
     def worker():
+        if adopt:
+            try:
+                core.adopt_task_context()
+            except Exception:
+                pass
         try:
             for item in it:
                 q.put(item)
