@@ -19,6 +19,7 @@ import ray_tpu
 from . import aggregate
 from .aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
 from .block import Block, BlockAccessor, BlockMetadata
+from .compute import ActorPoolStrategy, TaskPoolStrategy
 from .context import DataContext
 from .dataset import Dataset, MaterializedDataset
 from .datasource import (BinaryDatasource, BlocksDatasource, CSVDatasource,
@@ -294,4 +295,5 @@ __all__ = [
     "read_tfrecords", "read_images", "read_sql", "read_parquet_bulk",
     "from_blocks", "from_arrow_refs", "from_pandas_refs", "from_numpy_refs",
     "from_huggingface", "from_torch", "from_tf",
+    "ActorPoolStrategy", "TaskPoolStrategy",
 ]

@@ -42,30 +42,86 @@ class Dataset:
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     batch_format: Optional[str] = None, fn_args=(),
-                    fn_kwargs=None, num_cpus: Optional[float] = None,
+                    fn_kwargs=None, fn_constructor_args=(),
+                    fn_constructor_kwargs=None,
+                    compute=None, concurrency=None,
+                    zero_copy_batch: bool = False,
+                    num_cpus: Optional[float] = None,
                     num_tpus: Optional[float] = None,
-                    resources: Optional[Dict[str, float]] = None, **_ignored
+                    resources: Optional[Dict[str, float]] = None
                     ) -> "Dataset":
+        """Unknown keyword arguments raise TypeError (no silent-ignore
+        catch-all): a user porting an unsupported reference kwarg must
+        hear about it, not get silently different behavior.
+
+        A callable CLASS `fn` runs on an actor pool: instances are
+        constructed once per pool actor (`fn_constructor_args/kwargs`)
+        and reused for every batch — pass `concurrency=n` for a fixed
+        pool or `(min, max)` for autoscaling (reference: dataset.py
+        map_batches `concurrency` + compute.py ActorPoolStrategy).
+        `zero_copy_batch` is accepted as a hint (numpy batches here are
+        already zero-copy views over shm blocks)."""
+        import inspect
+
+        from .compute import ActorPoolStrategy, strategy_from_concurrency
+
         resources = dict(resources or {})
         if num_cpus:
             resources["CPU"] = num_cpus
         if num_tpus:
             resources["TPU"] = num_tpus
+        is_class = inspect.isclass(fn)
+        if not is_class and (fn_constructor_args or fn_constructor_kwargs):
+            raise ValueError(
+                "fn_constructor_args/kwargs are only valid with a "
+                "callable-class UDF")
+        if compute is None:
+            compute = strategy_from_concurrency(concurrency, is_class)
+        elif concurrency is not None:
+            raise ValueError("pass `compute` or `concurrency`, not both")
+        elif is_class and not isinstance(compute, ActorPoolStrategy):
+            raise ValueError(
+                "a callable-class UDF requires ActorPoolStrategy compute")
         ctx = DataContext.get_current()
-        return self._with(L.MapBatches(
+        op = L.MapBatches(
             self._dag, fn, batch_size=batch_size,
             batch_format=batch_format or ctx.default_batch_format,
-            fn_args=fn_args, fn_kwargs=fn_kwargs,
-            resources=resources or None))
+            fn_args=fn_args, fn_kwargs=fn_kwargs, compute=compute,
+            resources=resources or None)
+        op.is_class_udf = is_class
+        op.fn_constructor_args = tuple(fn_constructor_args or ())
+        op.fn_constructor_kwargs = fn_constructor_kwargs or {}
+        if is_class:
+            op.name = f"MapBatches({fn.__name__})"
+        return self._with(op)
 
-    def map(self, fn: Callable, **kw) -> "Dataset":
-        return self._with(L.MapRows(self._dag, fn))
+    def _row_op(self, cls, fn: Callable, concurrency, compute,
+                resources) -> "Dataset":
+        import inspect
 
-    def filter(self, fn: Callable, **kw) -> "Dataset":
-        return self._with(L.Filter(self._dag, fn))
+        from .compute import strategy_from_concurrency
 
-    def flat_map(self, fn: Callable, **kw) -> "Dataset":
-        return self._with(L.FlatMap(self._dag, fn))
+        is_class = inspect.isclass(fn)
+        if compute is None:
+            compute = strategy_from_concurrency(concurrency, is_class)
+        elif concurrency is not None:
+            raise ValueError("pass `compute` or `concurrency`, not both")
+        op = cls(self._dag, fn, compute=compute,
+                 resources=dict(resources or {}) or None)
+        op.is_class_udf = is_class
+        return self._with(op)
+
+    def map(self, fn: Callable, *, concurrency=None, compute=None,
+            resources=None) -> "Dataset":
+        return self._row_op(L.MapRows, fn, concurrency, compute, resources)
+
+    def filter(self, fn: Callable, *, concurrency=None, compute=None,
+               resources=None) -> "Dataset":
+        return self._row_op(L.Filter, fn, concurrency, compute, resources)
+
+    def flat_map(self, fn: Callable, *, concurrency=None, compute=None,
+                 resources=None) -> "Dataset":
+        return self._row_op(L.FlatMap, fn, concurrency, compute, resources)
 
     def add_column(self, name: str, fn: Callable[[Any], Any]) -> "Dataset":
         def add(batch: Dict[str, np.ndarray], _name=name, _fn=fn):

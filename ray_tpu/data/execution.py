@@ -51,6 +51,11 @@ class RefBundle:
 def _apply_stage(blocks: List[Block], stage: Dict) -> List[Block]:
     kind = stage["kind"]
     fn = stage["fn"]
+    if stage.get("is_class") and isinstance(fn, type):
+        # task-pool path never takes class UDFs (dataset.py validates),
+        # but a directly-built plan could: instantiate per call
+        fn = fn(*(stage.get("fn_constructor_args") or ()),
+                **(stage.get("fn_constructor_kwargs") or {}))
     fn_args = stage.get("fn_args") or ()
     fn_kwargs = stage.get("fn_kwargs") or {}
     if kind == "block":
@@ -557,6 +562,174 @@ class MapOperator(PhysicalOperator):
         return [_TaskRec(refs, on_done)]
 
 
+class _MapWorker:
+    """Pool worker actor: instantiates class UDFs ONCE at startup and
+    applies the fused stage chain to blocks (reference:
+    actor_pool_map_operator.py _MapWorker — per-actor warm state is the
+    whole point: a model loads / a program compiles once per actor, not
+    once per block)."""
+
+    def __init__(self, chain: List[Dict]):
+        self._chain = []
+        for s in chain:
+            s = dict(s)
+            if s.get("is_class"):
+                s["fn"] = s["fn"](*(s.get("fn_constructor_args") or ()),
+                                  **(s.get("fn_constructor_kwargs") or {}))
+            self._chain.append(s)
+
+    def apply(self, *blocks: Block):
+        return _map_task(self._chain, *blocks)
+
+
+class ActorPoolMapOperator(PhysicalOperator):
+    """Map over an autoscaling pool of `_MapWorker` actors (reference:
+    actor_pool_map_operator.py:34 ActorPoolMapOperator).
+
+    Pool behavior: `min_size` actors are created when the operator first
+    has work; while every live actor is saturated (max_tasks_in_flight
+    each) and input keeps queueing, the pool grows toward `max_size`.
+    Blocks route to the least-loaded ready actor.  An actor that dies
+    mid-block is replaced and its in-flight blocks are resubmitted —
+    tasks are retried, warm state is rebuilt by the replacement's
+    __init__."""
+
+    def __init__(self, name: str, chain: List[Dict], strategy,
+                 resources=None):
+        super().__init__(name)
+        self._chain = chain
+        self._strategy = strategy
+        self._resources = resources
+        # actor id -> [handle, inflight_count]
+        self._actors: Dict[int, List] = {}
+        self._next_actor_id = 0
+        self._started = False
+        self._shutdown = False
+        # consecutive actor deaths with zero completed blocks in between:
+        # a UDF that kills every actor it touches (bad import, OOM on
+        # init) must surface, not respawn forever
+        self._deaths_since_progress = 0
+
+    # -- pool management ----------------------------------------------------
+
+    def _spawn_actor(self):
+        cls = ray_tpu.remote(_MapWorker)
+        if self._resources:
+            cls = cls.options(resources=dict(self._resources))
+        handle = cls.remote(self._chain)
+        aid = self._next_actor_id
+        self._next_actor_id += 1
+        self._actors[aid] = [handle, 0]
+        return aid
+
+    def _ensure_pool(self):
+        if self._started:
+            return
+        self._started = True
+        for _ in range(self._strategy.min_size):
+            self._spawn_actor()
+
+    def _pick_actor(self) -> Optional[int]:
+        """Least-loaded actor below its in-flight cap; grows the pool when
+        all are saturated and room remains."""
+        cap = self._strategy.max_tasks_in_flight_per_actor
+        candidates = [(cnt, aid) for aid, (h, cnt) in
+                      self._actors.items() if cnt < cap]
+        if candidates:
+            return min(candidates)[1]
+        if len(self._actors) < self._strategy.max_size:
+            return self._spawn_actor()
+        return None
+
+    def _replace_actor(self, aid: int):
+        info = self._actors.pop(aid, None)
+        if info is None:
+            return  # another in-flight task of the same actor got here
+        try:
+            ray_tpu.kill(info[0], no_restart=True)
+        except Exception:
+            pass
+        if not self._shutdown:
+            self._spawn_actor()
+
+    def _maybe_shutdown_pool(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        for aid, (h, cnt) in list(self._actors.items()):
+            try:
+                ray_tpu.kill(h, no_restart=True)
+            except Exception:
+                pass
+        self._actors.clear()
+
+    # -- operator interface -------------------------------------------------
+
+    def out_min_pending(self) -> Optional[Tuple[int, ...]]:
+        return self._streaming_min_pending()
+
+    def try_submit(self, submit) -> List[_TaskRec]:
+        # at most one submission per call: the executor accounts its
+        # global budget / per-op caps per try_submit round (MapOperator
+        # keeps the same discipline)
+        if not self.in_queues[0]:
+            return []
+        self._ensure_pool()
+        aid = self._pick_actor()
+        if aid is None:
+            return []
+        bundle: RefBundle = self.in_queues[0].popleft()
+        return [self._submit_to(aid, bundle)]
+
+    def _submit_to(self, aid: int, bundle: RefBundle) -> _TaskRec:
+        handle = self._actors[aid][0]
+        self._actors[aid][1] += 1
+        self.active += 1
+        self.stats["tasks"] += 1
+        order = bundle.order
+        self._pending_orders.add(order)
+        refs = handle.apply.options(num_returns=2).remote(bundle.block_ref)
+
+        def on_done(rec: _TaskRec):
+            self.active -= 1
+            if aid in self._actors:
+                self._actors[aid][1] -= 1
+            try:
+                meta = ray_tpu.get(rec.refs[1], timeout=300)
+            except (ray_tpu.ActorDiedError,
+                    ray_tpu.WorkerCrashedError) as e:
+                self._pending_orders.discard(order)
+                self._deaths_since_progress += 1
+                if self._deaths_since_progress > \
+                        2 * max(2, self._strategy.max_size):
+                    raise RuntimeError(
+                        f"{self.name}: actor pool is dying faster than it "
+                        f"completes work ({self._deaths_since_progress} "
+                        f"consecutive deaths) — the UDF or its imports "
+                        f"likely crash the worker; last: {e}") from e
+                # replace the dead actor, resubmit this block: retried
+                # work re-enters the input queue so the normal submit
+                # path (with a fresh pool member) picks it up
+                self._replace_actor(aid)
+                self.in_queues[0].appendleft(bundle)
+                return
+            self._deaths_since_progress = 0
+            self._emit(RefBundle(rec.refs[0], meta, order=order))
+            self._pending_orders.discard(order)
+            self.maybe_finish()
+
+        return _TaskRec(list(refs), on_done, tag=aid)
+
+    def maybe_finish(self):
+        super().maybe_finish()
+        if self.finished:
+            self._maybe_shutdown_pool()
+
+    # introspection for tests
+    def pool_size(self) -> int:
+        return len(self._actors)
+
+
 class LimitOperator(PhysicalOperator):
     """Row-limit in DATASET order: blocks complete out of order, so input
     is staged in an order-heap and consumed only once no earlier block can
@@ -919,9 +1092,22 @@ class AllToAllOperator(PhysicalOperator):
 # Planner: logical DAG -> physical DAG
 
 def _stage_of(op: L.AbstractMap) -> Dict:
-    return {"kind": op.fn_kind, "fn": op.fn, "batch_size": op.batch_size,
-            "batch_format": op.batch_format, "fn_args": op.fn_args,
-            "fn_kwargs": op.fn_kwargs}
+    # stage fns travel as task/actor-constructor ARGS (not as the remote
+    # function itself), so the by-value registration that ray_tpu.remote
+    # applies to its target never sees them — a UDF class defined in a
+    # driver-only module would hit ModuleNotFoundError on the worker
+    from ray_tpu._private.common import _ensure_picklable_by_value
+
+    _ensure_picklable_by_value(op.fn)
+    stage = {"kind": op.fn_kind, "fn": op.fn, "batch_size": op.batch_size,
+             "batch_format": op.batch_format, "fn_args": op.fn_args,
+             "fn_kwargs": op.fn_kwargs}
+    if getattr(op, "is_class_udf", False):
+        stage["is_class"] = True
+        stage["fn_constructor_args"] = getattr(op, "fn_constructor_args", ())
+        stage["fn_constructor_kwargs"] = getattr(op, "fn_constructor_kwargs",
+                                                 None)
+    return stage
 
 
 def plan(logical_dag: L.LogicalOp
@@ -971,9 +1157,31 @@ def plan(logical_dag: L.LogicalOp
             upstream = build(op.inputs[0])
             stage = _stage_of(op)
             resources = op.resources or None
+            from .compute import ActorPoolStrategy, TaskPoolStrategy
+
+            strategy = op.compute
+            wants_actors = isinstance(strategy, ActorPoolStrategy)
             # fuse into upstream Read / Map when compatible — but never
-            # into a node other consumers also read (diamond DAGs)
-            fusable = consumers.get(id(op.inputs[0]), 0) <= 1
+            # into a node other consumers also read (diamond DAGs), and
+            # never when the user capped THIS stage's concurrency (fusing
+            # would run it at the upstream's parallelism instead)
+            capped = isinstance(strategy, TaskPoolStrategy) \
+                and strategy.size is not None
+            fusable = consumers.get(id(op.inputs[0]), 0) <= 1 \
+                and not capped
+            if wants_actors:
+                # actor compute is its own operator; a later fusable
+                # plain-map stage may fuse INTO it (runs on the actors),
+                # but an actor stage never fuses into a task upstream
+                phys = ActorPoolMapOperator(op.name, [stage], strategy,
+                                            resources=resources)
+                upstream.connect(phys, 0)
+                return phys
+            if fusable and isinstance(upstream, ActorPoolMapOperator) \
+                    and not resources:
+                upstream._chain.append(stage)
+                upstream.name = f"{upstream.name}->{op.name}"
+                return upstream
             if fusable and isinstance(upstream, ReadOperator) \
                     and not resources:
                 upstream._chain.append(stage)
@@ -985,6 +1193,8 @@ def plan(logical_dag: L.LogicalOp
                 upstream.name = f"{upstream.name}->{op.name}"
                 return upstream
             phys = MapOperator(op.name, [stage], resources=resources)
+            if capped:
+                phys.task_cap = strategy.size
             upstream.connect(phys, 0)
             return phys
         if isinstance(op, L.Limit):
@@ -1167,6 +1377,9 @@ class StreamingExecutor:
                         break
                     percap = self.ctx.max_tasks_per_operator
                     if percap is not None and op.active >= percap:
+                        continue
+                    opcap = getattr(op, "task_cap", None)
+                    if opcap is not None and op.active >= opcap:
                         continue
                     recs = op.try_submit(
                         lambda fn, args, **kw: self._submit(fn, args, **kw))
