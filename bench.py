@@ -290,6 +290,10 @@ def _gpt_only_main():
     }
     if mfu is not None:
         row["gpt2_small_mfu"] = round(mfu, 4)
+    if jax.default_backend() != "cpu":
+        # the child owns the cache write: every consumer of a real-chip
+        # number (extras stage, scripts/tpu_watch.sh) goes through here
+        _cache_store(row)
     print(json.dumps(row), flush=True)
 
 
@@ -312,41 +316,46 @@ def _extras_main():
         put["put_bench_error"] = str(e)[:200]
     print(json.dumps(put), flush=True)
 
+    # every stage prints ITS OWN line the moment it resolves, so a parent
+    # timeout mid-way never loses earlier results (main() merges lines)
     probe = _probe_accelerator()
-    gpt_extras = {}
     tpu_row = None
     if probe["ok"]:
-        gpt_extras["accelerator"] = probe.get("device_kind", "?")
+        print(json.dumps({"accelerator": probe.get("device_kind", "?")}),
+              flush=True)
         row = _run_gpt_subprocess(timeout_s=480.0, cpu=False)
         if "gpt2_small_train_tokens_per_s" in row:
             tpu_row = row
-            _cache_store(row)
-            gpt_extras.update(row)
+            print(json.dumps(row), flush=True)
         else:
-            gpt_extras["gpt_bench_error"] = row.get("error", "unknown")
+            print(json.dumps(
+                {"gpt_bench_error": row.get("error", "unknown")}),
+                flush=True)
     else:
-        gpt_extras["gpt_probe_failed"] = probe["error"]
+        print(json.dumps({"gpt_probe_failed": probe["error"]}), flush=True)
 
     if tpu_row is None:
         cached = _cache_load()
         if "gpt2_small_train_tokens_per_s" in cached:
-            gpt_extras["gpt_cached_last_good"] = cached
+            # the always-present headline row: the last real-chip number,
+            # clearly labeled as cached
+            print(json.dumps({
+                "gpt_cached_last_good": cached,
+                "gpt2_small_train_tokens_per_s":
+                    cached["gpt2_small_train_tokens_per_s"],
+                **({"gpt2_small_mfu": cached["gpt2_small_mfu"]}
+                   if "gpt2_small_mfu" in cached else {}),
+                "gpt_row_source": "cached_last_good_tpu",
+            }), flush=True)
         fb = _run_gpt_subprocess(timeout_s=380.0, cpu=True)
         fb["gpt_platform"] = "cpu-fallback"
-        gpt_extras["gpt_cpu_fallback"] = fb
-        # the always-present headline row: prefer the last real-chip
-        # number (labeled), else the fallback measurement
-        if "gpt2_small_train_tokens_per_s" in cached:
-            gpt_extras["gpt2_small_train_tokens_per_s"] = \
-                cached["gpt2_small_train_tokens_per_s"]
-            if "gpt2_small_mfu" in cached:
-                gpt_extras["gpt2_small_mfu"] = cached["gpt2_small_mfu"]
-            gpt_extras["gpt_row_source"] = "cached_last_good_tpu"
-        elif "gpt2_small_train_tokens_per_s" in fb:
-            gpt_extras["gpt2_small_train_tokens_per_s"] = \
+        out = {"gpt_cpu_fallback": fb}
+        if "gpt2_small_train_tokens_per_s" not in cached and \
+                "gpt2_small_train_tokens_per_s" in fb:
+            out["gpt2_small_train_tokens_per_s"] = \
                 fb["gpt2_small_train_tokens_per_s"]
-            gpt_extras["gpt_row_source"] = "cpu_fallback"
-    print(json.dumps(gpt_extras), flush=True)
+            out["gpt_row_source"] = "cpu_fallback"
+        print(json.dumps(out), flush=True)
 
 
 # ---------------------------------------------------------------------------

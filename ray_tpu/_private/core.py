@@ -577,7 +577,11 @@ class CoreWorker:
             if owner_addr == self.addr:
                 continue
             try:
-                self._owner_client(owner_addr).notify(
+                # best-effort with a FAST connect bound: the 30s default
+                # connect retry is for owners still booting; here a dead
+                # owner (its actors die with it) must not stall shutdown
+                # — N dead owners once cost N x 30s of teardown
+                self._owner_client(owner_addr, connect_timeout=0.5).notify(
                     "actor_del_ref", {"actor_id": aid,
                                       "borrower": self.worker_id,
                                       "all": True})
@@ -851,13 +855,14 @@ class CoreWorker:
             raise serialization.loads_inline(r["error"])
         raise ObjectLostError(f"{ref.id}: owner replied {kind}")
 
-    def _owner_client(self, addr) -> Client:
+    def _owner_client(self, addr, connect_timeout: float = 30.0) -> Client:
         addr = tuple(addr)
         with self.lock:
             cli = self.owner_clients.get(addr)
             if cli is not None and not cli.closed:
                 return cli
-        cli = Client(addr, name="core->owner")
+        cli = Client(addr, name="core->owner",
+                     connect_timeout=connect_timeout)
         with self.lock:
             self.owner_clients[addr] = cli
         return cli

@@ -177,7 +177,12 @@ class Client:
         last_err: Optional[Exception] = None
         while True:
             try:
-                self._sock = socket.create_connection(self.addr, timeout=5.0)
+                # per-attempt socket timeout must not exceed the overall
+                # budget: a host-down peer (SYN dropped) blocks the whole
+                # attempt, and a caller asking for a 0.5s bound must not
+                # wait 5s for it
+                self._sock = socket.create_connection(
+                    self.addr, timeout=min(5.0, connect_timeout))
                 break
             except OSError as e:  # daemon may still be booting
                 last_err = e

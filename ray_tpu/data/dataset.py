@@ -99,13 +99,16 @@ class Dataset:
                 resources) -> "Dataset":
         import inspect
 
-        from .compute import strategy_from_concurrency
+        from .compute import ActorPoolStrategy, strategy_from_concurrency
 
         is_class = inspect.isclass(fn)
         if compute is None:
             compute = strategy_from_concurrency(concurrency, is_class)
         elif concurrency is not None:
             raise ValueError("pass `compute` or `concurrency`, not both")
+        elif is_class and not isinstance(compute, ActorPoolStrategy):
+            raise ValueError(
+                "a callable-class UDF requires ActorPoolStrategy compute")
         op = cls(self._dag, fn, compute=compute,
                  resources=dict(resources or {}) or None)
         op.is_class_udf = is_class

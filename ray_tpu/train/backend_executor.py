@@ -91,7 +91,9 @@ class BackendExecutor:
                        checkpoint: Optional[Checkpoint] = None,
                        dataset_shards_per_worker: Optional[List[Dict[str, Any]]] = None,
                        start_iteration: int = 0):
-        os.makedirs(trial_dir, exist_ok=True)
+        from . import storage
+
+        storage.makedirs(trial_dir)
         from ray_tpu._private import common as _common
 
         _common._ensure_picklable_by_value(train_fn)

@@ -21,8 +21,25 @@ _METADATA_FILE = ".metadata.json"
 
 
 class Checkpoint:
+    """`path` may be a local directory or a remote URI (s3://, gs://,
+    mock-remote://...); remote checkpoints materialize through
+    `to_directory`/`as_directory` via train.storage (reference:
+    train/_checkpoint.py Checkpoint carries a pyarrow filesystem the
+    same way)."""
+
     def __init__(self, path: str):
-        self.path = os.path.abspath(os.path.expanduser(path))
+        from . import storage
+
+        if storage.is_uri(path):
+            self.path = path
+        else:
+            self.path = os.path.abspath(os.path.expanduser(path))
+
+    @property
+    def is_remote(self) -> bool:
+        from . import storage
+
+        return storage.is_uri(self.path)
 
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
@@ -31,28 +48,44 @@ class Checkpoint:
         return cls(path)
 
     def to_directory(self, path: Optional[str] = None) -> str:
-        """Copy checkpoint contents into `path` (default: temp dir)."""
+        """Copy checkpoint contents into `path` (default: temp dir);
+        remote checkpoints are downloaded."""
+        from . import storage
+
         dest = path or tempfile.mkdtemp(prefix="ckpt-")
         os.makedirs(dest, exist_ok=True)
-        if os.path.abspath(dest) != self.path:
+        if self.is_remote:
+            storage.download_dir(self.path, dest)
+        elif os.path.abspath(dest) != self.path:
             shutil.copytree(self.path, dest, dirs_exist_ok=True)
         return dest
 
     @contextmanager
     def as_directory(self):
-        """Yield a local directory view without copying when already local."""
-        yield self.path
+        """Yield a local directory view; remote checkpoints download to a
+        temp dir that is removed afterwards, local ones yield in place."""
+        if self.is_remote:
+            dest = self.to_directory()
+            try:
+                yield dest
+            finally:
+                shutil.rmtree(dest, ignore_errors=True)
+        else:
+            yield self.path
 
     def get_metadata(self) -> Dict[str, Any]:
-        p = os.path.join(self.path, _METADATA_FILE)
-        if not os.path.exists(p):
+        from . import storage
+
+        p = storage.join(self.path, _METADATA_FILE)
+        if not storage.exists(p):
             return {}
-        with open(p) as f:
-            return json.load(f)
+        return json.loads(storage.read_text(p))
 
     def set_metadata(self, metadata: Dict[str, Any]) -> None:
-        with open(os.path.join(self.path, _METADATA_FILE), "w") as f:
-            json.dump(metadata, f)
+        from . import storage
+
+        storage.write_text(storage.join(self.path, _METADATA_FILE),
+                           json.dumps(metadata))
 
     def update_metadata(self, metadata: Dict[str, Any]) -> None:
         m = self.get_metadata()

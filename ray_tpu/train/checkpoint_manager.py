@@ -9,7 +9,6 @@ attribute (or the most recent, when no attribute is set).
 from __future__ import annotations
 
 import logging
-import shutil
 from typing import Any, Dict, List, Optional, Tuple
 
 from .checkpoint import Checkpoint
@@ -60,10 +59,12 @@ class CheckpointManager:
         latest = max(self._checkpoints, key=lambda t: t.index)
         ranked = sorted((t for t in self._checkpoints if t is not latest),
                         key=self._score, reverse=True)
+        from . import storage
+
         while len(self._checkpoints) > keep and ranked:
             t = ranked.pop()
             self._checkpoints.remove(t)
-            shutil.rmtree(t.checkpoint.path, ignore_errors=True)
+            storage.rmtree(t.checkpoint.path)
 
     @property
     def latest_checkpoint(self) -> Optional[Checkpoint]:

@@ -76,8 +76,11 @@ class CheckpointConfig:
 class RunConfig:
     """Run-level config (reference: air/config.py:593).
 
-    storage_path: where checkpoints/results persist (local dir; a
-    gs://-style URI is accepted and treated as a mounted path).
+    storage_path: where checkpoints/results persist — a local directory
+    or a remote filesystem URI (s3://, gs://, or any fsspec scheme);
+    workers upload checkpoints straight to it, which is how multi-host
+    pods (no shared local disk) persist state (see train/storage.py,
+    reference: train/_internal/storage.py:358 StorageContext).
     """
 
     name: Optional[str] = None
@@ -88,6 +91,8 @@ class RunConfig:
     verbose: int = 1
 
     def resolved_storage_path(self) -> str:
-        return os.path.expanduser(
-            self.storage_path
-            or os.environ.get("RAY_TPU_STORAGE", "~/ray_tpu_results"))
+        from . import storage
+
+        path = (self.storage_path
+                or os.environ.get("RAY_TPU_STORAGE", "~/ray_tpu_results"))
+        return path if storage.is_uri(path) else os.path.expanduser(path)

@@ -88,10 +88,15 @@ class TrainSession:
                  checkpoint_upload_dir: Optional[str] = None,
                  dataset_shards: Optional[Dict[str, Any]] = None,
                  start_iteration: int = 0):
+        from .storage import StorageContext
+
         self.ctx = ctx
         self._train_fn = train_fn
         self._restore_checkpoint = checkpoint
         self._upload_dir = checkpoint_upload_dir
+        self._storage = StorageContext(
+            checkpoint_upload_dir or ctx.trial_dir or ".",
+            ctx.experiment_name, ctx.trial_name)
         self._dataset_shards = dataset_shards or {}
         self._results: "queue.Queue" = queue.Queue(maxsize=1)
         self._continue = threading.Semaphore(0)
@@ -114,6 +119,10 @@ class TrainSession:
     def _run(self):
         try:
             out = self._train_fn()
+            # the last checkpoint upload may still be in flight: the
+            # driver reads `latest complete checkpoint` right after the
+            # finish marker, so land it (and surface its error) first
+            self._storage.wait()
             self._results.put(_FinishedMarker(final=out if isinstance(out, dict) else None))
         except SessionAborted:
             return  # driver-initiated teardown; nobody is consuming results
@@ -176,13 +185,39 @@ class TrainSession:
         Layout: <trial_dir>/checkpoint_<iter>/rank_<k>/... so multi-host
         sharded checkpoints (each host saving its param shards, the orbax
         pattern) land in one logical checkpoint directory.
+
+        Remote storage (URI trial dir): each worker uploads its own shard
+        directly to the remote filesystem — multi-host pods have no
+        shared local disk.  Uploads are ASYNC and pipelined (snapshot the
+        dir now, upload in the background, write the completion marker
+        only after the upload lands): the next training step overlaps the
+        previous upload, and restore paths skip marker-less dirs.
         """
+        from . import storage
+
         base = self._upload_dir or self.ctx.trial_dir
-        dest = os.path.join(base, f"checkpoint_{self._iteration - 1:06d}")
+        dest = storage.join(base, f"checkpoint_{self._iteration - 1:06d}")
         if self.ctx.world_size > 1:
-            dest_rank = os.path.join(dest, f"rank_{self.ctx.world_rank}")
+            dest_rank = storage.join(dest, f"rank_{self.ctx.world_rank}")
         else:
             dest_rank = dest
+        marker = storage.join(
+            dest, f".complete_rank_{self.ctx.world_rank}")
+        if storage.is_uri(base):
+            import tempfile
+
+            # snapshot before returning: the user loop may rewrite the
+            # local dir while the background upload is still reading it
+            snap = tempfile.mkdtemp(prefix="ckpt-up-")
+            shutil.copytree(checkpoint.path, snap, dirs_exist_ok=True)
+
+            def on_complete(_snap=snap, _marker=marker):
+                storage.write_text(_marker, "")
+                shutil.rmtree(_snap, ignore_errors=True)
+
+            self._storage.upload_dir_async(snap, dest_rank,
+                                           on_complete=on_complete)
+            return dest
         os.makedirs(dest, exist_ok=True)
         if os.path.abspath(checkpoint.path) != os.path.abspath(dest_rank):
             shutil.copytree(checkpoint.path, dest_rank, dirs_exist_ok=True)

@@ -21,7 +21,6 @@ base_trainer.py:567-623).
 
 from __future__ import annotations
 
-import glob
 import logging
 import os
 import re
@@ -39,15 +38,28 @@ from .result import Result
 logger = logging.getLogger(__name__)
 
 
-def _find_latest_checkpoint(trial_dir: str) -> Optional[Checkpoint]:
-    """Scan <trial_dir>/checkpoint_* for the newest complete checkpoint
-    (one with at least one `.complete_rank_*` marker — written after the
-    copy, so a dir that died mid-copy is skipped)."""
-    cands = sorted(glob.glob(os.path.join(trial_dir, "checkpoint_*")))
-    cands = [c for c in cands
-             if re.search(r"checkpoint_\d+$", c)
-             and glob.glob(os.path.join(c, ".complete_rank_*"))]
-    return Checkpoint(cands[-1]) if cands else None
+def _find_latest_checkpoint(trial_dir: str,
+                            world_size: int = 1) -> Optional[Checkpoint]:
+    """Scan <trial_dir>/checkpoint_* for the newest complete checkpoint.
+
+    Complete = a `.complete_rank_k` marker exists for EVERY rank (markers
+    are written after each rank's copy/upload lands): a checkpoint where
+    one worker died mid-report has a subset of ranks and restoring from
+    it would hand the missing ranks someone else's shard — or nothing.
+    Works on local dirs and remote URIs alike (train.storage)."""
+    from . import storage
+
+    need = {f".complete_rank_{k}" for k in range(world_size)}
+    cands = []
+    for name in storage.listdir(trial_dir):
+        if not re.fullmatch(r"checkpoint_\d+", name):
+            continue
+        cdir = storage.join(trial_dir, name)
+        if need <= set(storage.listdir(cdir)):
+            cands.append((name, cdir))
+    if not cands:
+        return None
+    return Checkpoint(max(cands)[1])
 
 
 class JaxTrainer:
@@ -146,8 +158,11 @@ class JaxTrainer:
                         "training worker died (%s); restarting group "
                         "(failure %d/%s) from latest checkpoint", e,
                         failures, max_failures if max_failures != -1 else "inf")
-                    restore = (ckpt_mgr.latest_checkpoint
-                               or _find_latest_checkpoint(trial_dir)
+                    # the dir scan is marker-validated (an upload that
+                    # died with its worker left no marker), so it is the
+                    # safe restore source; the manager's latest may point
+                    # at an in-flight upload
+                    restore = (_find_latest_checkpoint(trial_dir, n)
                                or self._resume_checkpoint)
                     executor.restart()
                 except TrainingFailedError as e:
@@ -164,11 +179,13 @@ class JaxTrainer:
         from ray_tpu._private.usage_stats import record_library_usage
 
         record_library_usage("train")
+        from . import storage
+
         name = self.run_config.name or f"JaxTrainer_{int(time.time())}"
-        exp_dir = os.path.join(self.run_config.resolved_storage_path(), name)
+        exp_dir = storage.join(self.run_config.resolved_storage_path(), name)
         trial_name = f"{name}_00000"
-        trial_dir = os.path.join(exp_dir, trial_name)
-        os.makedirs(trial_dir, exist_ok=True)
+        trial_dir = storage.join(exp_dir, trial_name)
+        storage.makedirs(trial_dir)
         result = self._run(trial_dir, name, trial_name)
         if result.error is not None:
             raise TrainingFailedError(

@@ -32,8 +32,10 @@ class Trial:
         self.iteration = 0
         self.num_failures = 0
         self.start_time = time.time()
-        self.trial_dir = os.path.join(experiment_dir, trial_id)
-        os.makedirs(self.trial_dir, exist_ok=True)
+        from ray_tpu.train import storage
+
+        self.trial_dir = storage.join(experiment_dir, trial_id)
+        storage.makedirs(self.trial_dir)
 
     @property
     def is_finished(self) -> bool:

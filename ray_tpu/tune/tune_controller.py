@@ -120,9 +120,12 @@ class JsonLoggerCallback(Callback):
     tune/logger/json.py)."""
 
     def on_trial_result(self, trial: Trial, result: Dict[str, Any]):
+        from ray_tpu.train import storage
+
         try:
-            with open(os.path.join(trial.trial_dir, "result.json"), "a") as f:
-                f.write(json.dumps(result, default=str) + "\n")
+            storage.append_text(
+                storage.join(trial.trial_dir, "result.json"),
+                json.dumps(result, default=str) + "\n")
         except OSError:
             pass
 
@@ -404,12 +407,17 @@ class TuneController:
     # -- experiment state --------------------------------------------------
 
     def save_state(self):
+        from ray_tpu.train import storage
+
         state = {
             "experiment_name": self._experiment_name,
             "timestamp": time.time(),
             "trials": [t.to_json() for t in self.trials],
         }
-        path = os.path.join(self._experiment_dir, "experiment_state.json")
+        path = storage.join(self._experiment_dir, "experiment_state.json")
+        if storage.is_uri(path):
+            storage.write_text(path, json.dumps(state, default=str))
+            return
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(state, f, default=str)
@@ -417,9 +425,10 @@ class TuneController:
 
     @staticmethod
     def load_trials(experiment_dir: str) -> List[Trial]:
-        path = os.path.join(experiment_dir, "experiment_state.json")
-        with open(path) as f:
-            state = json.load(f)
+        from ray_tpu.train import storage
+
+        path = storage.join(experiment_dir, "experiment_state.json")
+        state = json.loads(storage.read_text(path))
         name = state["experiment_name"]
         trials = []
         for d in state["trials"]:

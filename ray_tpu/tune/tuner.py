@@ -102,11 +102,13 @@ class Tuner:
 
         record_library_usage("tune")
         cfg = self._tune_config
+        from ray_tpu.train import storage
+
         name = self._run_config.name or f"tune_{int(time.time())}"
         exp_dir = (self._experiment_dir
-                   or os.path.join(self._run_config.resolved_storage_path(),
+                   or storage.join(self._run_config.resolved_storage_path(),
                                    name))
-        os.makedirs(exp_dir, exist_ok=True)
+        storage.makedirs(exp_dir)
         if self._resumed_trials is not None:
             # restored experiments rerun their saved trials only; the
             # searcher's remaining budget was consumed by the original run

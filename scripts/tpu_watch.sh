@@ -1,25 +1,15 @@
 #!/bin/bash
-# Poll the TPU tunnel; when it answers, capture a real-chip GPT train-step
-# measurement into BENCH_CACHE.json (bench.py --gpt-only caches via
-# _cache_store? no - we redirect the JSON line ourselves) then exit.
-# Runs for up to MAX_TRIES polls.
+# Poll the TPU tunnel; when it answers, run the GPT train-step bench once
+# (bench.py --gpt-only caches a real-chip result to BENCH_CACHE.json
+# itself) and exit.  Runs for up to MAX_TRIES polls.
 cd "$(dirname "$0")/.." || exit 1
 MAX_TRIES=${MAX_TRIES:-140}
 for i in $(seq 1 "$MAX_TRIES"); do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "$(date -u +%H:%M:%S) probe ok, running gpt bench" >> /tmp/tpu_watch.log
     out=$(timeout 600 python bench.py --gpt-only 2>>/tmp/tpu_watch.log)
-    line=$(echo "$out" | grep gpt2_small_train_tokens_per_s | tail -1)
-    if [ -n "$line" ]; then
-      python - "$line" <<'EOF'
-import json, sys, time
-row = json.loads(sys.argv[1])
-row["cached_unix_time"] = int(time.time())
-with open("BENCH_CACHE.json", "w") as f:
-    json.dump(row, f, indent=2)
-print("cached:", row)
-EOF
-      echo "$(date -u +%H:%M:%S) cached TPU gpt number" >> /tmp/tpu_watch.log
+    if echo "$out" | grep -q gpt2_small_train_tokens_per_s; then
+      echo "$(date -u +%H:%M:%S) cached TPU gpt number: $out" >> /tmp/tpu_watch.log
       exit 0
     fi
     echo "$(date -u +%H:%M:%S) bench ran but no row; retrying" >> /tmp/tpu_watch.log
