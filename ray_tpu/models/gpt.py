@@ -104,7 +104,12 @@ def logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
         lp["attn_norm_b"] = Logical("layers", None)
         lp["mlp_norm_b"] = Logical("layers", None)
     out = {
-        "embed": Logical("vocab", "embed"),
+        # vocab-only sharding: the table's lookup is a gather, and an
+        # fsdp-sharded embed dim makes the partitioner emit embed-sharded
+        # activations + a full reshard ("involuntary full
+        # rematerialization"); vocab(tp) already gives the table a
+        # sharded-storage story
+        "embed": Logical("vocab", None),
         "layers": lp,
         "final_norm": Logical(None),
     }
@@ -173,10 +178,15 @@ def _norm(x, w, b, kind):
 
 
 def _constrain(x, *axes):
-    from ray_tpu.parallel.sharding import spec_from_logical
+    """Activation sharding constraint (ACTIVATION_RULES: fsdp stays on
+    the batch dim — params' embed-dim fsdp sharding is gathered on use,
+    never propagated onto activations)."""
+    from ray_tpu.parallel.sharding import (ACTIVATION_RULES,
+                                           spec_from_logical)
 
     try:
-        return jax.lax.with_sharding_constraint(x, spec_from_logical(axes))
+        return jax.lax.with_sharding_constraint(
+            x, spec_from_logical(axes, ACTIVATION_RULES))
     except Exception:
         return x  # outside jit / no mesh context
 

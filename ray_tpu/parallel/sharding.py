@@ -34,6 +34,19 @@ DEFAULT_RULES: Dict[str, Any] = {
     "norm": None,
 }
 
+# Rules for ACTIVATION constraints.  fsdp shards PARAMETER embed dims
+# (ZeRO-3: gathered on use); activations keep fsdp on their batch dim, so
+# their embed dim must stay unsharded — with the param table, an
+# activation spec like ("batch", "seq", "embed") would claim fsdp twice
+# (invalid), and before this split the resulting constraint was silently
+# dropped, leaving the partitioner free to embed-shard block outputs
+# (the "Involuntary full rematerialization" reshard in the r2 dryrun).
+ACTIVATION_RULES: Dict[str, Any] = {
+    **DEFAULT_RULES,
+    "embed": None,
+    "conv_out": None,
+}
+
 
 def spec_from_logical(logical: Sequence[Optional[str]],
                       rules: Optional[Dict[str, Any]] = None,
@@ -45,6 +58,7 @@ def spec_from_logical(logical: Sequence[Optional[str]],
     """
     rules = {**DEFAULT_RULES, **(rules or {})}
     out = []
+    used: set = set()
     for name in logical:
         mesh_axes = rules.get(name) if name is not None else None
         if mesh_axes is None:
@@ -55,6 +69,11 @@ def spec_from_logical(logical: Sequence[Optional[str]],
         if mesh is not None:
             mesh_axes = tuple(a for a in mesh_axes
                               if mesh.shape.get(a, 1) > 1)
+        # a mesh axis may shard only ONE tensor dim; on a clash the
+        # earlier (leftmost, usually batch) dim keeps it — a duplicate
+        # spec is invalid and would otherwise void the whole constraint
+        mesh_axes = tuple(a for a in mesh_axes if a not in used)
+        used.update(mesh_axes)
         if not mesh_axes:
             out.append(None)
         elif len(mesh_axes) == 1:

@@ -47,31 +47,42 @@ class Checkpoint:
             raise ValueError(f"not a directory: {path}")
         return cls(path)
 
-    def to_directory(self, path: Optional[str] = None) -> str:
+    def to_directory(self, path: Optional[str] = None,
+                     subdir: Optional[str] = None) -> str:
         """Copy checkpoint contents into `path` (default: temp dir);
-        remote checkpoints are downloaded."""
+        remote checkpoints are downloaded.
+
+        `subdir` limits the transfer to one subdirectory (e.g.
+        ``rank_3``): on a pod restore every host holds the same logical
+        checkpoint URI but needs only its own shard — downloading all N
+        rank dirs to all N hosts would be an N^2 transfer."""
         from . import storage
 
+        src = storage.join(self.path, subdir) if subdir else self.path
         dest = path or tempfile.mkdtemp(prefix="ckpt-")
         os.makedirs(dest, exist_ok=True)
         if self.is_remote:
-            storage.download_dir(self.path, dest)
-        elif os.path.abspath(dest) != self.path:
-            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+            storage.download_dir(src, dest)
+        elif os.path.abspath(dest) != os.path.abspath(src):
+            shutil.copytree(src, dest, dirs_exist_ok=True)
         return dest
 
     @contextmanager
-    def as_directory(self):
+    def as_directory(self, subdir: Optional[str] = None):
         """Yield a local directory view; remote checkpoints download to a
-        temp dir that is removed afterwards, local ones yield in place."""
+        temp dir that is removed afterwards, local ones yield in place.
+        `subdir` narrows the view (and the download) to one
+        subdirectory — see to_directory."""
+        from . import storage
+
         if self.is_remote:
-            dest = self.to_directory()
+            dest = self.to_directory(subdir=subdir)
             try:
                 yield dest
             finally:
                 shutil.rmtree(dest, ignore_errors=True)
         else:
-            yield self.path
+            yield storage.join(self.path, subdir) if subdir else self.path
 
     def get_metadata(self) -> Dict[str, Any]:
         from . import storage

@@ -223,8 +223,7 @@ class TrainSession:
             shutil.copytree(checkpoint.path, dest_rank, dirs_exist_ok=True)
         # completion marker, written last: restore paths skip checkpoint
         # dirs that died mid-copy (no marker present)
-        with open(os.path.join(
-                dest, f".complete_rank_{self.ctx.world_rank}"), "w"):
+        with open(marker, "w"):
             pass
         return dest
 

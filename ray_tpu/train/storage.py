@@ -229,10 +229,17 @@ class StorageContext:
         self._upload_thread.start()
 
     def wait(self, timeout: Optional[float] = None) -> None:
-        """Join the in-flight upload; re-raise its error, if any."""
+        """Join the in-flight upload; re-raise its error, if any.
+
+        A timed-out join leaves the upload tracked (still in flight):
+        callers must not mistake a timeout for completion — the
+        completion marker is only written by the upload itself."""
         t = self._upload_thread
         if t is not None:
             t.join(timeout=timeout)
+            if t.is_alive():
+                raise TimeoutError(
+                    f"checkpoint upload still in flight after {timeout}s")
             self._upload_thread = None
         if self._upload_error is not None:
             e, self._upload_error = self._upload_error, None

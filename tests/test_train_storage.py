@@ -135,12 +135,15 @@ def test_trainer_fit_remote_storage(ray_cluster, tmp_path):
     from ray_tpu.train.trainer import _find_latest_checkpoint
 
     trial_dir = _uri(tmp_path, "bucket", "remote_run", "remote_run_00000")
-    latest = _find_latest_checkpoint(trial_dir)
+    latest = _find_latest_checkpoint(trial_dir, world_size=2)
     assert latest is not None
     assert latest.path == result.checkpoint.path
-    with latest.as_directory() as d:
-        got = json.load(open(os.path.join(d, "rank_0", "s.json")))
+    # rank-filtered download: a pod host fetches only its own shard
+    with latest.as_directory(subdir="rank_0") as d:
+        got = json.load(open(os.path.join(d, "s.json")))
         assert got["step"] == 2
+    # a missing rank marker makes the checkpoint incomplete for that size
+    assert _find_latest_checkpoint(trial_dir, world_size=3) is None
 
 
 def test_trainer_local_paths_unchanged(ray_cluster, tmp_path):
