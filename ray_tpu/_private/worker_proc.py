@@ -68,6 +68,7 @@ class WorkerMain:
         # many in-flight async calls.
         self._aio_loop: asyncio.AbstractEventLoop = None
         self._aio_lock = threading.Lock()
+        self._stream_executor = None  # created with the aio loop
 
         # raylet client push handling (shutdown) + death of raylet kills us
         self.core.raylet._on_push = self._on_raylet_push
@@ -330,6 +331,13 @@ class WorkerMain:
                 loop.set_default_executor(ThreadPoolExecutor(
                     max_workers=8, thread_name_prefix="actor-aio-exec",
                     initializer=_mark_executing))
+                # streaming generators get their OWN pool: each in-flight
+                # stream pins a thread for its whole duration, and 8
+                # long-lived streams (SSE clients) would otherwise starve
+                # every other blocking hop on the default executor
+                self._stream_executor = ThreadPoolExecutor(
+                    max_workers=64, thread_name_prefix="actor-stream",
+                    initializer=_mark_executing)
 
                 def _loop_main():
                     _mark_executing()
@@ -514,11 +522,14 @@ class WorkerMain:
                                 if spec.num_returns == \
                                         common.STREAMING_RETURNS:
                                     # sync generator method on an async
-                                    # actor: stream from an executor
-                                    # thread, not the loop (acks block)
+                                    # actor: stream from the dedicated
+                                    # stream pool, not the loop (acks
+                                    # block) nor the 8-thread default
+                                    # executor (streams are long-lived)
                                     loop = asyncio.get_running_loop()
                                     reply = await loop.run_in_executor(
-                                        None, self._run_generator,
+                                        self._stream_executor,
+                                        self._run_generator,
                                         spec, out, t0)
                                 else:
                                     reply = self._store_reply(spec, out,

@@ -11,7 +11,9 @@ from ._common import AutoscalingConfig
 from ._deployment import Application, Deployment, deployment
 from .schema import (ServeApplicationSchema, ServeDeploySchema,
                      deploy_config, deploy_config_file)
-from ._handle import DeploymentHandle, DeploymentResponse
+from ._asgi import ingress
+from ._handle import (DeploymentHandle, DeploymentResponse,
+                      DeploymentResponseGenerator)
 from ._proxy import Request, Response, RpcClient
 from .api import (delete, get_app_handle, get_deployment_handle, run,
                   shutdown, start, start_rpc_proxy, status)
@@ -20,7 +22,8 @@ from .multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentHandle",
-    "DeploymentResponse", "Request", "Response", "RpcClient", "batch",
+    "DeploymentResponse", "DeploymentResponseGenerator", "ingress",
+    "Request", "Response", "RpcClient", "batch",
     "delete", "deployment", "get_app_handle", "get_deployment_handle",
     "get_multiplexed_model_id", "multiplexed", "run", "shutdown", "start",
     "start_rpc_proxy", "status",

@@ -1,0 +1,136 @@
+"""ASGI ingress: serve a FastAPI/Starlette/any-ASGI app as a deployment.
+
+Reference: python/ray/serve/api.py:164 @serve.ingress + the proxy's ASGI
+plumbing (serve/_private/proxy.py:864 receive_asgi_messages).  The ASGI
+app executes INSIDE the replica; its response events stream back to the
+HTTP proxy through the framework's streaming-generator plane, so chunked
+and SSE responses reach the client as the app produces them.
+
+Protocol between replica and proxy: the wrapped deployment's __call__
+yields ("__asgi_meta__", status, headers) first, then raw body chunks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue as _queue
+import threading
+import time
+import urllib.parse
+from typing import Any
+
+ASGI_META = "__asgi_meta__"
+
+
+def run_asgi(app, request):
+    """Generator driving one ASGI request; yields meta then body chunks.
+
+    The app runs on a private event loop in a side thread; `send` events
+    flow through a queue so a chunk yielded by the app is emitted here
+    (and on the wire) before the app finishes."""
+    events: "_queue.Queue" = _queue.Queue()
+    body = request._body or b""
+
+    async def receive():
+        return {"type": "http.request", "body": body, "more_body": False}
+
+    async def send(message):
+        events.put(message)
+
+    scope = {
+        "type": "http",
+        "asgi": {"version": "3.0", "spec_version": "2.3"},
+        "http_version": "1.1",
+        "method": request.method,
+        "path": request.route_path,
+        "raw_path": request.route_path.encode(),
+        "root_path": "",
+        "scheme": "http",
+        "query_string": urllib.parse.urlencode(
+            request.query_params).encode(),
+        "headers": [(k.lower().encode(), str(v).encode())
+                    for k, v in request.headers.items()],
+        "client": None,
+        "server": None,
+    }
+
+    def run():
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(app(scope, receive, send))
+            events.put({"type": "__done__"})
+        except BaseException as e:
+            events.put({"type": "__error__",
+                        "error": f"{type(e).__name__}: {e}"})
+        finally:
+            loop.close()
+
+    t = threading.Thread(target=run, daemon=True, name="serve-asgi")
+    t.start()
+    started = False
+    # bounded waits: a wedged ASGI app (dead upstream before it ever
+    # sends) must release this stream's executor thread + ongoing count
+    # once the proxy has long given up (its client timeout is 300s)
+    deadline = time.monotonic() + 320.0
+    while True:
+        try:
+            ev = events.get(timeout=max(1.0, deadline - time.monotonic()))
+        except _queue.Empty:
+            raise TimeoutError("ASGI app produced no event within the "
+                               "request deadline") from None
+        typ = ev.get("type")
+        if typ == "http.response.start":
+            headers = [
+                (k.decode() if isinstance(k, bytes) else str(k),
+                 v.decode() if isinstance(v, bytes) else str(v))
+                for k, v in ev.get("headers", [])]
+            started = True
+            yield (ASGI_META, int(ev.get("status", 200)), headers)
+        elif typ == "http.response.body":
+            b = ev.get("body", b"")
+            if b:
+                yield bytes(b)
+            if not ev.get("more_body"):
+                break
+        elif typ == "__done__":
+            break
+        elif typ == "__error__":
+            if not started:
+                yield (ASGI_META, 500, [("content-type", "text/plain")])
+            yield f"ASGI app failed: {ev['error']}".encode()
+            break
+    t.join(timeout=10)
+
+
+def ingress(asgi_app):
+    """Class decorator: the deployment serves `asgi_app` over HTTP
+    (reference: serve/api.py:164 @serve.ingress(app)).
+
+    The replica's instance is published as ``asgi_app.state.serve_deployment``
+    (when the app has a ``state``, as FastAPI/Starlette do) so route
+    functions can reach warm per-replica state.
+    """
+
+    def decorator(cls):
+        if not isinstance(cls, type):
+            raise TypeError("@serve.ingress decorates a class; for a bare "
+                            "ASGI app use serve.ingress(app)(object)")
+
+        class _ASGIIngress(cls):
+            __serve_asgi__ = True
+
+            def __init__(self, *args: Any, **kwargs: Any):
+                super().__init__(*args, **kwargs)
+                state = getattr(asgi_app, "state", None)
+                if state is not None:
+                    state.serve_deployment = self
+
+            def __call__(self, request):
+                return run_asgi(asgi_app, request)
+
+        _ASGIIngress.__name__ = cls.__name__
+        _ASGIIngress.__qualname__ = getattr(cls, "__qualname__",
+                                            cls.__name__)
+        return _ASGIIngress
+
+    return decorator

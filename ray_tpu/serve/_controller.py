@@ -175,8 +175,14 @@ class ServeController:
             routes = {}
             for app_name, app in self._apps.items():
                 if app["route_prefix"]:
+                    ingress = app["deployments"].get(app["ingress"])
+                    spec = ingress.spec if ingress else {}
                     routes[app["route_prefix"]] = {
-                        "app": app_name, "deployment": app["ingress"]}
+                        "app": app_name, "deployment": app["ingress"],
+                        # the proxy streams chunked responses for
+                        # generator/ASGI ingress callables
+                        "streaming": bool(spec.get("streaming")),
+                        "asgi": bool(spec.get("asgi"))}
             return {"version": self._routing_version, "routes": routes}
 
     def get_app_table(self) -> Dict[str, Any]:

@@ -51,41 +51,84 @@ class DeploymentResponse:
         self._fire_done()
 
 
+class DeploymentResponseGenerator:
+    """Streaming response: iterate to receive items as the deployment
+    yields them (reference: handle.py DeploymentResponseGenerator).
+    Sync iteration blocks per item; `async for` hops via an executor."""
+
+    def __init__(self, gen, done_cb=None):
+        self._gen = gen
+        self._done_cb = done_cb
+
+    def _fire_done(self):
+        if self._done_cb is not None:
+            cb, self._done_cb = self._done_cb, None
+            cb()
+
+    def __iter__(self):
+        try:
+            for ref in self._gen:
+                yield ray_tpu.get(ref, timeout=300.0)
+        finally:
+            self._fire_done()
+
+    async def __aiter__(self):
+        loop = asyncio.get_event_loop()
+        it = iter(self)
+        sentinel = object()
+        while True:
+            item = await loop.run_in_executor(
+                None, lambda: next(it, sentinel))
+            if item is sentinel:
+                return
+            yield item
+
+    def __del__(self):
+        self._fire_done()
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str,
                  method_name: Optional[str] = None,
-                 multiplexed_model_id: Optional[str] = None):
+                 multiplexed_model_id: Optional[str] = None,
+                 stream: bool = False):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method_name = method_name
         self._multiplexed_model_id = multiplexed_model_id
+        self._stream = stream
 
     def options(self, *, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None
-                ) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name, self.app_name,
             method_name=method_name or self._method_name,
             multiplexed_model_id=(multiplexed_model_id
-                                  or self._multiplexed_model_id))
+                                  or self._multiplexed_model_id),
+            stream=self._stream if stream is None else stream)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
         return self.options(method_name=name)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         router = get_router(self.app_name, self.deployment_name)
         metadata: Dict[str, Any] = {}
         if self._multiplexed_model_id:
             metadata["multiplexed_model_id"] = self._multiplexed_model_id
+        if self._stream:
+            gen, done = router.assign_streaming(self._method_name, args,
+                                                kwargs, metadata)
+            return DeploymentResponseGenerator(gen, done)
         ref, done = router.assign(self._method_name, args, kwargs, metadata)
         return DeploymentResponse(ref, done)
 
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.app_name, self._method_name,
-                 self._multiplexed_model_id))
+                 self._multiplexed_model_id, self._stream))
 
     def __repr__(self):
         return (f"DeploymentHandle(app={self.app_name!r}, "

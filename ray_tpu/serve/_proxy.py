@@ -103,6 +103,17 @@ class _ControllerTableCache:
         return self._value
 
 
+def _chunk_bytes(item: Any) -> bytes:
+    """Wire form of one streamed item: bytes pass through, strings encode
+    (SSE framing is the deployment's own `yield "data: ...\\n\\n"`),
+    anything else is one JSON line."""
+    if isinstance(item, (bytes, bytearray)):
+        return bytes(item)
+    if isinstance(item, str):
+        return item.encode()
+    return (json.dumps(item, default=str) + "\n").encode()
+
+
 class HTTPProxy:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         self._host = host
@@ -183,6 +194,10 @@ class HTTPProxy:
         router = get_router(target["app"], target["deployment"])
         loop = asyncio.get_event_loop()
 
+        if target.get("streaming") or target.get("asgi"):
+            return await self._handle_streaming(request, req, target,
+                                                router)
+
         def call():
             ref, done = router.assign(None, (req,), {}, {})
             try:
@@ -197,6 +212,72 @@ class HTTPProxy:
             return web.Response(status=500,
                                text=f"{type(e).__name__}: {e}")
         return self._to_http(out)
+
+    async def _handle_streaming(self, aio_req, req, target, router):
+        """Chunked-transfer path for generator/ASGI ingress (reference:
+        proxy.py:864 streaming plumbing): each item the deployment yields
+        goes onto the wire as soon as its ref resolves — first-token
+        latency is one item's production time, not the whole response's.
+        """
+        from aiohttp import web
+
+        from ._asgi import ASGI_META
+
+        loop = asyncio.get_event_loop()
+        gen, done = await loop.run_in_executor(
+            None, lambda: router.assign_streaming(None, (req,), {}, {}))
+        it = iter(gen)
+        sentinel = object()
+
+        def nxt():
+            try:
+                ref = next(it)
+            except StopIteration:
+                return sentinel
+            return ray_tpu.get(ref, timeout=300.0)
+
+        resp = None
+        try:
+            first = await loop.run_in_executor(None, nxt)
+            pending = None
+            if (target.get("asgi") and isinstance(first, tuple)
+                    and first and first[0] == ASGI_META):
+                from multidict import CIMultiDict
+
+                # multidict: duplicate names (several Set-Cookie) survive
+                headers = CIMultiDict(
+                    (k, v) for k, v in first[2]
+                    if k.lower() != "content-length")  # chunked
+                resp = web.StreamResponse(status=first[1], headers=headers)
+            else:
+                resp = web.StreamResponse(
+                    status=200,
+                    headers={"Content-Type": "text/plain; charset=utf-8"})
+                pending = first
+            await resp.prepare(aio_req)
+            if pending is not None and pending is not sentinel:
+                await resp.write(_chunk_bytes(pending))
+            if first is not sentinel:
+                while True:
+                    item = await loop.run_in_executor(None, nxt)
+                    if item is sentinel:
+                        break
+                    await resp.write(_chunk_bytes(item))
+            await resp.write_eof()
+            return resp
+        except Exception as e:
+            logger.exception("streaming request to %s failed", req.path)
+            if resp is None:
+                return web.Response(status=500,
+                                    text=f"{type(e).__name__}: {e}")
+            # headers already sent: terminate the stream
+            try:
+                await resp.write_eof()
+            except Exception:
+                pass
+            return resp
+        finally:
+            done()
 
     def _to_http(self, out: Any):
         from aiohttp import web

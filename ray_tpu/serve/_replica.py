@@ -58,20 +58,62 @@ class Replica:
 
     # -- request path -------------------------------------------------------
 
+    def _resolve_target(self, method_name: Optional[str]):
+        if method_name in (None, "__call__") and callable(self._callable):
+            return self._callable
+        return getattr(self._callable, method_name or "__call__")
+
     async def handle_request(self, method_name: Optional[str], args, kwargs,
                              metadata: Optional[Dict[str, Any]] = None):
         self._ongoing += 1
         self._total += 1
         token = _request_context.set(metadata or {})
         try:
-            target = (self._callable if method_name in (None, "__call__")
-                      and callable(self._callable)
-                      else getattr(self._callable, method_name or "__call__"))
-            out = target(*args, **kwargs)
+            out = self._resolve_target(method_name)(*args, **kwargs)
             if inspect.iscoroutine(out):
                 out = await out
             return out
         finally:
+            _request_context.reset(token)
+            self._ongoing -= 1
+
+    def handle_request_streaming(self, method_name: Optional[str], args,
+                                 kwargs, metadata: Optional[Dict] = None):
+        """Streaming request path (reference: proxy.py:864
+        receive_asgi_messages / generator deployments): the user target's
+        yields flow out as a streaming generator — the first token
+        reaches the client while the rest is still being produced.
+
+        Sync generator method: on this async-actor replica it drains in
+        an executor thread (see worker_proc), so blocking iteration is
+        fine; async generators pump on a private event loop."""
+        import asyncio
+
+        self._ongoing += 1
+        self._total += 1
+        token = _request_context.set(metadata or {})
+        loop = None
+        try:
+            out = self._resolve_target(method_name)(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                # e.g. _FunctionWrapper: the coroutine may resolve to the
+                # generator itself
+                loop = asyncio.new_event_loop()
+                out = loop.run_until_complete(out)
+            if inspect.isasyncgen(out):
+                loop = loop or asyncio.new_event_loop()
+                while True:
+                    try:
+                        yield loop.run_until_complete(out.__anext__())
+                    except StopAsyncIteration:
+                        break
+            elif inspect.isgenerator(out):
+                yield from out
+            else:
+                yield out
+        finally:
+            if loop is not None:
+                loop.close()
             _request_context.reset(token)
             self._ongoing -= 1
 

@@ -79,16 +79,17 @@ class Router:
         qb = self._inflight.get(b["replica_id"], 0)
         return a if qa <= qb else b
 
-    def assign(self, method_name: Optional[str], args, kwargs,
-               metadata: Optional[Dict[str, Any]] = None):
-        """Submit to a chosen replica; returns (ObjectRef, done_cb)."""
+    def _assign_to(self, method: str, method_name: Optional[str], args,
+                   kwargs, metadata, streaming: bool):
         model_id = (metadata or {}).get("multiplexed_model_id")
         replica = self._pick(model_id)
         rid = replica["replica_id"]
         with self._lock:
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
-        ref = replica["handle"].handle_request.remote(
-            method_name, args, kwargs, metadata or {})
+        m = getattr(replica["handle"], method)
+        if streaming:
+            m = m.options(num_returns="streaming")
+        ref = m.remote(method_name, args, kwargs, metadata or {})
 
         def done():
             with self._lock:
@@ -96,6 +97,19 @@ class Router:
                 self._inflight[rid] = max(0, n - 1)
 
         return ref, done
+
+    def assign(self, method_name: Optional[str], args, kwargs,
+               metadata: Optional[Dict[str, Any]] = None):
+        """Submit to a chosen replica; returns (ObjectRef, done_cb)."""
+        return self._assign_to("handle_request", method_name, args, kwargs,
+                               metadata, streaming=False)
+
+    def assign_streaming(self, method_name: Optional[str], args, kwargs,
+                         metadata: Optional[Dict[str, Any]] = None):
+        """Streaming submit; returns (ObjectRefGenerator, done_cb) — one
+        ref per item the deployment yields."""
+        return self._assign_to("handle_request_streaming", method_name,
+                               args, kwargs, metadata, streaming=True)
 
 
 _routers: Dict[Any, Router] = {}

@@ -78,7 +78,18 @@ def _spec_of(node: Application, handle_env: Dict[str, DeploymentHandle],
 
     args = tuple(sub(a) for a in node._args)
     kwargs = {k: sub(v) for k, v in node._kwargs.items()}
+    import inspect as _inspect
+
+    # the HTTP proxy streams (chunked transfer) when the ingress __call__
+    # is a generator, and speaks ASGI when @serve.ingress wrapped it
+    target = d.func_or_class if d.is_function else \
+        getattr(d.func_or_class, "__call__", None)
+    streaming = bool(target is not None and
+                     (_inspect.isgeneratorfunction(target)
+                      or _inspect.isasyncgenfunction(target)))
     return {
+        "streaming": streaming,
+        "asgi": bool(getattr(d.func_or_class, "__serve_asgi__", False)),
         "name": d.name,
         "num_replicas": d.num_replicas,
         "user_config": d.user_config,
