@@ -68,36 +68,112 @@ def bench_put_bandwidth() -> float:
     return total / dt / (1 << 30)
 
 
-def bench_put_bandwidth_multi(n_threads: int = 4) -> float:
-    """Aggregate GiB/s with several submitters putting 128MiB objects
-    concurrently (reference: multi_client_put_gigabytes)."""
-    import threading
-
+def _client_child_main(kind: str, addr: str, per: int) -> None:
+    """One multi-client benchmark client: a REAL separate driver process
+    connected to the parent's cluster (the reference's multi_client_*
+    rows run one driver process per client — threads in one interpreter
+    measure the GIL, not the framework)."""
     import numpy as np
 
     import ray_tpu
 
-    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
-    blob = np.random.bytes(128 * 1024 * 1024)
-    arrs = [np.frombuffer(blob, np.uint8) for _ in range(n_threads)]
-    for a in arrs:  # warm the arena's working set (steady state)
-        ray_tpu.put(a)
-        ray_tpu.put(a)
+    ray_tpu.init(address=addr)
+    if kind == "tasks":
+        @ray_tpu.remote
+        def tiny():
+            return None
 
-    per_thread = 3
-    def body(t):
-        for _ in range(per_thread):
-            ray_tpu.put(arrs[t])
-
-    ts = [threading.Thread(target=body, args=(t,)) for t in range(n_threads)]
-    t0 = time.perf_counter()
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join()
-    dt = time.perf_counter() - t0
+        ray_tpu.get([tiny.remote() for _ in range(100)], timeout=120)
+        print("READY", flush=True)
+        sys.stdin.readline()
+        t0 = time.perf_counter()
+        ray_tpu.get([tiny.remote() for _ in range(per)], timeout=300)
+        dt = time.perf_counter() - t0
+        count = per
+    elif kind == "put_calls":
+        small = np.zeros(16, np.uint8)
+        for _ in range(50):
+            ray_tpu.put(small)
+        print("READY", flush=True)
+        sys.stdin.readline()
+        t0 = time.perf_counter()
+        for _ in range(per):
+            ray_tpu.put(small)
+        dt = time.perf_counter() - t0
+        count = per
+    elif kind == "put_gb":
+        blob = np.frombuffer(np.random.bytes(128 * 1024 * 1024), np.uint8)
+        for _ in range(2):  # steady-state pages
+            ray_tpu.put(blob)
+        print("READY", flush=True)
+        sys.stdin.readline()
+        t0 = time.perf_counter()
+        for _ in range(per):
+            ray_tpu.put(blob)
+        dt = time.perf_counter() - t0
+        count = per * len(blob)  # bytes
+    else:
+        raise ValueError(kind)
+    print(json.dumps({"elapsed": dt, "count": count}), flush=True)
     ray_tpu.shutdown()
-    return n_threads * per_thread * len(blob) / dt / (1 << 30)
+
+
+def _multi_client_row(kind: str, n_clients: int, per: int) -> float:
+    """Aggregate ops/s (or bytes/s) over n separate driver processes all
+    hammering the already-running cluster; clients start measuring on a
+    shared GO so the window is truly concurrent."""
+    import subprocess
+    import tempfile
+
+    import ray_tpu
+
+    addr = ray_tpu.connection_info()["control_address"]
+    # stderr to files, not pipes: a chatty child would fill a pipe and
+    # wedge; files also survive for the failure diagnostic below
+    errs = [tempfile.TemporaryFile(mode="w+") for _ in range(n_clients)]
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--client-child",
+         kind, addr, str(per)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=errs[i],
+        text=True) for i in range(n_clients)]
+    try:
+        for i, p in enumerate(procs):
+            line = p.stdout.readline()
+            if "READY" not in line:
+                errs[i].seek(0)
+                raise RuntimeError(
+                    f"client failed to start: {line!r} "
+                    f"stderr: {errs[i].read()[-500:]}")
+        for p in procs:
+            p.stdin.write("go\n")
+            p.stdin.flush()
+        results = []
+        for p in procs:
+            results.append(json.loads(p.stdout.readline()))
+        total = sum(r["count"] for r in results)
+        window = max(r["elapsed"] for r in results)
+        return total / window
+    finally:
+        for p in procs:
+            try:
+                p.wait(timeout=60)
+            except Exception:
+                p.kill()
+        for f in errs:
+            f.close()
+
+
+def bench_put_bandwidth_multi(n_clients: int = 4) -> float:
+    """Aggregate GiB/s over separate driver processes putting 128MiB
+    objects concurrently (reference: multi_client_put_gigabytes)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=max(2, (os.cpu_count() or 2)),
+                 ignore_reinit_error=True)
+    try:
+        return _multi_client_row("put_gb", n_clients, per=3) / (1 << 30)
+    finally:
+        ray_tpu.shutdown()
 
 
 # peak dense bf16 FLOP/s per chip by device kind (public specs); used for
@@ -509,9 +585,10 @@ def bench_table() -> dict:
             [nn_async[(t + i) % 4].m.remote() for i in range(n)],
             timeout=300))
 
-    rows["multi_client_tasks_async"] = _concurrent(
-        4, 500, lambda t, n: ray_tpu.get(
-            [tiny.remote() for _ in range(n)], timeout=300))
+    # multi_client rows: separate DRIVER PROCESSES (like the reference's
+    # microbenchmark), not threads — threads share the GIL and measure
+    # the interpreter, not the cluster
+    rows["multi_client_tasks_async"] = _multi_client_row("tasks", 4, 500)
 
     nn_actors = [Actor.remote() for _ in range(4)]
     ray_tpu.get([x.m.remote() for x in nn_actors], timeout=60)
@@ -546,8 +623,7 @@ def bench_table() -> dict:
             ray_tpu.put(small)
     rows["single_client_put_calls"] = _timed(1000, puts)
 
-    rows["multi_client_put_calls"] = _concurrent(
-        4, 250, lambda t, n: [ray_tpu.put(small) for _ in range(n)])
+    rows["multi_client_put_calls"] = _multi_client_row("put_calls", 4, 250)
 
     # an object whose value is a list of 10k refs (reference:
     # single_client_get_object_containing_10k_refs, 12.6/s on 64 cores)
@@ -676,7 +752,11 @@ def main():
 
 
 if __name__ == "__main__":
-    if "--gpt-only" in sys.argv:
+    if "--client-child" in sys.argv:
+        i = sys.argv.index("--client-child")
+        _client_child_main(sys.argv[i + 1], sys.argv[i + 2],
+                           int(sys.argv[i + 3]))
+    elif "--gpt-only" in sys.argv:
         _gpt_only_main()
     elif "--extras-only" in sys.argv:
         _extras_main()

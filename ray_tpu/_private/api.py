@@ -119,7 +119,8 @@ class ActorMethod:
 class ActorHandle:
     def __init__(self, actor_id: str, class_name: str = "Actor",
                  is_owner: bool = False, owner_addr=None,
-                 _register_borrow: bool = False):
+                 _register_borrow: bool = False,
+                 _transit_nonce: Optional[str] = None):
         self._actor_id = actor_id
         self._class_name = class_name
         self._is_owner = is_owner
@@ -128,12 +129,13 @@ class ActorHandle:
         if _register_borrow and not is_owner:
             # deserialized handle: register as a borrower with the owner
             # so the actor outlives the owner's handles while we exist
-            # (reference: distributed actor-handle reference counting)
+            # (reference: distributed actor-handle reference counting);
+            # the nonce retires the specific transit hold this pickle took
             try:
                 core = current_core()
                 if core is not None and not core._shutdown:
                     self._borrow_registered = core.on_actor_handle_borrowed(
-                        actor_id, self._owner_addr)
+                        actor_id, self._owner_addr, nonce=_transit_nonce)
             except Exception:
                 pass
 
@@ -152,17 +154,20 @@ class ActorHandle:
 
     def __reduce__(self):
         # deserialized handles are borrowed: they don't own the lifetime
-        # but DO extend it (the serializing core takes a transit hold so
-        # the actor survives the pickling->registration gap)
+        # but DO extend it (the serializing core takes a per-pickle
+        # transit hold so the actor survives the pickling->registration
+        # gap; the nonce rides the pickle so the receiver retires exactly
+        # this hold)
+        nonce = None
         try:
             core = current_core()
             if core is not None and not core._shutdown:
-                core.on_actor_handle_serialized(self._actor_id,
-                                                self._owner_addr)
+                nonce = core.on_actor_handle_serialized(self._actor_id,
+                                                        self._owner_addr)
         except Exception:
             pass
         return (ActorHandle, (self._actor_id, self._class_name, False,
-                              self._owner_addr, True))
+                              self._owner_addr, True, nonce))
 
     def __del__(self):
         # the last owner handle going out of scope terminates the actor

@@ -30,6 +30,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 import cloudpickle
+import uuid
 
 from . import common, serialization
 from .common import (INLINE_OBJECT_LIMIT, STREAMING_RETURNS, ActorDiedError,
@@ -162,6 +163,10 @@ EXECUTING_TASK_ID: contextvars.ContextVar = contextvars.ContextVar(
     "ray_tpu_executing_task_id", default=None)
 EXECUTING_JOB_ID: contextvars.ContextVar = contextvars.ContextVar(
     "ray_tpu_executing_job_id", default=None)
+# set while serializing a task's ARGS: actor-handle transit holds taken
+# inside bind to this task and refresh while it is queued/running
+TRANSIT_TASK_ID: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_transit_task_id", default=None)
 
 
 class StreamState:
@@ -416,7 +421,8 @@ class CoreWorker:
         # release is pending — a crashed borrower never sends actor_del_ref.
         self._actor_borrowers: Dict[str, Dict[str, list]] = {}
         # one hold deadline per in-flight serialized copy of a handle
-        self._actor_transit: Dict[str, List[float]] = {}
+        # aid -> {nonce: [expiry, bound_task_id|None]} (per-pickle holds)
+        self._actor_transit: Dict[str, Dict[str, List]] = {}
         self._actor_pending_release: Set[str] = set()
         self._actor_probe_scheduled: Set[str] = set()
         self._borrowed_actors: Dict[str, list] = {}  # aid -> [count, owner]
@@ -540,6 +546,12 @@ class CoreWorker:
             try:
                 item = self._delete_queue.popleft()
             except IndexError:
+                continue
+            if item[0] > now:
+                # raced a concurrent drain: the popped item is a FRESH
+                # enqueue whose grace window has not elapsed — deleting
+                # it now would shave DELETE_GRACE_S off in-flight gets
+                self._delete_queue.append(item)
                 continue
             try:
                 self._maybe_delete(item[1])
@@ -1188,10 +1200,19 @@ class CoreWorker:
 
     _EMPTY_ARGS_BLOB = serialization.dumps_inline(((), {}))
 
-    def serialize_args(self, args, kwargs) -> bytes:
+    def serialize_args(self, args, kwargs,
+                       task_id: Optional[str] = None) -> bytes:
         if not args and not kwargs:
             return self._EMPTY_ARGS_BLOB  # no-arg calls skip pickling
-        return serialization.dumps_inline((args, kwargs))
+        if task_id is None:
+            return serialization.dumps_inline((args, kwargs))
+        # actor handles pickled inside these args take transit holds
+        # bound to this task: they refresh while the task is queued
+        token = TRANSIT_TASK_ID.set(task_id)
+        try:
+            return serialization.dumps_inline((args, kwargs))
+        finally:
+            TRANSIT_TASK_ID.reset(token)
 
     def submit_task(self, fn, args, kwargs, *, num_returns=1, resources=None,
                     max_retries=3, strategy=None, pg=None, bundle_index=-1,
@@ -1203,11 +1224,12 @@ class CoreWorker:
 
             runtime_env = rtenv.prepare(runtime_env, self.control)
         fid, fname = self.register_function(fn)
+        tid = common.task_id()
         spec = TaskSpec(
-            task_id=common.task_id(),
+            task_id=tid,
             function_id=fid,
             function_name=name or fname,
-            args_blob=self.serialize_args(args, kwargs),
+            args_blob=self.serialize_args(args, kwargs, task_id=tid),
             num_returns=num_returns,
             resources=normalize_resources(
                 {common.CPU: 1} if resources is None else resources),
@@ -1847,11 +1869,12 @@ class CoreWorker:
         with ac.lock:
             ac.seq += 1
             seq = ac.seq
+        tid = common.task_id()
         spec = TaskSpec(
-            task_id=common.task_id(),
+            task_id=tid,
             function_id="",
             function_name=method_name,
-            args_blob=self.serialize_args(args, kwargs),
+            args_blob=self.serialize_args(args, kwargs, task_id=tid),
             num_returns=num_returns,
             actor_id=actor_id,
             seq_no=seq,
@@ -2190,6 +2213,23 @@ class CoreWorker:
                 # actor child: force unsupported — plain cancel instead
                 self._cancel_task_id(tid, False, recursive=True)
 
+    def _task_is_live_locked(self, tid: str) -> bool:
+        """Caller holds self.lock.  True while `tid` is still tracked:
+        queued/running/retrying as a normal task, or buffered/in-flight
+        on an actor connection.  ac.buffer/inflight are mutated under
+        ac.lock (NOT self.lock) — taking ac.lock here would invert the
+        lock order, so snapshot with list()/`in` (atomic under the GIL)
+        instead of iterating the live deque."""
+        if tid in self.task_records:
+            return True
+        for ac in list(self.actors.values()):
+            if tid in ac.inflight:
+                return True
+            if any(getattr(s, "task_id", None) == tid
+                   for s in list(ac.buffer)):
+                return True
+        return False
+
     def kill_actor(self, actor_id: str, no_restart: bool = True):
         self._control_call("kill_actor", {"actor_id": actor_id,
                                          "no_restart": no_restart}, timeout=30.0)
@@ -2202,38 +2242,49 @@ class CoreWorker:
     # between pickling a handle and the receiver registering its borrow
     # (the window in which the old implementation killed the actor).
 
-    # Approximation bound: a pickled handle neither deserialized nor
-    # dropped within this window (e.g. queued in task args behind >60s of
-    # work) stops protecting the actor — acceptable because the owner
-    # handle usually outlives submission, and exact tracking would need
-    # per-copy acks.  Raise via subclassing if a deployment queues cold
-    # tasks for minutes.
+    # Baseline bound for holds not tied to a tracked task: a pickled
+    # handle neither deserialized nor dropped within this window stops
+    # protecting the actor.  Holds taken while serializing TASK ARGS are
+    # bound to that task and auto-refresh while it is still queued /
+    # running / retrying, so a call queued behind >60s of work keeps its
+    # protection (the exact-tracking role of the reference's borrow acks).
     ACTOR_TRANSIT_S = 60.0
 
-    def on_actor_handle_serialized(self, actor_id: str, owner_addr):
+    def on_actor_handle_serialized(self, actor_id: str,
+                                   owner_addr) -> Optional[str]:
+        """Take one per-pickle transit hold; returns its nonce (embedded
+        in the pickle so the borrower's add_ref retires exactly THIS
+        hold, never another in-flight copy's)."""
         if owner_addr is None:
             # a weak handle (get_actor lookup): extends nothing, matching
             # the reference — named lookups don't own or pin lifetime
-            return
+            return None
+        nonce = uuid.uuid4().hex[:16]
+        bound_task = TRANSIT_TASK_ID.get()
         if tuple(owner_addr) == self.addr:
             with self.lock:
-                self._actor_transit.setdefault(actor_id, []).append(
-                    time.monotonic() + self.ACTOR_TRANSIT_S)
-            return
+                self._actor_transit.setdefault(actor_id, {})[nonce] = \
+                    [time.monotonic() + self.ACTOR_TRANSIT_S, bound_task]
+            return nonce
         try:
+            # cross-core owner: no task binding (the owner cannot observe
+            # this core's task liveness) — fixed window, nonce-retired
             self._owner_client(tuple(owner_addr)).notify(
-                "actor_transit", {"actor_id": actor_id})
+                "actor_transit", {"actor_id": actor_id, "nonce": nonce})
         except Exception:
             pass
+        return nonce
 
-    def on_actor_handle_borrowed(self, actor_id: str, owner_addr) -> bool:
+    def on_actor_handle_borrowed(self, actor_id: str, owner_addr,
+                                 nonce: Optional[str] = None) -> bool:
         if owner_addr is None:
             return False
         owner_addr = tuple(owner_addr)
         if owner_addr == self.addr:
             # a handle round-tripped back to its owner: count it like any
             # other borrower (loopback entry, no RPC)
-            self._register_actor_borrow(actor_id, self.worker_id, self.addr)
+            self._register_actor_borrow(actor_id, self.worker_id, self.addr,
+                                        nonce=nonce)
             with self.lock:
                 self._borrowed_actors.setdefault(
                     actor_id, [0, owner_addr])[0] += 1
@@ -2241,15 +2292,16 @@ class CoreWorker:
         with self.lock:
             rec = self._borrowed_actors.setdefault(actor_id, [0, owner_addr])
             rec[0] += 1
-        # notify on EVERY deserialization, not just the first: the owner
-        # retires one per-pickle transit hold per add_ref, and a warm
-        # worker deserializing the same handle twice must retire both
-        # (the borrower set on the owner is idempotent)
+        # notify on EVERY deserialization, not just the first: the owner's
+        # borrower set is idempotent, and the carried nonce retires
+        # exactly this pickle's transit hold — a re-deserialized copy
+        # retires nothing extra, so other in-flight pickles keep theirs
         try:
             self._owner_client(owner_addr).notify(
                 "actor_add_ref", {"actor_id": actor_id,
                                   "borrower": self.worker_id,
-                                  "borrower_addr": self.addr})
+                                  "borrower_addr": self.addr,
+                                  "nonce": nonce})
         except Exception:
             pass
         return True
@@ -2276,18 +2328,19 @@ class CoreWorker:
         except Exception:
             pass
 
-    def _register_actor_borrow(self, aid: str, borrower: str, addr):
-        """Owner side: count one borrowed handle and retire one in-transit
-        hold (one hold per serialization, so other still-in-flight pickles
-        of the same handle keep their own protection)."""
+    def _register_actor_borrow(self, aid: str, borrower: str, addr,
+                               nonce: Optional[str] = None):
+        """Owner side: count one borrowed handle and retire THE pickle's
+        in-transit hold (matched by nonce — retiring the oldest would let
+        one twice-deserialized pickle strip another copy's protection)."""
         with self.lock:
             ent = self._actor_borrowers.setdefault(aid, {}) \
                 .setdefault(borrower, [0, addr])
             ent[0] += 1
             ent[1] = addr or ent[1]
             holds = self._actor_transit.get(aid)
-            if holds:
-                holds.pop(0)
+            if holds and nonce is not None:
+                holds.pop(nonce, None)
                 if not holds:
                     self._actor_transit.pop(aid, None)
 
@@ -2306,7 +2359,8 @@ class CoreWorker:
     def h_actor_add_ref(self, conn, p):
         self._register_actor_borrow(
             p["actor_id"], p["borrower"],
-            tuple(p.get("borrower_addr") or ()) or None)
+            tuple(p.get("borrower_addr") or ()) or None,
+            nonce=p.get("nonce"))
         return True
 
     def h_actor_del_ref(self, conn, p):
@@ -2317,8 +2371,9 @@ class CoreWorker:
 
     def h_actor_transit(self, conn, p):
         with self.lock:
-            self._actor_transit.setdefault(p["actor_id"], []).append(
-                time.monotonic() + self.ACTOR_TRANSIT_S)
+            self._actor_transit.setdefault(p["actor_id"], {})[
+                p.get("nonce") or uuid.uuid4().hex[:16]] = \
+                [time.monotonic() + self.ACTOR_TRANSIT_S, None]
         return True
 
     ACTOR_BORROW_PROBE_S = 20.0
@@ -2381,11 +2436,19 @@ class CoreWorker:
                     t.start()
                 return
             now = time.monotonic()
-            holds = [h for h in self._actor_transit.get(actor_id, [])
-                     if h > now]
+            holds = {}
+            for nonce, (exp, tid) in \
+                    self._actor_transit.get(actor_id, {}).items():
+                if tid is not None and self._task_is_live_locked(tid):
+                    # hold bound to a still-queued/running/retrying task:
+                    # its pickled handle is still in the args — refresh
+                    # (ADVICE r2: a call queued >60s must stay protected)
+                    exp = now + self.ACTOR_TRANSIT_S
+                if exp > now:
+                    holds[nonce] = [exp, tid]
             if holds:
                 self._actor_transit[actor_id] = holds
-                delay = min(holds) - now
+                delay = min(h[0] for h in holds.values()) - now
             else:
                 self._actor_pending_release.discard(actor_id)
                 self._actor_transit.pop(actor_id, None)

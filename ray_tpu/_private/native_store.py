@@ -86,6 +86,9 @@ def _load():
                             ctypes.c_uint64]
     lib.rt_stats.argtypes = [ctypes.c_void_p] + [
         ctypes.POINTER(ctypes.c_uint64)] * 4
+    lib.rt_memcpy.restype = None
+    lib.rt_memcpy.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                              ctypes.c_uint64]
     _lib = lib
     return lib
 
@@ -174,13 +177,13 @@ class NativeShmObjectStore:
             if self._wmap is not None and off + size <= len(self._wmap):
                 dst = memoryview(self._wmap)[off:off + size]
                 try:
-                    pack_into(dst, meta, buffers)
+                    self._pack_fast(dst, meta, buffers)
                 finally:
                     dst.release()
             else:
                 mm = mmap.mmap(self._fd, size, offset=off)
                 try:
-                    pack_into(memoryview(mm), meta, buffers)
+                    self._pack_fast(memoryview(mm), meta, buffers)
                 finally:
                     mm.close()
         except BaseException:
@@ -188,6 +191,51 @@ class NativeShmObjectStore:
             raise
         self._lib.rt_seal(self._arena, oid)
         return size
+
+    _GIL_FREE_COPY_MIN = 1 << 20  # below this, numpy/ctypes setup dominates
+
+    def _pack_fast(self, dst: memoryview, meta: bytes,
+                   buffers: Sequence[memoryview]) -> None:
+        """pack_into, but large payload copies go through the native
+        rt_memcpy — ctypes foreign calls release the GIL, so concurrent
+        putters' copies run in parallel instead of serializing on the
+        interpreter lock (a memoryview slice-assign holds the GIL for
+        the whole memcpy)."""
+        import struct
+
+        import numpy as np
+
+        from .shm_store import _MAGIC, _pad
+
+        lens = [len(b) for b in buffers]
+        off = 0
+        struct.pack_into("<IIQII", dst, off, _MAGIC, 1, len(meta),
+                         len(lens), 0)
+        off += 4 + 4 + 8 + 4 + 4
+        for l in lens:
+            struct.pack_into("<Q", dst, off, l)
+            off += 8
+        dst[off:off + len(meta)] = meta
+        off = _pad(off + len(meta))
+        dst_np = None
+        for b in buffers:
+            mv = b.cast("B") if isinstance(b, memoryview) else memoryview(b)
+            n = len(mv)
+            if n >= self._GIL_FREE_COPY_MIN:
+                try:
+                    src_np = np.frombuffer(mv, np.uint8)
+                    if dst_np is None:
+                        dst_np = np.frombuffer(dst, np.uint8)
+                    self._lib.rt_memcpy(
+                        ctypes.c_void_p(dst_np.ctypes.data + off),
+                        ctypes.c_void_p(src_np.ctypes.data),
+                        ctypes.c_uint64(n))
+                    off = _pad(off + n)
+                    continue
+                except (ValueError, BufferError):
+                    pass  # non-contiguous: plain slice-assign below
+            dst[off:off + n] = mv
+            off = _pad(off + n)
 
     def put_raw(self, object_id: str, data: bytes) -> int:
         # raw blobs are cache-like (no owner tracking them): evictable

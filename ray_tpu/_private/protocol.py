@@ -321,8 +321,20 @@ class Client:
         with self._out_cv:
             self._out_cv.notify_all()
         if threading.current_thread() is not self._writer:
-            # let queued frames flush before tearing the socket down
+            # let queued frames flush before tearing the socket down;
+            # one-way frames here can be resource releases (lease
+            # returns, object frees, actor_del_ref) whose silent loss
+            # leaks the resource on the peer — extend the drain while
+            # frames remain and say so if we give up on a stalled peer
             self._writer.join(timeout=5.0)
+            if self._writer.is_alive() and self._outq:
+                self._writer.join(timeout=10.0)
+                if self._writer.is_alive() and self._outq:
+                    logging.getLogger(__name__).warning(
+                        "client %s: dropping %d queued frame(s) at close "
+                        "(peer stalled) — peer-side resources they "
+                        "release may leak until reclaimed by liveness "
+                        "checks", self.name, len(self._outq))
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:

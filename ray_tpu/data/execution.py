@@ -1364,17 +1364,28 @@ class StreamingExecutor:
             # 1. submissions
             budget = (self.ctx.max_concurrent_tasks - len(self._inflight)
                       - len(self._streams))
-            # out_heap is NOT counted: its bundles are held back waiting
-            # for a straggler's smaller order — counting them would freeze
-            # submissions (including the straggler's) into a deadlock.
-            # Bundles are ref+metadata handles; block memory is bounded by
-            # the object store, not this buffer.
+            # out_heap is NOT counted in plain backpressure: its bundles
+            # are held back waiting for a straggler's smaller order —
+            # counting them would freeze submissions (including the
+            # straggler's) into a deadlock.  But unbounded staging pins
+            # every staged block in the object store, so past a cap only
+            # operators that can still produce an order <= the blocking
+            # one (the straggler's lineage) may submit.
             backpressured = (len(out_buffer)
                             >= self.ctx.max_buffered_output_bundles)
+            blocking_order = None
+            if ordered and len(out_heap) >= \
+                    4 * self.ctx.max_buffered_output_bundles \
+                    and out_heap._heap:
+                blocking_order = out_heap._heap[0][0]
             if budget > 0 and not backpressured and not self._limit_reached():
                 for op in self.ops:
                     if budget <= 0:
                         break
+                    if blocking_order is not None:
+                        m = op.out_min_pending()
+                        if m is None or m > blocking_order:
+                            continue
                     percap = self.ctx.max_tasks_per_operator
                     if percap is not None and op.active >= percap:
                         continue

@@ -631,6 +631,15 @@ uint64_t rt_list(Arena* a, char* buf, uint64_t buflen) {
   return count;
 }
 
+// Plain memcpy exposed for the Python write path: a ctypes foreign call
+// RELEASES the GIL, so concurrent putters' payload copies overlap on
+// separate cores — a memoryview slice-assign of the same bytes holds the
+// GIL for the whole copy and serializes every writer in the process
+// (the multi-client put-bandwidth collapse in the r2 bench table).
+void rt_memcpy(void* dst, const void* src, uint64_t n) {
+  memcpy(dst, src, n);
+}
+
 void rt_stats(Arena* a, uint64_t* capacity, uint64_t* used, uint64_t* nobj,
               uint64_t* nevict) {
   if (!a) return;

@@ -22,20 +22,47 @@ from typing import Any
 ASGI_META = "__asgi_meta__"
 
 
+# max seconds between ASGI events before the stream is declared wedged;
+# per-event (an active SSE stream with regular frames never trips it)
+IDLE_TIMEOUT_S = 300.0
+# bounded send queue: backpressure for apps producing faster than the
+# consumer drains (send() blocks the app until the wire catches up)
+QUEUE_DEPTH = 64
+
+
 def run_asgi(app, request):
     """Generator driving one ASGI request; yields meta then body chunks.
 
     The app runs on a private event loop in a side thread; `send` events
-    flow through a queue so a chunk yielded by the app is emitted here
-    (and on the wire) before the app finishes."""
-    events: "_queue.Queue" = _queue.Queue()
+    flow through a bounded queue so a chunk yielded by the app is emitted
+    here (and on the wire) before the app finishes.  ASGI semantics
+    honored: `receive` delivers the body once then blocks until
+    disconnect (so `request.is_disconnected()` loops work); abandoning
+    this generator (client gone) signals http.disconnect and unblocks a
+    full send queue, so the app thread exits instead of leaking."""
+    events: "_queue.Queue" = _queue.Queue(maxsize=QUEUE_DEPTH)
+    stop_evt = threading.Event()
     body = request._body or b""
+    body_sent = [False]
 
     async def receive():
-        return {"type": "http.request", "body": body, "more_body": False}
+        if not body_sent[0]:
+            body_sent[0] = True
+            return {"type": "http.request", "body": body,
+                    "more_body": False}
+        while not stop_evt.is_set():
+            await asyncio.sleep(0.1)
+        return {"type": "http.disconnect"}
 
     async def send(message):
-        events.put(message)
+        while True:
+            if stop_evt.is_set():
+                raise ConnectionError("client disconnected")
+            try:
+                events.put(message, timeout=0.25)
+                return
+            except _queue.Full:
+                continue
 
     scope = {
         "type": "http",
@@ -58,48 +85,55 @@ def run_asgi(app, request):
         loop = asyncio.new_event_loop()
         try:
             loop.run_until_complete(app(scope, receive, send))
-            events.put({"type": "__done__"})
+            events.put({"type": "__done__"}, timeout=IDLE_TIMEOUT_S)
         except BaseException as e:
-            events.put({"type": "__error__",
-                        "error": f"{type(e).__name__}: {e}"})
+            try:
+                events.put({"type": "__error__",
+                            "error": f"{type(e).__name__}: {e}"},
+                           timeout=1.0)
+            except _queue.Full:
+                pass
         finally:
             loop.close()
 
     t = threading.Thread(target=run, daemon=True, name="serve-asgi")
     t.start()
     started = False
-    # bounded waits: a wedged ASGI app (dead upstream before it ever
-    # sends) must release this stream's executor thread + ongoing count
-    # once the proxy has long given up (its client timeout is 300s)
-    deadline = time.monotonic() + 320.0
-    while True:
-        try:
-            ev = events.get(timeout=max(1.0, deadline - time.monotonic()))
-        except _queue.Empty:
-            raise TimeoutError("ASGI app produced no event within the "
-                               "request deadline") from None
-        typ = ev.get("type")
-        if typ == "http.response.start":
-            headers = [
-                (k.decode() if isinstance(k, bytes) else str(k),
-                 v.decode() if isinstance(v, bytes) else str(v))
-                for k, v in ev.get("headers", [])]
-            started = True
-            yield (ASGI_META, int(ev.get("status", 200)), headers)
-        elif typ == "http.response.body":
-            b = ev.get("body", b"")
-            if b:
-                yield bytes(b)
-            if not ev.get("more_body"):
+    try:
+        while True:
+            try:
+                ev = events.get(timeout=IDLE_TIMEOUT_S)
+            except _queue.Empty:
+                raise TimeoutError(
+                    "ASGI app produced no event within the idle "
+                    "timeout") from None
+            typ = ev.get("type")
+            if typ == "http.response.start":
+                headers = [
+                    (k.decode() if isinstance(k, bytes) else str(k),
+                     v.decode() if isinstance(v, bytes) else str(v))
+                    for k, v in ev.get("headers", [])]
+                started = True
+                yield (ASGI_META, int(ev.get("status", 200)), headers)
+            elif typ == "http.response.body":
+                b = ev.get("body", b"")
+                if b:
+                    yield bytes(b)
+                if not ev.get("more_body"):
+                    break
+            elif typ == "__done__":
                 break
-        elif typ == "__done__":
-            break
-        elif typ == "__error__":
-            if not started:
-                yield (ASGI_META, 500, [("content-type", "text/plain")])
-            yield f"ASGI app failed: {ev['error']}".encode()
-            break
-    t.join(timeout=10)
+            elif typ == "__error__":
+                if not started:
+                    yield (ASGI_META, 500,
+                           [("content-type", "text/plain")])
+                yield f"ASGI app failed: {ev['error']}".encode()
+                break
+    finally:
+        # normal end, error, OR abandoned generator (GeneratorExit when
+        # the client disconnects): tell the app, unblock its sends
+        stop_evt.set()
+        t.join(timeout=5)
 
 
 def ingress(asgi_app):

@@ -213,19 +213,40 @@ class HTTPProxy:
                                text=f"{type(e).__name__}: {e}")
         return self._to_http(out)
 
+    # long-lived streams pin a thread per in-flight item wait; a
+    # dedicated pool keeps ~32 SSE clients from starving the loop's
+    # default executor (which the non-streaming path also rides)
+    _stream_pool = None
+    _stream_pool_lock = threading.Lock()
+
+    @classmethod
+    def _stream_executor(cls):
+        with cls._stream_pool_lock:
+            if cls._stream_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                cls._stream_pool = ThreadPoolExecutor(
+                    max_workers=64, thread_name_prefix="proxy-stream")
+            return cls._stream_pool
+
     async def _handle_streaming(self, aio_req, req, target, router):
         """Chunked-transfer path for generator/ASGI ingress (reference:
         proxy.py:864 streaming plumbing): each item the deployment yields
         goes onto the wire as soon as its ref resolves — first-token
         latency is one item's production time, not the whole response's.
+
+        A plain-generator deployment may yield a serve.Response FIRST to
+        set status/headers (e.g. content_type="text/event-stream" for
+        EventSource clients); its body (if any) becomes the first chunk.
         """
         from aiohttp import web
 
         from ._asgi import ASGI_META
 
         loop = asyncio.get_event_loop()
+        pool = self._stream_executor()
         gen, done = await loop.run_in_executor(
-            None, lambda: router.assign_streaming(None, (req,), {}, {}))
+            pool, lambda: router.assign_streaming(None, (req,), {}, {}))
         it = iter(gen)
         sentinel = object()
 
@@ -238,7 +259,7 @@ class HTTPProxy:
 
         resp = None
         try:
-            first = await loop.run_in_executor(None, nxt)
+            first = await loop.run_in_executor(pool, nxt)
             pending = None
             if (target.get("asgi") and isinstance(first, tuple)
                     and first and first[0] == ASGI_META):
@@ -249,6 +270,15 @@ class HTTPProxy:
                     (k, v) for k, v in first[2]
                     if k.lower() != "content-length")  # chunked
                 resp = web.StreamResponse(status=first[1], headers=headers)
+            elif isinstance(first, Response):
+                from multidict import CIMultiDict
+
+                headers = CIMultiDict(first.headers)
+                headers["Content-Type"] = first.content_type
+                resp = web.StreamResponse(status=first.status,
+                                          headers=headers)
+                if first.body:
+                    pending = first.body
             else:
                 resp = web.StreamResponse(
                     status=200,
@@ -259,7 +289,7 @@ class HTTPProxy:
                 await resp.write(_chunk_bytes(pending))
             if first is not sentinel:
                 while True:
-                    item = await loop.run_in_executor(None, nxt)
+                    item = await loop.run_in_executor(pool, nxt)
                     if item is sentinel:
                         break
                     await resp.write(_chunk_bytes(item))

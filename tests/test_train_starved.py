@@ -1,0 +1,49 @@
+"""Train + streaming shards on a fully-booked cluster.
+
+Regression for the full-suite wedge: 2 train workers + 1 split
+coordinator + 1 unrelated CPU-holding actor book every CPU slot; the
+coordinator's inner dataset tasks then only run if the BLOCKED train
+workers lend their CPUs — which requires the session's user-loop thread
+to adopt the task context (session.py) so its gets notify the raylet
+(reference: blocked workers release CPUs, raylet dependency manager).
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+
+def _loop_data(config):
+    shard = train.get_dataset_shard("train")
+    total = rows = 0
+    for batch in shard.iter_batches(batch_size=8, batch_format="numpy"):
+        total += int(batch["id"].sum())
+        rows += len(batch["id"])
+    train.report({"rows": rows, "sum": total})
+
+
+def test_streaming_shards_on_fully_booked_cluster(ray_cluster, tmp_path):
+    from ray_tpu import data as rd
+
+    @ray_tpu.remote
+    class Squatter:  # books the 4th CPU for the whole test
+        def ping(self):
+            return "ok"
+
+    sq = Squatter.remote()
+    try:
+        assert ray_tpu.get(sq.ping.remote(), timeout=60) == "ok"
+
+        trainer = JaxTrainer(
+            _loop_data,
+            datasets={"train": rd.range(64, override_num_blocks=4)},
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(name="starved", storage_path=str(tmp_path)),
+        )
+        result = trainer.fit()
+        assert result.error is None
+        assert result.metrics["rows"] == 32
+    finally:
+        ray_tpu.kill(sq)
