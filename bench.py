@@ -148,12 +148,26 @@ def _multi_client_row(kind: str, n_clients: int, per: int) -> float:
             p.stdin.write("go\n")
             p.stdin.flush()
         results = []
-        for p in procs:
-            results.append(json.loads(p.stdout.readline()))
+        for i, p in enumerate(procs):
+            line = p.stdout.readline()
+            try:
+                results.append(json.loads(line))
+            except ValueError:
+                errs[i].seek(0)
+                raise RuntimeError(
+                    f"client died mid-run: stdout={line!r} "
+                    f"stderr: {errs[i].read()[-500:]}") from None
         total = sum(r["count"] for r in results)
         window = max(r["elapsed"] for r in results)
         return total / window
     finally:
+        for p in procs:
+            try:
+                # EOF on stdin unblocks children still parked on the GO
+                # read (failure paths), so wait() returns promptly
+                p.stdin.close()
+            except Exception:
+                pass
         for p in procs:
             try:
                 p.wait(timeout=60)

@@ -65,6 +65,20 @@ def current_core() -> "CoreWorker":
     return _current_core
 
 
+def adopt_task_context() -> None:
+    """Module-level form of CoreWorker.adopt_task_context for helper
+    threads spawned inside tasks (train session loops, data
+    prefetchers): no-op outside a worker, never raises — THE one place
+    library code should call so the blocked-CPU-lending contract stays
+    in sync everywhere."""
+    try:
+        core = _current_core
+        if core is not None and not core._shutdown:
+            core.adopt_task_context()
+    except Exception:
+        pass
+
+
 def raise_stored(err: BaseException) -> None:
     """Raise a stored (in-process-store) exception without mutating it.
 
@@ -1134,7 +1148,9 @@ class CoreWorker:
         """Mark THIS thread as part of the running task.  Helper threads a
         task spawns (e.g. data prefetchers) must call this, or their
         blocking get() never notifies the raylet and the worker's CPUs
-        are not lent out while it waits (the Train+streaming deadlock)."""
+        are not lent out while it waits (the Train+streaming deadlock).
+        Library code should prefer the module-level
+        `adopt_task_context()` (safe outside workers)."""
         self._executing.active = True
 
     def _mark_blocked(self, blocked: bool):

@@ -200,23 +200,13 @@ class NativeShmObjectStore:
         rt_memcpy — ctypes foreign calls release the GIL, so concurrent
         putters' copies run in parallel instead of serializing on the
         interpreter lock (a memoryview slice-assign holds the GIL for
-        the whole memcpy)."""
-        import struct
-
+        the whole memcpy).  The header layout is owned by
+        shm_store.pack_header_into (shared with pack_into)."""
         import numpy as np
 
-        from .shm_store import _MAGIC, _pad
+        from .shm_store import _pad, pack_header_into
 
-        lens = [len(b) for b in buffers]
-        off = 0
-        struct.pack_into("<IIQII", dst, off, _MAGIC, 1, len(meta),
-                         len(lens), 0)
-        off += 4 + 4 + 8 + 4 + 4
-        for l in lens:
-            struct.pack_into("<Q", dst, off, l)
-            off += 8
-        dst[off:off + len(meta)] = meta
-        off = _pad(off + len(meta))
+        off = pack_header_into(dst, meta, [len(b) for b in buffers])
         dst_np = None
         for b in buffers:
             mv = b.cast("B") if isinstance(b, memoryview) else memoryview(b)

@@ -40,8 +40,12 @@ def layout_size(meta_len: int, buf_lens: Sequence[int]) -> int:
     return total
 
 
-def pack_into(buf: memoryview, meta: bytes, buffers: Sequence[memoryview]) -> None:
-    lens = [len(b) for b in buffers]
+def pack_header_into(buf: memoryview, meta: bytes,
+                     lens: Sequence[int]) -> int:
+    """Write the object header + meta; returns the (padded) offset where
+    buffer 0 starts.  THE single owner of the on-disk layout's header —
+    every writer (mmap pack, native-arena fast path) goes through it so a
+    format change cannot silently fork."""
     off = 0
     struct.pack_into("<IIQII", buf, off, _MAGIC, 1, len(meta), len(lens), 0)
     off += 4 + 4 + 8 + 4 + 4
@@ -49,7 +53,11 @@ def pack_into(buf: memoryview, meta: bytes, buffers: Sequence[memoryview]) -> No
         struct.pack_into("<Q", buf, off, l)
         off += 8
     buf[off:off + len(meta)] = meta
-    off = _pad(off + len(meta))
+    return _pad(off + len(meta))
+
+
+def pack_into(buf: memoryview, meta: bytes, buffers: Sequence[memoryview]) -> None:
+    off = pack_header_into(buf, meta, [len(b) for b in buffers])
     for b in buffers:
         n = len(b)
         buf[off:off + n] = b.cast("B") if isinstance(b, memoryview) else memoryview(b)

@@ -112,21 +112,10 @@ def prefetch_iter(it: Iterator, depth: int) -> Iterator:
     # (e.g. a Train worker's loop); the prefetcher does that task's
     # blocking get()s, so it must count as the task for the raylet's
     # blocked-CPU lending or a fully-reserved node deadlocks
-    adopt = False
-    try:
-        from ray_tpu._private.core import current_core
-
-        core = current_core()
-        adopt = core is not None and core.in_task_context()
-    except Exception:
-        core = None
+    from ray_tpu._private.core import adopt_task_context
 
     def worker():
-        if adopt:
-            try:
-                core.adopt_task_context()
-            except Exception:
-                pass
+        adopt_task_context()
         try:
             for item in it:
                 q.put(item)

@@ -18,8 +18,14 @@ from .algorithms.sac import SAC, SACConfig
 from .core.learner import Learner, LearnerGroup
 from .core.rl_module import (DiscretePolicyModule, QModule, RLModule,
                              module_for_env)
+from .algorithms.multi_agent_ppo import MultiAgentPPO, MultiAgentPPOConfig
+from .core.multi_rl_module import MultiRLModule
 from .env.env_runner import EnvRunnerGroup, GymEnvRunner, JaxEnvRunner
 from .env.jax_env import CartPole, JaxEnv, make_env, register_env
+from .env.multi_agent_env import (CooperativeMatchEnv, MultiAgentEnv,
+                                  MultiAgentEnvRunner,
+                                  MultiAgentEnvRunnerGroup)
+from .env.multi_agent_env import register_env as register_multi_agent_env
 from .utils.replay_buffer import ReplayBuffer
 
 __all__ = [
@@ -31,4 +37,7 @@ __all__ = [
     "Learner", "LearnerGroup", "RLModule", "DiscretePolicyModule", "QModule",
     "module_for_env", "EnvRunnerGroup", "JaxEnvRunner", "GymEnvRunner",
     "JaxEnv", "CartPole", "make_env", "register_env", "ReplayBuffer",
+    "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentEnvRunnerGroup",
+    "MultiAgentPPO", "MultiAgentPPOConfig", "MultiRLModule",
+    "CooperativeMatchEnv", "register_multi_agent_env",
 ]

@@ -1,0 +1,48 @@
+"""MultiRLModule: one RLModule per policy id.
+
+Analog of the reference's MultiRLModule (reference:
+rllib/core/rl_module/multi_rl_module.py): a dict of policy_id ->
+RLModule whose params pytree is {policy_id: module_params} — so a
+multi-policy checkpoint is still a single pytree save, and each
+policy's forward passes stay independently jittable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+
+from .rl_module import DiscretePolicyModule
+
+
+class MultiRLModule:
+    def __init__(self, specs: Dict[str, Dict[str, int]],
+                 hidden: Sequence[int] = (64, 64)):
+        self.specs = dict(specs)
+        self.modules: Dict[str, DiscretePolicyModule] = {
+            pid: DiscretePolicyModule(s["obs_dim"], s["num_actions"],
+                                      hidden)
+            for pid, s in specs.items()}
+
+    def __getitem__(self, policy_id: str) -> DiscretePolicyModule:
+        return self.modules[policy_id]
+
+    def policy_ids(self):
+        return list(self.modules)
+
+    def init(self, rng) -> Dict[str, Any]:
+        keys = jax.random.split(rng, len(self.modules))
+        return {pid: m.init(k)
+                for (pid, m), k in zip(sorted(self.modules.items()), keys)}
+
+    def forward_exploration(self, policy_id: str, params, obs, rng):
+        return self.modules[policy_id].forward_exploration(
+            params[policy_id], obs, rng)
+
+    def forward_inference(self, policy_id: str, params, obs):
+        return self.modules[policy_id].forward_inference(
+            params[policy_id], obs)
+
+    def value(self, policy_id: str, params, obs):
+        return self.modules[policy_id].value(params[policy_id], obs)

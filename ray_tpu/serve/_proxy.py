@@ -273,7 +273,9 @@ class HTTPProxy:
             elif isinstance(first, Response):
                 from multidict import CIMultiDict
 
-                headers = CIMultiDict(first.headers)
+                headers = CIMultiDict(
+                    (k, v) for k, v in first.headers.items()
+                    if k.lower() != "content-length")  # chunked
                 headers["Content-Type"] = first.content_type
                 resp = web.StreamResponse(status=first.status,
                                           headers=headers)

@@ -117,19 +117,14 @@ class TrainSession:
         self._thread.start()
 
     def _run(self):
-        try:
-            # this helper thread IS the actor task's work: adopt its
-            # context so a blocking get() inside the user loop (dataset
-            # shards, collective rendezvous) lends the worker's CPUs —
-            # without this, 2 train workers blocked on a 1-CPU split
-            # coordinator deadlock a fully-booked cluster
-            from ray_tpu._private.core import current_core
+        # this helper thread IS the actor task's work: adopt its context
+        # so a blocking get() inside the user loop (dataset shards,
+        # collective rendezvous) lends the worker's CPUs — without this,
+        # 2 train workers blocked on a 1-CPU split coordinator deadlock
+        # a fully-booked cluster
+        from ray_tpu._private.core import adopt_task_context
 
-            core = current_core()
-            if core is not None:
-                core.adopt_task_context()
-        except Exception:
-            pass
+        adopt_task_context()
         try:
             out = self._train_fn()
             # the last checkpoint upload may still be in flight: the
