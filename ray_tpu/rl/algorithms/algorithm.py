@@ -105,6 +105,21 @@ class AlgorithmConfig:
         return rl_trainable
 
 
+def merge_batches(batches) -> Dict[str, Any]:
+    """Concat same-keyed [T, B, ...] batches along B ([B...] tails like
+    final_vf along axis 0) — the one batch-merge rule for runner results
+    in single- and multi-agent algorithms."""
+    import numpy as np
+
+    merged = {}
+    for k in batches[0]:
+        arrs = [b[k] for b in batches]
+        axis = 1 if arrs[0].ndim >= 2 else 0
+        merged[k] = np.concatenate(arrs, axis=axis) if len(arrs) > 1 \
+            else arrs[0]
+    return merged
+
+
 class Algorithm:
     """Base training loop; subclasses implement training_step()."""
 
@@ -178,13 +193,7 @@ class Algorithm:
         """Concat [T,B] batches along B; merge episode stats."""
         import numpy as np
 
-        batches = [r["batch"] for r in results]
-        merged = {}
-        for k in batches[0]:
-            arrs = [b[k] for b in batches]
-            axis = 1 if arrs[0].ndim >= 2 else 0
-            merged[k] = np.concatenate(arrs, axis=axis) if len(arrs) > 1 \
-                else arrs[0]
+        merged = merge_batches([r["batch"] for r in results])
         stats: Dict[str, Any] = {}
         rets = [r["stats"].get("episode_return_mean") for r in results
                 if r["stats"].get("episodes_this_iter", 0) > 0]

@@ -12,15 +12,14 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, List, Optional
 
-import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.rl.core.learner import LearnerGroup
 from ray_tpu.rl.core.rl_module import DiscretePolicyModule
 from ray_tpu.rl.env.multi_agent_env import MultiAgentEnvRunnerGroup
 
-from .algorithm import Algorithm, AlgorithmConfig
-from .ppo import PPOLearner, compute_gae
+from .algorithm import Algorithm, AlgorithmConfig, merge_batches
+from .ppo import PPOLearner, ppo_update_on_batch
 
 
 class MultiAgentPPOConfig(AlgorithmConfig):
@@ -105,38 +104,8 @@ class MultiAgentPPO(Algorithm):
 
         rng = np.random.default_rng(cfg.seed + self.iteration)
         for pid, group in self.learner_groups.items():
-            parts = [r["batches"][pid] for r in results]
-            batch = {k: (np.concatenate([p[k] for p in parts], axis=1)
-                         if parts[0][k].ndim >= 2 and k != "final_vf"
-                         else np.concatenate(
-                             [p[k] for p in parts], axis=0)
-                         if k == "final_vf" and len(parts) > 1
-                         else parts[0][k])
-                     for k in parts[0]}
-            adv, vtarg = compute_gae(
-                jnp.asarray(batch["reward"]),
-                jnp.asarray(batch["done"]),
-                jnp.asarray(batch["vf"]),
-                jnp.asarray(batch["final_vf"]),
-                cfg.gamma, cfg.gae_lambda)
-            adv = np.asarray(adv).reshape(-1)
-            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-            flat = {
-                "obs": np.asarray(batch["obs"]).reshape(
-                    -1, batch["obs"].shape[-1]),
-                "action": np.asarray(batch["action"]).reshape(-1),
-                "logp_old": np.asarray(batch["logp"]).reshape(-1),
-                "advantage": adv,
-                "value_target": np.asarray(vtarg).reshape(-1),
-            }
-            n = flat["obs"].shape[0]
-            metrics: Dict[str, float] = {}
-            for _ in range(cfg.num_epochs):
-                perm = rng.permutation(n)
-                for lo in range(0, n, cfg.minibatch_size):
-                    idx = perm[lo:lo + cfg.minibatch_size]
-                    metrics = group.update(
-                        {k: v[idx] for k, v in flat.items()})
+            batch = merge_batches([r["batches"][pid] for r in results])
+            metrics = ppo_update_on_batch(group, batch, cfg, rng)
             for k, v in metrics.items():
                 stats[f"{pid}/{k}"] = v
         self.runners.sync_weights(self._weights())

@@ -71,6 +71,34 @@ class PPOLearner(Learner):
                           .astype(jnp.float32))}
 
 
+def ppo_update_on_batch(learner_group, batch, cfg, rng) -> Dict[str, float]:
+    """GAE -> advantage normalization -> minibatched epoch loop: the PPO
+    update procedure shared by single- and multi-agent PPO (one owner —
+    a fix here reaches both paths)."""
+    adv, vtarg = compute_gae(
+        jnp.asarray(batch["reward"]), jnp.asarray(batch["done"]),
+        jnp.asarray(batch["vf"]), jnp.asarray(batch["final_vf"]),
+        cfg.gamma, cfg.gae_lambda)
+    adv = np.asarray(adv).reshape(-1)
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    flat = {
+        "obs": np.asarray(batch["obs"]).reshape(-1, batch["obs"].shape[-1]),
+        "action": np.asarray(batch["action"]).reshape(-1),
+        "logp_old": np.asarray(batch["logp"]).reshape(-1),
+        "advantage": adv,
+        "value_target": np.asarray(vtarg).reshape(-1),
+    }
+    n = flat["obs"].shape[0]
+    metrics: Dict[str, float] = {}
+    for _ in range(cfg.num_epochs):
+        perm = rng.permutation(n)
+        for lo in range(0, n, cfg.minibatch_size):
+            idx = perm[lo:lo + cfg.minibatch_size]
+            metrics = learner_group.update(
+                {k: v[idx] for k, v in flat.items()})
+    return metrics
+
+
 class PPOConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__()
@@ -105,33 +133,8 @@ class PPO(Algorithm):
         cfg: PPOConfig = self.config
         results = self.runners.sample(cfg.rollout_len)
         batch, stats = self._merge_runner_results(results)
-
-        # GAE over the time axis, then flatten [T, B] -> [T*B]
-        rewards = jnp.asarray(batch["reward"])
-        dones = jnp.asarray(batch["done"])
-        values = jnp.asarray(batch["vf"])
-        final_vf = jnp.asarray(batch["final_vf"])
-        adv, vtarg = compute_gae(rewards, dones, values, final_vf,
-                                 cfg.gamma, cfg.gae_lambda)
-        adv = np.asarray(adv).reshape(-1)
-        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-        flat = {
-            "obs": np.asarray(batch["obs"]).reshape(
-                -1, batch["obs"].shape[-1]),
-            "action": np.asarray(batch["action"]).reshape(-1),
-            "logp_old": np.asarray(batch["logp"]).reshape(-1),
-            "advantage": adv,
-            "value_target": np.asarray(vtarg).reshape(-1),
-        }
-        n = flat["obs"].shape[0]
         rng = np.random.default_rng(cfg.seed + self.iteration)
-        metrics: Dict[str, float] = {}
-        for _ in range(cfg.num_epochs):
-            perm = rng.permutation(n)
-            for lo in range(0, n, cfg.minibatch_size):
-                idx = perm[lo:lo + cfg.minibatch_size]
-                metrics = self.learner_group.update(
-                    {k: v[idx] for k, v in flat.items()})
+        metrics = ppo_update_on_batch(self.learner_group, batch, cfg, rng)
         self.runners.sync_weights(self.learner_group.get_weights())
         return {**stats, **metrics}
 

@@ -17,14 +17,18 @@ from .config import (CheckpointConfig, FailureConfig, RunConfig,
 from .result import Result
 from .session import (TrainContext, get_checkpoint, get_context,
                       get_dataset_shard, report)
+from .gbdt import (GBDTTrainer, LightGBMTrainer, SklearnGBDTTrainer,
+                   XGBoostTrainer)
 from .trainer import DataParallelTrainer, JaxTrainer
 from .worker_group import WorkerGroup
 
 __all__ = [
     "Backend", "BackendConfig", "BackendExecutor", "Checkpoint",
     "CheckpointConfig", "CheckpointManager", "DataParallelTrainer",
-    "FailureConfig", "JaxConfig", "JaxTrainer", "Result", "RunConfig",
-    "ScalingConfig", "TensorflowConfig", "TorchConfig", "TPUConfig",
+    "FailureConfig", "GBDTTrainer", "JaxConfig", "JaxTrainer",
+    "LightGBMTrainer", "Result", "RunConfig",
+    "ScalingConfig", "SklearnGBDTTrainer", "TensorflowConfig",
+    "TorchConfig", "TPUConfig", "XGBoostTrainer",
     "TrainContext",
     "TrainingFailedError",
     "TrainingWorkerError", "WorkerGroup", "get_checkpoint", "get_context",
