@@ -769,6 +769,11 @@ class ControlServer:
                     # worker already adopted this actor: reap the spare we
                     # just started (addressed by worker_addr so a same-node
                     # adopted worker is never the one killed)
+                    logger.info(
+                        "reaping spare worker of actor %s (%s)",
+                        rec.actor_id[:12],
+                        "killed during placement" if killed
+                        else "adopted elsewhere")
                     self._kill_actor_worker(
                         node.node_id, rec.actor_id,
                         worker_addr=tuple(r["worker_addr"]))

@@ -409,7 +409,8 @@ class CoreWorker:
         self.raylet: Optional[Client] = None
         self.raylet_addr = None
         if raylet_addr is not None:
-            self.raylet = Client(raylet_addr, name=f"{mode}->raylet")
+            self.raylet = Client(raylet_addr, name=f"{mode}->raylet",
+                                 on_push=self._on_raylet_push)
             self.raylet_addr = tuple(raylet_addr)
 
         # local shm store access (same node as raylet)
@@ -1727,7 +1728,33 @@ class CoreWorker:
         # tasks whose replies will never come are retried by their pending
         # futures erroring out (ConnectionLost) via _on_task_failure
 
-    def _maybe_return_idle_leases(self, pool: SchedPool):
+    def _on_raylet_push(self, topic, payload):
+        """Raylet -> core notifications (worker_proc forwards unhandled
+        worker-level pushes here)."""
+        if topic == "reclaim_idle_leases":
+            # off the push thread: returning leases does RPCs
+            self.pool_executor.submit(self.flush_idle_leases)
+
+    def flush_idle_leases(self) -> None:
+        """Return EVERY currently-idle lease now (on-demand reclaim: the
+        raylet pushes this when other clients' lease requests are starved
+        — the reference's ReleaseUnusedWorkers role).  Without it, idle
+        leases sit for IDLE_LEASE_TTL_S while a queued request waits:
+        each new scheduling key (new remote function) builds its own
+        lease pool, so a sequence of one-shot workloads once degraded to
+        one 30s reap-quantum per round."""
+        with self.lock:
+            pools = list(self.pools.values())
+        for pool in pools:
+            # 1s threshold: a just-idled lease may be mid-assignment in
+            # the submit pipeline; anything idle a full second is truly
+            # surplus (vs the 30s TTL reaper)
+            self._maybe_return_idle_leases(pool, ttl_s=1.0,
+                                           allow_cancel=False)
+
+    def _maybe_return_idle_leases(self, pool: SchedPool,
+                                  ttl_s: float = IDLE_LEASE_TTL_S,
+                                  allow_cancel: bool = True):
         now = time.monotonic()
         to_return = []
         cancel = False
@@ -1735,9 +1762,15 @@ class CoreWorker:
             if pool.queue:
                 return
             if pool.pending_requests > 0:
+                # the pool still wants workers: in the on-demand flush
+                # (allow_cancel=False) leave it entirely alone — its
+                # in-flight requests are someone's live work, and
+                # canceling them here starves the flushing client itself
+                if not allow_cancel:
+                    return
                 cancel = True
             for wid, lw in list(pool.leases.items()):
-                if not lw.inflight and now - lw.idle_since > IDLE_LEASE_TTL_S:
+                if not lw.inflight and now - lw.idle_since > ttl_s:
                     pool.leases.pop(wid)
                     to_return.append(lw)
         if cancel and self.raylet is not None:

@@ -89,6 +89,9 @@ class Raylet:
         self.workers_by_token: Dict[int, WorkerRecord] = {}
         self.idle: Deque[WorkerRecord] = deque()
         self.pending_leases: Deque[PendingLease] = deque()
+        # lessee core conns, for on-demand idle-lease reclaim pushes
+        self.client_conns: Dict[str, Any] = {}
+        self._last_reclaim_push = 0.0
         self.bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}  # (pg,idx)->{resources,state}
         self._next_token = 0
         self._stop = threading.Event()
@@ -388,6 +391,12 @@ class Raylet:
         return True
 
     def h_disconnect(self, conn: ServerConn):
+        # drop reclaim-push registrations bound to this conn (drivers
+        # and worker cores alike), or dead ServerConns accumulate
+        with self.lock:
+            for cid, c in list(self.client_conns.items()):
+                if c is conn:
+                    self.client_conns.pop(cid, None)
         wid = conn.meta.get("worker_id")
         if not wid:
             return
@@ -509,9 +518,12 @@ class Raylet:
                     if b is None or b["state"] != "committed":
                         d.reject(f"bundle {bundle} not committed on this node")
                         return
+        cid = p.get("client_id", "")
         with self.lock:
+            if cid:
+                self.client_conns[cid] = conn
             self.pending_leases.append(
-                PendingLease(demand, d, p.get("client_id", ""), bundle,
+                PendingLease(demand, d, cid, bundle,
                              retriable=p.get("retriable", True)))
         self._try_grant()
 
@@ -581,10 +593,12 @@ class Raylet:
         grants: List[Tuple[PendingLease, WorkerRecord]] = []
         spawn = 0
         spawn_tpu = False
+        starved = False
         with self.lock:
             while self.pending_leases:
                 pl = self.pending_leases[0]
                 if not self._lease_fits(pl):
+                    starved = True
                     break
                 wants_tpu = any(k.startswith(common.TPU)
                                 for k in pl.demand)
@@ -638,6 +652,29 @@ class Raylet:
                 "ok": True, "lease_id": w.lease_id, "worker_id": w.worker_id,
                 "worker_addr": w.addr, "node_id": self.node_id,
             })
+        if starved:
+            self._request_idle_reclaim()
+
+    def _request_idle_reclaim(self):
+        """A queued lease can't be served: ask every known lessee core to
+        return its IDLE leases now instead of at the TTL reaper
+        (reference: raylet ReleaseUnusedWorkers).  Without this, each
+        new scheduling key's pool hoards leases and serialized one-shot
+        workloads degrade to one reap-quantum per step."""
+        now = time.monotonic()
+        with self.lock:
+            if now - self._last_reclaim_push < 0.5:
+                return
+            self._last_reclaim_push = now
+            conns = list(self.client_conns.items())
+        for cid, conn in conns:
+            try:
+                if not conn.push("reclaim_idle_leases", {}):
+                    raise OSError("push failed")
+            except Exception:
+                with self.lock:
+                    if self.client_conns.get(cid) is conn:
+                        self.client_conns.pop(cid, None)
 
     def _free_lease_resources(self, rec: WorkerRecord):
         """Return a worker's held resources to the right pool (general

@@ -224,6 +224,9 @@ class WorkerMain:
             self.actor_id = payload["actor_id"]
             self.incarnation = payload.get("incarnation", 0)
             threading.Thread(target=self._init_actor, daemon=True).start()
+        else:
+            # core-level pushes (reclaim_idle_leases etc.)
+            self.core._on_raylet_push(topic, payload)
 
     def _exit_soon(self):
         self._stop.set()
@@ -625,6 +628,22 @@ def main():
     ap.add_argument("--raylet", required=True)
     ap.add_argument("--control", required=True)
     args = ap.parse_args()
+    # `kill -USR1 <worker pid>` dumps all thread stacks to a per-pid file
+    # — the py-spy-dump analog for diagnosing wedged workers (reference:
+    # dashboard ReporterAgent stack dumps).  The file is created lazily
+    # on the first signal so worker churn doesn't litter /tmp.
+    try:
+        import faulthandler
+        import signal
+
+        def _dump_stacks(signum, frame):
+            with open(f"/tmp/ray_tpu_worker_stacks_{os.getpid()}.txt",
+                      "w") as f:
+                faulthandler.dump_traceback(file=f)
+
+        signal.signal(signal.SIGUSR1, _dump_stacks)
+    except (AttributeError, OSError, ValueError):
+        pass
     logging.basicConfig(
         level=logging.INFO,
         format=f"%(asctime)s worker[{os.getpid()}] %(levelname)s %(message)s")

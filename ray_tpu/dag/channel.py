@@ -9,13 +9,26 @@ Value envelope (first byte):
   0x02  spilled payload: pickled ObjectRef follows (value was larger than
         the slot; it went through the object store instead)
   0x03  error: pickled exception follows (propagates through the DAG)
+  0x04  device tensor: tiny (shape, dtype) header + RAW buffer bytes —
+        the jax.Array fast path (see below)
   0x00  stop sentinel (teardown)
+
+Device tensors (the NCCL-channel role, reference:
+experimental/channel/torch_tensor_nccl_channel.py): a TPU stage actor
+owns its own slice and jax runtime, so a cross-ACTOR edge necessarily
+stages through host memory — the TPU in-slice analog of an NCCL device
+channel is the *compiled* ppermute pipeline (parallel/pipeline.py), not a
+runtime channel.  What the channel CAN eliminate is the serialization
+tax: a jax.Array payload moves as one device->shm copy on the writer and
+one shm->device copy on the reader (raw dtype bytes, no pickle of the
+array data on either side).
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
+import struct
 from typing import Any, Optional, Tuple
 
 import cloudpickle
@@ -26,6 +39,7 @@ TAG_STOP = 0
 TAG_INLINE = 1
 TAG_SPILLED = 2
 TAG_ERROR = 3
+TAG_DEVICE = 4
 
 DEFAULT_SLOT_BYTES = 1 << 20
 DEFAULT_NSLOTS = 4
@@ -97,7 +111,51 @@ class Channel:
 
     # -- write -------------------------------------------------------------
 
+    @staticmethod
+    def _device_path_enabled(jax) -> bool:
+        """Raw-bytes tensor transport pays off when host staging replaces
+        a full pickle of device memory (TPU/GPU); on the cpu backend jnp
+        arrays already ARE host memory and the extra device_put dispatch
+        makes it a net loss — so default on only for real accelerators.
+        RAY_TPU_DAG_DEVICE_CHANNEL=1/0 forces either way (tests)."""
+        env = os.environ.get("RAY_TPU_DAG_DEVICE_CHANNEL")
+        if env is not None:
+            return env.strip().lower() not in ("0", "false", "no", "off",
+                                               "")
+        try:
+            return jax.default_backend() != "cpu"
+        except Exception:
+            return False
+
+    @classmethod
+    def _as_device_array(cls, value):
+        """The jax.Array fast-path guard (no jax import when unused)."""
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None or not isinstance(value, jax.Array) \
+                or value.is_deleted():
+            return None
+        # extended dtypes (PRNG keys) have no raw-bytes form: pickle path
+        if jax.dtypes.issubdtype(value.dtype, jax.dtypes.extended):
+            return None
+        if not cls._device_path_enabled(jax):
+            return None
+        return value
+
     def write(self, value: Any, timeout_s: Optional[float] = None):
+        arr = self._as_device_array(value)
+        if arr is not None:
+            import numpy as np
+
+            meta = cloudpickle.dumps((tuple(arr.shape), str(arr.dtype)))
+            header = struct.pack("<I", len(meta))
+            if 1 + len(header) + len(meta) + arr.nbytes <= self._slot:
+                # device -> shm in ONE copy (np.asarray is the host
+                # staging; zero-copy on the cpu backend, one DMA on TPU)
+                self._write_device(header + meta, np.asarray(arr),
+                                   timeout_s)
+                return
         payload = serialization.dumps_inline(value)
         if 1 + len(payload) > self._slot:
             import ray_tpu
@@ -110,6 +168,28 @@ class Channel:
         else:
             tag = TAG_INLINE
         self._write_raw(tag, payload, timeout_s)
+
+    def _write_device(self, head: bytes, host_view,
+                      timeout_s: Optional[float]):
+        import numpy as np
+
+        t_us = -1 if timeout_s is None else int(timeout_s * 1e6)
+        off = self._lib.rt_chan_write_acquire(self._chan, t_us)
+        if off == -3:
+            raise ChannelClosed(self.path)
+        if off == -2:
+            raise ChannelTimeout(self.path)
+        mm = self._map()
+        mm[off] = TAG_DEVICE
+        pos = off + 1
+        mm[pos:pos + len(head)] = head
+        pos += len(head)
+        # raw dtype bytes straight into the ring slot — no pickle copy
+        dst = np.frombuffer(memoryview(mm)[pos:pos + host_view.nbytes],
+                            np.uint8)
+        dst[:] = host_view.reshape(-1).view(np.uint8)
+        self._lib.rt_chan_write_release(
+            self._chan, 1 + len(head) + host_view.nbytes)
 
     def write_error(self, exc: BaseException,
                     timeout_s: Optional[float] = None):
@@ -158,6 +238,31 @@ class Channel:
         mm = self._map()
         try:
             tag = mm[off]
+            if tag == TAG_DEVICE:
+                # device tensors transfer straight off the ring slot:
+                # ONE shm -> device copy, synchronized before the slot is
+                # released for reuse.  The tag is surfaced so tests can
+                # observe the fast path; consumers treat any
+                # non-STOP/ERROR tag as a value
+                import jax
+                import numpy as np
+
+                view = memoryview(mm)[off + 1:off + nbytes.value]
+                try:
+                    (meta_len,) = struct.unpack_from("<I", view, 0)
+                    shape, dtype = cloudpickle.loads(
+                        bytes(view[4:4 + meta_len]))
+                    # stage into an OWNED host buffer (one copy), then
+                    # device_put: on cpu device_put may alias its input,
+                    # and an alias of ring-slot memory would be
+                    # overwritten by the next writer
+                    host = np.empty(len(view) - 4 - meta_len, np.uint8)
+                    host[:] = np.frombuffer(view, np.uint8,
+                                            offset=4 + meta_len)
+                    arr = jax.device_put(host.view(dtype).reshape(shape))
+                finally:
+                    view.release()
+                return TAG_DEVICE, arr
             payload = bytes(mm[off + 1:off + nbytes.value])
         finally:
             self._lib.rt_chan_read_release(self._chan)

@@ -118,15 +118,20 @@ def _gbdt_loop(config):
     likewise drives the library from inside the worker group)."""
     from ray_tpu import train as train_api
 
+    import shutil
+
     ctx = train_api.get_context()
     framework = config["framework"]
     if ctx.get_world_rank() != 0:
         # report WITH an (empty) checkpoint dir: the all-ranks
         # completion markers make the checkpoint restorable
         # (_find_latest_checkpoint requires every rank's marker)
-        train_api.report({"rank": ctx.get_world_rank()},
-                         checkpoint=Checkpoint(
-                             tempfile.mkdtemp(prefix="gbdt-empty-")))
+        d = tempfile.mkdtemp(prefix="gbdt-empty-")
+        try:
+            train_api.report({"rank": ctx.get_world_rank()},
+                             checkpoint=Checkpoint(d))
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
         return
     train_fn = _FRAMEWORKS[framework]
     ds = config["dataset"]
@@ -135,18 +140,24 @@ def _gbdt_loop(config):
     X, y = _to_xy(ds, config["label_column"])
     ckpt_dir = tempfile.mkdtemp(prefix="gbdt-")
     try:
-        metrics = train_fn(X, y, config["params"],
-                           config["num_boost_round"],
-                           os.path.join(ckpt_dir, _MODEL_FILE))
-    except ImportError as e:
-        raise ImportError(
-            f"{framework} is not installed in this environment; install "
-            f"it or use SklearnGBDTTrainer") from e
-    with open(os.path.join(ckpt_dir, _META_FILE), "w") as f:
-        json.dump({"framework": framework,
-                   "label_column": config["label_column"]}, f)
-    train_api.report({**metrics, "framework": framework},
-                     checkpoint=Checkpoint(ckpt_dir))
+        try:
+            metrics = train_fn(X, y, config["params"],
+                               config["num_boost_round"],
+                               os.path.join(ckpt_dir, _MODEL_FILE))
+        except ImportError as e:
+            raise ImportError(
+                f"{framework} is not installed in this environment; "
+                f"install it or use SklearnGBDTTrainer") from e
+        with open(os.path.join(ckpt_dir, _META_FILE), "w") as f:
+            json.dump({"framework": framework,
+                       "label_column": config["label_column"]}, f)
+        # report persists the checkpoint (local copy, or a pre-upload
+        # snapshot for remote storage) before returning: the source dir
+        # is free to go
+        train_api.report({**metrics, "framework": framework},
+                         checkpoint=Checkpoint(ckpt_dir))
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
 
 
 class GBDTTrainer:
@@ -167,6 +178,15 @@ class GBDTTrainer:
         ds = datasets["train"]
         # plain in-memory data rides the config; Datasets shard normally
         inline = None if hasattr(ds, "streaming_split") else ds
+        n_workers = (scaling_config or ScalingConfig()).num_workers
+        if inline is None and n_workers > 1:
+            # streaming_split would hand rank 0 only 1/N of the rows and
+            # silently train on that; distributed boosting (rabit-style)
+            # is not implemented — fail loudly instead
+            raise ValueError(
+                "GBDT training consumes the dataset on one worker; use "
+                "num_workers=1 with a ray_tpu.data Dataset (in-memory "
+                "frames may use more workers — extras idle)")
         self._trainer = JaxTrainer(
             _gbdt_loop,
             train_loop_config={

@@ -1,0 +1,155 @@
+"""Device-tensor DAG channels (the NCCL-channel role, reference:
+experimental/channel/torch_tensor_nccl_channel.py): jax.Array payloads
+ride the ring as raw buffer bytes — no pickling of array data."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu
+from ray_tpu.dag.channel import (TAG_DEVICE, TAG_INLINE, Channel,
+                                 DEFAULT_NSLOTS)
+
+
+@pytest.fixture(autouse=True)
+def _force_device_path(monkeypatch):
+    # the raw-bytes path defaults on only for real accelerators; force it
+    # so the cpu-backend CI exercises it
+    monkeypatch.setenv("RAY_TPU_DAG_DEVICE_CHANNEL", "1")
+
+
+@pytest.fixture
+def chan(tmp_path):
+    c = Channel(str(tmp_path / "chan"), slot_bytes=4 << 20, nslots=4)
+    yield c
+    c.close()
+    c.release()
+
+
+def test_cpu_backend_defaults_to_pickle_path(tmp_path, monkeypatch):
+    """Policy: without the override, cpu-backend jnp arrays take the
+    pickle path (device_put dispatch is pure overhead there)."""
+    monkeypatch.delenv("RAY_TPU_DAG_DEVICE_CHANNEL", raising=False)
+    c = Channel(str(tmp_path / "plain"), slot_bytes=4 << 20, nslots=2)
+    try:
+        c.write(jnp.ones((8, 8)))
+        tag, v = c.read(timeout_s=10)
+        assert tag == TAG_INLINE
+        assert v.shape == (8, 8)
+    finally:
+        c.close()
+        c.release()
+
+
+def test_device_tensor_roundtrip(chan):
+    x = jnp.arange(1024, dtype=jnp.float32).reshape(32, 32) * 0.5
+    chan.write(x)
+    tag, y = chan.read(timeout_s=10)
+    assert tag == TAG_DEVICE            # the raw-bytes fast path ran
+    assert isinstance(y, jax.Array)
+    assert y.dtype == jnp.float32 and y.shape == (32, 32)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_device_tensor_bf16(chan):
+    x = jnp.ones((16, 16), jnp.bfloat16) * 3
+    chan.write(x)
+    tag, y = chan.read(timeout_s=10)
+    assert tag == TAG_DEVICE
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(y, np.float32),
+                                  np.full((16, 16), 3.0, np.float32))
+
+
+def test_non_array_values_unchanged(chan):
+    chan.write({"a": 1})
+    tag, v = chan.read(timeout_s=10)
+    assert tag == TAG_INLINE and v == {"a": 1}
+
+
+def test_oversize_array_spills(ray_cluster, tmp_path):
+    c = Channel(str(tmp_path / "small"), slot_bytes=1 << 16, nslots=2)
+    try:
+        big = jnp.zeros((256, 256), jnp.float32)  # 256 KiB > 64 KiB slot
+        c.write(big)
+        tag, y = c.read(timeout_s=30)
+        assert y.shape == (256, 256)
+        assert float(jnp.sum(y)) == 0.0
+    finally:
+        c.close()
+        c.release()
+
+
+def test_device_path_comparable_on_large_tensors(chan, tmp_path):
+    """Microbench guard: the raw-bytes path stays within 8x of the
+    pickle path on the CPU BACKEND (where jax.device_put dispatch over
+    the 8-virtual-device mesh is pure overhead: cpu jnp arrays already
+    live in host memory).  The path's real win — skipping array pickling
+    and returning a live jax.Array with dtype (bf16) preserved — shows
+    on the TPU backend; this bound only catches pathological
+    regressions."""
+    x = jnp.ones((512, 512), jnp.float32)  # 1 MiB activation
+    host = np.asarray(x)
+
+    def roundtrip_device(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            chan.write(x)
+            chan.read(timeout_s=10)
+        return time.perf_counter() - t0
+
+    pick = Channel(str(tmp_path / "pickled"), slot_bytes=4 << 20, nslots=4)
+    try:
+        def roundtrip_pickle(n):
+            # numpy host arrays take the pickle path (dumps_inline)
+            t0 = time.perf_counter()
+            for _ in range(n):
+                pick.write(host)
+                pick.read(timeout_s=10)
+            return time.perf_counter() - t0
+
+        roundtrip_device(3)  # warm both paths
+        pick.write(host)
+        tag, _ = pick.read(timeout_s=10)
+        assert tag == TAG_INLINE
+        # best-of-3: a shared 2-cpu box's scheduler noise dwarfs a single
+        # measurement.  The 8-virtual-device cpu mesh makes device_put
+        # expensive, hence the slack bound; on TPU the saved pickle wins
+        t_dev = min(roundtrip_device(10) for _ in range(3))
+        t_pkl = min(roundtrip_pickle(10) for _ in range(3))
+        assert t_dev < t_pkl * 8.0, (t_dev, t_pkl)
+    finally:
+        pick.close()
+        pick.release()
+
+
+def test_pp_over_dag_with_device_activations(ray_cluster):
+    """2-stage MPMD pipeline over compiled-dag channels with jax.Array
+    activations on every edge (the VERDICT's PP-over-dag microbench)."""
+    from ray_tpu.dag import InputNode, MultiOutputNode
+
+    @ray_tpu.remote
+    class Stage:
+        def __init__(self, scale):
+            self.w = jnp.float32(scale)
+
+        def fwd(self, x):
+            return (jnp.asarray(x, jnp.float32) * self.w)
+
+    s1, s2 = Stage.remote(2.0), Stage.remote(10.0)
+    with InputNode() as inp:
+        out = s2.fwd.bind(s1.fwd.bind(inp))
+    dag = MultiOutputNode([out]).experimental_compile(
+        buffer_size_bytes=4 << 20)
+    try:
+        for i in range(4):
+            ref = dag.execute(jnp.full((64, 64), float(i + 1)))
+            (y,) = ref.get(timeout=120)
+            assert float(np.asarray(y)[0, 0]) == 20.0 * (i + 1)
+    finally:
+        dag.teardown()
