@@ -635,8 +635,34 @@ class CoreWorker:
             pools = list(self.pools.values())
             actors = list(self.actors.values())
             owners = list(self.owner_clients.values())
+        # withdraw our queued lease requests: granting one to a departing
+        # client books resources nobody will use (conn-drop purging on
+        # the raylet is the backstop for crashes)
+        try:
+            if self.raylet is not None:
+                self.raylet.notify("cancel_lease_requests",
+                                   {"client_id": self.worker_id})
+        except Exception:
+            pass
         for pool in pools:
             for lw in list(pool.leases.values()):
+                # return IDLE leases explicitly, addressed to the raylet
+                # that granted them: a departing driver's conn teardown
+                # also reclaims (h_disconnect), but the polite return
+                # frees resources without waiting for the socket.  An
+                # INFLIGHT lease is not returned — recycling a worker
+                # mid-task would queue the next lessee behind abandoned
+                # work; conn-drop reclaim kills those instead.
+                try:
+                    if not lw.inflight:
+                        cli = Client(tuple(lw.raylet_addr),
+                                     name="core-return",
+                                     connect_timeout=1.0)
+                        cli.notify("return_lease",
+                                   {"worker_id": lw.worker_id})
+                        cli.close()
+                except Exception:
+                    pass
                 try:
                     lw.client.close()
                 except Exception:
@@ -1417,7 +1443,9 @@ class CoreWorker:
             raylet_cli = self.raylet
             if picked is not None and tuple(picked["addr"]) != self.raylet_addr:
                 raylet_addr = tuple(picked["addr"])
-                raylet_cli = Client(raylet_addr, name="core->remote-raylet")
+                # on_push: remote raylets send reclaim_idle_leases too
+                raylet_cli = Client(raylet_addr, name="core->remote-raylet",
+                                    on_push=self._on_raylet_push)
             if raylet_cli is None:
                 raise RuntimeError("no raylet available for lease request")
             payload = {"resources": common.denormalize_resources(dict(resources)),

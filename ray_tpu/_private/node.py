@@ -392,11 +392,26 @@ class Raylet:
 
     def h_disconnect(self, conn: ServerConn):
         # drop reclaim-push registrations bound to this conn (drivers
-        # and worker cores alike), or dead ServerConns accumulate
+        # and worker cores alike), or dead ServerConns accumulate —
+        # and reclaim the departed client's leases: a DRIVER exiting
+        # mid-lease never registers as a worker, so without this its
+        # task leases leak until the whole node starves (each departed
+        # driver once pinned its leased CPUs forever)
+        gone_clients = []
         with self.lock:
             for cid, c in list(self.client_conns.items()):
                 if c is conn:
                     self.client_conns.pop(cid, None)
+                    gone_clients.append(cid)
+        for cid in gone_clients:
+            # purge the departed client's QUEUED lease requests too:
+            # granting one to a ghost books resources nobody will ever
+            # use or return (the leak that starved a node after a burst
+            # of short-lived drivers)
+            self._purge_pending_of_client(cid)
+            self._reclaim_leases_of_dead_client(cid)
+        if gone_clients:
+            self._try_grant()
         wid = conn.meta.get("worker_id")
         if not wid:
             return
@@ -667,6 +682,7 @@ class Raylet:
                 return
             self._last_reclaim_push = now
             conns = list(self.client_conns.items())
+        dead = []
         for cid, conn in conns:
             try:
                 if not conn.push("reclaim_idle_leases", {}):
@@ -675,6 +691,14 @@ class Raylet:
                 with self.lock:
                     if self.client_conns.get(cid) is conn:
                         self.client_conns.pop(cid, None)
+                dead.append(cid)
+        for cid in dead:
+            # a push to a dead conn may race ahead of its h_disconnect;
+            # having popped the registration (the disconnect handler's
+            # only cue), run the same reclaim here or the dead client's
+            # leases/queued requests leak
+            self._purge_pending_of_client(cid)
+            self._reclaim_leases_of_dead_client(cid)
 
     def _free_lease_resources(self, rec: WorkerRecord):
         """Return a worker's held resources to the right pool (general
@@ -728,8 +752,7 @@ class Raylet:
         self._try_grant()
         return True
 
-    def h_cancel_lease_requests(self, conn, p):
-        cid = p.get("client_id")
+    def _purge_pending_of_client(self, cid: str) -> int:
         canceled = []
         with self.lock:
             keep = deque()
@@ -740,8 +763,14 @@ class Raylet:
                     keep.append(pl)
             self.pending_leases = keep
         for pl in canceled:
-            pl.deferred.resolve({"ok": False, "canceled": True})
+            try:
+                pl.deferred.resolve({"ok": False, "canceled": True})
+            except Exception:
+                pass
         return len(canceled)
+
+    def h_cancel_lease_requests(self, conn, p):
+        return self._purge_pending_of_client(p.get("client_id"))
 
     def h_task_blocked(self, conn, p):
         """A worker blocked in get() lends its CPUs (CPU only — never a

@@ -181,3 +181,42 @@ def test_blocked_pg_actor_lends_cpu(ray_cluster):
     assert ray_tpu.get(c.go.remote(), timeout=120) == 7
     ray_tpu.kill(c)
     remove_placement_group(pg)
+
+
+def test_departed_driver_leases_reclaimed(ray_cluster):
+    """A second driver PROCESS exits while holding task leases: its CPUs
+    must return to the pool (regression: departed drivers once pinned
+    their leased CPUs forever — drivers never register as workers, so
+    only conn-based reclaim can catch them)."""
+    import subprocess
+    import sys
+    import time
+
+    import ray_tpu
+
+    addr = ray_tpu.connection_info()["control_address"]
+    child = (
+        "import ray_tpu\n"
+        f"ray_tpu.init(address={addr!r})\n"
+        "@ray_tpu.remote\n"
+        "def tiny(): return None\n"
+        "ray_tpu.get([tiny.remote() for _ in range(40)], timeout=120)\n"
+        "ray_tpu.shutdown()\n")
+    p = subprocess.run([sys.executable, "-c", child], capture_output=True,
+                       text=True, timeout=180)
+    assert p.returncode == 0, p.stderr[-300:]
+    total = ray_tpu.cluster_resources().get("CPU", 0)
+    deadline = time.time() + 90
+    from ray_tpu._private.core import current_core
+
+    while time.time() < deadline:
+        # THIS driver's own idle pools (earlier tests in the shared
+        # session) also hold leases; flush them so the assertion
+        # isolates the departed child's
+        current_core().flush_idle_leases()
+        if ray_tpu.available_resources().get("CPU", 0) == total:
+            return
+        time.sleep(0.5)
+    raise AssertionError(
+        f"departed driver's leases leaked: avail="
+        f"{ray_tpu.available_resources()} total={total}")
