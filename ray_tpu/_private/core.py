@@ -452,6 +452,10 @@ class CoreWorker:
         self._push_handlers: Dict[str, list] = {}
         self.actors: Dict[str, ActorConn] = {}
         self.owner_clients: Dict[Tuple[str, int], Client] = {}
+        # negative cache of unreachable owner addrs (see _owner_client)
+        self._owner_dead_until: Dict[Tuple[str, int], float] = {}
+        # cached clients to remote raylets (see _remote_raylet_client)
+        self._remote_raylets: Dict[Tuple[str, int], Client] = {}
         self.pool_executor = DaemonPool(max_workers=8, name="core")
         self._put_seq = 0
         self._blocked_depth = 0
@@ -644,25 +648,34 @@ class CoreWorker:
                                    {"client_id": self.worker_id})
         except Exception:
             pass
+        # return IDLE leases explicitly, one client per granting raylet:
+        # a departing driver's conn teardown also reclaims
+        # (h_disconnect), but the polite return frees resources without
+        # waiting for the socket.  An INFLIGHT lease is not returned —
+        # recycling a worker mid-task would queue the next lessee behind
+        # abandoned work; conn-drop reclaim kills those instead.
+        by_raylet: Dict[Tuple, List] = {}
         for pool in pools:
             for lw in list(pool.leases.values()):
-                # return IDLE leases explicitly, addressed to the raylet
-                # that granted them: a departing driver's conn teardown
-                # also reclaims (h_disconnect), but the polite return
-                # frees resources without waiting for the socket.  An
-                # INFLIGHT lease is not returned — recycling a worker
-                # mid-task would queue the next lessee behind abandoned
-                # work; conn-drop reclaim kills those instead.
-                try:
-                    if not lw.inflight:
-                        cli = Client(tuple(lw.raylet_addr),
-                                     name="core-return",
-                                     connect_timeout=1.0)
-                        cli.notify("return_lease",
-                                   {"worker_id": lw.worker_id})
-                        cli.close()
-                except Exception:
-                    pass
+                if not lw.inflight:
+                    by_raylet.setdefault(tuple(lw.raylet_addr),
+                                         []).append(lw.worker_id)
+        for addr, wids in by_raylet.items():
+            try:
+                if addr == self.raylet_addr and self.raylet is not None:
+                    cli, transient = self.raylet, False
+                else:
+                    cli = Client(addr, name="core-return",
+                                 connect_timeout=1.0)
+                    transient = True
+                for wid in wids:
+                    cli.notify("return_lease", {"worker_id": wid})
+                if transient:
+                    cli.close()
+            except Exception:
+                pass
+        for pool in pools:
+            for lw in list(pool.leases.values()):
                 try:
                     lw.client.close()
                 except Exception:
@@ -910,13 +923,34 @@ class CoreWorker:
 
     def _owner_client(self, addr, connect_timeout: float = 30.0) -> Client:
         addr = tuple(addr)
+        housekeeping = connect_timeout <= 5.0
         with self.lock:
             cli = self.owner_clients.get(addr)
             if cli is not None and not cli.closed:
                 return cli
-        cli = Client(addr, name="core->owner",
-                     connect_timeout=connect_timeout)
+            dead_until = self._owner_dead_until.get(addr, 0.0)
+        if housekeeping and dead_until > time.monotonic():
+            # negative cache for NOTIFY flows only (short timeouts): a
+            # churned-away owner (dead coordinator, exited driver) must
+            # not cost every ref-release a fresh connect retry.  The
+            # data path (long default timeout: get/add_ref — owners may
+            # still be booting) always attempts, and success clears the
+            # quarantine.
+            raise ConnectionLost(f"owner {addr} recently unreachable")
+        try:
+            cli = Client(addr, name="core->owner",
+                         connect_timeout=connect_timeout)
+        except ConnectionLost:
+            with self.lock:
+                if len(self._owner_dead_until) > 64:
+                    now = time.monotonic()
+                    self._owner_dead_until = {
+                        a: t for a, t in self._owner_dead_until.items()
+                        if t > now}
+                self._owner_dead_until[addr] = time.monotonic() + 60.0
+            raise
         with self.lock:
+            self._owner_dead_until.pop(addr, None)
             self.owner_clients[addr] = cli
         return cli
 
@@ -1030,6 +1064,7 @@ class CoreWorker:
     # ------------------------------------------------------------------
 
     def _remove_local_ref(self, ref: ObjectRef):
+        notify_owner = False
         with self.lock:
             if ref.id in self.objects:
                 n = self.local_ref_counts.get(ref.id, 0) - 1
@@ -1038,12 +1073,18 @@ class CoreWorker:
                     self._unpin(ref.id)
             elif ref.id in self.borrowed:
                 self.borrowed.pop(ref.id, None)
-                if ref.owner_addr:
-                    try:
-                        self._owner_client(ref.owner_addr).notify(
-                            "del_ref", {"object_id": ref.id})
-                    except Exception:
-                        pass
+                notify_owner = bool(ref.owner_addr)
+        if notify_owner:
+            # OUTSIDE the lock: connecting to a dead owner retries for
+            # seconds, and holding the core lock through that froze the
+            # entire core in 30s quanta whenever refs to a dead owner
+            # (e.g. a finished split coordinator) were dropped
+            try:
+                self._owner_client(ref.owner_addr,
+                                   connect_timeout=2.0).notify(
+                    "del_ref", {"object_id": ref.id})
+            except Exception:
+                pass
 
     def _pin(self, oid: str, n: int = 1):
         with self.lock:
@@ -1406,6 +1447,23 @@ class CoreWorker:
             return not strategy.get("soft")
         return False
 
+    def _remote_raylet_client(self, addr) -> Client:
+        """One cached client per remote raylet (reference: the raylet
+        client pool): a fresh conn per lease request would leak a socket
+        + two threads each, and the remote raylet's reclaim/disconnect
+        tracking keys off the conn — churning conns per request would
+        false-signal client death on any one socket error."""
+        addr = tuple(addr)
+        with self.lock:
+            cli = self._remote_raylets.get(addr)
+            if cli is not None and not cli.closed:
+                return cli
+        cli = Client(addr, name="core->remote-raylet",
+                     on_push=self._on_raylet_push)
+        with self.lock:
+            self._remote_raylets[addr] = cli
+        return cli
+
     def _request_lease(self, pool: SchedPool):
         try:
             resources = dict(pool.key[0])
@@ -1443,9 +1501,7 @@ class CoreWorker:
             raylet_cli = self.raylet
             if picked is not None and tuple(picked["addr"]) != self.raylet_addr:
                 raylet_addr = tuple(picked["addr"])
-                # on_push: remote raylets send reclaim_idle_leases too
-                raylet_cli = Client(raylet_addr, name="core->remote-raylet",
-                                    on_push=self._on_raylet_push)
+                raylet_cli = self._remote_raylet_client(raylet_addr)
             if raylet_cli is None:
                 raise RuntimeError("no raylet available for lease request")
             payload = {"resources": common.denormalize_resources(dict(resources)),

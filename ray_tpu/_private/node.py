@@ -402,7 +402,11 @@ class Raylet:
             for cid, c in list(self.client_conns.items()):
                 if c is conn:
                     self.client_conns.pop(cid, None)
-                    gone_clients.append(cid)
+                    # a worker core's own id is handled by the worker
+                    # tail below (which also kills the proc) — don't
+                    # run the reclaim scan twice for it
+                    if cid != conn.meta.get("worker_id"):
+                        gone_clients.append(cid)
         for cid in gone_clients:
             # purge the departed client's QUEUED lease requests too:
             # granting one to a ghost books resources nobody will ever
@@ -689,9 +693,11 @@ class Raylet:
                     raise OSError("push failed")
             except Exception:
                 with self.lock:
+                    # identity guard: a failed push to a STALE conn must
+                    # not reclaim a client that reconnected since
                     if self.client_conns.get(cid) is conn:
                         self.client_conns.pop(cid, None)
-                dead.append(cid)
+                        dead.append(cid)
         for cid in dead:
             # a push to a dead conn may race ahead of its h_disconnect;
             # having popped the registration (the disconnect handler's
