@@ -24,6 +24,15 @@ def test_gbdt_requires_train_dataset():
         SklearnGBDTTrainer(datasets={})
 
 
+def test_gbdt_rejects_sharded_dataset_multi_worker(ray_cluster):
+    """A ray Dataset with num_workers>1 would silently train on 1/N of
+    the rows (streaming_split) — refused loudly."""
+    ds = rd.from_pandas(__import__("pandas").DataFrame(_toy_frame()))
+    with pytest.raises(ValueError, match="num_workers=1"):
+        SklearnGBDTTrainer(datasets={"train": ds}, label_column="label",
+                           scaling_config=ScalingConfig(num_workers=2))
+
+
 def test_sklearn_gbdt_train_and_checkpoint(ray_cluster, tmp_path):
     trainer = SklearnGBDTTrainer(
         datasets={"train": _toy_frame()},
