@@ -21,8 +21,18 @@ Supported fields:
                unless RAY_TPU_ALLOW_PKG_INSTALL=1.  With
                RAY_TPU_WHEELHOUSE=<dir> the install is fully offline
                (--no-index --find-links), which is also how it is tested.
-  conda        rejected unless RAY_TPU_ALLOW_PKG_INSTALL=1 (the build
-               forbids network installs; the hook exists for parity)
+  uv           [requirements]  same content-addressed target-dir model
+               as pip, installed by the `uv` binary (worker-local PATH /
+               RAY_TPU_UV_BIN, falling back to the driver's setting).
+               Same gate and wheelhouse behavior as pip.
+  conda        str (existing env NAME or PREFIX) or environment.yml-style
+               dict (created once per content hash).  The env's
+               site-packages is import-path scoped into the worker — the
+               reference re-execs workers inside the env, so only
+               ABI-compatible (same python minor) envs are accepted.
+               Same RAY_TPU_ALLOW_PKG_INSTALL gate.
+
+pip/uv/conda are mutually exclusive, as in the reference.
 """
 
 from __future__ import annotations
@@ -53,13 +63,16 @@ _materialized: Dict[str, str] = {}  # pkg hash -> extracted dir
 def validate(env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     env = dict(env or {})
     unknown = set(env) - {"env_vars", "working_dir", "py_modules", "pip",
-                          "conda", "config"}
+                          "uv", "conda", "config"}
     if unknown:
         raise ValueError(f"unsupported runtime_env fields: {sorted(unknown)}")
-    if env.get("pip") or env.get("conda"):
+    if sum(1 for k in ("pip", "uv", "conda") if env.get(k)) > 1:
+        raise ValueError("pip, uv, and conda are mutually exclusive "
+                         "(reference: runtime_env validation)")
+    if env.get("pip") or env.get("uv") or env.get("conda"):
         if not _cfg().allow_pkg_install:
             raise ValueError(
-                "runtime_env pip/conda installs are disabled in this "
+                "runtime_env pip/uv/conda installs are disabled in this "
                 "deployment (set RAY_TPU_ALLOW_PKG_INSTALL=1 to enable)")
     ev = env.get("env_vars") or {}
     if not all(isinstance(k, str) and isinstance(v, str)
@@ -149,10 +162,53 @@ def prepare(env: Optional[Dict[str, Any]], control) -> Optional[Dict[str, Any]]:
     if mods:
         out["py_modules"] = [m if str(m).startswith("pkg:")
                              else _upload_package(control, m) for m in mods]
-    if env.get("pip"):
+    if env.get("pip") or env.get("uv"):
         # driver policy rides along so the worker installs the same way
         out["_wheelhouse"] = os.environ.get("RAY_TPU_WHEELHOUSE")
+    if env.get("uv"):
+        out["_uv_bin"] = os.environ.get("RAY_TPU_UV_BIN")
+    if env.get("conda"):
+        out["_conda_bin"] = os.environ.get("RAY_TPU_CONDA_BIN")
     return out
+
+
+def _build_target_env(kind: str, digest_material: str,
+                      make_cmd) -> str:
+    """Shared content-addressed build protocol for pip-style installers:
+    digest-keyed dest under the node cache, build into tmp, marker file,
+    atomic rename (loser of the race cleans up its tmp).  `make_cmd(tmp)`
+    returns the argv installing into tmp."""
+    import shutil
+    import subprocess
+
+    py = f"py{sys.version_info[0]}.{sys.version_info[1]}"
+    digest = hashlib.sha256(
+        (digest_material + "\0" + py).encode()).hexdigest()[:20]
+    dest = os.path.join(CACHE_ROOT, f"{kind}env-{digest}")
+    marker = os.path.join(dest, ".complete")
+    if os.path.exists(marker):
+        return dest
+    tmp = dest + f".tmp{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    cmd = make_cmd(tmp)
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600)
+    except (FileNotFoundError, subprocess.TimeoutExpired) as e:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise RuntimeError(
+            f"{kind} runtime_env build failed to run {cmd[0]!r}: "
+            f"{e}") from e
+    if proc.returncode != 0:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise RuntimeError(
+            f"{kind} runtime_env build failed: {proc.stderr[-2000:]}")
+    open(os.path.join(tmp, ".complete"), "w").close()
+    try:
+        os.rename(tmp, dest)
+    except OSError:
+        shutil.rmtree(tmp, ignore_errors=True)  # another worker won
+    return dest
 
 
 def _build_pip_env(requirements: List[str],
@@ -162,39 +218,145 @@ def _build_pip_env(requirements: List[str],
     runtime_env/pip.py — virtualenv keyed by the requirements hash with a
     node-shared cache).  ``pip install --target`` replaces the venv
     because our workers insert import paths instead of re-exec'ing."""
+    reqs = sorted(str(r) for r in requirements)
+
+    def make_cmd(tmp):
+        cmd = [sys.executable, "-m", "pip", "install", "--quiet",
+               "--target", tmp]
+        if wheelhouse:
+            # fully offline: wheels (and deps) come from the wheelhouse
+            cmd += ["--no-index", "--find-links", wheelhouse]
+        return cmd + reqs
+
+    return _build_target_env("pip", "\n".join(reqs), make_cmd)
+
+
+def _build_uv_env(requirements: List[str],
+                  wheelhouse: Optional[str],
+                  uv_bin: Optional[str] = None) -> str:
+    """uv-backed requirement install (reference: runtime_env/uv.py):
+    same content-addressed target-dir model as pip, but resolved and
+    installed by the `uv` binary.  Resolution order is WORKER-LOCAL
+    first (this node's env/PATH), then the driver's setting riding the
+    env spec (also how tests inject a stub) — a driver-local path may
+    not exist on the worker's image."""
+    import shutil as _shutil
+
+    uv = os.environ.get("RAY_TPU_UV_BIN") or _shutil.which("uv") or uv_bin
+    if not uv:
+        raise RuntimeError(
+            "runtime_env {'uv': ...} requires the `uv` binary on PATH "
+            "(or RAY_TPU_UV_BIN); it is not installed in this image — "
+            "use {'pip': ...} instead")
+    reqs = sorted(str(r) for r in requirements)
+
+    def make_cmd(tmp):
+        cmd = [uv, "pip", "install", "--target", tmp,
+               "--python", sys.executable]
+        if wheelhouse:
+            cmd += ["--no-index", "--find-links", wheelhouse]
+        return cmd + reqs
+
+    return _build_target_env("uv", "uv\0" + "\n".join(reqs), make_cmd)
+
+
+def _conda_site_packages(prefix: str) -> str:
+    """The env's site-packages dir, version-checked against THIS
+    interpreter: our workers import the env in-place (the reference
+    re-execs the worker inside the conda env; in-place import only
+    works for an ABI-compatible python)."""
+    import glob as _glob
+
+    cands = sorted(_glob.glob(os.path.join(prefix, "lib", "python*",
+                                           "site-packages")))
+    if not cands:
+        raise RuntimeError(
+            f"conda env at {prefix!r} has no site-packages")
+    want = f"python{sys.version_info[0]}.{sys.version_info[1]}"
+    for c in cands:
+        if want in c:
+            return c
+    raise RuntimeError(
+        f"conda env at {prefix!r} was built for "
+        f"{os.path.basename(os.path.dirname(cands[0]))}, but workers run "
+        f"{want}: packages would be ABI-incompatible.  Build the env on "
+        f"{want} (the reference re-execs workers inside the env; this "
+        f"runtime imports it in-place)")
+
+
+def _build_conda_env(spec, conda_bin: Optional[str] = None) -> str:
+    """Conda env support (reference: runtime_env/conda.py).
+
+    spec forms:
+      str  — the NAME or PREFIX of an existing conda env (resolved via
+             `conda env list`-style prefix paths)
+      dict — an environment.yml-style spec, created once per content
+             hash with `conda env create`
+
+    Returns the env's site-packages for sys.path insertion (see
+    _conda_site_packages for the in-place-import caveat).  The binary
+    comes from RAY_TPU_CONDA_BIN or PATH; absent -> loud error."""
+    import shutil as _shutil
     import subprocess
 
-    reqs = sorted(str(r) for r in requirements)
-    py = f"py{sys.version_info[0]}.{sys.version_info[1]}"
-    digest = hashlib.sha256(
-        ("\n".join(reqs) + "\0" + py).encode()).hexdigest()[:20]
-    dest = os.path.join(CACHE_ROOT, f"pipenv-{digest}")
-    marker = os.path.join(dest, ".complete")
-    if os.path.exists(marker):
-        return dest
-    tmp = dest + f".tmp{os.getpid()}"
-    os.makedirs(tmp, exist_ok=True)
-    cmd = [sys.executable, "-m", "pip", "install", "--quiet",
-           "--target", tmp]
-    if wheelhouse:
-        # fully offline: wheels (and their deps) come from the wheelhouse
-        cmd += ["--no-index", "--find-links", wheelhouse]
-    cmd += reqs
-    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
-    if proc.returncode != 0:
-        import shutil
-
-        shutil.rmtree(tmp, ignore_errors=True)
+    conda = os.environ.get("RAY_TPU_CONDA_BIN") \
+        or _shutil.which("conda") or conda_bin
+    if isinstance(spec, str) and os.path.isdir(spec):
+        # an existing env PREFIX needs no conda binary at all
+        return _conda_site_packages(spec)
+    if not conda:
         raise RuntimeError(
-            f"pip runtime_env build failed: {proc.stderr[-2000:]}")
-    open(os.path.join(tmp, ".complete"), "w").close()
-    try:
-        os.rename(tmp, dest)
-    except OSError:
-        import shutil
+            "runtime_env {'conda': ...} requires the `conda` binary on "
+            "PATH (or RAY_TPU_CONDA_BIN); it is not installed in this "
+            "image — use {'pip': ...} instead")
+    if isinstance(spec, str):
+        # name of an existing env
+        proc = subprocess.run(
+            [conda, "env", "list", "--json"],
+            capture_output=True, text=True, timeout=120)
+        if proc.returncode == 0:
+            import json as _json
 
-        shutil.rmtree(tmp, ignore_errors=True)  # another worker won
-    return dest
+            for p in _json.loads(proc.stdout).get("envs", []):
+                if os.path.basename(p) == spec:
+                    return _conda_site_packages(p)
+        raise RuntimeError(f"conda env {spec!r} not found")
+    # dict spec: create once per content hash — into a tmp prefix with
+    # an atomic rename, so concurrent builders (or a crash between
+    # create and marker) can never destroy the winner's env
+    import json as _json
+
+    blob = _json.dumps(spec, sort_keys=True)
+    digest = hashlib.sha256(blob.encode()).hexdigest()[:20]
+    prefix = os.path.join(CACHE_ROOT, f"condaenv-{digest}")
+    marker = os.path.join(prefix, ".complete")
+    if not os.path.exists(marker):
+        os.makedirs(CACHE_ROOT, exist_ok=True)
+        tmp = prefix + f".tmp{os.getpid()}"
+        spec_path = tmp + ".yml"
+        with open(spec_path, "w") as f:
+            import yaml as _yaml
+
+            _yaml.safe_dump(spec, f)
+        try:
+            proc = subprocess.run(
+                [conda, "env", "create", "--prefix", tmp,
+                 "--file", spec_path],
+                capture_output=True, text=True, timeout=1800)
+        except (FileNotFoundError, subprocess.TimeoutExpired) as e:
+            _shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"conda env create failed to run {conda!r}: {e}") from e
+        if proc.returncode != 0:
+            _shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"conda env create failed: {proc.stderr[-2000:]}")
+        open(os.path.join(tmp, ".complete"), "w").close()
+        try:
+            os.rename(tmp, prefix)
+        except OSError:
+            _shutil.rmtree(tmp, ignore_errors=True)  # another worker won
+    return _conda_site_packages(prefix)
 
 
 def _fetch_package(control, uri: str) -> str:
@@ -307,4 +469,14 @@ def materialize(env: Optional[Dict[str, Any]], control) -> Context:
             pip_reqs = pip_reqs.get("packages") or []
         sys_paths.append(_build_pip_env(list(pip_reqs),
                                         env.get("_wheelhouse")))
+    uv_reqs = env.get("uv")
+    if uv_reqs:
+        if isinstance(uv_reqs, dict):  # reference: {"packages": [...]}
+            uv_reqs = uv_reqs.get("packages") or []
+        sys_paths.append(_build_uv_env(list(uv_reqs),
+                                       env.get("_wheelhouse"),
+                                       env.get("_uv_bin")))
+    if env.get("conda"):
+        sys_paths.append(_build_conda_env(env["conda"],
+                                          env.get("_conda_bin")))
     return Context(dict(env.get("env_vars") or {}), sys_paths, cwd)

@@ -84,7 +84,7 @@ def test_pip_rejected_without_optin(ray_cluster):
     def f():
         return 1
 
-    with pytest.raises(ValueError, match="pip/conda"):
+    with pytest.raises(ValueError, match="pip/uv/conda"):
         f.options(runtime_env={"pip": ["requests"]}).remote()
 
 

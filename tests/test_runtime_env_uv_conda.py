@@ -1,0 +1,130 @@
+"""Runtime env uv/conda plugins (reference: _private/runtime_env/uv.py,
+conda.py).  The binaries are not in this image, so the end-to-end paths
+run against STUB executables injected via RAY_TPU_UV_BIN /
+RAY_TPU_CONDA_BIN — proving the plumbing (spec -> build -> sys.path ->
+import -> scoped teardown) without the real tools."""
+
+import os
+import stat
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import runtime_env as rtenv
+
+
+# ---------------------------------------------------------------------------
+# validation / gating
+# ---------------------------------------------------------------------------
+
+def test_uv_conda_gated_by_default(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_ALLOW_PKG_INSTALL", raising=False)
+    with pytest.raises(ValueError, match="disabled"):
+        rtenv.validate({"uv": ["x"]})
+    with pytest.raises(ValueError, match="disabled"):
+        rtenv.validate({"conda": "someenv"})
+
+
+def test_pip_uv_conda_mutually_exclusive(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_ALLOW_PKG_INSTALL", "1")
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        rtenv.validate({"pip": ["a"], "uv": ["b"]})
+
+
+def test_uv_missing_binary_is_loud(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_UV_BIN", raising=False)
+    monkeypatch.setenv("PATH", "/nonexistent")
+    with pytest.raises(RuntimeError, match="uv"):
+        rtenv._build_uv_env(["somepkg"], None)
+
+
+def test_conda_missing_binary_is_loud(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_CONDA_BIN", raising=False)
+    monkeypatch.setenv("PATH", "/nonexistent")
+    with pytest.raises(RuntimeError, match="conda"):
+        rtenv._build_conda_env({"dependencies": ["x"]})
+
+
+# ---------------------------------------------------------------------------
+# uv end-to-end with a stub binary
+# ---------------------------------------------------------------------------
+
+def _write_uv_stub(path) -> str:
+    """A fake `uv` that understands `uv pip install --target <dir> ...`
+    and drops a marker module into the target."""
+    stub = path / "uv"
+    stub.write_text(
+        "#!/bin/bash\n"
+        "target=\"\"\n"
+        "args=(\"$@\")\n"
+        "for ((i=0;i<${#args[@]};i++)); do\n"
+        "  if [ \"${args[$i]}\" = \"--target\" ]; then\n"
+        "    target=\"${args[$((i+1))]}\"\n"
+        "  fi\n"
+        "done\n"
+        "[ -n \"$target\" ] || exit 2\n"
+        "mkdir -p \"$target\"\n"
+        "printf 'MAGIC = \"uv-stub-worked\"\\n' > "
+        "\"$target/uvstub_mod_qqq.py\"\n")
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    return str(stub)
+
+
+def test_uv_env_with_stub(ray_cluster, tmp_path, monkeypatch):
+    uv_bin = _write_uv_stub(tmp_path)
+    monkeypatch.setenv("RAY_TPU_ALLOW_PKG_INSTALL", "1")
+    monkeypatch.setenv("RAY_TPU_UV_BIN", uv_bin)
+
+    @ray_tpu.remote(runtime_env={"uv": ["uvstub_mod_qqq"]})
+    def use_uv():
+        import uvstub_mod_qqq
+
+        return uvstub_mod_qqq.MAGIC
+
+    assert ray_tpu.get(use_uv.remote(), timeout=180) == "uv-stub-worked"
+
+    # scoping: the package must not leak into plain tasks
+    @ray_tpu.remote
+    def plain():
+        try:
+            import uvstub_mod_qqq  # noqa: F401
+
+            return "leaked"
+        except ImportError:
+            return "clean"
+
+    assert ray_tpu.get(plain.remote(), timeout=60) == "clean"
+
+
+# ---------------------------------------------------------------------------
+# conda: existing-prefix path (no binary needed) + ABI guard
+# ---------------------------------------------------------------------------
+
+def _fake_conda_env(tmp_path, pyver: str):
+    prefix = tmp_path / "fakeenv"
+    sp = prefix / "lib" / f"python{pyver}" / "site-packages"
+    sp.mkdir(parents=True)
+    (sp / "condastub_mod_qqq.py").write_text('MAGIC = "conda-env-worked"\n')
+    return prefix, sp
+
+
+def test_conda_existing_prefix(ray_cluster, tmp_path, monkeypatch):
+    pyver = f"{sys.version_info[0]}.{sys.version_info[1]}"
+    prefix, _ = _fake_conda_env(tmp_path, pyver)
+    monkeypatch.setenv("RAY_TPU_ALLOW_PKG_INSTALL", "1")
+
+    @ray_tpu.remote(runtime_env={"conda": str(prefix)})
+    def use_conda():
+        import condastub_mod_qqq
+
+        return condastub_mod_qqq.MAGIC
+
+    assert ray_tpu.get(use_conda.remote(), timeout=180) == \
+        "conda-env-worked"
+
+
+def test_conda_abi_mismatch_is_loud(tmp_path):
+    prefix, _ = _fake_conda_env(tmp_path, "9.9")
+    with pytest.raises(RuntimeError, match="ABI-incompatible"):
+        rtenv._conda_site_packages(str(prefix))
