@@ -122,6 +122,7 @@ class Raylet:
         s.handle("delete_objects", self.h_delete_objects)
         s.handle("store_stats", self.h_store_stats)
         s.handle("node_info", self.h_node_info)
+        s.handle("list_leases", self.h_list_leases)
         s.handle("list_workers", self.h_list_workers)
         s.handle("list_logs", self.h_list_logs)
         s.handle("read_log", self.h_read_log)
@@ -1085,6 +1086,22 @@ class Raylet:
                 return f.read().decode(errors="replace")
         except OSError:
             return None
+
+    def h_list_leases(self, conn, p):
+        """Debug introspection: every worker record's state + lease
+        bookkeeping (who holds each CPU) — the first question when a
+        node shows avail=0 with nothing visibly running."""
+        with self.lock:
+            return [{
+                "worker_id": r.worker_id,
+                "state": r.state,
+                "actor_id": r.actor_id,
+                "lease_resources": dict(r.lease_resources or {}),
+                "lease_client_id": r.lease_client_id,
+                "blocked": r.blocked,
+                "lent": dict(r.lent or {}),
+                "bundle_key": r.bundle_key,
+            } for r in self.workers.values()]
 
     def h_node_info(self, conn, p):
         with self.lock:

@@ -5,7 +5,15 @@ The reference ships a 21.5k-line React/TS frontend
 equivalent for the same data: one HTML page that polls the head's JSON
 API (/api/cluster_status, /api/nodes, /api/actors, /api/jobs,
 /api/placement_groups, /api/tasks) and renders live tables — cluster
-overview, nodes, actors, jobs, placement groups, recent task events.
+overview, nodes, actors, jobs, placement groups, recent task events —
+plus two canvas views the reference renders in React:
+
+  * a task TIMELINE (one lane per worker, spans from each record's
+    state_ts transitions — the dashboard-embedded flavor of `ray-tpu
+    timeline`'s Chrome-trace export);
+  * per-node CPU utilization SPARKLINES + a cluster utilization strip,
+    built client-side from the poll history (the reference's
+    Grafana-backed metrics charts, without Grafana).
 """
 
 PAGE = """<!doctype html>
@@ -45,7 +53,13 @@ PAGE = """<!doctype html>
 <span class="sub" id="ts"></span><span id="err"></span></header>
 <main>
   <div class="cards" id="cards"></div>
+  <h2>Cluster CPU utilization (last 5 min)</h2>
+  <canvas id="util" width="1160" height="60"
+          style="background:#fff;border-radius:8px;width:100%"></canvas>
   <h2>Nodes</h2><table id="nodes"></table>
+  <h2>Task timeline (last 60 s, one lane per worker)</h2>
+  <canvas id="timeline" width="1160" height="160"
+          style="background:#fff;border-radius:8px;width:100%"></canvas>
   <h2>Actors</h2><table id="actors"></table>
   <h2>Jobs</h2><table id="jobs"></table>
   <h2>Placement groups</h2><table id="pgs"></table>
@@ -62,8 +76,15 @@ function table(el, rows, cols) {
   let h = "<tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("") + "</tr>";
   for (const r of rows.slice(0, 50)) {
     h += "<tr>" + cols.map(c => {
-      // escape BEFORE interpolation: entrypoints / actor names / error
-      // strings are workload-controlled (stored-XSS sink otherwise)
+      // ONLY the client-built "util" column may carry raw markup (the
+      // sparkline data URL generated in this page) — keying on a value
+      // shape would let workload-controlled dicts (node labels!) smuggle
+      // HTML; everything else is escaped BEFORE interpolation:
+      // entrypoints / actor names / error strings are
+      // workload-controlled (stored-XSS sink otherwise)
+      if (c === "util" && r[c] && typeof r[c] === "object"
+          && r[c].__html !== undefined)
+        return `<td>${r[c].__html}</td>`;
       const v = fmt(r[c]);
       const cls = /^(ALIVE|DEAD|PENDING|RESTARTING|RUNNING|SUCCEEDED|FAILED|FINISHED)$/.test(v) ? ` class="${v}"` : "";
       return `<td${cls}>${esc(v.slice(0, 80))}</td>`;
@@ -72,6 +93,107 @@ function table(el, rows, cols) {
   t.innerHTML = h;
 }
 async function j(path) { const r = await fetch(path); return r.json(); }
+
+// ---- metrics history (client-side: each tick appends one sample) ----
+const hist = [];            // {t, used, total, perNode: {id: frac}}
+function pushSample(cs, nodes) {
+  const total = (cs.total_resources || {}).CPU || 0;
+  const avail = (cs.available_resources || {}).CPU || 0;
+  const perNode = {};
+  for (const n of nodes || []) {
+    const t = (n.total || {}).CPU || 0, a = (n.available || {}).CPU || 0;
+    if (t > 0) perNode[n.node_id] = (t - a) / t;
+  }
+  hist.push({t: Date.now() / 1000, used: total - avail, total, perNode});
+  while (hist.length && hist[0].t < Date.now() / 1000 - 300) hist.shift();
+}
+function drawUtil() {
+  const c = document.getElementById("util"), g = c.getContext("2d");
+  g.clearRect(0, 0, c.width, c.height);
+  if (hist.length < 2) return;
+  const t1 = Date.now() / 1000, t0 = t1 - 300;
+  g.beginPath(); g.strokeStyle = "#3b6fd4"; g.lineWidth = 2;
+  g.fillStyle = "rgba(59,111,212,.15)";
+  const pts = hist.map(h => [
+    (h.t - t0) / 300 * c.width,
+    c.height - 4 - (h.total ? h.used / h.total : 0) * (c.height - 10)]);
+  g.moveTo(pts[0][0], pts[0][1]);
+  for (const [x, y] of pts) g.lineTo(x, y);
+  g.stroke();
+  g.lineTo(pts[pts.length-1][0], c.height); g.lineTo(pts[0][0], c.height);
+  g.closePath(); g.fill();
+  const h = hist[hist.length - 1];
+  g.fillStyle = "#334"; g.font = "11px monospace";
+  g.fillText(`${h.used.toFixed(1)}/${h.total} CPU busy`, 8, 14);
+}
+function sparkline(nodeId) {  // tiny inline chart per node row
+  const w = 90, hgt = 18;
+  const cv = document.createElement("canvas");
+  cv.width = w; cv.height = hgt;
+  const g = cv.getContext("2d");
+  g.strokeStyle = "#3b6fd4"; g.beginPath();
+  const samples = hist.slice(-45);
+  samples.forEach((h, i) => {
+    const f = h.perNode[nodeId] ?? 0;
+    const x = i / Math.max(1, samples.length - 1) * w;
+    const y = hgt - 2 - f * (hgt - 4);
+    i ? g.lineTo(x, y) : g.moveTo(x, y);
+  });
+  g.stroke();
+  return `<img src="${cv.toDataURL()}" width="${w}" height="${hgt}">`;
+}
+
+// ---- task timeline: lanes per worker, spans from state_ts ----
+const STATE_COLOR = {FINISHED: "#0a7d33", FAILED: "#c0262d",
+                     RUNNING: "#3b6fd4"};
+function drawTimeline(records) {
+  const c = document.getElementById("timeline"), g = c.getContext("2d");
+  g.clearRect(0, 0, c.width, c.height);
+  const t1 = Date.now() / 1000, t0 = t1 - 60;
+  const lanes = new Map();  // worker_id -> lane index
+  const spans = [];
+  for (const r of records || []) {
+    const st = r.state_ts || {};
+    const start = st.RUNNING ?? st.PENDING_ARGS_AVAIL ?? null;
+    if (start === null) continue;
+    const end = st.FINISHED ?? st.FAILED ?? t1;  // still running: now
+    if (end < t0) continue;
+    const key = r.worker_id || r.actor_id || "driver";
+    if (!lanes.has(key)) lanes.set(key, lanes.size);
+    spans.push({lane: lanes.get(key), s: Math.max(start, t0),
+                e: Math.min(end, t1), state: r.state, name: r.name || ""});
+  }
+  const nl = Math.max(1, Math.min(lanes.size, 12));
+  const lh = Math.floor((c.height - 18) / nl);
+  g.font = "10px monospace"; g.fillStyle = "#99a";
+  for (let m = 0; m <= 6; m++) {  // 10s gridlines
+    const x = m / 6 * c.width;
+    g.fillRect(x, 0, 1, c.height - 14);
+    g.fillText(`-${60 - m * 10}s`, Math.min(x + 2, c.width - 30),
+               c.height - 3);
+  }
+  for (const sp of spans) {
+    if (sp.lane >= nl) continue;
+    const x0 = (sp.s - t0) / 60 * c.width;
+    const x1 = Math.max(x0 + 2, (sp.e - t0) / 60 * c.width);
+    g.fillStyle = STATE_COLOR[sp.state] || "#b26a00";
+    g.globalAlpha = 0.85;
+    g.fillRect(x0, sp.lane * lh + 3, x1 - x0, lh - 6);
+    g.globalAlpha = 1;
+    if (x1 - x0 > 60) {
+      g.fillStyle = "#fff";
+      g.fillText(sp.name.slice(0, Math.floor((x1-x0)/7)),
+                 x0 + 3, sp.lane * lh + lh / 2 + 3);
+    }
+  }
+  let li = 0;
+  g.fillStyle = "#667";
+  for (const [k] of lanes) {
+    if (li >= nl) break;
+    g.fillText(k.slice(0, 10), 2, li * lh + 12);
+    li++;
+  }
+}
 async function tick() {
   try {
     const [cs, nodes, actors, jobs, pgs, tasks, ver] = await Promise.all([
@@ -87,7 +209,11 @@ async function tick() {
       card("TPU free/total", `${avail.TPU ?? 0}/${total.TPU ?? 0}`) +
       card("actors", actors.length) + card("jobs", jobs.length) +
       card("placement groups", pgs.length);
-    table("nodes", nodes, ["node_id", "addr", "state", "total", "available", "labels"]);
+    pushSample(cs, nodes);
+    drawUtil();
+    drawTimeline(tasks.records || []);
+    for (const n of nodes || []) n.util = {__html: sparkline(n.node_id)};
+    table("nodes", nodes, ["node_id", "addr", "state", "total", "available", "util", "labels"]);
     table("actors", actors, ["actor_id", "class_name", "name", "state", "node_id", "restarts"]);
     table("jobs", jobs, ["submission_id", "entrypoint", "status", "message"]);
     table("pgs", pgs, ["pg_id", "name", "state", "bundles", "strategy"]);

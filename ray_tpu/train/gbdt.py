@@ -133,10 +133,12 @@ def _gbdt_loop(config):
         finally:
             shutil.rmtree(d, ignore_errors=True)
         return
+    import ray_tpu
+
     train_fn = _FRAMEWORKS[framework]
     ds = config["dataset"]
-    if ds is None:  # `or` would call bool(DataFrame) — ambiguous
-        ds = train_api.get_dataset_shard("train")
+    if isinstance(ds, ray_tpu.ObjectRef):
+        ds = ray_tpu.get(ds, timeout=600)  # driver-materialized frame
     X, y = _to_xy(ds, config["label_column"])
     ckpt_dir = tempfile.mkdtemp(prefix="gbdt-")
     try:
@@ -176,17 +178,27 @@ class GBDTTrainer:
         if "train" not in datasets:
             raise ValueError('datasets={"train": ...} is required')
         ds = datasets["train"]
-        # plain in-memory data rides the config; Datasets shard normally
-        inline = None if hasattr(ds, "streaming_split") else ds
         n_workers = (scaling_config or ScalingConfig()).num_workers
-        if inline is None and n_workers > 1:
-            # streaming_split would hand rank 0 only 1/N of the rows and
-            # silently train on that; distributed boosting (rabit-style)
-            # is not implemented — fail loudly instead
-            raise ValueError(
-                "GBDT training consumes the dataset on one worker; use "
-                "num_workers=1 with a ray_tpu.data Dataset (in-memory "
-                "frames may use more workers — extras idle)")
+        if hasattr(ds, "streaming_split"):
+            # boosting consumes the WHOLE table on one worker anyway (the
+            # reference materializes to a DMatrix in memory), so
+            # materialize DRIVER-side and ship the frame inline: simpler
+            # and avoids a per-fit streaming coordinator actor.
+            # Distributed (rabit-style) boosting is not implemented.
+            if n_workers > 1:
+                raise ValueError(
+                    "GBDT training consumes the dataset on one worker; "
+                    "use num_workers=1 with a ray_tpu.data Dataset "
+                    "(in-memory frames may use more workers — extras "
+                    "idle)")
+            # ship via the object store, not the config pickle: the ref
+            # crosses the wire once and restarts reuse it
+            import ray_tpu
+
+            inline = ray_tpu.put(ds.to_pandas())
+        else:
+            # plain in-memory data rides the config directly
+            inline = ds
         self._trainer = JaxTrainer(
             _gbdt_loop,
             train_loop_config={
@@ -196,7 +208,7 @@ class GBDTTrainer:
                 "num_boost_round": num_boost_round,
                 "dataset": inline,
             },
-            datasets=None if inline is not None else datasets,
+            datasets=None,
             scaling_config=scaling_config or ScalingConfig(num_workers=1),
             run_config=run_config,
         )

@@ -29,3 +29,14 @@ def test_root_serves_html_ui(dashboard):
                      "/api/jobs", "/api/placement_groups"):
         assert endpoint in body
     assert "ray_tpu" in body
+
+
+def test_ui_has_timeline_and_utilization_views(dashboard):
+    """The canvas views (task timeline + utilization charts) ship in the
+    page and reference real API fields (state_ts from /api/tasks)."""
+    with urllib.request.urlopen(dashboard.url + "/", timeout=30) as r:
+        body = r.read().decode()
+    assert 'id="timeline"' in body
+    assert 'id="util"' in body
+    assert "state_ts" in body        # timeline derives spans from it
+    assert "sparkline" in body       # per-node utilization cells
