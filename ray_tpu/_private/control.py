@@ -1336,7 +1336,11 @@ class ControlServer:
                         if len(out) >= limit:
                             break
                 return {"records": out, "dropped": self.task_events_dropped,
-                        "total": len(self.task_records)}
+                        "total": len(self.task_records),
+                        # server clock anchor: event ts are cluster-host
+                        # time; viewers (dashboard timeline) must render
+                        # relative to THIS, not their own skewed clock
+                        "now": time.time()}
 
         self._defer(d, run)
 

@@ -231,18 +231,34 @@ def _build_pip_env(requirements: List[str],
     return _build_target_env("pip", "\n".join(reqs), make_cmd)
 
 
+def _resolve_bin(explicit: Optional[str], env_var: str,
+                 name: str) -> Optional[str]:
+    """Installer-binary resolution, shared by uv and conda: the driver's
+    explicit setting wins WHEN it is an executable on this node (a
+    deliberate choice — also how tests inject stubs); a driver-local
+    path absent from the worker image falls back to the worker's env
+    var, then PATH."""
+    import shutil as _shutil
+
+    for cand in (explicit, os.environ.get(env_var), _shutil.which(name)):
+        if not cand:
+            continue
+        if os.path.isfile(cand) and os.access(cand, os.X_OK):
+            return cand
+        found = _shutil.which(cand)
+        if found:
+            return found
+    return None
+
+
 def _build_uv_env(requirements: List[str],
                   wheelhouse: Optional[str],
                   uv_bin: Optional[str] = None) -> str:
     """uv-backed requirement install (reference: runtime_env/uv.py):
     same content-addressed target-dir model as pip, but resolved and
-    installed by the `uv` binary.  Resolution order is WORKER-LOCAL
-    first (this node's env/PATH), then the driver's setting riding the
-    env spec (also how tests inject a stub) — a driver-local path may
-    not exist on the worker's image."""
-    import shutil as _shutil
-
-    uv = os.environ.get("RAY_TPU_UV_BIN") or _shutil.which("uv") or uv_bin
+    installed by the `uv` binary (_resolve_bin precedence: driver's
+    setting when runnable here, else worker env/PATH)."""
+    uv = _resolve_bin(uv_bin, "RAY_TPU_UV_BIN", "uv")
     if not uv:
         raise RuntimeError(
             "runtime_env {'uv': ...} requires the `uv` binary on PATH "
@@ -299,8 +315,7 @@ def _build_conda_env(spec, conda_bin: Optional[str] = None) -> str:
     import shutil as _shutil
     import subprocess
 
-    conda = os.environ.get("RAY_TPU_CONDA_BIN") \
-        or _shutil.which("conda") or conda_bin
+    conda = _resolve_bin(conda_bin, "RAY_TPU_CONDA_BIN", "conda")
     if isinstance(spec, str) and os.path.isdir(spec):
         # an existing env PREFIX needs no conda binary at all
         return _conda_site_packages(spec)

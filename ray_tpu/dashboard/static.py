@@ -146,10 +146,12 @@ function sparkline(nodeId) {  // tiny inline chart per node row
 // ---- task timeline: lanes per worker, spans from state_ts ----
 const STATE_COLOR = {FINISHED: "#0a7d33", FAILED: "#c0262d",
                      RUNNING: "#3b6fd4"};
-function drawTimeline(records) {
+function drawTimeline(records, serverNow) {
   const c = document.getElementById("timeline"), g = c.getContext("2d");
   g.clearRect(0, 0, c.width, c.height);
-  const t1 = Date.now() / 1000, t0 = t1 - 60;
+  // anchor to the SERVER clock: event ts are cluster-host time, and a
+  // skewed viewer clock would shift or blank the chart
+  const t1 = serverNow || Date.now() / 1000, t0 = t1 - 60;
   const lanes = new Map();  // worker_id -> lane index
   const spans = [];
   for (const r of records || []) {
@@ -211,7 +213,7 @@ async function tick() {
       card("placement groups", pgs.length);
     pushSample(cs, nodes);
     drawUtil();
-    drawTimeline(tasks.records || []);
+    drawTimeline(tasks.records || [], tasks.now);
     for (const n of nodes || []) n.util = {__html: sparkline(n.node_id)};
     table("nodes", nodes, ["node_id", "addr", "state", "total", "available", "util", "labels"]);
     table("actors", actors, ["actor_id", "class_name", "name", "state", "node_id", "restarts"]);

@@ -177,44 +177,48 @@ class GBDTTrainer:
         datasets = dict(datasets or {})
         if "train" not in datasets:
             raise ValueError('datasets={"train": ...} is required')
-        ds = datasets["train"]
+        self._ds = datasets["train"]
         n_workers = (scaling_config or ScalingConfig()).num_workers
+        if hasattr(self._ds, "streaming_split") and n_workers > 1:
+            raise ValueError(
+                "GBDT training consumes the dataset on one worker; use "
+                "num_workers=1 with a ray_tpu.data Dataset (in-memory "
+                "frames may use more workers — extras idle)")
+        self._params = dict(params or {})
+        self._label_column = label_column
+        self._num_boost_round = num_boost_round
+        self._scaling_config = scaling_config or ScalingConfig(
+            num_workers=1)
+        self._run_config = run_config
+
+    def fit(self) -> Result:
+        ds = self._ds
         if hasattr(ds, "streaming_split"):
             # boosting consumes the WHOLE table on one worker anyway (the
             # reference materializes to a DMatrix in memory), so
-            # materialize DRIVER-side and ship the frame inline: simpler
-            # and avoids a per-fit streaming coordinator actor.
-            # Distributed (rabit-style) boosting is not implemented.
-            if n_workers > 1:
-                raise ValueError(
-                    "GBDT training consumes the dataset on one worker; "
-                    "use num_workers=1 with a ray_tpu.data Dataset "
-                    "(in-memory frames may use more workers — extras "
-                    "idle)")
-            # ship via the object store, not the config pickle: the ref
-            # crosses the wire once and restarts reuse it
+            # materialize driver-side AT FIT TIME (construction stays
+            # lazy/cheap) and ship via the object store: one upload,
+            # reused across elastic restarts.  Distributed (rabit-style)
+            # boosting is not implemented.
             import ray_tpu
 
             inline = ray_tpu.put(ds.to_pandas())
         else:
-            # plain in-memory data rides the config directly
-            inline = ds
-        self._trainer = JaxTrainer(
+            inline = ds  # plain in-memory data rides the config directly
+        trainer = JaxTrainer(
             _gbdt_loop,
             train_loop_config={
                 "framework": self.framework,
-                "params": dict(params or {}),
-                "label_column": label_column,
-                "num_boost_round": num_boost_round,
+                "params": self._params,
+                "label_column": self._label_column,
+                "num_boost_round": self._num_boost_round,
                 "dataset": inline,
             },
             datasets=None,
-            scaling_config=scaling_config or ScalingConfig(num_workers=1),
-            run_config=run_config,
+            scaling_config=self._scaling_config,
+            run_config=self._run_config,
         )
-
-    def fit(self) -> Result:
-        return self._trainer.fit()
+        return trainer.fit()
 
     @staticmethod
     def get_model(checkpoint: Checkpoint):
