@@ -70,21 +70,21 @@ const fmt = (x) => x === null || x === undefined ? "" :
   (typeof x === "object" ? JSON.stringify(x) : String(x));
 const esc = (s) => s.replace(/&/g, "&amp;").replace(/</g, "&lt;")
   .replace(/>/g, "&gt;").replace(/"/g, "&quot;");
+const RAW = Symbol("raw-html");  // unforgeable marker for page-built cells
 function table(el, rows, cols) {
   const t = document.getElementById(el);
   if (!rows || !rows.length) { t.innerHTML = "<tr><td>none</td></tr>"; return; }
   let h = "<tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("") + "</tr>";
   for (const r of rows.slice(0, 50)) {
     h += "<tr>" + cols.map(c => {
-      // ONLY the client-built "util" column may carry raw markup (the
-      // sparkline data URL generated in this page) — keying on a value
-      // shape would let workload-controlled dicts (node labels!) smuggle
-      // HTML; everything else is escaped BEFORE interpolation:
-      // entrypoints / actor names / error strings are
-      // workload-controlled (stored-XSS sink otherwise)
-      if (c === "util" && r[c] && typeof r[c] === "object"
-          && r[c].__html !== undefined)
-        return `<td>${r[c].__html}</td>`;
+      // ONLY cells built in this page may carry raw markup — keyed on a
+      // Symbol, which is unforgeable through JSON (server data can never
+      // produce it, so no column name or value shape reinstates the
+      // stored-XSS sink); everything else is escaped BEFORE
+      // interpolation: entrypoints / actor names / error strings are
+      // workload-controlled
+      if (r[c] && typeof r[c] === "object" && r[c][RAW] !== undefined)
+        return `<td>${r[c][RAW]}</td>`;
       const v = fmt(r[c]);
       const cls = /^(ALIVE|DEAD|PENDING|RESTARTING|RUNNING|SUCCEEDED|FAILED|FINISHED)$/.test(v) ? ` class="${v}"` : "";
       return `<td${cls}>${esc(v.slice(0, 80))}</td>`;
@@ -214,7 +214,7 @@ async function tick() {
     pushSample(cs, nodes);
     drawUtil();
     drawTimeline(tasks.records || [], tasks.now);
-    for (const n of nodes || []) n.util = {__html: sparkline(n.node_id)};
+    for (const n of nodes || []) n.util = {[RAW]: sparkline(n.node_id)};
     table("nodes", nodes, ["node_id", "addr", "state", "total", "available", "util", "labels"]);
     table("actors", actors, ["actor_id", "class_name", "name", "state", "node_id", "restarts"]);
     table("jobs", jobs, ["submission_id", "entrypoint", "status", "message"]);

@@ -1352,6 +1352,16 @@ class StreamingExecutor:
             yield from self._run_loop()
         finally:
             self.wall_s = time.perf_counter() - self._started
+            # abnormal exit (crash-loop RuntimeError, UDF exception, or
+            # the consumer abandoning the generator) must not leak pool
+            # actors — including replacements just spawned for dead ones
+            for op in self.ops:
+                shutdown = getattr(op, "_maybe_shutdown_pool", None)
+                if shutdown is not None:
+                    try:
+                        shutdown()
+                    except Exception:
+                        pass
 
     def _run_loop(self) -> Iterator[RefBundle]:
         # preserve_order: outputs stage in an order-heap and yield only

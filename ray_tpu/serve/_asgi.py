@@ -73,10 +73,12 @@ def run_asgi(app, request):
         "raw_path": request.route_path.encode(),
         "root_path": "",
         "scheme": "http",
-        "query_string": urllib.parse.urlencode(
-            request.query_params).encode(),
+        "query_string": (getattr(request, "query_string", b"")
+                         or urllib.parse.urlencode(
+                             request.query_params).encode()),
         "headers": [(k.lower().encode(), str(v).encode())
-                    for k, v in request.headers.items()],
+                    for k, v in getattr(request, "header_pairs", None)
+                    or request.headers.items()],
         "client": None,
         "server": None,
     }
@@ -165,6 +167,17 @@ def ingress(asgi_app):
         _ASGIIngress.__name__ = cls.__name__
         _ASGIIngress.__qualname__ = getattr(cls, "__qualname__",
                                             cls.__name__)
+        # the wrapper is defined HERE, so its __module__ would be this
+        # framework module — keep the user's module so the by-value
+        # pickling registration in serve.run sees driver-only code; the
+        # app object itself may live in yet another driver-only module,
+        # register its class too (FastAPI etc. are installed libs and
+        # skipped by the helper)
+        _ASGIIngress.__module__ = getattr(cls, "__module__",
+                                          _ASGIIngress.__module__)
+        from ray_tpu._private.common import _ensure_picklable_by_value
+
+        _ensure_picklable_by_value(type(asgi_app))
         return _ASGIIngress
 
     return decorator

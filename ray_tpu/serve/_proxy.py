@@ -18,7 +18,7 @@ import json
 import logging
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
 
@@ -47,11 +47,20 @@ class Request:
 
     def __init__(self, method: str, path: str, route_path: str,
                  query_params: Dict[str, str], headers: Dict[str, str],
-                 body: bytes):
+                 body: bytes, query_string: bytes = b"",
+                 header_pairs: Optional[List[Tuple[str, str]]] = None):
         self.method = method
         self.path = path            # full path
         self.route_path = route_path  # path with route prefix stripped
         self.query_params = query_params
+        # raw percent-encoded query string verbatim: repeated keys
+        # (?tag=a&tag=b) and escapes (%C3%A9, 1+2) survive here even
+        # though the query_params dict keeps only decoded last values
+        self.query_string = query_string
+        # ordered (name, value) pairs: repeated headers (Set-Cookie,
+        # X-Forwarded-For) survive here; the dict keeps only the last
+        self.header_pairs = (header_pairs if header_pairs is not None
+                             else list(headers.items()))
         self.headers = headers
         self._body = body
 
@@ -190,7 +199,10 @@ class HTTPProxy:
         route_path = path[len(prefix):] if prefix != "/" else path
         body = await request.read()
         req = Request(request.method, path, route_path or "/",
-                      dict(request.query), dict(request.headers), body)
+                      dict(request.query), dict(request.headers), body,
+                      query_string=(
+                          request.rel_url.raw_query_string.encode()),
+                      header_pairs=list(request.headers.items()))
         router = get_router(target["app"], target["deployment"])
         loop = asyncio.get_event_loop()
 
@@ -299,14 +311,21 @@ class HTTPProxy:
             return resp
         except Exception as e:
             logger.exception("streaming request to %s failed", req.path)
-            if resp is None:
+            if resp is None or not resp.prepared:
+                # nothing hit the wire yet (including prepare() itself
+                # failing): a plain 500 is still deliverable
                 return web.Response(status=500,
                                     text=f"{type(e).__name__}: {e}")
-            # headers already sent: terminate the stream
+            # headers already sent: abort the connection rather than
+            # emitting the normal chunked terminator — a clean write_eof
+            # would make the truncated body indistinguishable from a
+            # complete response for SSE/chunked consumers
             try:
-                await resp.write_eof()
+                if aio_req.transport is not None:
+                    aio_req.transport.close()
             except Exception:
                 pass
+            resp.force_close()
             return resp
         finally:
             done()

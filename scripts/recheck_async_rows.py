@@ -1,0 +1,65 @@
+"""Rerun the 1_n/n_n async actor rows (ADVICE r3 #5) to confirm the
+BENCH_TABLE magnitudes — is n_n_actor_calls_async really ~1.4k ops/s
+while 1_n does ~6.7k, or were the round-3 labels swapped?"""
+import os
+import sys
+import time
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=max(24, (os.cpu_count() or 2)),
+                 ignore_reinit_error=True)
+
+    @ray_tpu.remote
+    class Actor:
+        def m(self):
+            return None
+
+    def timed(n, fn):
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fn()
+            best = max(best, n / (time.perf_counter() - t0))
+        return round(best, 1)
+
+    def concurrent(n_threads, per_thread, fn):
+        def run():
+            errs = []
+
+            def body(t):
+                try:
+                    fn(t, per_thread)
+                except Exception as e:
+                    errs.append(e)
+            ts = [threading.Thread(target=body, args=(t,))
+                  for t in range(n_threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if errs:
+                raise errs[0]
+        return timed(n_threads * per_thread, run)
+
+    actors = [Actor.remote() for _ in range(4)]
+    ray_tpu.get([x.m.remote() for x in actors], timeout=60)
+    one_n = timed(2000, lambda: ray_tpu.get(
+        [actors[i % 4].m.remote() for i in range(2000)], timeout=300))
+    print("1_n_actor_calls_async", one_n, flush=True)
+
+    nn = [Actor.remote() for _ in range(4)]
+    ray_tpu.get([x.m.remote() for x in nn], timeout=60)
+    n_n = concurrent(4, 500, lambda t, n: ray_tpu.get(
+        [nn[(t + i) % 4].m.remote() for i in range(n)], timeout=300))
+    print("n_n_actor_calls_async", n_n, flush=True)
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
