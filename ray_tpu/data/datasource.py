@@ -8,7 +8,6 @@ worker.  Writes are map tasks that persist blocks and return paths.
 
 from __future__ import annotations
 
-import glob as _glob
 import os
 import uuid
 from dataclasses import dataclass, field
@@ -116,29 +115,22 @@ class BlocksDatasource(Datasource):
 
 
 def _expand_paths(paths, suffixes: Optional[List[str]] = None) -> List[str]:
-    if isinstance(paths, str):
-        paths = [paths]
-    out: List[str] = []
-    for p in paths:
-        if os.path.isdir(p):
-            for root, _, files in os.walk(p):
-                for f in sorted(files):
-                    out.append(os.path.join(root, f))
-        elif any(ch in p for ch in "*?["):
-            out.extend(sorted(_glob.glob(p)))
-        else:
-            out.append(p)
-    if suffixes:
-        out = [p for p in out
-               if any(p.endswith(s) for s in suffixes)] or out
-    if not out:
-        raise FileNotFoundError(f"no input files found for {paths!r}")
-    return out
+    """Local dirs/globs plus any fsspec scheme (s3://, gs://,
+    mock-remote://) — a TPU pod has no shared disk, so remote paths are
+    the ONLY way pod workers can all reach the same training data
+    (reference: file_based_datasource.py:65 resolves through pyarrow.fs).
+    """
+    from ray_tpu._private import fileio
+
+    return fileio.expand_paths(paths, suffixes)
 
 
 class FileBasedDatasource(Datasource):
     """One-or-more files per read task (reference:
-    python/ray/data/datasource/file_based_datasource.py)."""
+    python/ray/data/datasource/file_based_datasource.py).  Paths may be
+    local or any fsspec URI; read thunks re-resolve the filesystem on the
+    worker from the path's scheme (nothing host-specific is pickled).
+    """
 
     _suffixes: Optional[List[str]] = None
 
@@ -149,7 +141,21 @@ class FileBasedDatasource(Datasource):
     def _read_file(self, path: str, **kwargs) -> Block:
         raise NotImplementedError
 
+    def _plan_metadata(self, path: str):
+        """Optional plan-time (num_rows, size_bytes, schema) for one file
+        — parquet reads its footer; other formats return None and the
+        plan falls back to byte-size estimates (reference:
+        parquet_meta_provider.py vs DefaultFileMetadataProvider)."""
+        return None
+
+    # footer reads at plan time are capped: past this many files the
+    # per-file row counts are extrapolated from the sampled mean (the
+    # reference's meta provider samples similarly for huge file lists)
+    _PLAN_META_SAMPLE = 32
+
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        from ray_tpu._private import fileio
+
         paths = self._paths
         parallelism = max(1, min(parallelism, len(paths)))
         groups: List[List[str]] = [[] for _ in range(parallelism)]
@@ -157,6 +163,21 @@ class FileBasedDatasource(Datasource):
             groups[i % parallelism].append(p)
         read_file = self._read_file
         args = self._reader_args
+
+        meta_by_path = {}
+        sample = paths[:self._PLAN_META_SAMPLE]
+        for p in sample:
+            try:
+                meta_by_path[p] = self._plan_metadata(p)
+            except Exception:
+                meta_by_path[p] = None
+        sampled = [m for m in meta_by_path.values() if m is not None]
+        mean_rows = (sum(m[0] for m in sampled) / len(sampled)
+                     if sampled else None)
+        mean_size = (sum(m[1] for m in sampled) / len(sampled)
+                     if sampled else None)
+        plan_schema = sampled[0][2] if sampled else None
+
         tasks = []
         for group in groups:
             if not group:
@@ -166,11 +187,35 @@ class FileBasedDatasource(Datasource):
                 for p in group:
                     yield read_file(p, **args)
 
-            est = sum(os.path.getsize(p) for p in group
-                      if os.path.exists(p))
+            rows = 0
+            size = 0
+            exact = bool(sampled)
+            for p in group:
+                m = meta_by_path.get(p)
+                if m is not None:
+                    rows += m[0]
+                    size += m[1]
+                elif mean_rows is not None:
+                    # beyond the sample cap: extrapolate BOTH rows and
+                    # bytes from the sampled means (no extra IO at plan
+                    # time for 10k-file reads)
+                    rows += int(mean_rows)
+                    size += int(mean_size)
+                    exact = False
+                else:
+                    exact = False
+            if not sampled:
+                size = sum(fileio.filesize(p) or 0 for p in group)
             tasks.append(ReadTask(read, BlockMetadata(
-                num_rows=0, size_bytes=est, input_files=group)))
+                num_rows=rows, size_bytes=size, schema=plan_schema,
+                input_files=group, exec_stats={"rows_exact": exact})))
         return tasks
+
+
+def _open(path: str, mode: str = "rb"):
+    from ray_tpu._private import fileio
+
+    return fileio.open_file(path, mode)
 
 
 class ParquetDatasource(FileBasedDatasource):
@@ -179,7 +224,21 @@ class ParquetDatasource(FileBasedDatasource):
     def _read_file(self, path: str, columns=None, **kw) -> Block:
         import pyarrow.parquet as pq
 
-        return pq.read_table(path, columns=columns)
+        with _open(path) as f:
+            return pq.read_table(f, columns=columns)
+
+    def _plan_metadata(self, path: str):
+        """Row count + schema from the parquet footer — a few KB read,
+        no data pages touched (reference: parquet_meta_provider.py)."""
+        import pyarrow.parquet as pq
+
+        with _open(path) as f:
+            pf = pq.ParquetFile(f)
+            return (pf.metadata.num_rows,
+                    pf.metadata.serialized_size
+                    + sum(pf.metadata.row_group(i).total_byte_size
+                          for i in range(pf.metadata.num_row_groups)),
+                    pf.schema_arrow)
 
 
 class CSVDatasource(FileBasedDatasource):
@@ -188,7 +247,8 @@ class CSVDatasource(FileBasedDatasource):
     def _read_file(self, path: str, **kw) -> Block:
         import pyarrow.csv as pcsv
 
-        return pcsv.read_csv(path)
+        with _open(path) as f:
+            return pcsv.read_csv(f)
 
 
 class JSONDatasource(FileBasedDatasource):
@@ -197,14 +257,17 @@ class JSONDatasource(FileBasedDatasource):
     def _read_file(self, path: str, **kw) -> Block:
         import pyarrow.json as pjson
 
-        return pjson.read_json(path)
+        with _open(path) as f:
+            return pjson.read_json(f)
 
 
 class TextDatasource(FileBasedDatasource):
     def _read_file(self, path: str, encoding="utf-8", drop_empty_lines=True,
                    **kw) -> Block:
-        with open(path, "r", encoding=encoding) as f:
-            lines = f.read().split("\n")
+        with _open(path) as f:
+            # splitlines = universal newlines (\n, \r\n, \r) — the bytes
+            # come straight off the remote fs with no text-mode layer
+            lines = f.read().decode(encoding).splitlines()
         if drop_empty_lines:
             lines = [ln for ln in lines if ln.strip()]
         return pa.table({"text": lines})
@@ -212,7 +275,7 @@ class TextDatasource(FileBasedDatasource):
 
 class BinaryDatasource(FileBasedDatasource):
     def _read_file(self, path: str, include_paths=False, **kw) -> Block:
-        with open(path, "rb") as f:
+        with _open(path) as f:
             data = f.read()
         cols = {"bytes": [data]}
         if include_paths:
@@ -226,7 +289,8 @@ class NumpyDatasource(FileBasedDatasource):
     def _read_file(self, path: str, **kw) -> Block:
         from .block import batch_to_block
 
-        return batch_to_block({"data": np.load(path)})
+        with _open(path) as f:
+            return batch_to_block({"data": np.load(f)})
 
 
 # ---------------------------------------------------------------------------
@@ -234,31 +298,42 @@ class NumpyDatasource(FileBasedDatasource):
 
 def write_block(block: Block, path: str, file_format: str,
                 **writer_args) -> str:
-    os.makedirs(path, exist_ok=True)
-    fname = os.path.join(path, f"{uuid.uuid4().hex[:12]}.{file_format}")
+    """Persist one block under `path` (local dir or fsspec URI — pod
+    workers write their shard straight to the remote fs; reference:
+    file_datasink.py)."""
+    from ray_tpu._private import fileio
+
+    fileio.makedirs(path)
+    sep = "/" if fileio.is_uri(path) else os.sep
+    fname = f"{path.rstrip(sep)}{sep}{uuid.uuid4().hex[:12]}.{file_format}"
     if file_format == "parquet":
         import pyarrow.parquet as pq
 
-        pq.write_table(block, fname, **writer_args)
+        with fileio.open_file(fname, "wb") as f:
+            pq.write_table(block, f, **writer_args)
     elif file_format == "csv":
         import pyarrow.csv as pcsv
 
-        pcsv.write_csv(block, fname)
+        with fileio.open_file(fname, "wb") as f:
+            pcsv.write_csv(block, f)
     elif file_format == "json":
         df = block.to_pandas()
-        df.to_json(fname, orient="records", lines=True)
+        text = df.to_json(orient="records", lines=True)
+        with fileio.open_file(fname, "wb") as f:
+            f.write(text.encode())
     elif file_format == "npy":
         from .block import BlockAccessor
 
         cols = BlockAccessor(block).to_numpy()
-        if len(cols) == 1:
-            np.save(fname, next(iter(cols.values())))
-        else:
-            np.save(fname, cols, allow_pickle=True)
+        with fileio.open_file(fname, "wb") as f:
+            if len(cols) == 1:
+                np.save(f, next(iter(cols.values())))
+            else:
+                np.save(f, cols, allow_pickle=True)
     elif file_format == "tfrecords":
         from .block import BlockAccessor
 
-        with open(fname, "wb") as f:
+        with fileio.open_file(fname, "wb") as f:
             for row in BlockAccessor(block).iter_rows():
                 _tfrecord_write(f, _example_encode(row))
     else:
@@ -461,7 +536,7 @@ class TFRecordsDatasource(FileBasedDatasource):
 
     def _read_file(self, path: str, **kw) -> Block:
         rows = []
-        with open(path, "rb") as f:
+        with _open(path) as f:
             for payload in _tfrecord_read(f):
                 rows.append(_example_decode(payload))
         return rows_to_block(rows)
@@ -478,7 +553,9 @@ class ImagesDatasource(FileBasedDatasource):
 
         from .block import batch_to_block
 
-        img = Image.open(path)
+        with _open(path) as f:
+            img = Image.open(f)
+            img.load()
         if mode:
             img = img.convert(mode)
         if size:

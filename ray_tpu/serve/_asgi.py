@@ -177,7 +177,10 @@ def ingress(asgi_app):
                                           _ASGIIngress.__module__)
         from ray_tpu._private.common import _ensure_picklable_by_value
 
-        _ensure_picklable_by_value(type(asgi_app))
+        # the app itself, not type(app): instances resolve __module__
+        # through their class, and function-style ASGI apps carry their
+        # defining module directly (type() would say builtins.function)
+        _ensure_picklable_by_value(asgi_app)
         return _ASGIIngress
 
     return decorator

@@ -32,57 +32,13 @@ __all__ = [
 ]
 
 
-def is_uri(path: str) -> bool:
-    return "://" in (path or "")
-
-
-def _fs_and_path(uri: str):
-    """fsspec filesystem + in-fs path for a URI."""
-    import fsspec
-
-    _register_mock_remote()
-    fs, p = fsspec.core.url_to_fs(uri)
-    return fs, p
-
-
-_mock_registered = False
-_reg_lock = threading.Lock()
-
-
-def _register_mock_remote() -> None:
-    """Register the test/dev `mock-remote://` scheme (idempotent).
-
-    `mock-remote:///abs/dir/...` persists under /abs/dir but is reachable
-    ONLY through the fsspec API, so code paths proven against it hold for
-    any real remote scheme (s3/gs via their fsspec drivers)."""
-    global _mock_registered
-    with _reg_lock:
-        if _mock_registered:
-            return
-        import fsspec
-        from fsspec.implementations.local import LocalFileSystem
-
-        class MockRemoteFileSystem(LocalFileSystem):
-            protocol = "mock-remote"
-
-            def __init__(self, **kw):
-                kw.pop("auto_mkdir", None)
-                super().__init__(auto_mkdir=True, **kw)
-
-            @classmethod
-            def _strip_protocol(cls, path):
-                path = str(path)
-                if path.startswith("mock-remote://"):
-                    path = path[len("mock-remote://"):]
-                return LocalFileSystem._strip_protocol(path)
-
-        try:
-            fsspec.register_implementation("mock-remote",
-                                           MockRemoteFileSystem,
-                                           clobber=True)
-        except Exception:
-            pass
-        _mock_registered = True
+# scheme dispatch + mock-remote:// live in _private.fileio so that
+# ray_tpu.data shares the exact same resolution path (one registration,
+# one set of semantics for every byte that leaves the host)
+from ray_tpu._private.fileio import fs_for as _fs_and_path  # noqa: E402
+from ray_tpu._private.fileio import is_uri  # noqa: F401,E402
+from ray_tpu._private.fileio import \
+    register_mock_remote as _register_mock_remote  # noqa: F401,E402
 
 
 def join(base: str, *parts: str) -> str:
