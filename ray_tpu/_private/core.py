@@ -457,6 +457,14 @@ class CoreWorker:
         # cached clients to remote raylets (see _remote_raylet_client)
         self._remote_raylets: Dict[Tuple[str, int], Client] = {}
         self.pool_executor = DaemonPool(max_workers=8, name="core")
+        # object serving NEVER shares threads with scheduling: a
+        # request_lease call can park its pool thread for up to 120 s on
+        # the raylet's deferred grant, and with the shared pool full of
+        # those, broadcast consumers waiting on h_get_object starved —
+        # blocked workers lent CPU, the raylet granted MORE leases, the
+        # driver parked MORE threads: a livelock (found via the 8 MiB
+        # x200 broadcast-fanout envelope test)
+        self.obj_serve_pool = DaemonPool(max_workers=4, name="core-obj")
         self._put_seq = 0
         self._blocked_depth = 0
         self._executing = threading.local()
@@ -1170,7 +1178,13 @@ class CoreWorker:
             d.resolve({"kind": "pending"})
             return
         if e.ready:
-            self.pool_executor.submit(self._reply_get_object, e, oid, d)
+            if e.error is None and e.has_value is False \
+                    and e.shm_node is not None:
+                # shm redirect: a tiny dict, safe on the loop thread —
+                # the hot broadcast path never waits on any pool
+                self._reply_get_object(e, oid, d)
+            else:
+                self.obj_serve_pool.submit(self._reply_get_object, e, oid, d)
         else:
             # pending objects wait on a dedicated thread so they can never
             # starve the shared pool (lease requests, actor resolution)

@@ -1,15 +1,45 @@
-"""Scalability envelope at CI scale (reference: release/benchmarks —
-10,000 args to one task, 3,000 returns, 10,000-object get, 1M queued
-tasks; here scaled to the 1-core test box but exercising the same
-mechanisms: arg fan-in resolution, wide num_returns, bulk get, deep
-queues)."""
+"""Scalability envelope at the reference's single-node COUNTS
+(reference: release/benchmarks + scalability/single_node.json —
+10,000 args to one task in 18.0s, 3,000 returns in 5.85s, 10,000-object
+get in 24.7s, 1,000,000 queued tasks in 201.2s, all on a 64-vCPU node).
+
+This 2-CPU box cannot match the reference's *rates*, but it can and must
+match the *counts*: arg fan-in resolution at 10k, wide num_returns at 3k,
+bulk get at 10k objects, a 100k-deep task queue with bounded
+control-plane memory, and broadcast fan-out of one large object.
+Wall-clock budgets are enforced via get() timeouts.
+"""
+
+import os
+import time
 
 import numpy as np
 
 import ray_tpu
 
 
-def test_many_args_to_single_task(ray_cluster):
+def _rss_mb(pid: int) -> float:
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+def _control_pid() -> int:
+    import subprocess
+
+    out = subprocess.run(
+        ["pgrep", "-f", "ray_tpu._private.control"],
+        capture_output=True, text=True)
+    pids = [int(p) for p in out.stdout.split()]
+    assert pids, "control daemon not found"
+    return pids[0]
+
+
+def test_many_args_to_single_task_10k(ray_cluster):
+    """Reference count: 10,000 refs as args to ONE task (owner-side arg
+    resolution must fan-in all of them)."""
     @ray_tpu.remote
     def make(i):
         return i
@@ -18,13 +48,14 @@ def test_many_args_to_single_task(ray_cluster):
     def consume(*xs):
         return sum(xs)
 
-    refs = [make.remote(i) for i in range(1000)]
-    assert ray_tpu.get(consume.remote(*refs), timeout=300) == \
-        sum(range(1000))
+    refs = [make.remote(i) for i in range(10_000)]
+    assert ray_tpu.get(consume.remote(*refs), timeout=600) == \
+        sum(range(10_000))
 
 
-def test_many_returns_from_single_task(ray_cluster):
-    n = 500
+def test_many_returns_from_single_task_3k(ray_cluster):
+    """Reference count: 3,000 return values from one task."""
+    n = 3_000
 
     @ray_tpu.remote(num_returns=n)
     def burst():
@@ -32,23 +63,67 @@ def test_many_returns_from_single_task(ray_cluster):
 
     refs = burst.remote()
     assert len(refs) == n
-    vals = ray_tpu.get(refs, timeout=300)
+    vals = ray_tpu.get(refs, timeout=600)
     assert vals == list(range(n))
 
 
-def test_bulk_get(ray_cluster):
-    refs = [ray_tpu.put(np.full(8, i)) for i in range(2000)]
-    out = ray_tpu.get(refs, timeout=300)
-    assert len(out) == 2000
+def test_bulk_get_10k(ray_cluster):
+    """Reference count: one ray.get over 10,000 objects."""
+    refs = [ray_tpu.put(np.full(8, i)) for i in range(10_000)]
+    out = ray_tpu.get(refs, timeout=600)
+    assert len(out) == 10_000
     assert int(out[1234][0]) == 1234
+    assert int(out[9999][0]) == 9999
 
 
-def test_deep_task_queue(ray_cluster):
+def test_queued_tasks_100k_bounded_memory(ray_cluster):
+    """100k+ tasks queued at once (reference envelope: 1M on 64 vCPU)
+    with BOUNDED control-plane memory: driver and control-daemon RSS
+    growth while the queue is deep must stay far below per-task-payload
+    scale (~the queue is descriptors, not data)."""
     @ray_tpu.remote
     def tick(i):
         return i
 
-    n = 10000
+    n = 100_000
+    ctl = _control_pid()
+    rss0_driver = _rss_mb(os.getpid())
+    rss0_ctl = _rss_mb(ctl)
+
+    t0 = time.perf_counter()
     refs = [tick.remote(i) for i in range(n)]
-    out = ray_tpu.get(refs, timeout=600)
+    submit_s = time.perf_counter() - t0
+
+    rss_driver = _rss_mb(os.getpid()) - rss0_driver
+    rss_ctl = _rss_mb(ctl) - rss0_ctl
+    # 100k queued descriptors: generous bounds that still catch
+    # per-task buffering of anything payload-sized (each MB here is
+    # ~10 bytes/task)
+    assert rss_driver < 600, f"driver grew {rss_driver:.0f} MB"
+    assert rss_ctl < 300, f"control grew {rss_ctl:.0f} MB"
+
+    out = ray_tpu.get(refs, timeout=900)
     assert out == list(range(n))
+    total_s = time.perf_counter() - t0
+    # sanity budget: the reference does 1M/201s on 64 vCPUs (~5k/s);
+    # require forward progress, not parity, on 2 cores
+    assert total_s < 600, f"100k queue took {total_s:.0f}s"
+    print(f"queued_100k: submit {submit_s:.1f}s total {total_s:.1f}s "
+          f"driver +{rss_driver:.0f}MB control +{rss_ctl:.0f}MB")
+
+
+def test_broadcast_fanout_large_object(ray_cluster):
+    """One put object consumed by many tasks at once: the object moves
+    into shared memory ONCE and every consumer maps it (reference:
+    single-node broadcast envelope)."""
+    blob = np.random.RandomState(0).bytes(8 * 1024 * 1024)  # 8 MiB
+    ref = ray_tpu.put(blob)
+
+    @ray_tpu.remote
+    def probe(b, i):
+        return (len(b), i)
+
+    refs = [probe.remote(ref, i) for i in range(200)]
+    out = ray_tpu.get(refs, timeout=600)
+    assert [i for _, i in out] == list(range(200))
+    assert all(n == len(blob) for n, _ in out)
