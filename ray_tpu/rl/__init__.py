@@ -27,6 +27,8 @@ from .env.multi_agent_env import (CooperativeMatchEnv, MultiAgentEnv,
                                   MultiAgentEnvRunnerGroup)
 from .env.multi_agent_env import register_env as register_multi_agent_env
 from .utils.replay_buffer import ReplayBuffer
+from . import connectors
+from .offline import OfflineData, record_rollouts
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
@@ -40,4 +42,5 @@ __all__ = [
     "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentEnvRunnerGroup",
     "MultiAgentPPO", "MultiAgentPPOConfig", "MultiRLModule",
     "CooperativeMatchEnv", "register_multi_agent_env",
+    "connectors", "OfflineData", "record_rollouts",
 ]

@@ -35,6 +35,10 @@ class AlgorithmConfig:
         self.runner_kind = "jax"          # "jax" | "gym"
         self.num_learners = 0             # 0 = local learner
         self.rollout_len = 128            # steps per env per iteration
+        #: zero-arg factories for connector pipelines (reference:
+        #: AlgorithmConfig.env_runners(env_to_module_connector=...))
+        self.env_to_module_connector = None
+        self.module_to_env_connector = None
         self.lr = 3e-4
         self.gamma = 0.99
         self.seed = 0
@@ -50,10 +54,16 @@ class AlgorithmConfig:
 
     def env_runners(self, num_env_runners: int = 0, *,
                     num_envs_per_runner: int = 8,
-                    runner_kind: str = "jax"):
+                    runner_kind: str = "jax",
+                    env_to_module_connector=None,
+                    module_to_env_connector=None):
         self.num_env_runners = num_env_runners
         self.num_envs_per_runner = num_envs_per_runner
         self.runner_kind = runner_kind
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
+        if module_to_env_connector is not None:
+            self.module_to_env_connector = module_to_env_connector
         return self
 
     def learners(self, num_learners: int = 0):
@@ -129,6 +139,12 @@ class Algorithm:
     def __init__(self, config: AlgorithmConfig):
         self.config = config
         self.iteration = 0
+        # connector factories (reference: env_to_module_connector config
+        # arg): built once here, pickled per remote runner — so stateful
+        # connectors (NormalizeObs) end up with independent state per
+        # runner actor
+        e2m = config.env_to_module_connector
+        m2e = config.module_to_env_connector
         self.runners = EnvRunnerGroup(
             env_name=config.env_name,
             module_spec={"kind": self.module_kind, "hidden": config.hidden,
@@ -138,6 +154,8 @@ class Algorithm:
             runner_kind=config.runner_kind,
             seed=config.seed,
             explore_kwargs=self._explore_kwargs(),
+            env_to_module=e2m() if e2m else None,
+            module_to_env=m2e() if m2e else None,
         )
         self.env_spec = self.runners.env_spec()
         self._setup()

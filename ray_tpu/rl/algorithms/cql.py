@@ -127,13 +127,14 @@ class CQL(Algorithm):
 
         self.learner_group = LearnerGroup(factory, cfg.num_learners)
         self.runners.sync_weights(self.learner_group.get_weights())
-        self._offline: List[Dict[str, np.ndarray]] = []
-        if cfg.offline_data is not None:
-            for item in cfg.offline_data:
-                if "next_obs" not in item:
-                    item = transitions_from_rollout(item)
-                self._offline.append(
-                    {k: np.asarray(v) for k, v in item.items()})
+        from ray_tpu.rl.offline import resolve_offline_data
+
+        # file paths / OfflineData / Dataset / legacy in-memory iterable
+        # all land here as flat numpy transition batches (reference:
+        # offline_data.py:22 feeds ray.data into the learner)
+        self._offline: List[Dict[str, np.ndarray]] = resolve_offline_data(
+            cfg.offline_data, gamma=cfg.gamma,
+            batch_size=cfg.minibatch_size)
         self._rng = np.random.RandomState(cfg.seed)
 
     def _offline_minibatches(self):

@@ -116,13 +116,13 @@ class MARWIL(Algorithm):
 
         self.learner_group = LearnerGroup(factory, cfg.num_learners)
         self.runners.sync_weights(self.learner_group.get_weights())
-        self._offline: List[Dict[str, np.ndarray]] = []
-        if cfg.offline_data is not None:
-            for item in cfg.offline_data:
-                if "return" not in item:
-                    item = episodes_to_batch(item, cfg.gamma)
-                self._offline.append(
-                    {k: np.asarray(v) for k, v in item.items()})
+        from ray_tpu.rl.offline import resolve_offline_data
+
+        # file paths / OfflineData / Dataset / legacy in-memory iterable
+        # (reference: offline_data.py:22 feeds ray.data into the learner)
+        self._offline: List[Dict[str, np.ndarray]] = resolve_offline_data(
+            cfg.offline_data, gamma=cfg.gamma,
+            batch_size=cfg.minibatch_size, want_return=True)
         self._rng = np.random.RandomState(cfg.seed)
 
     def _offline_minibatches(self):

@@ -55,11 +55,17 @@ class _EpisodeTracker:
 
 
 class JaxEnvRunner:
-    """Sampling over pure-jax envs; the rollout is one compiled scan."""
+    """Sampling over pure-jax envs; the rollout is one compiled scan.
+
+    `env_to_module` (a ConnectorV2/pipeline) runs on observations INSIDE
+    the jitted scan, so it must be traceable; stateful host-side
+    connectors (NormalizeObs) belong on GymEnvRunner.
+    """
 
     def __init__(self, env_name: str, module_spec: Dict[str, Any],
                  num_envs: int = 8, seed: int = 0,
-                 explore_kwargs: Optional[Dict[str, Any]] = None):
+                 explore_kwargs: Optional[Dict[str, Any]] = None,
+                 env_to_module=None):
         import jax
 
         from ray_tpu.rl.core.rl_module import module_for_env
@@ -73,6 +79,13 @@ class JaxEnvRunner:
                                                             (64, 64)))
         self.num_envs = num_envs
         self.explore_kwargs = explore_kwargs or {}
+        if env_to_module is not None and not env_to_module.traceable:
+            raise ValueError(
+                "JaxEnvRunner connectors run inside the jitted rollout "
+                f"scan and must be traceable; {env_to_module!r} is not "
+                "(use GymEnvRunner for stateful connectors like "
+                "NormalizeObs)")
+        self.env_to_module = env_to_module
         self.params = self.module.init(jax.random.PRNGKey(seed))
         self.carry = jax_env.init_carry(self.env, jax.random.PRNGKey(seed + 1),
                                         num_envs)
@@ -86,8 +99,11 @@ class JaxEnvRunner:
         # retrace every call
         kwargs = dict(self.explore_kwargs)
         module = self.module
+        e2m = self.env_to_module
 
         def policy_fn(params, obs, rng):
+            if e2m is not None:
+                obs = e2m(obs)
             return module.forward_exploration(params, obs, rng, **kwargs)
 
         self._policy_fn = policy_fn
@@ -114,8 +130,21 @@ class JaxEnvRunner:
 
         self.carry, batch = rollout(self.env, self._policy_fn, self.params,
                                     self.carry, num_steps)
+        if self.env_to_module is not None:
+            # the rollout records RAW env obs; the policy sampled from
+            # TRANSFORMED obs (the connector runs inside policy_fn) — the
+            # learner must see the same representation actions came from,
+            # or importance ratios / value targets are silently wrong.
+            # Connectors are written against [B, ...]; collapse [T, B]
+            # so FlattenObs-style shape ops see one batch axis.
+            obs = batch["obs"]
+            tb = obs.shape[:2]
+            flat = self.env_to_module(obs.reshape(-1, *obs.shape[2:]))
+            batch["obs"] = flat.reshape(*tb, *flat.shape[1:])
         # bootstrap value for the obs after the last step (GAE tail)
         final_obs = self.carry[1]
+        if self.env_to_module is not None:
+            final_obs = self.env_to_module(final_obs)
         if hasattr(self.module, "value"):
             batch["final_vf"] = self.module.value(self.params, final_obs)
         batch = jax.tree_util.tree_map(np.asarray, batch)
@@ -132,10 +161,13 @@ class GymEnvRunner:
 
     def __init__(self, env_name: str, module_spec: Dict[str, Any],
                  num_envs: int = 8, seed: int = 0,
-                 explore_kwargs: Optional[Dict[str, Any]] = None):
+                 explore_kwargs: Optional[Dict[str, Any]] = None,
+                 env_to_module=None, module_to_env=None):
         import gymnasium as gym
         import jax
 
+        from ray_tpu.rl.connectors import (default_env_to_module,
+                                           default_module_to_env)
         from ray_tpu.rl.core.rl_module import module_for_env
 
         self.envs = gym.vector.SyncVectorEnv(
@@ -152,6 +184,12 @@ class GymEnvRunner:
                                                             (64, 64)))
         self.num_envs = num_envs
         self.explore_kwargs = explore_kwargs or {}
+        # obs/action handling as composable pipelines (reference:
+        # connectors/env_to_module/, module_to_env/) — not hardcoded here
+        self.env_to_module = (env_to_module if env_to_module is not None
+                              else default_env_to_module())
+        self.module_to_env = (module_to_env if module_to_env is not None
+                              else default_module_to_env())
         self.params = self.module.init(jax.random.PRNGKey(seed))
         self.rng = jax.random.PRNGKey(seed + 1)
         self.obs, _ = self.envs.reset(seed=seed)
@@ -177,10 +215,10 @@ class GymEnvRunner:
         rows = []
         for _ in range(num_steps):
             self.rng, act_rng = jax.random.split(self.rng)
-            obs = jnp.asarray(self.obs, jnp.float32)
+            obs = jnp.asarray(self.env_to_module(self.obs))
             action, extras = self.module.forward_exploration(
                 self.params, obs, act_rng, **self.explore_kwargs)
-            action_np = np.asarray(action)
+            action_np = self.module_to_env(action)
             next_obs, reward, term, trunc, _ = self.envs.step(action_np)
             done = np.logical_or(term, trunc)
             rows.append({"obs": np.asarray(obs), "action": action_np,
@@ -190,8 +228,13 @@ class GymEnvRunner:
             self.obs = next_obs
         batch = {k: np.stack([r[k] for r in rows]) for k in rows[0]}
         if hasattr(self.module, "value"):
+            # bootstrap obs goes through the SAME pipeline the recorded
+            # obs did (the value net was trained on transformed obs);
+            # no_update so stateful filters don't double-count it when
+            # the next sample() transforms it again
+            fin = self.env_to_module(self.obs, {"no_update": True})
             batch["final_vf"] = np.asarray(self.module.value(
-                self.params, jnp.asarray(self.obs, jnp.float32)))
+                self.params, jnp.asarray(fin, jnp.float32)))
         self.tracker.update(batch["reward"], batch["done"])
         self._steps_sampled += num_steps * self.num_envs
         stats = self.tracker.pop_stats()
@@ -211,13 +254,29 @@ class EnvRunnerGroup:
                  num_runners: int = 2, num_envs_per_runner: int = 8,
                  runner_kind: str = "jax", seed: int = 0,
                  explore_kwargs: Optional[Dict[str, Any]] = None,
-                 local: bool = False):
+                 local: bool = False, env_to_module=None,
+                 module_to_env=None):
+        conn_kw: Dict[str, Any] = {}
+        if env_to_module is not None:
+            conn_kw["env_to_module"] = env_to_module
+        if module_to_env is not None:
+            if runner_kind == "jax":
+                # jax rollouts feed actions straight back into the jitted
+                # env step — there is no host boundary for this hook, and
+                # silently dropping it would train differently than the
+                # same config on the gym runner
+                raise ValueError(
+                    "module_to_env connectors are not supported with "
+                    "runner_kind='jax' (actions never cross a host "
+                    "boundary inside the compiled rollout); use "
+                    "runner_kind='gym' or drop the connector")
+            conn_kw["module_to_env"] = module_to_env
         self.local = local or num_runners == 0
         if self.local:
             self.runner = make_runner(
                 runner_kind, env_name=env_name, module_spec=module_spec,
                 num_envs=num_envs_per_runner, seed=seed,
-                explore_kwargs=explore_kwargs)
+                explore_kwargs=explore_kwargs, **conn_kw)
             self.actors = []
         else:
             RemoteRunner = ray_tpu.remote(
@@ -226,7 +285,7 @@ class EnvRunnerGroup:
                 RemoteRunner.remote(
                     env_name=env_name, module_spec=module_spec,
                     num_envs=num_envs_per_runner, seed=seed + 1000 * i,
-                    explore_kwargs=explore_kwargs)
+                    explore_kwargs=explore_kwargs, **conn_kw)
                 for i in range(num_runners)
             ]
 

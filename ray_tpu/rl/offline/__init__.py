@@ -1,0 +1,8 @@
+"""Offline-RL data path (reference: rllib/offline/ — OfflineData reads
+ray.data datasets of logged experience into learners; offline_env_runner
+records rollouts back out as files)."""
+
+from .offline_data import (OfflineData, record_rollouts,
+                           resolve_offline_data)
+
+__all__ = ["OfflineData", "record_rollouts", "resolve_offline_data"]
