@@ -626,8 +626,20 @@ def _extras_main():
         if not landed["resnet"]:
             rfb = _run_resnet_subprocess(timeout_s=300.0, cpu=True)
             rfb["resnet_platform"] = "cpu-fallback"
-            print(json.dumps({"resnet_cpu_fallback": rfb}), flush=True)
+            rout = {"resnet_cpu_fallback": rfb}
+            if "resnet_train_images_per_s" not in _cache_get("resnet") \
+                    and "resnet_train_images_per_s" in rfb:
+                # mirror the GPT path: promote a headline row so the
+                # metric is never absent just because no cache exists
+                rout["resnet_train_images_per_s"] = \
+                    rfb["resnet_train_images_per_s"]
+                rout["resnet_row_source"] = "cpu_fallback"
+            print(json.dumps(rout), flush=True)
 
+        # emit the probe log NOW: the recovery stages below can exceed
+        # the parent's timeout, and the retry evidence must survive that
+        print(json.dumps({"accelerator_probe_log": _PROBE_LOG}),
+              flush=True)
         # the wedge is transient: the tunnel has been seen coming back
         # mid-session, and several minutes of fallback work just passed —
         # probe once more before giving up on a real-chip number
@@ -648,6 +660,17 @@ def _extras_main():
 # ---------------------------------------------------------------------------
 
 BASELINES = {
+    # envelope rows: reference scalability/single_node.json wall times
+    # converted to counts/s (10k args/18.0s, 3k returns/5.85s,
+    # 10k get/24.7s) on the 64-vCPU node.  The queued-tasks baseline is
+    # the reference's 1M-task RATE (1,000,000/201.2s) while this table
+    # measures a 100k-task run — a rate comparison across different
+    # queue depths, not an identical workload (deeper queues carry more
+    # backlog pressure; see notes in the emitted table)
+    "envelope_10k_args_per_s": 555.6,
+    "envelope_3k_returns_per_s": 512.8,
+    "envelope_10k_get_per_s": 404.9,
+    "envelope_100k_queued_per_s": 4970.2,
     "single_client_tasks_sync": 942.0,
     "single_client_tasks_async": 7998.0,
     "1_1_actor_calls_sync": 1935.0,
@@ -868,6 +891,47 @@ def bench_table() -> dict:
             ray_tpu.util.remove_placement_group(pg)
     rows["placement_group_create_removal"] = _timed(20, pg_churn)
 
+    # single-node scalability envelope at reference COUNTS (reference:
+    # scalability/single_node.json wall seconds, inverted to counts/s so
+    # vs_baseline keeps this table's higher-is-better convention); runs
+    # in the session the PG block already holds
+
+    @ray_tpu.remote
+    def env_make(i):
+        return i
+
+    @ray_tpu.remote
+    def env_consume(*xs):
+        return len(xs)
+
+    t0 = time.perf_counter()
+    arg_refs = [env_make.remote(i) for i in range(10_000)]
+    assert ray_tpu.get(env_consume.remote(*arg_refs), timeout=600) == 10_000
+    rows["envelope_10k_args_per_s"] = 10_000 / (time.perf_counter() - t0)
+    del arg_refs
+
+    @ray_tpu.remote(num_returns=3000)
+    def env_burst():
+        return list(range(3000))
+
+    t0 = time.perf_counter()
+    vals = ray_tpu.get(env_burst.remote(), timeout=600)
+    assert len(vals) == 3000
+    rows["envelope_3k_returns_per_s"] = 3000 / (time.perf_counter() - t0)
+
+    objs = [ray_tpu.put(np.full(8, i)) for i in range(10_000)]
+    t0 = time.perf_counter()
+    assert len(ray_tpu.get(objs, timeout=600)) == 10_000
+    rows["envelope_10k_get_per_s"] = 10_000 / (time.perf_counter() - t0)
+    del objs
+
+    t0 = time.perf_counter()
+    q_refs = [env_make.remote(i) for i in range(100_000)]
+    ray_tpu.get(q_refs, timeout=900)
+    rows["envelope_100k_queued_per_s"] = \
+        100_000 / (time.perf_counter() - t0)
+    del q_refs
+
     ray_tpu.shutdown()
     try:
         rows["single_client_put_gigabytes"] = bench_put_bandwidth()
@@ -903,7 +967,10 @@ def bench_table() -> dict:
             "multi-client aggregate cannot exceed single-client for "
             "memory-bound rows (put_gigabytes) — the reference's "
             "multi>single ratios come from 64 cores of headroom, not "
-            "from the store's design; see per-cpu columns."),
+            "from the store's design; see per-cpu columns. "
+            "envelope_100k_queued_per_s compares against the "
+            "reference's 1M-task rate (1M/201.2s) — a rate comparison "
+            "across different queue depths, not an identical workload."),
         "rows": {},
         "tasks_async_vs_num_workers": curve,
     }

@@ -1,0 +1,11 @@
+"""ray_tpu.air: shared train/tune runtime pieces (reference:
+python/ray/air/ — RunConfig & co. live in train/config.py here; this
+package carries the experiment-tracking integrations)."""
+
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig,
+                                  RunConfig, ScalingConfig)
+
+from . import integrations
+
+__all__ = ["CheckpointConfig", "FailureConfig", "RunConfig",
+           "ScalingConfig", "integrations"]

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 
 @dataclass
@@ -89,6 +89,11 @@ class RunConfig:
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     stop: Optional[Dict[str, Any]] = None  # e.g. {"training_iteration": 10}
     verbose: int = 1
+    #: experiment callbacks (tune.Callback subclasses — e.g. the
+    #: TBX/W&B/MLflow logger callbacks in ray_tpu.air.integrations);
+    #: fire per result in Tuner runs AND standalone trainer.fit()
+    #: (reference: air/config.py RunConfig.callbacks)
+    callbacks: List[Any] = field(default_factory=list)
 
     def resolved_storage_path(self) -> str:
         from . import storage

@@ -186,7 +186,28 @@ class JaxTrainer:
         trial_name = f"{name}_00000"
         trial_dir = storage.join(exp_dir, trial_name)
         storage.makedirs(trial_dir)
-        result = self._run(trial_dir, name, trial_name)
+        callbacks = list(self.run_config.callbacks or ())
+        on_report = None
+        trial_shim = None
+        if callbacks:
+            # standalone fit() fires RunConfig.callbacks too (reference:
+            # trainers always run through Tune's callback plumbing); the
+            # shim carries the trial fields callbacks read
+            trial_shim = type("TrialShim", (), {})()
+            trial_shim.trial_id = trial_name
+            trial_shim.trial_dir = trial_dir
+            trial_shim.config = dict(self._config)
+
+            def on_report(metrics, _t=trial_shim):
+                for cb in callbacks:
+                    cb.on_trial_result(_t, metrics)
+
+        result = self._run(trial_dir, name, trial_name, on_report=on_report)
+        for cb in callbacks:
+            if result.error is not None:
+                cb.on_trial_error(trial_shim)
+            else:
+                cb.on_trial_complete(trial_shim)
         if result.error is not None:
             raise TrainingFailedError(
                 f"training failed: {result.error}") from result.error

@@ -56,6 +56,11 @@ class ResultGrid:
     def errors(self) -> List[BaseException]:
         return [r.error for r in self._results if r.error is not None]
 
+    @property
+    def num_errors(self) -> int:
+        """reference: tune/result_grid.py ResultGrid.num_errors"""
+        return len(self.errors)
+
     def get_best_result(self, metric: Optional[str] = None,
                         mode: Optional[str] = None) -> Result:
         metric = metric or getattr(self, "_default_metric", None)
@@ -122,6 +127,8 @@ class Tuner:
         if scheduler is not None and scheduler.metric is None:
             scheduler.metric = cfg.metric
             scheduler.mode = cfg.mode
+        from .tune_controller import JsonLoggerCallback
+
         controller = TuneController(
             self._trainable, searcher=searcher, scheduler=scheduler,
             experiment_dir=exp_dir, experiment_name=name,
@@ -130,6 +137,10 @@ class Tuner:
             max_failures=self._run_config.failure_config.max_failures,
             trial_resources=cfg.trial_resources,
             resumed_trials=self._resumed_trials,
+            # user callbacks (RunConfig.callbacks — e.g. TBX/W&B/MLflow
+            # from air.integrations) ride alongside the default logger
+            callbacks=[JsonLoggerCallback()]
+            + list(self._run_config.callbacks or ()),
         )
         controller.run()
         results = []
