@@ -100,6 +100,9 @@ class ActorRecord:
         self.incarnation = 0
         self.error: Optional[str] = None
         self.class_name = ""
+        #: validated container spec ({'image': ...}) — the raylet wraps
+        #: this actor's dedicated worker in the container runtime
+        self.container: Optional[Dict] = None
         self.last_pending_warn = -1e9  # monotonic ts of last pending warning
 
     def view(self):
@@ -283,6 +286,7 @@ class ControlServer:
                 "incarnation": rec.incarnation, "error": rec.error,
                 "class_name": rec.class_name,
                 "namespace": rec.namespace,
+                "container": rec.container,
             })
 
     def _persist_pg(self, rec: PlacementGroupRecord):
@@ -319,6 +323,7 @@ class ControlServer:
                               job_id=d.get("job_id", ""))
             rec.class_name = d.get("class_name", "")
             rec.strategy = d.get("strategy")
+            rec.container = d.get("container")
             rec.restarts = d.get("restarts", 0)
             rec.incarnation = d.get("incarnation", 0)
             self.actors[aid] = rec
@@ -669,6 +674,7 @@ class ControlServer:
         )
         rec.class_name = p.get("class_name", "")
         rec.strategy = p.get("strategy")
+        rec.container = p.get("container")
         with self.lock:
             # idempotent on actor_id: clients retry blindly after a
             # control-plane reconnect, and the first attempt may have
@@ -753,6 +759,7 @@ class ControlServer:
                 "pg_id": rec.pg_id,
                 "bundle_index": rec.bundle_index,
                 "incarnation": rec.incarnation,
+                "container": rec.container,
             }, timeout=60.0)
             if r and r.get("ok"):
                 with self.lock:
@@ -777,6 +784,13 @@ class ControlServer:
                     self._kill_actor_worker(
                         node.node_id, rec.actor_id,
                         worker_addr=tuple(r["worker_addr"]))
+                return True
+            if r and r.get("permanent"):
+                # the raylet says retrying can't help (e.g. container
+                # runtime missing) — fail the actor loudly now instead
+                # of re-queueing it forever
+                self._on_actor_failure(
+                    rec.actor_id, r.get("error", "worker spawn failed"))
                 return True
         except Exception as e:
             logger.warning("actor %s placement on %s failed: %s",

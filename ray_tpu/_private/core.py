@@ -1910,8 +1910,14 @@ class CoreWorker:
         ac.max_task_retries = max_task_retries
         with self.lock:
             self.actors[aid] = ac
+        container = None
+        if runtime_env:
+            from . import runtime_env as rtenv
+
+            container = rtenv.container_spec(runtime_env)
         self._control_call("create_actor", {
             "actor_id": aid,
+            "container": container,
             "spec_blob": cloudpickle.dumps(spec),
             "name": name,
             "class_name": getattr(cls, "__name__", "Actor"),

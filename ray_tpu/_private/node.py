@@ -301,7 +301,8 @@ class Raylet:
 
     def _spawn_worker(self, actor_id: Optional[str] = None,
                       env_extra: Optional[Dict[str, str]] = None,
-                      tpu: bool = False) -> WorkerRecord:
+                      tpu: bool = False,
+                      container: Optional[Dict] = None) -> WorkerRecord:
         with self.lock:
             self._next_token += 1
             token = self._next_token
@@ -325,27 +326,55 @@ class Raylet:
             env["JAX_PLATFORMS"] = "cpu"
         from .bootstrap import _package_pythonpath
 
-        env["PYTHONPATH"] = _package_pythonpath()
-        env["RAY_TPU_STARTUP_TOKEN"] = str(token)
-        env["RAY_TPU_WORKER_ID"] = wid
-        # line-buffered stdout so task prints reach the log tailer (and
-        # the driver) promptly, not on buffer flushes
-        env["PYTHONUNBUFFERED"] = "1"
-        env["RAY_TPU_NODE_ID"] = self.node_id
-        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        # ONE dict of worker-specific vars: the same set is applied to
+        # the host env AND forwarded into containers as -e flags (a
+        # second hand-written list would silently drift)
+        worker_vars = {
+            "PYTHONPATH": _package_pythonpath(),
+            "RAY_TPU_STARTUP_TOKEN": str(token),
+            "RAY_TPU_WORKER_ID": wid,
+            # line-buffered stdout so task prints reach the log tailer
+            # (and the driver) promptly, not on buffer flushes
+            "PYTHONUNBUFFERED": "1",
+            "RAY_TPU_NODE_ID": self.node_id,
+            "RAY_TPU_SESSION_DIR": self.session_dir,
+        }
+        if "JAX_PLATFORMS" in env and env.get("JAX_PLATFORMS") == "cpu":
+            worker_vars["JAX_PLATFORMS"] = "cpu"
         if actor_id:
-            env["RAY_TPU_ACTOR_ID"] = actor_id
+            worker_vars["RAY_TPU_ACTOR_ID"] = actor_id
         if env_extra:
-            env.update(env_extra)
+            worker_vars.update(env_extra)
+        env.update(worker_vars)
         cmd = [sys.executable, "-m", "ray_tpu._private.worker_proc",
                "--raylet", f"{self.server.addr[0]}:{self.server.addr[1]}",
                "--control", f"{self.control_addr[0]}:{self.control_addr[1]}"]
-        log_dir = os.path.join(self.session_dir, "logs")
-        os.makedirs(log_dir, exist_ok=True)
-        out = open(os.path.join(log_dir, f"worker-{wid[:12]}.log"), "ab")
-        rec.proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=out,
-                                    start_new_session=True)
-        out.close()
+        try:
+            if container:
+                # containerized actor worker (reference: image_uri.py:106
+                # ImageURIPlugin wrapping the worker command): the runtime
+                # does not forward its client's env, so worker_vars ride
+                # as -e flags; host network + /dev/shm + session dir
+                # mounts keep the data/control planes reachable
+                from . import runtime_env as _rtenv
+
+                cmd = _rtenv.wrap_container_cmd(
+                    cmd, worker_vars, container, self.session_dir,
+                    env["PYTHONPATH"])
+            log_dir = os.path.join(self.session_dir, "logs")
+            os.makedirs(log_dir, exist_ok=True)
+            out = open(os.path.join(log_dir, f"worker-{wid[:12]}.log"), "ab")
+            rec.proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=out,
+                                        start_new_session=True)
+            out.close()
+        except Exception:
+            # never leak the pre-registered record of a worker that was
+            # never born (the reap loop skips proc=None records)
+            with self.lock:
+                self.workers.pop(wid, None)
+                self.workers_by_token.pop(token, None)
+            rec.state = "dead"
+            raise
         return rec
 
     def h_register_worker(self, conn: ServerConn, p):
@@ -850,9 +879,12 @@ class Raylet:
         # WorkerPool::PopWorker worker_pool.h:366).  TPU actors need a
         # device-visible process — the warm pool is CPU-only, so they spawn.
         wants_tpu = any(k.startswith(common.TPU) for k in demand)
+        container = p.get("container")
         w = None
         with self.lock:
-            while not wants_tpu and self.idle:
+            # containerized actors never reuse the warm pool: those
+            # processes run on the host, not in the requested image
+            while not wants_tpu and not container and self.idle:
                 cand = self.idle.popleft()
                 if cand.state == "idle" and cand.conn is not None:
                     w = cand
@@ -882,8 +914,21 @@ class Raylet:
         env = {}
         if p.get("incarnation") is not None:
             env["RAY_TPU_ACTOR_INCARNATION"] = str(p["incarnation"])
-        rec = self._spawn_worker(actor_id=p["actor_id"], env_extra=env,
-                                 tpu=wants_tpu)
+        try:
+            rec = self._spawn_worker(actor_id=p["actor_id"], env_extra=env,
+                                     tpu=wants_tpu, container=container)
+        except Exception as e:
+            # e.g. no container runtime on this node — release the
+            # admission and surface the reason instead of a silent spawn
+            with self.lock:
+                if not from_bundle:
+                    add(self.available, demand)
+            # permanent: retrying on this node can't help (e.g. no
+            # container runtime installed) — the control plane fails the
+            # actor loudly instead of re-queueing forever
+            d.resolve({"ok": False, "permanent": True,
+                       "error": f"worker spawn failed: {e}"})
+            return
         rec.lease_resources = demand if not from_bundle else {}
         rec.bundle_demand = demand if from_bundle else {}
         if from_bundle:
@@ -903,7 +948,18 @@ class Raylet:
             with self.lock:
                 if not from_bundle:
                     add(self.available, rec.lease_resources)
-            d.resolve({"ok": False, "error": "actor worker failed to start"})
+            reply = {"ok": False, "error": "actor worker failed to start"}
+            rc = rec.proc.poll() if rec.proc is not None else None
+            if container and rc not in (None, 0):
+                # `podman run` exited before the worker registered: bad
+                # image tag, failed pull, broken entrypoint — respawning
+                # outside the actor's restart budget can't fix it (the
+                # budget still applies via the control's failure path)
+                reply["permanent"] = True
+                reply["error"] = (f"container worker exited with code {rc} "
+                                  f"before registering (image "
+                                  f"{container.get('image')!r})")
+            d.resolve(reply)
 
         threading.Thread(target=waiter, daemon=True).start()
 

@@ -63,9 +63,16 @@ _materialized: Dict[str, str] = {}  # pkg hash -> extracted dir
 def validate(env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     env = dict(env or {})
     unknown = set(env) - {"env_vars", "working_dir", "py_modules", "pip",
-                          "uv", "conda", "config"}
+                          "uv", "conda", "config", "container", "image_uri"}
     if unknown:
         raise ValueError(f"unsupported runtime_env fields: {sorted(unknown)}")
+    if env.get("image_uri"):
+        # sugar (reference: runtime_env/image_uri.py ImageURIPlugin):
+        # image_uri="img" == container={"image": "img"}
+        if env.get("container"):
+            raise ValueError("image_uri and container are mutually "
+                             "exclusive (image_uri is shorthand)")
+        env["container"] = {"image": env.pop("image_uri")}
     if sum(1 for k in ("pip", "uv", "conda") if env.get(k)) > 1:
         raise ValueError("pip, uv, and conda are mutually exclusive "
                          "(reference: runtime_env validation)")
@@ -74,11 +81,93 @@ def validate(env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
             raise ValueError(
                 "runtime_env pip/uv/conda installs are disabled in this "
                 "deployment (set RAY_TPU_ALLOW_PKG_INSTALL=1 to enable)")
+    c = env.get("container")
+    if c:
+        if not isinstance(c, dict) or not isinstance(c.get("image"), str) \
+                or not c["image"]:
+            raise ValueError("container must be {'image': str, "
+                             "'run_options': [str, ...]?}")
+        opts = c.get("run_options", [])
+        if not isinstance(opts, (list, tuple)) or \
+                not all(isinstance(o, str) for o in opts):
+            # a bare string is an iterable of 1-char strings and would
+            # splat into per-character argv entries downstream
+            raise ValueError(
+                "container run_options must be a list of strings")
+        if env.get("pip") or env.get("uv") or env.get("conda"):
+            raise ValueError("container excludes pip/uv/conda — bake "
+                             "dependencies into the image (reference: "
+                             "image_uri.py validation)")
+        if not _cfg().allow_pkg_install:
+            # image pulls are egress, gated exactly like pip installs
+            raise ValueError(
+                "container runtime_envs are disabled in this deployment "
+                "(pulling images needs egress; set "
+                "RAY_TPU_ALLOW_PKG_INSTALL=1 to enable)")
     ev = env.get("env_vars") or {}
     if not all(isinstance(k, str) and isinstance(v, str)
                for k, v in ev.items()):
         raise ValueError("env_vars must be {str: str}")
     return env
+
+
+def container_spec(env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """The validated container spec of a prepared env (None when absent)."""
+    return (env or {}).get("container") or None
+
+
+def resolve_container_runtime(explicit: Optional[str] = None) -> str:
+    """Container runtime resolution (reference: image_uri.py uses podman):
+    explicit > RAY_TPU_CONTAINER_RUNTIME > podman > docker on PATH; loud
+    failure when none exists — a container env must never silently run
+    un-containerized."""
+    import shutil as _shutil
+
+    for cand in (explicit, os.environ.get("RAY_TPU_CONTAINER_RUNTIME")):
+        if not cand:
+            continue
+        if os.path.isfile(cand) and os.access(cand, os.X_OK):
+            return cand
+        found = _shutil.which(cand)
+        if found:
+            return found
+        # an EXPLICIT pin that doesn't resolve must fail, not silently
+        # fall back to whatever podman/docker is on PATH (different
+        # rootless/network semantics than the operator chose)
+        raise RuntimeError(
+            f"configured container runtime {cand!r} not found or not "
+            "executable")
+    for name in ("podman", "docker"):
+        found = _shutil.which(name)
+        if found:
+            return found
+    raise RuntimeError(
+        "runtime_env requests a container but no container runtime was "
+        "found (looked for RAY_TPU_CONTAINER_RUNTIME, podman, docker)")
+
+
+def wrap_container_cmd(cmd: List[str], env_delta: Dict[str, str],
+                       spec: Dict[str, Any], session_dir: str,
+                       pythonpath: str) -> List[str]:
+    """Worker argv -> containerized argv (reference: image_uri.py:106
+    _modify_context building the podman invocation).
+
+    Host network (the worker dials the raylet/control on host TCP),
+    host /dev/shm (the plasma arena lives there), the session dir and
+    every PYTHONPATH entry mounted read-only, env via -e (the runtime
+    does not forward its client's environment)."""
+    runtime = resolve_container_runtime(spec.get("runtime"))
+    args = [runtime, "run", "--rm", "--network=host", "--ipc=host",
+            "-v", "/dev/shm:/dev/shm",
+            "-v", f"{session_dir}:{session_dir}"]
+    for entry in [p for p in pythonpath.split(os.pathsep) if p]:
+        args += ["-v", f"{entry}:{entry}:ro"]
+    env_delta = dict(env_delta, RAY_TPU_IN_CONTAINER="1")
+    for k, v in sorted(env_delta.items()):
+        args += ["-e", f"{k}={v}"]
+    args += list(spec.get("run_options", ()))
+    args.append(spec["image"])
+    return args + list(cmd)
 
 
 def _zip_dir(path: str) -> bytes:
@@ -468,6 +557,16 @@ def materialize(env: Optional[Dict[str, Any]], control) -> Context:
     """Worker-side: resolve pkg URIs and build an applicable Context
     (reference: the runtime_env agent's CreateRuntimeEnv)."""
     env = env or {}
+    if env.get("container") and not os.environ.get("RAY_TPU_IN_CONTAINER"):
+        # containers wrap the WORKER LAUNCH (raylet-side, actors get a
+        # dedicated wrapped worker); an in-process materialize cannot
+        # retrofit one — reject loudly instead of running outside the
+        # requested image
+        raise RuntimeError(
+            "container runtime_env reached a non-containerized worker: "
+            "containers are applied at worker spawn and currently "
+            "supported for ACTORS (which get a dedicated worker); plain "
+            "tasks run on pooled workers — wrap the work in an actor")
     sys_paths: List[str] = []
     cwd = None
     wd = env.get("working_dir")

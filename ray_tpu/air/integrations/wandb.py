@@ -49,9 +49,15 @@ class WandbLoggerCallback(Callback):
     def _run(self, trial):
         run = self._runs.get(trial.trial_id)
         if run is None:
+            # reinit="create_new" (wandb >= 0.19): each trial gets an
+            # INDEPENDENT run handle — TuneController interleaves trial
+            # results in one process, and legacy reinit=True would
+            # finish trial A's active run when trial B starts.  All
+            # logging below goes through the returned handle, never the
+            # module-level fluent API, for the same reason.
             run = self._wandb.init(
                 project=self.project, group=self.group,
-                name=trial.trial_id, reinit=True,
+                name=trial.trial_id, reinit="create_new",
                 config=(dict(trial.config) if self.log_config else None),
                 **self.init_kwargs)
             self._runs[trial.trial_id] = run
