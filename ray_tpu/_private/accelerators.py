@@ -2,18 +2,88 @@
 
 Mirror of the reference's accelerator-manager layer
 (reference: python/ray/_private/accelerators/tpu.py:71 TPUAcceleratorManager
-— chip detection via GCE metadata :48, TPU_VISIBLE_CHIPS env :155-195).
-We detect chips from /dev/accel* (TPU VMs expose one per chip), or the
-GCE metadata env mirrors, or RAY_TPU_NUM_CHIPS; topology labels
-(slice name, worker id, accelerator type) come from the standard TPU env
-vars so gang placement can keep bundles on one ICI-connected slice.
+— chip detection via /dev/accel*, GCE metadata probing :48
+_get_tpu_metadata, TPU_VISIBLE_CHIPS env :155-195).
+
+Detection precedence per field: GKE env vars (TPU_NAME /
+TPU_WORKER_ID / TPU_ACCELERATOR_TYPE, preset by the webhook) first,
+then the GCE instance-metadata server (gcloud-provisioned TPU VMs carry
+no env but always have metadata).  Worker 0 of a pod additionally
+exposes the `TPU-<pod_type>-head` resource (reference: tpu.py:381) —
+the handle gang schedulers target to run exactly one coordinator per
+pod slice.
 """
 
 from __future__ import annotations
 
 import glob
 import os
+import re
+import threading
 from typing import Dict, Optional, Tuple
+
+# GCE VM instance metadata (reference: tpu.py:23-29; endpoint
+# overridable so tests point it at a fake metadata server)
+_DEFAULT_METADATA_ENDPOINT = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/attributes")
+_METADATA_KEYS = {"accelerator_type": "accelerator-type",
+                  "tpu_name": "instance-id",
+                  "worker_id": "agent-worker-number"}
+_ACCEL_TYPE_RE = re.compile(r"^v\d+[a-zA-Z]*-\d+$")
+
+_meta_lock = threading.Lock()
+_meta_cache: Dict[str, Optional[str]] = {}
+_meta_dead = False  # no metadata server here; stop re-probing
+
+
+def _metadata_endpoint() -> str:
+    return os.environ.get("RAY_TPU_GCE_METADATA_ENDPOINT",
+                          _DEFAULT_METADATA_ENDPOINT)
+
+
+def _get_tpu_metadata(key: str) -> Optional[str]:
+    """One metadata attribute, or None (reference: tpu.py:48).  A failed
+    connect marks the server dead for the process — laptops and non-GCE
+    clusters pay the probe timeout once, not per call."""
+    global _meta_dead
+    with _meta_lock:
+        if key in _meta_cache:
+            return _meta_cache[key]
+        if _meta_dead:
+            return None
+    import urllib.error
+    import urllib.request
+
+    val: Optional[str] = None
+    try:
+        req = urllib.request.Request(
+            f"{_metadata_endpoint()}/{key}",
+            headers={"Metadata-Flavor": "Google"})
+        with urllib.request.urlopen(req, timeout=1.0) as r:
+            if r.status == 200:
+                val = r.read().decode().strip() or None
+    except urllib.error.HTTPError:
+        # 404/5xx: the server is ALIVE (an absent attribute is normal on
+        # some shapes) — cache the miss for this key only
+        val = None
+    except OSError:
+        # connection-level failure: no metadata server here
+        with _meta_lock:
+            _meta_dead = True
+        return None
+    except Exception:
+        val = None
+    with _meta_lock:
+        _meta_cache[key] = val
+    return val
+
+
+def _reset_metadata_cache() -> None:
+    """Test hook: forget probe results (endpoint changed)."""
+    global _meta_dead
+    with _meta_lock:
+        _meta_cache.clear()
+        _meta_dead = False
 
 
 def num_tpu_chips() -> int:
@@ -23,6 +93,13 @@ def num_tpu_chips() -> int:
     chips = glob.glob("/dev/accel*")
     if chips:
         return len(chips)
+    # vfio-bound chips (reference: tpu.py get_current_node_num_accelerators)
+    try:
+        vfio = [e for e in os.listdir("/dev/vfio") if e.isdigit()]
+        if vfio:
+            return len(vfio)
+    except FileNotFoundError:
+        pass
     bounds = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS")  # e.g. "2,2,1"
     if bounds:
         n = 1
@@ -32,18 +109,70 @@ def num_tpu_chips() -> int:
     return 0
 
 
+def current_pod_type() -> Optional[str]:
+    """Validated pod type, e.g. "v4-16" (reference: tpu.py
+    _get_current_node_tpu_pod_type — GKE env, then GCE metadata)."""
+    acc = os.environ.get("TPU_ACCELERATOR_TYPE")
+    if not acc and num_tpu_chips():
+        acc = _get_tpu_metadata(_METADATA_KEYS["accelerator_type"])
+    if acc and _ACCEL_TYPE_RE.match(acc):
+        return acc
+    return None
+
+
+def current_tpu_name() -> Optional[str]:
+    """Pod/slice name (reference: tpu.py get_current_node_tpu_name)."""
+    name = os.environ.get("TPU_NAME")
+    if name:
+        return name.split(",")[0]
+    if num_tpu_chips():
+        return _get_tpu_metadata(_METADATA_KEYS["tpu_name"])
+    return None
+
+
+def current_worker_id() -> Optional[int]:
+    """This host's index within the pod (reference: tpu.py
+    _get_current_node_tpu_worker_id)."""
+    wid = os.environ.get("TPU_WORKER_ID")
+    if not wid and num_tpu_chips():
+        wid = _get_tpu_metadata(_METADATA_KEYS["worker_id"])
+    try:
+        return int(wid) if wid is not None and wid != "" else None
+    except ValueError:
+        return None
+
+
 def tpu_labels() -> Dict[str, str]:
     labels = {}
-    slice_name = os.environ.get("TPU_NAME") or os.environ.get("TPU_WORKER_HOSTNAMES", "")
-    if slice_name:
-        labels["tpu_slice"] = slice_name.split(",")[0]
-    wid = os.environ.get("TPU_WORKER_ID")
+    name = current_tpu_name()
+    if not name:
+        hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        name = hosts.split(",")[0] if hosts else None
+    if name:
+        labels["tpu_slice"] = name
+    wid = current_worker_id()
     if wid is not None:
-        labels["tpu_worker_id"] = wid
-    acc = os.environ.get("TPU_ACCELERATOR_TYPE")
+        labels["tpu_worker_id"] = str(wid)
+    acc = current_pod_type()
     if acc:
         labels["tpu_accelerator_type"] = acc
     return labels
+
+
+def pod_resources() -> Dict[str, float]:
+    """Per-pod custom resources (reference: tpu.py:381
+    get_additional_resources): every pod host exposes {<tpu_name>: 1};
+    worker 0 additionally exposes {TPU-<pod_type>-head: 1} — request it
+    to land exactly one coordinating task per pod slice."""
+    out: Dict[str, float] = {}
+    name = current_tpu_name()
+    wid = current_worker_id()
+    pod_type = current_pod_type()
+    if name and wid is not None and pod_type:
+        out[name] = 1.0
+        if wid == 0:
+            out[f"TPU-{pod_type}-head"] = 1.0
+    return out
 
 
 def default_resources() -> Dict[str, float]:
@@ -51,6 +180,7 @@ def default_resources() -> Dict[str, float]:
     chips = num_tpu_chips()
     if chips:
         res["TPU"] = float(chips)
+        res.update(pod_resources())
     return res
 
 

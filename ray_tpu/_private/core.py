@@ -1896,10 +1896,12 @@ class CoreWorker:
                      runtime_env=None, namespace=None, strategy=None) -> str:
         aid = common.actor_id()
         common._ensure_picklable_by_value(cls)
+        container = None
         if runtime_env:
             from . import runtime_env as rtenv
 
             runtime_env = rtenv.prepare(runtime_env, self.control)
+            container = rtenv.container_spec(runtime_env)
         spec = {
             "class_blob": cloudpickle.dumps(cls),
             "args_blob": self.serialize_args(args, kwargs),
@@ -1910,11 +1912,6 @@ class CoreWorker:
         ac.max_task_retries = max_task_retries
         with self.lock:
             self.actors[aid] = ac
-        container = None
-        if runtime_env:
-            from . import runtime_env as rtenv
-
-            container = rtenv.container_spec(runtime_env)
         self._control_call("create_actor", {
             "actor_id": aid,
             "container": container,

@@ -351,6 +351,14 @@ class Raylet:
                "--control", f"{self.control_addr[0]}:{self.control_addr[1]}"]
         try:
             if container:
+                if tpu:
+                    # device mounts + TPU plugin env forwarding are not
+                    # implemented — failing loudly beats JAX silently
+                    # falling back to CPU while holding the TPU lease
+                    raise RuntimeError(
+                        "containerized TPU actors are not supported yet "
+                        "(the container would see no /dev/accel devices); "
+                        "drop the container env or the TPU resource")
                 # containerized actor worker (reference: image_uri.py:106
                 # ImageURIPlugin wrapping the worker command): the runtime
                 # does not forward its client's env, so worker_vars ride
@@ -918,15 +926,16 @@ class Raylet:
             rec = self._spawn_worker(actor_id=p["actor_id"], env_extra=env,
                                      tpu=wants_tpu, container=container)
         except Exception as e:
-            # e.g. no container runtime on this node — release the
-            # admission and surface the reason instead of a silent spawn
+            # release the admission and surface the reason instead of a
+            # silent spawn.  Only CONTAINER failures are permanent
+            # (missing runtime / unsupported combination — retrying on
+            # this node can't help); a transient host error on a plain
+            # spawn (ENOMEM, disk blip) keeps the pre-container retry
+            # semantics
             with self.lock:
                 if not from_bundle:
                     add(self.available, demand)
-            # permanent: retrying on this node can't help (e.g. no
-            # container runtime installed) — the control plane fails the
-            # actor loudly instead of re-queueing forever
-            d.resolve({"ok": False, "permanent": True,
+            d.resolve({"ok": False, "permanent": bool(container),
                        "error": f"worker spawn failed: {e}"})
             return
         rec.lease_resources = demand if not from_bundle else {}

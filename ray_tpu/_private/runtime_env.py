@@ -87,6 +87,12 @@ def validate(env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
                 or not c["image"]:
             raise ValueError("container must be {'image': str, "
                              "'run_options': [str, ...]?}")
+        unknown_c = set(c) - {"image", "run_options", "runtime"}
+        if unknown_c:
+            raise ValueError(
+                f"unsupported container fields: {sorted(unknown_c)}")
+        if "runtime" in c and not isinstance(c["runtime"], str):
+            raise ValueError("container runtime must be a string path")
         opts = c.get("run_options", [])
         if not isinstance(opts, (list, tuple)) or \
                 not all(isinstance(o, str) for o in opts):

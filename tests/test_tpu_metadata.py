@@ -1,0 +1,160 @@
+"""GCE-metadata TPU detection + pod-head resource (reference:
+python/ray/_private/accelerators/tpu.py:48 _get_tpu_metadata,
+:155-195 visibility env, :381 TPU-<pod_type>-head resource).  The
+metadata server is faked over real HTTP (RAY_TPU_GCE_METADATA_ENDPOINT
+points at it), so the probe exercises the exact wire path GCE uses."""
+
+import http.server
+import threading
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import accelerators as acc
+
+
+class _FakeMetadata(http.server.BaseHTTPRequestHandler):
+    attrs = {}
+    require_header = True
+    hits = []
+
+    def do_GET(self):
+        type(self).hits.append(self.path)
+        if self.require_header and \
+                self.headers.get("Metadata-Flavor") != "Google":
+            self.send_response(403)
+            self.end_headers()
+            return
+        key = self.path.rsplit("/", 1)[-1]
+        val = self.attrs.get(key)
+        if val is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = val.encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def metadata_server(monkeypatch):
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _FakeMetadata)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    _FakeMetadata.attrs = {"accelerator-type": "v4-16",
+                           "instance-id": "my-tpu-pod",
+                           "agent-worker-number": "0"}
+    _FakeMetadata.hits = []
+    monkeypatch.setenv(
+        "RAY_TPU_GCE_METADATA_ENDPOINT",
+        f"http://127.0.0.1:{srv.server_address[1]}/meta")
+    # pretend this host carries chips but no GKE env
+    monkeypatch.setenv("RAY_TPU_NUM_CHIPS", "4")
+    for var in ("TPU_NAME", "TPU_WORKER_ID", "TPU_ACCELERATOR_TYPE",
+                "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(var, raising=False)
+    acc._reset_metadata_cache()
+    yield srv
+    acc._reset_metadata_cache()
+    srv.shutdown()
+
+
+def test_metadata_probe_and_pod_resources(metadata_server):
+    assert acc.current_pod_type() == "v4-16"
+    assert acc.current_tpu_name() == "my-tpu-pod"
+    assert acc.current_worker_id() == 0
+    res = acc.default_resources()
+    assert res["TPU"] == 4.0
+    assert res["my-tpu-pod"] == 1.0
+    assert res["TPU-v4-16-head"] == 1.0          # worker 0 only
+    labels = acc.tpu_labels()
+    assert labels == {"tpu_slice": "my-tpu-pod", "tpu_worker_id": "0",
+                      "tpu_accelerator_type": "v4-16"}
+    # probe results are cached: the three keys hit the server once each
+    assert len(_FakeMetadata.hits) == 3
+
+
+def test_non_head_worker_gets_no_head_resource(metadata_server):
+    _FakeMetadata.attrs["agent-worker-number"] = "2"
+    acc._reset_metadata_cache()
+    res = acc.pod_resources()
+    assert res == {"my-tpu-pod": 1.0}
+    assert acc.current_worker_id() == 2
+
+
+def test_gke_env_wins_over_metadata(metadata_server, monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e-8")
+    monkeypatch.setenv("TPU_NAME", "gke-slice")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    acc._reset_metadata_cache()
+    _FakeMetadata.hits = []
+    assert acc.current_pod_type() == "v5e-8"
+    assert acc.current_tpu_name() == "gke-slice"
+    assert acc.current_worker_id() == 1
+    assert _FakeMetadata.hits == []              # env answered everything
+
+
+def test_invalid_accelerator_type_rejected(metadata_server):
+    _FakeMetadata.attrs["accelerator-type"] = "not-a-type!"
+    acc._reset_metadata_cache()
+    assert acc.current_pod_type() is None
+    assert acc.pod_resources() == {}             # incomplete -> no extras
+
+
+def test_dead_metadata_server_probes_once(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_GCE_METADATA_ENDPOINT",
+                       "http://127.0.0.1:9")      # nothing listens
+    monkeypatch.setenv("RAY_TPU_NUM_CHIPS", "4")
+    for var in ("TPU_NAME", "TPU_WORKER_ID", "TPU_ACCELERATOR_TYPE"):
+        monkeypatch.delenv(var, raising=False)
+    acc._reset_metadata_cache()
+    import time
+    assert acc.current_tpu_name() is None
+    t0 = time.perf_counter()
+    for _ in range(20):
+        assert acc.current_tpu_name() is None    # dead-cached, no I/O
+        assert acc.current_pod_type() is None
+    assert time.perf_counter() - t0 < 0.5
+    acc._reset_metadata_cache()
+
+
+def test_gang_placement_consumes_head_resource(monkeypatch):
+    """The pod-head resource flows into the node's advertised resources
+    and a task targeting it lands on the head node — the gang pattern
+    from the reference docstring (tpu.py:361).
+
+    The head-resource NAME is discovered from the started cluster rather
+    than assumed: this host's sitecustomize re-injects the real
+    TPU_ACCELERATOR_TYPE into every child interpreter, so the daemons
+    may derive the real pod type instead of a test-pinned one."""
+    monkeypatch.setenv("RAY_TPU_NUM_CHIPS", "4")
+    monkeypatch.setenv("TPU_NAME", "gang-pod")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-16")
+    ray_tpu.shutdown()
+    ray_tpu.init()
+    try:
+        res = ray_tpu.cluster_resources()
+        heads = [r for r in res
+                 if r.startswith("TPU-") and r.endswith("-head")]
+        assert heads, f"no pod-head resource advertised: {res}"
+        assert res.get("gang-pod") == 1.0       # slice-name resource
+
+        @ray_tpu.remote(resources={heads[0]: 1})
+        def head_task():
+            return "on-head"
+
+        assert ray_tpu.get(head_task.remote(), timeout=60) == "on-head"
+
+        @ray_tpu.remote(resources={"gang-pod": 1})
+        def on_slice():
+            return True
+
+        assert ray_tpu.get(on_slice.remote(), timeout=60)
+    finally:
+        ray_tpu.shutdown()
