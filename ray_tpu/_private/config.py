@@ -30,6 +30,9 @@ CONFIG_DEFS: List[Tuple[str, type, Any, str]] = [
     # -- control plane / failure detection
     ("heartbeat_interval_s", float, 0.5,
      "raylet -> control heartbeat period"),
+    ("resource_sync_delta", bool, True,
+     "ship node availability only when it changed (versioned delta "
+     "sync, the ray_syncer analog); False = full snapshot every beat"),
     ("node_death_timeout_s", float, 10.0,
      "missed-heartbeat window before a node is declared dead"),
     ("control_reconnect_s", float, 20.0,

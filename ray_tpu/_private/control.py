@@ -60,6 +60,12 @@ class NodeRecord:
         self.labels = dict(labels or {})
         self.last_heartbeat = time.monotonic()
         self.state = ALIVE
+        #: last applied availability version (delta resource sync)
+        self.avail_version = 0
+        #: an optimistic reservation diverged this view from the
+        #: raylet's truth; ask the raylet to resend it (delta sync
+        #: would otherwise never correct a control-side guess)
+        self.needs_resync = False
 
     def view(self):
         return {
@@ -445,10 +451,23 @@ class ControlServer:
                 return {"ok": False, "reregister": True}
             rec.last_heartbeat = time.monotonic()
             if "available" in p:
-                rec.available = normalize_resources(p["available"])
-                if self.nsched is not None:
-                    self.nsched.set_available(rec.node_id, rec.available)
-            return {"ok": True}
+                # versioned delta sync (reference: ray_syncer.h:44-70):
+                # only snapshots newer than the last applied version
+                # land — a reordered/raced update can never roll the
+                # view backwards
+                v = p.get("avail_version", 0)
+                if v == 0 or v > rec.avail_version:
+                    if v:   # unversioned updates keep the high-water mark
+                        rec.avail_version = v
+                    rec.available = normalize_resources(p["available"])
+                    rec.needs_resync = False
+                    if self.nsched is not None:
+                        self.nsched.set_available(rec.node_id,
+                                                  rec.available)
+            # resync: an optimistic pick_node reservation diverged this
+            # view from the raylet's truth — delta sync skips unchanged
+            # views, so explicitly request the ground truth back
+            return {"ok": True, "resync": rec.needs_resync}
 
     def h_get_nodes(self, conn, p):
         with self.lock:
@@ -574,9 +593,11 @@ class ControlServer:
             n = self._pick_node_locked(demand, p.get("strategy"))
             if n is None:
                 return None
-            # optimistic reservation so concurrent picks spread; the next
-            # heartbeat overwrites with the raylet's ground truth
+            # optimistic reservation so concurrent picks spread; the
+            # raylet's ground truth comes back via the resync flag on
+            # its next heartbeat (delta sync skips unchanged views)
             subtract(n.available, demand)
+            n.needs_resync = True
             if self.nsched is not None:
                 self.nsched.set_available(n.node_id, n.available)
             return {"node_id": n.node_id, "addr": n.addr}

@@ -1208,23 +1208,55 @@ class Raylet:
     # -- heartbeats --------------------------------------------------------
 
     def _heartbeat_loop(self):
+        """Liveness + resource sync (reference: ray_syncer.h:44-70 — a
+        versioned RESOURCE_VIEW where only snapshots newer than the
+        peer's last-seen version travel).  Heartbeats always carry
+        liveness; the availability dict rides along ONLY when it changed
+        since the last ACKED send, under a monotonically increasing
+        version the control uses to drop stale/reordered updates.  At
+        the reference's 2k-node envelope this is the difference between
+        the control plane deserializing 2k resource dicts per beat and
+        deserializing only what actually changed."""
+        from .config import cfg as _hcfg
         from .control import HEARTBEAT_INTERVAL_S
 
+        delta_sync = _hcfg().resource_sync_delta
+        last_acked: Optional[Dict[str, float]] = None
+        version = 0
+        reg_seen = self._registered_at
         while not self._stop.is_set():
             try:
+                if self._registered_at != reg_seen:
+                    # re-registered (control restart / resurrect): the
+                    # fresh NodeRecord assumed available == total, so
+                    # force a full resync on the next beat
+                    reg_seen = self._registered_at
+                    last_acked = None
                 with self.lock:
                     avail = common.denormalize_resources(
                         {k: max(v, 0) for k, v in self.available.items()})
+                payload = {"node_id": self.node_id}
+                send_avail = (not delta_sync) or avail != last_acked
+                if send_avail:
+                    version += 1
+                    payload["available"] = avail
+                    payload["avail_version"] = version
                 sent = time.monotonic()
-                r = self.control.call("heartbeat", {
-                    "node_id": self.node_id, "available": avail,
-                }, timeout=5.0)
+                r = self.control.call("heartbeat", payload, timeout=5.0)
+                if r and r.get("ok") and send_avail:
+                    last_acked = avail
+                if r and r.get("resync"):
+                    # the control's view diverged (optimistic pick_node
+                    # reservation): resend ground truth next beat even
+                    # if our own view hasn't changed
+                    last_acked = None
                 if r and not r.get("ok") and r.get("reregister"):
                     # a heartbeat that raced with a concurrent re-register
                     # (e.g. the reconnect thread after a control restart)
                     # may be rejected even though we ARE registered now —
                     # resurrecting again would reap actors the restored
                     # control just placed here
+                    last_acked = None   # new control: resend full view
                     if self._registered_at < sent:
                         self._resurrect()
             except Exception:

@@ -1,0 +1,133 @@
+"""Versioned delta resource sync (reference:
+src/ray/common/ray_syncer/ray_syncer.h:44-70 — a RESOURCE_VIEW where
+only snapshots newer than the peer's last-seen version are applied)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.protocol import Client
+
+
+@pytest.fixture
+def control_only(multi_node_cluster):
+    c = multi_node_cluster()
+    return c
+
+
+def _register(cli, nid, cpus=4.0):
+    cli.call("register_node", {
+        "node_id": nid, "addr": ("127.0.0.1", 45000),
+        "resources": {"CPU": cpus}, "labels": {}}, timeout=10)
+
+
+def _avail(cli, nid):
+    nodes = cli.call("get_nodes", {}, timeout=10)
+    return next(n["available"] for n in nodes if n["node_id"] == nid)
+
+
+def test_stale_version_never_rolls_back(control_only):
+    cli = Client(control_only.control_addr, name="t")
+    _register(cli, "sync-a")
+    assert cli.call("heartbeat", {
+        "node_id": "sync-a", "available": {"CPU": 2.0},
+        "avail_version": 5}, timeout=10)["ok"]
+    assert _avail(cli, "sync-a") == {"CPU": 2.0}
+    # an older (reordered) snapshot must be dropped
+    cli.call("heartbeat", {"node_id": "sync-a",
+                           "available": {"CPU": 4.0},
+                           "avail_version": 3}, timeout=10)
+    assert _avail(cli, "sync-a") == {"CPU": 2.0}
+    # a newer one lands
+    cli.call("heartbeat", {"node_id": "sync-a",
+                           "available": {"CPU": 1.0},
+                           "avail_version": 6}, timeout=10)
+    assert _avail(cli, "sync-a") == {"CPU": 1.0}
+    cli.close()
+
+
+def test_liveness_beat_without_payload_keeps_view(control_only):
+    cli = Client(control_only.control_addr, name="t")
+    _register(cli, "sync-b")
+    cli.call("heartbeat", {"node_id": "sync-b",
+                           "available": {"CPU": 3.0},
+                           "avail_version": 1}, timeout=10)
+    # bare liveness beats (the delta-sync common case) change nothing
+    for _ in range(3):
+        assert cli.call("heartbeat", {"node_id": "sync-b"},
+                        timeout=10)["ok"]
+    assert _avail(cli, "sync-b") == {"CPU": 3.0}
+    cli.close()
+
+
+def test_pick_node_reservation_triggers_resync(control_only):
+    """The optimistic pick_node reservation diverges the control view;
+    the resync flag must travel back on the next beat and the raylet's
+    full resend must restore the ground truth (this handshake is the
+    delta protocol's only correction path for control-side guesses)."""
+    cli = Client(control_only.control_addr, name="t")
+    _register(cli, "sync-c", cpus=4.0)
+    r = cli.call("heartbeat", {"node_id": "sync-c",
+                               "available": {"CPU": 4.0},
+                               "avail_version": 1}, timeout=10)
+    assert r["ok"] and not r.get("resync")
+    picked = cli.call("pick_node", {"resources": {"CPU": 2.0}}, timeout=10)
+    assert picked and picked["node_id"] == "sync-c"
+    assert _avail(cli, "sync-c") == {"CPU": 2.0}   # optimistic guess
+    # a bare liveness beat is told to resync...
+    r = cli.call("heartbeat", {"node_id": "sync-c"}, timeout=10)
+    assert r["ok"] and r["resync"]
+    # ...the flag stays up until an availability payload arrives...
+    r = cli.call("heartbeat", {"node_id": "sync-c"}, timeout=10)
+    assert r["resync"]
+    # ...and the full resend restores truth and clears the flag
+    r = cli.call("heartbeat", {"node_id": "sync-c",
+                               "available": {"CPU": 4.0},
+                               "avail_version": 2}, timeout=10)
+    assert r["ok"]
+    assert _avail(cli, "sync-c") == {"CPU": 4.0}
+    r = cli.call("heartbeat", {"node_id": "sync-c"}, timeout=10)
+    assert not r["resync"]
+    cli.close()
+
+
+def test_unversioned_update_keeps_version_high_water(control_only):
+    """Legacy (unversioned) availability payloads apply but must NOT
+    reset the monotonic guard — a stale reordered versioned snapshot
+    could otherwise roll the view backwards through the reset."""
+    cli = Client(control_only.control_addr, name="t")
+    _register(cli, "sync-d")
+    cli.call("heartbeat", {"node_id": "sync-d",
+                           "available": {"CPU": 2.0},
+                           "avail_version": 10}, timeout=10)
+    # unversioned update applies...
+    cli.call("heartbeat", {"node_id": "sync-d",
+                           "available": {"CPU": 3.0}}, timeout=10)
+    assert _avail(cli, "sync-d") == {"CPU": 3.0}
+    # ...but an old versioned duplicate still can't land
+    cli.call("heartbeat", {"node_id": "sync-d",
+                           "available": {"CPU": 1.0},
+                           "avail_version": 4}, timeout=10)
+    assert _avail(cli, "sync-d") == {"CPU": 3.0}
+    cli.close()
+
+
+def test_view_converges_after_task_churn(ray_cluster):
+    """End-to-end: the delta protocol keeps the control view fresh —
+    after a burst of work completes, advertised availability returns to
+    the full capacity within a few heartbeat periods."""
+    @ray_tpu.remote
+    def spin(s):
+        time.sleep(s)
+        return 1
+
+    total = ray_tpu.cluster_resources().get("CPU")
+    refs = [spin.remote(0.4) for _ in range(8)]
+    assert sum(ray_tpu.get(refs, timeout=120)) == 8
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if ray_tpu.available_resources().get("CPU") == total:
+            break
+        time.sleep(0.2)
+    assert ray_tpu.available_resources().get("CPU") == total
