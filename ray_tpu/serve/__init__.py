@@ -16,7 +16,7 @@ from ._handle import (DeploymentHandle, DeploymentResponse,
                       DeploymentResponseGenerator)
 from ._proxy import Request, Response, RpcClient
 from .api import (delete, get_app_handle, get_deployment_handle, run,
-                  shutdown, start, start_rpc_proxy, status)
+                  shutdown, start, start_grpc, start_rpc_proxy, status)
 from .batching import batch
 from .multiplex import get_multiplexed_model_id, multiplexed
 
@@ -26,7 +26,7 @@ __all__ = [
     "Request", "Response", "RpcClient", "batch",
     "delete", "deployment", "get_app_handle", "get_deployment_handle",
     "get_multiplexed_model_id", "multiplexed", "run", "shutdown", "start",
-    "start_rpc_proxy", "status",
+    "start_grpc", "start_rpc_proxy", "status",
     "ServeApplicationSchema", "ServeDeploySchema", "deploy_config",
     "deploy_config_file",
 ]

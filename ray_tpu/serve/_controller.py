@@ -75,6 +75,7 @@ class ServeController:
         self._http_port = http_port
         self._proxy = None
         self._rpc_proxy = None
+        self._grpc_proxy = None
         self._shutdown = False
         self._reconciler = threading.Thread(target=self._reconcile_loop,
                                             name="serve-reconcile",
@@ -267,6 +268,34 @@ class ServeController:
                     name="SERVE_RPC_PROXY", max_concurrency=8,
                     num_cpus=0).remote(self._http_host, 0)
             proxy = self._rpc_proxy
+        return ray_tpu.get(proxy.ready.remote(), timeout=30.0)
+
+    def ensure_grpc_proxy(self, servicer_blob: bytes,
+                          host: Optional[str] = None) -> Any:
+        """Start the REAL gRPC ingress actor on demand (reference:
+        proxy.py:558 gRPCProxy); returns (host, port).  The user's
+        add_*Servicer_to_server functions arrive pickled (they are
+        driver-side code) and pass through to the proxy unopened."""
+        import hashlib
+
+        digest = hashlib.sha256(servicer_blob).hexdigest()
+        with self._lock:
+            if self._grpc_proxy is None:
+                from ._grpc import GrpcProxy
+
+                self._grpc_blob_digest = digest
+                self._grpc_proxy = ray_tpu.remote(GrpcProxy).options(
+                    name="SERVE_GRPC_PROXY", max_concurrency=8,
+                    num_cpus=0).remote(host or self._http_host, 0,
+                                       servicer_blob=servicer_blob)
+            elif digest != self._grpc_blob_digest:
+                # a second start_grpc with DIFFERENT services would
+                # silently serve only the first set — refuse loudly
+                raise ValueError(
+                    "the gRPC proxy is already running with a different "
+                    "set of servicer functions; serve.shutdown() first "
+                    "to change the registered services")
+            proxy = self._grpc_proxy
         return ray_tpu.get(proxy.ready.remote(), timeout=30.0)
 
     # -- reconcile loop -----------------------------------------------------

@@ -1,0 +1,251 @@
+"""Real gRPC ingress for Serve (reference: serve/_private/proxy.py:558
+gRPCProxy + grpc_util.py gRPCServer/DummyServicer).
+
+Users register their OWN generated proto services: each entry of
+`servicer_functions` is a standard `add_<Service>Servicer_to_server`
+callable.  It is invoked against a pass-through dummy servicer, and the
+server subclass rewrites every registered method handler to route into
+Serve instead — the request still travels as the user's proto message,
+the reply as raw serialized bytes — so ANY grpc client (any language)
+that speaks the user's proto can call a deployment.
+
+Routing: the target application comes from the `application` request
+metadata (falling back to the single deployed app); the deployment
+method is the RPC method name (falling back to __call__).  Requires
+grpcio; `serve.start_grpc` raises ImportError without it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+from ._proxy import _ControllerTableCache
+from ._router import get_router
+
+logger = logging.getLogger("ray_tpu.serve.grpc")
+
+
+class _DummyServicer:
+    """Accepts any method lookup (reference: grpc_util.py:73) — user
+    add_*_to_server functions read handler callables off the servicer,
+    which the server subclass discards and replaces with the router."""
+
+    def __getattr__(self, attr):
+        return None
+
+
+async def _unimplemented_unary(request_iter, context):
+    import grpc
+
+    context.set_code(grpc.StatusCode.UNIMPLEMENTED)
+    context.set_details("client-streaming RPCs are not supported by the "
+                        "serve gRPC ingress")
+
+
+async def _unimplemented_stream(request_iter, context):
+    import grpc
+
+    context.set_code(grpc.StatusCode.UNIMPLEMENTED)
+    context.set_details("client-streaming RPCs are not supported by the "
+                        "serve gRPC ingress")
+    return
+    yield  # pragma: no cover - makes this an async generator
+
+
+def _make_server(handler_factory):
+    """grpc.aio server whose add_generic_rpc_handlers rewrites every
+    user method handler onto the Serve router (reference:
+    grpc_util.py:9 gRPCServer)."""
+    from grpc.aio._server import Server
+
+    class _ServeGrpcServer(Server):
+        def add_generic_rpc_handlers(self, generic_rpc_handlers):
+            for gh in generic_rpc_handlers:
+                handlers = getattr(gh, "_method_handlers", None)
+                if not handlers:
+                    continue
+                replaced = {}
+                for service_method, mh in handlers.items():
+                    replaced[service_method] = mh._replace(
+                        # reply bytes pass through un-reserialized: the
+                        # deployment returns the user's proto (or bytes)
+                        response_serializer=None,
+                        unary_unary=handler_factory(service_method,
+                                                    stream=False),
+                        unary_stream=handler_factory(service_method,
+                                                     stream=True),
+                        # client-streaming RPCs are not routed (yet):
+                        # answer UNIMPLEMENTED instead of invoking the
+                        # dummy servicer's None
+                        stream_unary=_unimplemented_unary,
+                        stream_stream=_unimplemented_stream,
+                    )
+                gh._method_handlers = replaced
+            super().add_generic_rpc_handlers(generic_rpc_handlers)
+
+    return _ServeGrpcServer(None, (), (), (), None, None)
+
+
+def _to_wire(out: Any) -> bytes:
+    if isinstance(out, (bytes, bytearray)):
+        return bytes(out)
+    ser = getattr(out, "SerializeToString", None)
+    if ser is not None:
+        return ser()
+    raise TypeError(
+        f"gRPC deployment replies must be proto messages or bytes, "
+        f"got {type(out).__name__}")
+
+
+class GrpcProxy:
+    """Serve's gRPC ingress actor; `ready()` returns (host, port)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 servicer_functions: Optional[List[Any]] = None,
+                 servicer_blob: Optional[bytes] = None):
+        import grpc
+
+        if servicer_blob is not None:
+            # pickled in the driver, opened HERE (the controller passes
+            # the blob through untouched — no double deserialization)
+            import cloudpickle
+
+            servicer_functions = cloudpickle.loads(servicer_blob)
+
+        self._table = _ControllerTableCache(
+            "get_app_table", lambda t: dict(t["apps"]))
+        self._loop = asyncio.new_event_loop()
+        self._host = host
+        self._bound_port: Optional[int] = None
+        self._started = threading.Event()
+        self._grpc = grpc
+        self._server = None
+        self._init_error: Optional[BaseException] = None
+
+        def run():
+            # grpc.aio server construction needs the thread's event loop
+            # in place — build everything on the serving thread
+            asyncio.set_event_loop(self._loop)
+            try:
+                server = _make_server(self._handler_factory)
+                for fn in servicer_functions or []:
+                    fn(_DummyServicer(), server)
+                self._server = server
+                self._bound_port = self._loop.run_until_complete(
+                    self._start(port))
+            except BaseException as e:
+                self._init_error = e
+                self._started.set()
+                return
+            self._started.set()
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="serve-grpc")
+        self._thread.start()
+
+    async def _start(self, port: int) -> int:
+        bound = self._server.add_insecure_port(f"{self._host}:{port}")
+        await self._server.start()
+        return bound
+
+    def ready(self):
+        if not self._started.wait(timeout=30.0):
+            raise RuntimeError("grpc proxy did not start within 30s")
+        if self._init_error is not None:
+            raise RuntimeError(
+                f"grpc proxy failed to start: {self._init_error}")
+        return (self._host, self._bound_port)
+
+    # -- routing --------------------------------------------------------
+
+    def _resolve(self, metadata) -> Optional[Dict[str, Any]]:
+        apps = self._table.get()
+        app = dict(metadata or {}).get("application")
+        if app:
+            return apps.get(app)
+        if len(apps) == 1:
+            return next(iter(apps.values()))
+        return apps.get("default")
+
+    def _call_blocking(self, service_method: str, request: Any, metadata):
+        target = self._resolve(metadata)
+        if target is None:
+            raise KeyError(
+                "no serve application matched; set the 'application' "
+                "request metadata")
+        method = service_method.rsplit("/", 1)[-1]
+        router = get_router(target["app"], target["deployment"])
+        ref, done = router.assign(method, (request,), {}, {})
+        try:
+            return ray_tpu.get(ref, timeout=300.0)
+        finally:
+            done()
+
+    def _stream_blocking_iter(self, service_method: str, request: Any,
+                              metadata):
+        target = self._resolve(metadata)
+        if target is None:
+            raise KeyError(
+                "no serve application matched; set the 'application' "
+                "request metadata")
+        router = get_router(target["app"], target["deployment"])
+        gen, done = router.assign_streaming(
+            service_method.rsplit("/", 1)[-1], (request,), {}, {})
+        try:
+            for ref in gen:
+                yield ray_tpu.get(ref, timeout=300.0)
+        finally:
+            done()
+
+    def _handler_factory(self, service_method: str, stream: bool):
+        grpc = self._grpc
+
+        async def unary_unary(request, context):
+            loop = asyncio.get_event_loop()
+            try:
+                out = await loop.run_in_executor(
+                    None, self._call_blocking, service_method, request,
+                    dict(context.invocation_metadata()))
+                return _to_wire(out)
+            except KeyError as e:
+                context.set_code(grpc.StatusCode.NOT_FOUND)
+                context.set_details(str(e))
+            except Exception as e:
+                logger.exception("grpc call %s failed", service_method)
+                context.set_code(grpc.StatusCode.INTERNAL)
+                context.set_details(f"{type(e).__name__}: {e}")
+
+        async def unary_stream(request, context):
+            loop = asyncio.get_event_loop()
+            meta = dict(context.invocation_metadata())
+            it = iter(self._stream_blocking_iter(service_method, request,
+                                                 meta))
+            sentinel = object()
+
+            def nxt():
+                try:
+                    return next(it)
+                except StopIteration:
+                    return sentinel
+
+            try:
+                while True:
+                    item = await loop.run_in_executor(None, nxt)
+                    if item is sentinel:
+                        break
+                    yield _to_wire(item)
+            except KeyError as e:
+                context.set_code(grpc.StatusCode.NOT_FOUND)
+                context.set_details(str(e))
+            except Exception as e:
+                logger.exception("grpc stream %s failed", service_method)
+                context.set_code(grpc.StatusCode.INTERNAL)
+                context.set_details(f"{type(e).__name__}: {e}")
+
+        return unary_stream if stream else unary_unary

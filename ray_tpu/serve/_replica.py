@@ -61,7 +61,17 @@ class Replica:
     def _resolve_target(self, method_name: Optional[str]):
         if method_name in (None, "__call__") and callable(self._callable):
             return self._callable
-        return getattr(self._callable, method_name or "__call__")
+        target = getattr(self._callable, method_name or "__call__", None)
+        if target is None and callable(self._callable):
+            # a named route (e.g. a gRPC RPC method) on a deployment
+            # that only defines __call__: fall back to it (resolution
+            # only — exceptions raised INSIDE methods never retry here)
+            return self._callable
+        if target is None:
+            raise AttributeError(
+                f"deployment has no method {method_name!r} and is not "
+                "callable")
+        return target
 
     async def handle_request(self, method_name: Optional[str], args, kwargs,
                              metadata: Optional[Dict[str, Any]] = None):

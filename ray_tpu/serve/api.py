@@ -51,10 +51,35 @@ def start(http_host: str = "127.0.0.1", http_port: int = 0,
 
 
 def start_rpc_proxy():
-    """Start the binary RPC ingress (reference: the gRPC proxy,
-    proxy.py:558); returns its (host, port)."""
+    """Start the binary RPC ingress (the native-protocol fast path);
+    returns its (host, port)."""
     return ray_tpu.get(_get_controller().ensure_rpc_proxy.remote(),
                        timeout=60.0)
+
+
+def start_grpc(servicer_functions, *, host: Optional[str] = None):
+    """Start the REAL gRPC ingress (reference: serve/_private/proxy.py:558
+    gRPCProxy): `servicer_functions` are standard generated
+    `add_<Service>Servicer_to_server` callables; any grpc client that
+    speaks the user's proto can then call deployments (app selected via
+    the `application` request metadata). Returns (host, port). Requires
+    grpcio."""
+    try:
+        import grpc  # noqa: F401
+    except ImportError:
+        raise ImportError(
+            "serve.start_grpc requires grpcio, which is not installed in "
+            "this environment") from None
+    import cloudpickle
+
+    from ray_tpu._private import common as _common
+
+    for fn in servicer_functions:
+        _common._ensure_picklable_by_value(fn)
+    blob = cloudpickle.dumps(list(servicer_functions))
+    return ray_tpu.get(
+        _get_controller().ensure_grpc_proxy.remote(blob, host),
+        timeout=60.0)
 
 
 def _get_controller():

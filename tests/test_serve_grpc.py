@@ -1,0 +1,98 @@
+"""Real gRPC ingress (reference: serve/_private/proxy.py:558 gRPCProxy):
+user proto services registered via standard add_*Servicer_to_server
+functions, called by a PLAIN grpc client (no ray_tpu client code on the
+wire) — genuine cross-ecosystem interop, unlike the framed-pickle
+RpcProxy."""
+
+import json
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+import ray_tpu  # noqa: E402
+from ray_tpu import serve  # noqa: E402
+
+
+def add_EchoServicer_to_server(servicer, server):
+    """Hand-written equivalent of grpcio-tools codegen output (the exact
+    API surface generated _pb2_grpc.py files expose); bytes-passthrough
+    serializers stand in for proto classes (grpcio-tools is not in this
+    image — the wire mechanics are identical)."""
+    rpc_method_handlers = {
+        "Predict": grpc.unary_unary_rpc_method_handler(
+            servicer.Predict,
+            request_deserializer=None, response_serializer=None),
+        "StreamPredict": grpc.unary_stream_rpc_method_handler(
+            servicer.StreamPredict,
+            request_deserializer=None, response_serializer=None),
+    }
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler("test.Echo",
+                                             rpc_method_handlers),))
+
+
+@pytest.fixture(scope="module")
+def grpc_serve(ray_cluster):
+    @serve.deployment
+    class Echo:
+        def Predict(self, request: bytes) -> bytes:
+            payload = json.loads(request)
+            return json.dumps({"echo": payload["x"] * 2}).encode()
+
+        def StreamPredict(self, request: bytes):
+            n = json.loads(request)["n"]
+            for i in range(n):
+                yield json.dumps({"i": i}).encode()
+
+    serve.run(Echo.bind(), name="echo_app", route_prefix=None)
+    addr = serve.start_grpc([add_EchoServicer_to_server])
+    yield addr
+    serve.delete("echo_app")
+
+
+def test_plain_grpc_client_calls_deployment(grpc_serve):
+    host, port = grpc_serve
+    with grpc.insecure_channel(f"{host}:{port}") as ch:
+        call = ch.unary_unary("/test.Echo/Predict")
+        reply = call(json.dumps({"x": 21}).encode(),
+                     metadata=(("application", "echo_app"),),
+                     timeout=60)
+    assert json.loads(reply) == {"echo": 42}
+
+
+def test_grpc_single_app_needs_no_metadata(grpc_serve):
+    host, port = grpc_serve
+    with grpc.insecure_channel(f"{host}:{port}") as ch:
+        reply = ch.unary_unary("/test.Echo/Predict")(
+            json.dumps({"x": 5}).encode(), timeout=60)
+    assert json.loads(reply) == {"echo": 10}
+
+
+def test_grpc_streaming(grpc_serve):
+    host, port = grpc_serve
+    with grpc.insecure_channel(f"{host}:{port}") as ch:
+        stream = ch.unary_stream("/test.Echo/StreamPredict")(
+            json.dumps({"n": 4}).encode(),
+            metadata=(("application", "echo_app"),), timeout=60)
+        items = [json.loads(m)["i"] for m in stream]
+    assert items == [0, 1, 2, 3]
+
+
+def test_grpc_unknown_app_is_not_found(grpc_serve):
+    host, port = grpc_serve
+    with grpc.insecure_channel(f"{host}:{port}") as ch:
+        with pytest.raises(grpc.RpcError) as e:
+            ch.unary_unary("/test.Echo/Predict")(
+                b"{}", metadata=(("application", "nope"),), timeout=60)
+    assert e.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_grpc_bad_payload_is_internal(grpc_serve):
+    host, port = grpc_serve
+    with grpc.insecure_channel(f"{host}:{port}") as ch:
+        with pytest.raises(grpc.RpcError) as e:
+            ch.unary_unary("/test.Echo/Predict")(
+                b"not json", metadata=(("application", "echo_app"),),
+                timeout=60)
+    assert e.value.code() == grpc.StatusCode.INTERNAL
