@@ -296,7 +296,20 @@ class ServeController:
                     "set of servicer functions; serve.shutdown() first "
                     "to change the registered services")
             proxy = self._grpc_proxy
-        return ray_tpu.get(proxy.ready.remote(), timeout=30.0)
+        try:
+            return ray_tpu.get(proxy.ready.remote(), timeout=30.0)
+        except Exception:
+            # failed/dead proxy must not brick every future start_grpc
+            # behind the digest guard — forget it so a retry re-creates
+            with self._lock:
+                if self._grpc_proxy is proxy:
+                    self._grpc_proxy = None
+                    self._grpc_blob_digest = None
+            try:
+                ray_tpu.kill(proxy, no_restart=True)
+            except Exception:
+                pass
+            raise
 
     # -- reconcile loop -----------------------------------------------------
 

@@ -39,20 +39,20 @@ class _DummyServicer:
         return None
 
 
-async def _unimplemented_unary(request_iter, context):
+def _set_unimplemented(context):
     import grpc
 
     context.set_code(grpc.StatusCode.UNIMPLEMENTED)
     context.set_details("client-streaming RPCs are not supported by the "
                         "serve gRPC ingress")
+
+
+async def _unimplemented_unary(request_iter, context):
+    _set_unimplemented(context)
 
 
 async def _unimplemented_stream(request_iter, context):
-    import grpc
-
-    context.set_code(grpc.StatusCode.UNIMPLEMENTED)
-    context.set_details("client-streaming RPCs are not supported by the "
-                        "serve gRPC ingress")
+    _set_unimplemented(context)
     return
     yield  # pragma: no cover - makes this an async generator
 
@@ -164,24 +164,50 @@ class GrpcProxy:
 
     # -- routing --------------------------------------------------------
 
-    def _resolve(self, metadata) -> Optional[Dict[str, Any]]:
-        apps = self._table.get()
-        app = dict(metadata or {}).get("application")
-        if app:
-            return apps.get(app)
-        if len(apps) == 1:
-            return next(iter(apps.values()))
-        return apps.get("default")
+    # the gRPC ingress routes RPC METHOD names: deployments exposing
+    # only __call__ still serve them (opt-in resolution fallback flag;
+    # handle callers keep strict AttributeError semantics)
+    _CALL_META = {"_method_fallback": True}
 
-    def _call_blocking(self, service_method: str, request: Any, metadata):
-        target = self._resolve(metadata)
+    def _executor(self):
+        # a DEDICATED pool: cancelled calls can pin a thread for up to
+        # one ray_tpu.get timeout; on the loop's default executor that
+        # would starve every other handler in the process
+        pool = getattr(self, "_pool", None)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = self._pool = ThreadPoolExecutor(
+                max_workers=32, thread_name_prefix="serve-grpc")
+        return pool
+
+    def _router_for(self, service_method: str, metadata):
+        """(router, method) or KeyError with the user-facing message."""
+        app = dict(metadata or {}).get("application")
+
+        def pick(apps):
+            return (apps.get(app) if app
+                    else (next(iter(apps.values())) if len(apps) == 1
+                          else apps.get("default")))
+
+        target = pick(self._table.get())
+        if target is None:
+            # a just-deployed app may postdate the cached table: refetch
+            # once before answering NOT_FOUND (deploys are rare; the
+            # refetch is one controller call)
+            self._table.invalidate()
+            target = pick(self._table.get())
         if target is None:
             raise KeyError(
                 "no serve application matched; set the 'application' "
                 "request metadata")
-        method = service_method.rsplit("/", 1)[-1]
-        router = get_router(target["app"], target["deployment"])
-        ref, done = router.assign(method, (request,), {}, {})
+        return (get_router(target["app"], target["deployment"]),
+                service_method.rsplit("/", 1)[-1])
+
+    def _call_blocking(self, service_method: str, request: Any, metadata):
+        router, method = self._router_for(service_method, metadata)
+        ref, done = router.assign(method, (request,), {},
+                                  dict(self._CALL_META))
         try:
             return ray_tpu.get(ref, timeout=300.0)
         finally:
@@ -189,37 +215,36 @@ class GrpcProxy:
 
     def _stream_blocking_iter(self, service_method: str, request: Any,
                               metadata):
-        target = self._resolve(metadata)
-        if target is None:
-            raise KeyError(
-                "no serve application matched; set the 'application' "
-                "request metadata")
-        router = get_router(target["app"], target["deployment"])
-        gen, done = router.assign_streaming(
-            service_method.rsplit("/", 1)[-1], (request,), {}, {})
+        router, method = self._router_for(service_method, metadata)
+        gen, done = router.assign_streaming(method, (request,), {},
+                                            dict(self._CALL_META))
         try:
             for ref in gen:
                 yield ray_tpu.get(ref, timeout=300.0)
         finally:
             done()
 
-    def _handler_factory(self, service_method: str, stream: bool):
+    def _set_error(self, context, e, service_method):
         grpc = self._grpc
 
+        if isinstance(e, KeyError):
+            context.set_code(grpc.StatusCode.NOT_FOUND)
+            context.set_details(str(e))
+        else:
+            logger.exception("grpc %s failed", service_method)
+            context.set_code(grpc.StatusCode.INTERNAL)
+            context.set_details(f"{type(e).__name__}: {e}")
+
+    def _handler_factory(self, service_method: str, stream: bool):
         async def unary_unary(request, context):
             loop = asyncio.get_event_loop()
             try:
                 out = await loop.run_in_executor(
-                    None, self._call_blocking, service_method, request,
-                    dict(context.invocation_metadata()))
+                    self._executor(), self._call_blocking, service_method,
+                    request, dict(context.invocation_metadata()))
                 return _to_wire(out)
-            except KeyError as e:
-                context.set_code(grpc.StatusCode.NOT_FOUND)
-                context.set_details(str(e))
             except Exception as e:
-                logger.exception("grpc call %s failed", service_method)
-                context.set_code(grpc.StatusCode.INTERNAL)
-                context.set_details(f"{type(e).__name__}: {e}")
+                self._set_error(context, e, service_method)
 
         async def unary_stream(request, context):
             loop = asyncio.get_event_loop()
@@ -236,16 +261,31 @@ class GrpcProxy:
 
             try:
                 while True:
-                    item = await loop.run_in_executor(None, nxt)
+                    item = await loop.run_in_executor(self._executor(),
+                                                      nxt)
                     if item is sentinel:
                         break
                     yield _to_wire(item)
-            except KeyError as e:
-                context.set_code(grpc.StatusCode.NOT_FOUND)
-                context.set_details(str(e))
             except Exception as e:
-                logger.exception("grpc stream %s failed", service_method)
-                context.set_code(grpc.StatusCode.INTERNAL)
-                context.set_details(f"{type(e).__name__}: {e}")
+                self._set_error(context, e, service_method)
+            finally:
+                # client cancellation (CancelledError, a BaseException)
+                # abandons `it` mid-stream: close it from the pool so
+                # the router's done() fires as soon as the in-flight
+                # get returns, instead of waiting on GC.  close() on a
+                # generator mid-next raises ValueError — retry until the
+                # blocked get returns (bounded by its own timeout).
+                def _close_soon():
+                    import time as _t
+
+                    deadline = _t.monotonic() + 330.0
+                    while _t.monotonic() < deadline:
+                        try:
+                            it.close()
+                            return
+                        except ValueError:
+                            _t.sleep(0.5)
+
+                self._executor().submit(_close_soon)
 
         return unary_stream if stream else unary_unary

@@ -58,20 +58,23 @@ class Replica:
 
     # -- request path -------------------------------------------------------
 
-    def _resolve_target(self, method_name: Optional[str]):
+    def _resolve_target(self, method_name: Optional[str],
+                        allow_fallback: bool = False):
         if method_name in (None, "__call__") and callable(self._callable):
             return self._callable
-        target = getattr(self._callable, method_name or "__call__", None)
-        if target is None and callable(self._callable):
-            # a named route (e.g. a gRPC RPC method) on a deployment
-            # that only defines __call__: fall back to it (resolution
-            # only — exceptions raised INSIDE methods never retry here)
-            return self._callable
-        if target is None:
-            raise AttributeError(
-                f"deployment has no method {method_name!r} and is not "
-                "callable")
-        return target
+        if allow_fallback:
+            # opt-in (gRPC ingress routes RPC method names and declares
+            # the fallback): a deployment that only defines __call__
+            # still serves named RPCs.  NOT the default — handle callers
+            # typo-ing a method name must keep getting AttributeError,
+            # not a silently-wrong __call__ result.
+            target = getattr(self._callable, method_name or "__call__",
+                             None)
+            if target is None and callable(self._callable):
+                return self._callable
+            if target is not None:
+                return target
+        return getattr(self._callable, method_name or "__call__")
 
     async def handle_request(self, method_name: Optional[str], args, kwargs,
                              metadata: Optional[Dict[str, Any]] = None):
@@ -79,7 +82,10 @@ class Replica:
         self._total += 1
         token = _request_context.set(metadata or {})
         try:
-            out = self._resolve_target(method_name)(*args, **kwargs)
+            out = self._resolve_target(
+                method_name,
+                allow_fallback=bool((metadata or {}).get(
+                    "_method_fallback")))(*args, **kwargs)
             if inspect.iscoroutine(out):
                 out = await out
             return out
@@ -104,7 +110,10 @@ class Replica:
         token = _request_context.set(metadata or {})
         loop = None
         try:
-            out = self._resolve_target(method_name)(*args, **kwargs)
+            out = self._resolve_target(
+                method_name,
+                allow_fallback=bool((metadata or {}).get(
+                    "_method_fallback")))(*args, **kwargs)
             if inspect.iscoroutine(out):
                 # e.g. _FunctionWrapper: the coroutine may resolve to the
                 # generator itself

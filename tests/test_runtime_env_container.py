@@ -101,17 +101,15 @@ os.execvp(args[i + 1], args[i + 1:])
 
 
 @pytest.fixture
-def container_cluster(tmp_path, monkeypatch):
+def container_cluster(tmp_path, monkeypatch, private_cluster_slot):
     """Fresh cluster whose raylet resolves the shim as the runtime
     (env must be set BEFORE init so the raylet daemon inherits it)."""
     log_file = tmp_path / "shim_calls.jsonl"
     shim = _write_shim(tmp_path, log_file)
     monkeypatch.setenv("RAY_TPU_CONTAINER_RUNTIME", shim)
     monkeypatch.setenv("RAY_TPU_ALLOW_PKG_INSTALL", "1")
-    ray_tpu.shutdown()
     ray_tpu.init(num_cpus=2)
     yield log_file
-    ray_tpu.shutdown()
 
 
 def test_containerized_actor_e2e(container_cluster):
@@ -175,21 +173,19 @@ def test_plain_task_with_container_rejected(container_cluster):
         ray_tpu.get(ref, timeout=60)
 
 
-def test_actor_fails_loudly_without_runtime(tmp_path, monkeypatch):
+def test_actor_fails_loudly_without_runtime(tmp_path, monkeypatch,
+                                            private_cluster_slot):
     monkeypatch.setenv("RAY_TPU_CONTAINER_RUNTIME",
                        str(tmp_path / "missing-runtime"))
     monkeypatch.setenv("RAY_TPU_ALLOW_PKG_INSTALL", "1")
     monkeypatch.setenv("PATH", "/nonexistent:" + os.environ.get("PATH", ""))
-    ray_tpu.shutdown()
     ray_tpu.init(num_cpus=2)
-    try:
-        @ray_tpu.remote
-        class P:
-            def ping(self):
-                return 1
 
-        a = P.options(runtime_env={"image_uri": IMAGE}).remote()
-        with pytest.raises(Exception, match="spawn failed|container"):
-            ray_tpu.get(a.ping.remote(), timeout=90)
-    finally:
-        ray_tpu.shutdown()
+    @ray_tpu.remote
+    class P:
+        def ping(self):
+            return 1
+
+    a = P.options(runtime_env={"image_uri": IMAGE}).remote()
+    with pytest.raises(Exception, match="spawn failed|container"):
+        ray_tpu.get(a.ping.remote(), timeout=90)

@@ -88,6 +88,27 @@ def test_grpc_unknown_app_is_not_found(grpc_serve):
     assert e.value.code() == grpc.StatusCode.NOT_FOUND
 
 
+def test_grpc_call_only_deployment_serves_named_rpc(grpc_serve):
+    """A deployment exposing only __call__ still serves named RPC
+    methods (opt-in fallback on the gRPC path)."""
+    @serve.deployment
+    class CallOnly:
+        def __call__(self, request: bytes) -> bytes:
+            return b"from-call:" + request
+
+    serve.run(CallOnly.bind(), name="call_only", route_prefix=None)
+    host, port = grpc_serve
+    with grpc.insecure_channel(f"{host}:{port}") as ch:
+        reply = ch.unary_unary("/test.Echo/Predict")(
+            b"hi", metadata=(("application", "call_only"),), timeout=60)
+    assert reply == b"from-call:hi"
+    # handles stay STRICT: a typo'd method must not silently hit __call__
+    h = serve.get_app_handle("call_only")
+    with pytest.raises(Exception, match="Predcit|attribute"):
+        h.Predcit.remote(b"x").result(timeout_s=60)
+    serve.delete("call_only")
+
+
 def test_grpc_bad_payload_is_internal(grpc_serve):
     host, port = grpc_serve
     with grpc.insecure_channel(f"{host}:{port}") as ch:

@@ -123,7 +123,8 @@ def test_dead_metadata_server_probes_once(monkeypatch):
     acc._reset_metadata_cache()
 
 
-def test_gang_placement_consumes_head_resource(monkeypatch):
+def test_gang_placement_consumes_head_resource(monkeypatch,
+                                               private_cluster_slot):
     """The pod-head resource flows into the node's advertised resources
     and a task targeting it lands on the head node — the gang pattern
     from the reference docstring (tpu.py:361).
@@ -136,25 +137,21 @@ def test_gang_placement_consumes_head_resource(monkeypatch):
     monkeypatch.setenv("TPU_NAME", "gang-pod")
     monkeypatch.setenv("TPU_WORKER_ID", "0")
     monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-16")
-    ray_tpu.shutdown()
     ray_tpu.init()
-    try:
-        res = ray_tpu.cluster_resources()
-        heads = [r for r in res
-                 if r.startswith("TPU-") and r.endswith("-head")]
-        assert heads, f"no pod-head resource advertised: {res}"
-        assert res.get("gang-pod") == 1.0       # slice-name resource
+    res = ray_tpu.cluster_resources()
+    heads = [r for r in res
+             if r.startswith("TPU-") and r.endswith("-head")]
+    assert heads, f"no pod-head resource advertised: {res}"
+    assert res.get("gang-pod") == 1.0       # slice-name resource
 
-        @ray_tpu.remote(resources={heads[0]: 1})
-        def head_task():
-            return "on-head"
+    @ray_tpu.remote(resources={heads[0]: 1})
+    def head_task():
+        return "on-head"
 
-        assert ray_tpu.get(head_task.remote(), timeout=60) == "on-head"
+    assert ray_tpu.get(head_task.remote(), timeout=60) == "on-head"
 
-        @ray_tpu.remote(resources={"gang-pod": 1})
-        def on_slice():
-            return True
+    @ray_tpu.remote(resources={"gang-pod": 1})
+    def on_slice():
+        return True
 
-        assert ray_tpu.get(on_slice.remote(), timeout=60)
-    finally:
-        ray_tpu.shutdown()
+    assert ray_tpu.get(on_slice.remote(), timeout=60)
