@@ -70,6 +70,10 @@ CONFIG_DEFS: List[Tuple[str, type, Any, str]] = [
      "export task lifecycle events to the control plane"),
     ("max_task_events", int, 10000,
      "task events retained by the control plane"),
+    ("max_cluster_events", int, 10000,
+     "structured cluster events retained by the control plane "
+     "(node/actor/pg/job lifecycle; separate from task events so "
+     "tuning one buffer never evicts the other's history)"),
     # -- runtime env
     ("rtenv_max_bytes", int, 256 * 1024 * 1024,
      "max size of one runtime_env package"),

@@ -199,6 +199,10 @@ class ControlServer:
         self._event_thread = threading.Thread(
             target=self._event_merge_loop, name="control-task-events",
             daemon=True)
+        # structured cluster events (reference: src/ray/util/event.h):
+        # bounded, seq-ordered; fed by publish() + h_report_event
+        self.events: deque = deque(maxlen=_cfg().max_cluster_events)
+        self._event_seq = 0
         # pending-actor scheduler queue (reference: GcsActorScheduler)
         self.pending_actors: List[ActorRecord] = []
         self._sched_event = threading.Event()
@@ -247,6 +251,8 @@ class ControlServer:
         s.handle("cluster_resources", self.h_cluster_resources)
         s.handle("state_dump", self.h_state_dump)
         s.handle("report_task_events", self.h_report_task_events)
+        s.handle("list_events", self.h_list_events)
+        s.handle("report_event", self.h_report_event)
         s.handle("list_task_events", self.h_list_task_events, deferred=True)
         s.handle("list_profile_events", self.h_list_profile_events,
                  deferred=True)
@@ -639,6 +645,10 @@ class ControlServer:
         conn.meta["job_id"] = p["job_id"]
         if self.pstore is not None:
             self.pstore.rec_put("job", p["job_id"], self.jobs[p["job_id"]])
+        self.record_event(severity="INFO", source="job",
+                          event_type="started",
+                          message=f"job {p['job_id'][:20]} registered",
+                          entity_id=p["job_id"])
         return True
 
     # -- pubsub ------------------------------------------------------------
@@ -654,6 +664,7 @@ class ControlServer:
         return True
 
     def publish(self, topic: str, payload: Any):
+        self._maybe_record_event(topic, payload)
         with self.lock:
             conns = list(self.subs.get(topic, ()))
         dead = [c for c in conns if not c.push(f"pub:{topic}", payload)]
@@ -662,6 +673,96 @@ class ControlServer:
                 for c in dead:
                     for s in self.subs.values():
                         s.discard(c)
+
+    # -- structured cluster events -----------------------------------------
+    # reference: src/ray/util/event.h + dashboard/modules/event — durable,
+    # queryable records of lifecycle transitions (node died, actor failed,
+    # job finished), distinct from free-text logs.  publish() is the
+    # chokepoint every such transition already flows through.
+
+    _EVENT_SEVERITY = {  # (topic, event) -> severity; default INFO
+        ("node", "removed"): "WARNING",
+        ("actor", "dead"): "WARNING",
+        ("actor", "restarting"): "WARNING",
+        ("pg", "removed"): "INFO",
+        ("error", None): "ERROR",
+    }
+
+    def _maybe_record_event(self, topic: str, payload: Any):
+        if topic not in ("node", "actor", "pg", "job", "error"):
+            return
+        p = payload if isinstance(payload, dict) else {"data": payload}
+        ev = p.get("event", topic)
+        entity = (p.get("node", {}).get("node_id", "")
+                  if "node" in p else
+                  p.get("actor", {}).get("actor_id", "")
+                  if "actor" in p else
+                  p.get("pg", {}).get("pg_id", p.get("pg_id", ""))
+                  if topic == "pg" else
+                  p.get("job_id", p.get("submission_id", "")))
+        sev = self._EVENT_SEVERITY.get((topic, ev)) \
+            or self._EVENT_SEVERITY.get((topic, None)) or "INFO"
+        # actor death with an error message is an ERROR, not a shutdown
+        if topic == "actor" and ev == "dead" \
+                and p.get("actor", {}).get("error"):
+            sev = "ERROR"
+        msg = f"{topic} {entity[:20]} {ev}"
+        err = (p.get("actor", {}) or {}).get("error") or p.get("error")
+        if err:
+            msg += f": {str(err)[:300]}"
+        self.record_event(severity=sev, source=topic, event_type=ev,
+                          message=msg, entity_id=entity)
+
+    def record_event(self, *, severity: str, source: str, event_type: str,
+                     message: str, entity_id: str = "",
+                     custom: Optional[Dict[str, Any]] = None):
+        """Append one structured event (bounded buffer, monotonic seq)."""
+        with self.lock:
+            self._event_seq += 1
+            self.events.append({
+                "seq": self._event_seq,
+                "ts": time.time(),
+                "severity": severity,
+                "source": source,
+                "event_type": event_type,
+                "entity_id": entity_id,
+                "message": message,
+                **({"custom": custom} if custom else {}),
+            })
+
+    def h_report_event(self, conn, p):
+        """External emitters (raylets, libraries) push structured events
+        (reference: the event agent's ReportEvents RPC)."""
+        self.record_event(
+            severity=str(p.get("severity", "INFO")).upper(),
+            source=str(p.get("source", "user")),
+            event_type=str(p.get("event_type", "custom")),
+            message=str(p.get("message", ""))[:2000],
+            entity_id=str(p.get("entity_id", "")),
+            custom=p.get("custom"))
+        return True
+
+    def h_list_events(self, conn, p):
+        """Filterable, seq-ordered slice of the event buffer.
+
+        With a cursor (after_seq > 0) the OLDEST `limit` matches after
+        the cursor return, so pollers that fall behind page forward
+        without silently skipping the middle; cursorless calls (the
+        dashboard) get the newest `limit`."""
+        sev = p.get("severity")
+        src = p.get("source")
+        ent = p.get("entity_id")
+        after = int(p.get("after_seq") or 0)
+        limit = max(0, int(p.get("limit", 1000)))
+        if limit == 0:
+            return []
+        with self.lock:
+            out = [e for e in self.events
+                   if e["seq"] > after
+                   and (sev is None or e["severity"] == sev)
+                   and (src is None or e["source"] == src)
+                   and (ent is None or e["entity_id"] == ent)]
+        return out[:limit] if after else out[-limit:]
 
     # -- raylet client cache ----------------------------------------------
 

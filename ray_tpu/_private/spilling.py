@@ -313,4 +313,17 @@ class OomKiller:
             return False
         self.n_killed += 1
         self._last_kill = now
+        try:
+            # structured cluster event (reference: the OOM killer's
+            # ray.event emission) — dashboards/state API surface it
+            self.raylet.control.notify("report_event", {
+                "severity": "ERROR", "source": "raylet",
+                "event_type": "worker_oom_killed",
+                "entity_id": victim.worker_id,
+                "message": (f"memory {self.monitor.last_fraction:.0%} > "
+                            f"{self.monitor.threshold:.0%}: killed worker "
+                            f"{victim.worker_id[:12]} (most recent lease)"),
+            })
+        except Exception:
+            pass
         return True

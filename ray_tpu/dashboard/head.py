@@ -148,6 +148,14 @@ class DashboardHead:
                 if info is None:
                     return 404, "text/plain", f"no job {rest}"
                 return self._json(info)
+            if path == "/api/events":
+                # structured cluster events (reference: dashboard
+                # modules/event); ?severity=&source=&limit=
+                return self._json(self.control.call("list_events", {
+                    "severity": (query.get("severity") or [None])[0],
+                    "source": (query.get("source") or [None])[0],
+                    "limit": int((query.get("limit") or ["200"])[0]),
+                }, timeout=10.0))
             if path == "/api/tasks":
                 limit = int(query.get("limit", ["1000"])[0])
                 out = self.control.call("list_task_events",

@@ -64,6 +64,7 @@ PAGE = """<!doctype html>
   <h2>Jobs</h2><table id="jobs"></table>
   <h2>Placement groups</h2><table id="pgs"></table>
   <h2>Recent task events</h2><table id="tasks"></table>
+  <h2>Cluster events</h2><table id="events"></table>
 </main>
 <script>
 const fmt = (x) => x === null || x === undefined ? "" :
@@ -198,10 +199,12 @@ function drawTimeline(records, serverNow) {
 }
 async function tick() {
   try {
-    const [cs, nodes, actors, jobs, pgs, tasks, ver] = await Promise.all([
+    const [cs, nodes, actors, jobs, pgs, tasks, events, ver] =
+      await Promise.all([
       j("/api/cluster_status"), j("/api/nodes"), j("/api/actors"),
       j("/api/jobs"), j("/api/placement_groups"),
-      j("/api/tasks?limit=50"), j("/api/version")]);
+      j("/api/tasks?limit=50"), j("/api/events?limit=30"),
+      j("/api/version")]);
     document.getElementById("addr").textContent = ver.control_address;
     const total = cs.total_resources || {}, avail = cs.available_resources || {};
     const card = (k, v) => `<div class="card"><div class="v">${v}</div><div class="k">${k}</div></div>`;
@@ -220,6 +223,9 @@ async function tick() {
     table("jobs", jobs, ["submission_id", "entrypoint", "status", "message"]);
     table("pgs", pgs, ["pg_id", "name", "state", "bundles", "strategy"]);
     table("tasks", tasks.records || [], ["task_id", "name", "state", "actor_id", "error"]);
+    const evs = (events || []).slice().reverse().map(e => ({
+      ...e, when: new Date(e.ts * 1000).toLocaleTimeString()}));
+    table("events", evs, ["when", "severity", "source", "event_type", "entity_id", "message"]);
     document.getElementById("ts").textContent = new Date().toLocaleTimeString();
     document.getElementById("err").textContent = "";
   } catch (e) { document.getElementById("err").textContent = " " + e; }

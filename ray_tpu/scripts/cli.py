@@ -358,7 +358,7 @@ def cmd_serve(args):
 # -- state commands ----------------------------------------------------------
 
 _LISTABLE = ("nodes", "actors", "tasks", "workers", "objects",
-             "placement_groups", "jobs")
+             "placement_groups", "jobs", "cluster_events")
 
 
 def cmd_list(args):

@@ -259,33 +259,34 @@ class GrpcProxy:
                 except StopIteration:
                     return sentinel
 
+            def safe_close(_f=None):
+                try:
+                    it.close()
+                except Exception:
+                    pass
+
+            fut = None
+            finished = False
             try:
                 while True:
-                    item = await loop.run_in_executor(self._executor(),
-                                                      nxt)
+                    fut = loop.run_in_executor(self._executor(), nxt)
+                    item = await fut
                     if item is sentinel:
+                        finished = True
                         break
                     yield _to_wire(item)
             except Exception as e:
+                # nxt returned (by raising): the generator is idle, the
+                # inline close runs its finally -> router done() fires
                 self._set_error(context, e, service_method)
+                finished = True
+                safe_close()
             finally:
-                # client cancellation (CancelledError, a BaseException)
-                # abandons `it` mid-stream: close it from the pool so
-                # the router's done() fires as soon as the in-flight
-                # get returns, instead of waiting on GC.  close() on a
-                # generator mid-next raises ValueError — retry until the
-                # blocked get returns (bounded by its own timeout).
-                def _close_soon():
-                    import time as _t
-
-                    deadline = _t.monotonic() + 330.0
-                    while _t.monotonic() < deadline:
-                        try:
-                            it.close()
-                            return
-                        except ValueError:
-                            _t.sleep(0.5)
-
-                self._executor().submit(_close_soon)
+                if not finished and fut is not None:
+                    # client cancellation (CancelledError) abandoned the
+                    # await mid-nxt: close the generator the moment the
+                    # blocked next() returns — no polling thread, no
+                    # extra pool task on the happy path
+                    fut.add_done_callback(safe_close)
 
         return unary_stream if stream else unary_unary

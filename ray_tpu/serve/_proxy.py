@@ -392,8 +392,12 @@ class RpcProxy:
                 router = get_router(target["app"], target["deployment"])
                 args = p.get("args", ())
                 kwargs = p.get("kwargs", {})
+                # named-method ingress routes RPC method names: keep the
+                # __call__ fallback (same contract as the gRPC ingress);
+                # handle callers stay strict
                 ref, done = router.assign(p.get("method"), tuple(args),
-                                          dict(kwargs), {})
+                                          dict(kwargs),
+                                          {"_method_fallback": True})
                 try:
                     out = ray_tpu.get(ref, timeout=300.0)
                 finally:

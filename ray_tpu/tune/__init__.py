@@ -13,7 +13,8 @@ from .schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
                          PopulationBasedTraining, TrialScheduler)
 from .search import (BasicVariantGenerator, BayesOptSearch, Categorical,
                      ConcurrencyLimiter,
-                     Domain, Float, Integer, Repeater, Searcher, TPESearch,
+                     Domain, Float, Integer, Repeater, Searcher,
+                     SearcherWrapper, TPESearch,
                      choice, generate_variants, grid_search, loguniform,
                      randint, sample_from, uniform)
 from .trial import Trial
@@ -29,7 +30,8 @@ __all__ = [
     "FIFOScheduler", "Float", "HyperBandScheduler", "Integer",
     "JsonLoggerCallback", "MedianStoppingRule", "PB2",
     "PopulationBasedTraining", "Repeater", "ResultGrid", "Searcher",
-    "TPESearch", "Trial", "TrialScheduler", "TuneConfig", "TuneController",
+    "SearcherWrapper", "TPESearch", "Trial", "TrialScheduler",
+    "TuneConfig", "TuneController",
     "Tuner", "choice", "generate_variants", "get_checkpoint", "get_context",
     "grid_search", "loguniform", "randint", "report", "run", "sample_from",
     "uniform",

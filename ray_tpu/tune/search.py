@@ -512,3 +512,81 @@ class BayesOptSearch(Searcher):
         if self.mode == "min":
             score = -score
         self._obs.append((u, cats, score))
+
+
+class SearcherWrapper(Searcher):
+    """Adapt any ask/tell optimizer object into a Tune Searcher
+    (reference: python/ray/tune/search/ ships nine per-library
+    integrations — OptunaSearch, HyperOptSearch, AxSearch, BOHB, HEBO,
+    Nevergrad, ZOOpt... — all of which reduce to an ask/tell loop; this
+    one duck-typed shim covers that surface without bundling any of
+    the libraries).
+
+    The wrapped object needs:
+      * ``ask()`` returning either a config dict, or a trial-like
+        object whose config is found under ``.params`` / ``.config``
+        / ``.args`` (optuna's ``study.ask()`` returns a Trial with
+        ``.params``... populated on access; for such lazy objects pass
+        ``to_config=`` to extract the dict yourself), and
+      * ``tell(token, value)`` where ``token`` is exactly what ask()
+        returned (skopt/nevergrad style) — the wrapper remembers it
+        per trial.
+
+    ``mode="max"`` negates values before tell() for minimizers (every
+    ask/tell library minimizes by default; pass ``minimize=False`` if
+    yours maximizes).
+
+    The run ends when ``ask()`` returns None — the wrapped optimizer
+    owns the trial budget (wrap in ConcurrencyLimiter/your own counter
+    for unbounded optimizers).
+    """
+
+    def __init__(self, opt, metric: Optional[str] = None,
+                 mode: str = "max", *, to_config=None,
+                 minimize: bool = True):
+        super().__init__(metric=metric, mode=mode)
+        for attr in ("ask", "tell"):
+            if not callable(getattr(opt, attr, None)):
+                raise TypeError(
+                    f"SearcherWrapper needs an object with ask()/tell(); "
+                    f"{type(opt).__name__} has no {attr}()")
+        self._opt = opt
+        self._to_config = to_config
+        self._minimize = minimize
+        self._tokens: Dict[str, Any] = {}
+
+    def _extract(self, token) -> Dict[str, Any]:
+        if self._to_config is not None:
+            return dict(self._to_config(token))
+        if isinstance(token, dict):
+            return dict(token)
+        for attr in ("params", "config", "args"):
+            cfg = getattr(token, attr, None)
+            if isinstance(cfg, dict):
+                return dict(cfg)
+        raise TypeError(
+            f"cannot extract a config dict from {type(token).__name__}; "
+            "pass to_config= to SearcherWrapper")
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        token = self._opt.ask()
+        if token is None:
+            return None            # optimizer exhausted
+        self._tokens[trial_id] = token
+        return self._extract(token)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        token = self._tokens.pop(trial_id, None)
+        if token is None:
+            return
+        if error or not result or self.metric not in result:
+            # most ask/tell libraries accept a failure signal as a very
+            # bad value; losing one observation is safer than feeding a
+            # fake number — skip the tell
+            return
+        value = float(result[self.metric])
+        if self._minimize and self.mode == "max":
+            value = -value
+        elif not self._minimize and self.mode == "min":
+            value = -value
+        self._opt.tell(token, value)

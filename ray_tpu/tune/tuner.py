@@ -123,6 +123,12 @@ class Tuner:
             searcher = cfg.search_alg or BasicVariantGenerator(
                 self._param_space, num_samples=cfg.num_samples, seed=cfg.seed,
                 metric=cfg.metric, mode=cfg.mode)
+        if getattr(searcher, "metric", None) is None and cfg.metric:
+            # user-supplied search_alg without an explicit metric: inherit
+            # the TuneConfig's (same backfill the scheduler gets below) —
+            # otherwise ask/tell searchers silently never observe results
+            searcher.metric = cfg.metric
+            searcher.mode = cfg.mode
         scheduler = cfg.scheduler
         if scheduler is not None and scheduler.metric is None:
             scheduler.metric = cfg.metric

@@ -139,6 +139,23 @@ def list_jobs(address: Optional[str] = None, *, filters=None,
     return _run(address, go)
 
 
+def list_cluster_events(address: Optional[str] = None, *,
+                        severity: Optional[str] = None,
+                        source: Optional[str] = None,
+                        entity_id: Optional[str] = None,
+                        after_seq: int = 0,
+                        limit: int = 1000) -> List[Dict[str, Any]]:
+    """Structured cluster events (reference: `ray list cluster-events`,
+    src/ray/util/event.h): node/actor/PG/job lifecycle transitions with
+    severity, distinct from free-text logs."""
+    def go(c):
+        return c._control.call("list_events", {
+            "severity": severity, "source": source,
+            "entity_id": entity_id, "after_seq": after_seq,
+            "limit": limit}, timeout=10.0)
+    return _run(address, go)
+
+
 def list_tasks(address: Optional[str] = None, *, filters=None,
                limit: int = 1000) -> List[Dict[str, Any]]:
     def go(c):

@@ -1,0 +1,112 @@
+"""Structured cluster-event framework (reference: src/ray/util/event.h +
+dashboard/modules/event + `ray list cluster-events`): lifecycle
+transitions recorded as bounded, severity-tagged, queryable events —
+distinct from free-text logs."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.state import list_cluster_events
+
+
+def _wait_for(pred, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        evs = list_cluster_events(limit=5000)
+        got = [e for e in evs if pred(e)]
+        if got:
+            return got
+        time.sleep(0.25)
+    raise AssertionError("no matching event appeared")
+
+
+def test_node_and_job_events_recorded(ray_cluster):
+    evs = list_cluster_events(limit=5000)
+    assert any(e["source"] == "node" and e["event_type"] == "added"
+               for e in evs)
+    assert any(e["source"] == "job" and e["event_type"] == "started"
+               for e in evs)
+    # shape: monotonically increasing seq, ts, severity present
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs)
+    assert all(e["severity"] in ("INFO", "WARNING", "ERROR")
+               for e in evs)
+
+
+def test_actor_death_event_with_severity(ray_cluster):
+    @ray_tpu.remote
+    class Doomed:
+        def boom(self):
+            import os
+
+            os._exit(1)
+
+    a = Doomed.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(a.boom.remote(), timeout=60)
+    got = _wait_for(lambda e: e["source"] == "actor"
+                    and e["event_type"] == "dead"
+                    and e["severity"] in ("WARNING", "ERROR"))
+    assert got[-1]["entity_id"]
+
+
+def test_user_reported_events_and_filters(ray_cluster):
+    from ray_tpu._private.core import current_core
+
+    core = current_core()
+    core.control.call("report_event", {
+        "severity": "error", "source": "mylib",
+        "event_type": "shard_corrupt", "entity_id": "shard-7",
+        "message": "checksum mismatch on shard-7",
+        "custom": {"attempt": 3}}, timeout=10)
+    got = _wait_for(lambda e: e["source"] == "mylib")
+    assert got[-1]["severity"] == "ERROR"          # normalized upper
+    assert got[-1]["custom"] == {"attempt": 3}
+    # server-side filters
+    only = list_cluster_events(source="mylib")
+    assert only and all(e["source"] == "mylib" for e in only)
+    none = list_cluster_events(source="mylib", severity="INFO")
+    assert none == []
+    by_entity = list_cluster_events(entity_id="shard-7")
+    assert by_entity and by_entity[-1]["event_type"] == "shard_corrupt"
+    # after_seq pagination
+    seq = got[-1]["seq"]
+    assert list_cluster_events(source="mylib", after_seq=seq) == []
+
+
+def test_dashboard_events_endpoint(ray_cluster):
+    import json
+    import urllib.request
+
+    from ray_tpu.dashboard.head import DashboardHead
+
+    info = ray_tpu.connection_info()
+    head = DashboardHead(info["control_address"], port=0)
+    head.start()
+    try:
+        url = f"http://127.0.0.1:{head.port}/api/events?limit=10"
+        with urllib.request.urlopen(url, timeout=30) as r:
+            evs = json.loads(r.read())
+        assert isinstance(evs, list) and len(evs) <= 10
+        assert all("severity" in e and "message" in e for e in evs)
+    finally:
+        head.stop()
+
+
+def test_cli_lists_cluster_events(ray_cluster):
+    import subprocess
+    import sys
+
+    info = ray_tpu.connection_info()
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "list",
+         "cluster_events", "--address", info["control_address"],
+         "--format", "json"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr[-500:]
+    import json
+
+    rows = json.loads(out.stdout)
+    assert rows and all("event_type" in r for r in rows)

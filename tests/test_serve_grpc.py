@@ -102,6 +102,11 @@ def test_grpc_call_only_deployment_serves_named_rpc(grpc_serve):
         reply = ch.unary_unary("/test.Echo/Predict")(
             b"hi", metadata=(("application", "call_only"),), timeout=60)
     assert reply == b"from-call:hi"
+    # the binary RPC ingress keeps the same named-method fallback
+    rpc_addr = serve.start_rpc_proxy()
+    out = serve.RpcClient(rpc_addr).call("call_only", b"yo",
+                                         method="Predict")
+    assert out == b"from-call:yo"
     # handles stay STRICT: a typo'd method must not silently hit __call__
     h = serve.get_app_handle("call_only")
     with pytest.raises(Exception, match="Predcit|attribute"):

@@ -1,0 +1,105 @@
+"""SearcherWrapper: any ask/tell optimizer as a Tune searcher
+(reference: python/ray/tune/search/'s nine per-library integrations —
+Optuna/HyperOpt/Ax/BOHB/HEBO/Nevergrad/ZOOpt... all reduce to ask/tell;
+one duck-typed shim covers the surface without bundling any library)."""
+
+import pytest
+
+from ray_tpu import tune
+from ray_tpu.tune import SearcherWrapper
+
+
+class _SkoptLike:
+    """ask() -> config dict; tell(config, value); minimizes."""
+
+    def __init__(self, grid):
+        self.grid = list(grid)
+        self.told = []
+
+    def ask(self):
+        return self.grid.pop(0) if self.grid else None
+
+    def tell(self, token, value):
+        self.told.append((token, value))
+
+
+class _OptunaLike:
+    """ask() -> trial-like with .params; tell(trial, value)."""
+
+    class T:
+        def __init__(self, params):
+            self.params = params
+
+    def __init__(self, grid):
+        self.grid = [self.T(g) for g in grid]
+        self.told = []
+
+    def ask(self):
+        return self.grid.pop(0) if self.grid else None
+
+    def tell(self, trial, value):
+        self.told.append((trial, value))
+
+
+def test_requires_ask_tell():
+    with pytest.raises(TypeError, match="ask"):
+        SearcherWrapper(object(), metric="score")
+
+
+def test_dict_ask_and_mode_negation():
+    opt = _SkoptLike([{"x": 1.0}, {"x": 2.0}])
+    s = SearcherWrapper(opt, metric="score", mode="max")
+    c1 = s.suggest("t1")
+    assert c1 == {"x": 1.0}
+    s.on_trial_complete("t1", {"score": 5.0})
+    # maximizing over a minimizer: value negated
+    assert opt.told == [({"x": 1.0}, -5.0)]
+    assert s.suggest("t2") == {"x": 2.0}
+    assert s.suggest("t3") is None      # exhausted
+
+
+def test_trial_like_token_and_error_skips_tell():
+    opt = _OptunaLike([{"lr": 0.1}])
+    s = SearcherWrapper(opt, metric="loss", mode="min")
+    cfg = s.suggest("t1")
+    assert cfg == {"lr": 0.1}
+    s.on_trial_complete("t1", error=True)
+    assert opt.told == []               # failures are not fake values
+
+
+def test_to_config_extractor():
+    class Weird:
+        def __init__(self, kv):
+            self.kv = kv
+
+    class Opt:
+        def ask(self):
+            return Weird({"a": 3})
+
+        def tell(self, token, value):
+            pass
+
+    s = SearcherWrapper(Opt(), metric="m", to_config=lambda t: t.kv)
+    assert s.suggest("t") == {"a": 3}
+
+
+def test_end_to_end_through_tuner(ray_cluster, tmp_path):
+    opt = _SkoptLike([{"x": 1.0}, {"x": 3.0}, {"x": 2.0}])
+
+    def obj(config):
+        tune.report({"score": config["x"] * 10})
+
+    from ray_tpu.train import RunConfig
+
+    tuner = tune.Tuner(
+        obj,
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=3,
+            search_alg=SearcherWrapper(opt, metric="score", mode="max")),
+        run_config=RunConfig(name="wrap", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert grid.num_errors == 0
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 30.0
+    # every completed trial was told back, negated for the minimizer
+    assert sorted(v for _, v in opt.told) == [-30.0, -20.0, -10.0]
