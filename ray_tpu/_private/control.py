@@ -664,7 +664,13 @@ class ControlServer:
         return True
 
     def publish(self, topic: str, payload: Any):
-        self._maybe_record_event(topic, payload)
+        try:
+            # event recording must never break pubsub delivery: user
+            # payloads on these topics may have any shape
+            self._maybe_record_event(topic, payload)
+        except Exception:
+            logger.debug("event recording failed for topic %s", topic,
+                         exc_info=True)
         with self.lock:
             conns = list(self.subs.get(topic, ()))
         dead = [c for c in conns if not c.push(f"pub:{topic}", payload)]
@@ -750,6 +756,7 @@ class ControlServer:
         without silently skipping the middle; cursorless calls (the
         dashboard) get the newest `limit`."""
         sev = p.get("severity")
+        sev = sev.upper() if sev else None   # stored normalized upper
         src = p.get("source")
         ent = p.get("entity_id")
         after = int(p.get("after_seq") or 0)

@@ -59,6 +59,32 @@ class DashboardHead:
     def _state_dump(self) -> Dict[str, Any]:
         return self.control.call("state_dump", {}, timeout=10.0)
 
+    def _node_calls(self, node: Dict[str, Any], *calls):
+        """Several RPCs to a node's raylet over ONE connection
+        (drill-down endpoints); each call is (method, payload).
+        Returns a list of results; a failed call yields None and the
+        error string is returned as the trailing element."""
+        from ray_tpu._private.protocol import Client
+
+        out = []
+        err = None
+        try:
+            c = Client(tuple(node["addr"]), name="dashboard-node")
+            try:
+                for method, payload in calls:
+                    try:
+                        out.append(c.call(method, payload or {},
+                                          timeout=10.0))
+                    except Exception as e:
+                        out.append(None)
+                        err = err or str(e)
+            finally:
+                c.close()
+        except Exception as e:
+            err = str(e)
+            out += [None] * (len(calls) - len(out))
+        return out + [err]
+
     def _job_client(self):
         """Lazy full driver connection for job submission (reference: the
         job head submits through an internal JobSubmissionClient).
@@ -160,6 +186,63 @@ class DashboardHead:
                 limit = int(query.get("limit", ["1000"])[0])
                 out = self.control.call("list_task_events",
                                         {"limit": limit}, timeout=10.0)
+                return self._json(out)
+            if path == "/api/node":
+                # per-node drill-down: the node view + its raylet's live
+                # worker/lease tables + log file list (reference: the
+                # dashboard's node detail page)
+                nid = (query.get("node_id") or [None])[0]
+                node = next((n for n in self._state_dump()["nodes"]
+                             if n["node_id"] == nid), None)
+                if node is None:
+                    return 404, "text/plain", f"no node {nid}"
+                detail = dict(node)
+                if node["state"] == "ALIVE":
+                    workers, leases, logs, err = self._node_calls(
+                        node, ("list_workers", None),
+                        ("list_leases", None), ("list_logs", None))
+                    # endpoint contract: these fields are LISTS; raylet
+                    # failures ride a separate error key
+                    detail["workers"] = workers \
+                        if isinstance(workers, list) else []
+                    detail["leases"] = leases \
+                        if isinstance(leases, list) else []
+                    detail["logs"] = logs.get("logs", []) \
+                        if isinstance(logs, dict) else []
+                    if err:
+                        detail["error"] = err
+                return self._json(detail)
+            if path == "/api/actor":
+                aid = (query.get("actor_id") or [None])[0]
+                actor = self.control.call("get_actor",
+                                          {"actor_id": aid}, timeout=10.0)
+                if actor is None:
+                    return 404, "text/plain", f"no actor {aid}"
+                # this actor's task events round out the drill-down
+                evs = self.control.call(
+                    "list_task_events",
+                    {"limit": 100, "filters": {"actor_id": aid}},
+                    timeout=10.0)
+                return self._json({**actor, "task_events":
+                                   (evs or {}).get("records", [])})
+            if path == "/api/log_tail":
+                nid = (query.get("node_id") or [None])[0]
+                name = (query.get("name") or [None])[0]
+                tail = int((query.get("tail_bytes") or ["65536"])[0])
+                node = next((n for n in self._state_dump()["nodes"]
+                             if n["node_id"] == nid), None)
+                if node is None or not name:
+                    return 404, "text/plain", "need node_id and name"
+                text, err = self._node_calls(
+                    node, ("read_log", {"name": name,
+                                        "tail_bytes": tail}))
+                if text is None and not err:
+                    # the raylet rejected the name (traversal guard)
+                    err = f"log {name!r} not readable"
+                out = {"node_id": nid, "name": name,
+                       "text": text if isinstance(text, str) else ""}
+                if err:
+                    out["error"] = err
                 return self._json(out)
             if path == "/api/objects":
                 from ray_tpu.util.state.api import StateApiClient

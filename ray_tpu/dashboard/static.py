@@ -46,11 +46,28 @@ PAGE = """<!doctype html>
   .DEAD, .FAILED { color: #c0262d; }
   .PENDING, .RESTARTING { color: #b26a00; }
   #err { color: #c0262d; font-size: 12px; }
+  tbody tr { cursor: pointer; }
+  #panel { position: fixed; top: 0; right: 0; width: 46%; height: 100%;
+           background: #fff; box-shadow: -4px 0 16px rgba(0,0,0,.25);
+           padding: 14px 18px; overflow: auto; display: none;
+           z-index: 10; }
+  #panel.open { display: block; }
+  #panel h3 { margin: 4px 0 10px; font-size: 14px; }
+  #panel pre { background: #16161f; color: #d8d8e8; padding: 10px;
+               border-radius: 6px; font-size: 11px; overflow: auto;
+               max-height: 45vh; white-space: pre-wrap; }
+  #panel .close { float: right; cursor: pointer; font-size: 18px;
+                  color: #667; }
+  #panel .loglink { color: #2a5bd7; cursor: pointer; display: block;
+                    font-family: ui-monospace, monospace; font-size: 12px;
+                    padding: 1px 0; }
 </style>
 </head>
 <body>
 <header><h1>ray_tpu</h1><span class="sub" id="addr"></span>
 <span class="sub" id="ts"></span><span id="err"></span></header>
+<div id="panel"><span class="close" onclick="closePanel()">&times;</span>
+  <div id="panel-body"></div></div>
 <main>
   <div class="cards" id="cards"></div>
   <h2>Cluster CPU utilization (last 5 min)</h2>
@@ -72,9 +89,20 @@ const fmt = (x) => x === null || x === undefined ? "" :
 const esc = (s) => s.replace(/&/g, "&amp;").replace(/</g, "&lt;")
   .replace(/>/g, "&gt;").replace(/"/g, "&quot;");
 const RAW = Symbol("raw-html");  // unforgeable marker for page-built cells
+const drill = {};   // table id -> row click handler (drill-down panel)
 function table(el, rows, cols) {
   const t = document.getElementById(el);
   if (!rows || !rows.length) { t.innerHTML = "<tr><td>none</td></tr>"; return; }
+  t._rows = rows.slice(0, 50);
+  if (drill[el] && !t._wired) {
+    t._wired = true;
+    t.addEventListener("click", ev => {
+      const tr = ev.target.closest("tr");
+      if (!tr || !tr.parentNode) return;
+      const i = [...tr.parentNode.children].indexOf(tr) - 1; // header row
+      if (i >= 0 && t._rows && t._rows[i]) drill[el](t._rows[i]);
+    });
+  }
   let h = "<tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("") + "</tr>";
   for (const r of rows.slice(0, 50)) {
     h += "<tr>" + cols.map(c => {
@@ -197,6 +225,60 @@ function drawTimeline(records, serverNow) {
     li++;
   }
 }
+// ---- drill-down panel (node detail / actor detail / task record / logs)
+function closePanel() { document.getElementById("panel").classList.remove("open"); }
+function panel(title, html) {
+  document.getElementById("panel-body").innerHTML =
+    `<h3>${esc(title)}</h3>` + html;
+  document.getElementById("panel").classList.add("open");
+}
+function miniTable(rows, cols) {
+  if (!rows || !rows.length) return "<div>none</div>";
+  let h = "<table><tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("") + "</tr>";
+  for (const r of rows.slice(0, 40))
+    h += "<tr>" + cols.map(c => `<td>${esc(fmt(r[c]).slice(0, 60))}</td>`).join("") + "</tr>";
+  return h + "</table>";
+}
+async function openNode(n) {
+  const d = await j("/api/node?node_id=" + encodeURIComponent(n.node_id));
+  panel("node " + d.node_id,
+    `<pre>${esc(JSON.stringify({addr: d.addr, state: d.state, total: d.total,
+      available: d.available, labels: d.labels}, null, 1))}</pre>` +
+    "<h3>workers</h3>" + miniTable(d.workers || [],
+      ["worker_id", "pid", "state", "actor_id", "blocked"]) +
+    "<h3>leases</h3>" + miniTable(d.leases || [],
+      ["worker_id", "state", "lease_resources", "bundle_key"]) +
+    "<h3>logs</h3>" + (d.logs || []).map(lg =>
+      `<span class="loglink" data-log="${esc(lg.name)}">${esc(lg.name)} ` +
+      `(${lg.size_bytes ?? "?"} B)</span>`).join("") +
+    `<pre id="logview" style="display:none"></pre>`);
+  document.getElementById("panel-body").querySelectorAll(".loglink")
+    .forEach(a => a.addEventListener("click", async () => {
+      const r = await j("/api/log_tail?node_id=" +
+        encodeURIComponent(n.node_id) + "&name=" +
+        encodeURIComponent(a.dataset.log));
+      const v = document.getElementById("logview");
+      v.style.display = "block";
+      // textContent: no HTML sink
+      v.textContent = r.error ? "ERROR: " + r.error
+                              : (r.text || "(empty)");
+    }));
+}
+async function openActor(a) {
+  const d = await j("/api/actor?actor_id=" + encodeURIComponent(a.actor_id));
+  const evs = d.task_events || [];
+  delete d.task_events;
+  panel("actor " + a.actor_id,
+    `<pre>${esc(JSON.stringify(d, null, 1))}</pre>` +
+    "<h3>recent tasks</h3>" +
+    miniTable(evs, ["task_id", "name", "state", "error"]));
+}
+function openTask(t) {
+  panel("task " + t.task_id, `<pre>${esc(JSON.stringify(t, null, 1))}</pre>`);
+}
+drill.nodes = openNode; drill.actors = openActor; drill.tasks = openTask;
+document.addEventListener("keydown", e => { if (e.key === "Escape") closePanel(); });
+
 async function tick() {
   try {
     const [cs, nodes, actors, jobs, pgs, tasks, events, ver] =

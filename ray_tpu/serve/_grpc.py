@@ -265,12 +265,18 @@ class GrpcProxy:
                 except Exception:
                     pass
 
-            fut = None
+            cf = None
             finished = False
             try:
                 while True:
-                    fut = loop.run_in_executor(self._executor(), nxt)
-                    item = await fut
+                    # submit() + wrap_future, NOT run_in_executor: the
+                    # cleanup callback must attach to the CONCURRENT
+                    # future, which completes only when nxt() really
+                    # returns — a cancelled asyncio wrapper is "done"
+                    # immediately, and closing then raises ValueError
+                    # (generator still executing) and leaks the slot
+                    cf = self._executor().submit(nxt)
+                    item = await asyncio.wrap_future(cf)
                     if item is sentinel:
                         finished = True
                         break
@@ -282,11 +288,11 @@ class GrpcProxy:
                 finished = True
                 safe_close()
             finally:
-                if not finished and fut is not None:
-                    # client cancellation (CancelledError) abandoned the
-                    # await mid-nxt: close the generator the moment the
-                    # blocked next() returns — no polling thread, no
-                    # extra pool task on the happy path
-                    fut.add_done_callback(safe_close)
+                if not finished and cf is not None:
+                    # client cancellation abandoned the await mid-nxt:
+                    # close the generator the moment the blocked next()
+                    # returns — no polling thread, no extra pool task on
+                    # the happy path
+                    cf.add_done_callback(safe_close)
 
         return unary_stream if stream else unary_unary

@@ -123,12 +123,18 @@ class Tuner:
             searcher = cfg.search_alg or BasicVariantGenerator(
                 self._param_space, num_samples=cfg.num_samples, seed=cfg.seed,
                 metric=cfg.metric, mode=cfg.mode)
-        if getattr(searcher, "metric", None) is None and cfg.metric:
-            # user-supplied search_alg without an explicit metric: inherit
+        if cfg.metric:
+            # user-supplied search_alg without an explicit metric inherits
             # the TuneConfig's (same backfill the scheduler gets below) —
-            # otherwise ask/tell searchers silently never observe results
-            searcher.metric = cfg.metric
-            searcher.mode = cfg.mode
+            # otherwise ask/tell searchers silently never observe
+            # results.  Walk .searcher chains: ConcurrencyLimiter/
+            # Repeater delegate completion to the INNER searcher
+            s = searcher
+            while s is not None:
+                if getattr(s, "metric", None) is None:
+                    s.metric = cfg.metric
+                    s.mode = cfg.mode
+                s = getattr(s, "searcher", None)
         scheduler = cfg.scheduler
         if scheduler is not None and scheduler.metric is None:
             scheduler.metric = cfg.metric

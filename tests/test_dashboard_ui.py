@@ -1,6 +1,8 @@
 """The dashboard serves a live HTML UI at / (the stand-in for the
 reference's React client, dashboard/client/)."""
 
+import urllib.error
+import urllib.parse
 import urllib.request
 
 import pytest
@@ -40,3 +42,62 @@ def test_ui_has_timeline_and_utilization_views(dashboard):
     assert 'id="util"' in body
     assert "state_ts" in body        # timeline derives spans from it
     assert "sparkline" in body       # per-node utilization cells
+
+
+def _get_json(url):
+    import json
+
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_node_drilldown_endpoint(dashboard):
+    """Per-node detail: node view + live worker/lease tables + log list
+    (reference: the dashboard's node detail page)."""
+    # ensure at least one worker exists
+    @ray_tpu.remote
+    def warm():
+        print("drill-down-marker")
+        return 1
+
+    assert ray_tpu.get(warm.remote(), timeout=60) == 1
+    nodes = _get_json(dashboard.url + "/api/nodes")
+    nid = nodes[0]["node_id"]
+    d = _get_json(dashboard.url + "/api/node?node_id=" + nid)
+    assert d["node_id"] == nid and d["state"] == "ALIVE"
+    assert isinstance(d["workers"], list) and d["workers"]
+    assert isinstance(d["leases"], list)
+    assert any(lg.get("name") for lg in d["logs"])
+    # log tail round-trips through the raylet's read_log
+    name = d["logs"][0]["name"]
+    t = _get_json(dashboard.url + "/api/log_tail?node_id=" + nid
+                  + "&name=" + urllib.parse.quote(name))
+    assert t["name"] == name and isinstance(t["text"], str)
+    with pytest.raises(urllib.error.HTTPError):
+        _get_json(dashboard.url + "/api/node?node_id=nope")
+
+
+def test_actor_drilldown_endpoint(dashboard):
+    @ray_tpu.remote
+    class Probe:
+        def hit(self):
+            return 1
+
+    a = Probe.remote()
+    assert ray_tpu.get(a.hit.remote(), timeout=60) == 1
+    actors = _get_json(dashboard.url + "/api/actors")
+    rec = next(r for r in actors if r["class_name"] == "Probe"
+               and r["state"] == "ALIVE")
+    d = _get_json(dashboard.url + "/api/actor?actor_id="
+                  + rec["actor_id"])
+    assert d["actor_id"] == rec["actor_id"]
+    assert isinstance(d["task_events"], list)
+    ray_tpu.kill(a)
+
+
+def test_ui_ships_drilldown_panel(dashboard):
+    with urllib.request.urlopen(dashboard.url + "/", timeout=30) as r:
+        body = r.read().decode()
+    assert 'id="panel"' in body
+    assert "openNode" in body and "openActor" in body
+    assert "/api/log_tail" in body
