@@ -1572,6 +1572,10 @@ class CoreWorker:
         lw.client.call_cb("push_task", rec.spec, on_reply)
 
     def _on_task_reply(self, pool, lw: LeasedWorker, rec: TaskRecord, reply):
+        # ONE lock acquisition for the bookkeeping: this path runs once
+        # per completed task on the reply thread and ping-pongs the core
+        # lock with the submitting thread — every extra acquire/release
+        # pair is contention at 100k-task submission bursts
         with self.lock:
             lw.inflight.discard(rec.spec.task_id)
             lw.inflight_since.pop(rec.spec.task_id, None)
@@ -1580,8 +1584,7 @@ class CoreWorker:
             if ms is not None:
                 pool.avg_ms = ms if pool.avg_ms is None else \
                     0.8 * pool.avg_ms + 0.2 * ms
-        rec.done = True
-        with self.lock:
+            rec.done = True
             self.task_records.pop(rec.spec.task_id, None)
         self._released_streams.discard(rec.spec.task_id)
         if rec.canceled and reply.get("status") != "ok":

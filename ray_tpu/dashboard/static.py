@@ -121,7 +121,17 @@ function table(el, rows, cols) {
   }
   t.innerHTML = h;
 }
-async function j(path) { const r = await fetch(path); return r.json(); }
+async function j(path) {
+  const r = await fetch(path);
+  if (!r.ok) throw new Error(`${path}: ${r.status} ${await r.text()}`);
+  return r.json();
+}
+function drillSafe(fn) {   // surface drill-down failures in #err
+  return async row => {
+    try { await fn(row); }
+    catch (e) { document.getElementById("err").textContent = " " + e; }
+  };
+}
 
 // ---- metrics history (client-side: each tick appends one sample) ----
 const hist = [];            // {t, used, total, perNode: {id: frac}}
@@ -276,7 +286,8 @@ async function openActor(a) {
 function openTask(t) {
   panel("task " + t.task_id, `<pre>${esc(JSON.stringify(t, null, 1))}</pre>`);
 }
-drill.nodes = openNode; drill.actors = openActor; drill.tasks = openTask;
+drill.nodes = drillSafe(openNode); drill.actors = drillSafe(openActor);
+drill.tasks = drillSafe(openTask);
 document.addEventListener("keydown", e => { if (e.key === "Escape") closePanel(); });
 
 async function tick() {

@@ -231,6 +231,13 @@ class Dataset:
         return out
 
     def count(self) -> int:
+        # metadata fast path (reference: Dataset.count's parquet-footer
+        # shortcut): a bare Read whose datasource knows its EXACT row
+        # count answers without executing a single read task
+        if type(self._dag) is L.Read:
+            n = self._dag.datasource.plan_row_count()
+            if n is not None:
+                return n
         return sum(b.metadata.num_rows for b in self._execute())
 
     def schema(self) -> Optional[pa.Schema]:

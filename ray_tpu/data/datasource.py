@@ -39,6 +39,13 @@ class Datasource:
     def estimate_inmemory_data_size(self) -> Optional[int]:
         return None
 
+    def plan_row_count(self) -> Optional[int]:
+        """EXACT total row count known without executing any read, or
+        None (reference: parquet metadata makes `ds.count()` an O(files)
+        footer scan instead of a full read).  Only return a number that
+        is guaranteed exact — Dataset.count() trusts it."""
+        return None
+
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
         raise NotImplementedError
 
@@ -47,6 +54,9 @@ class RangeDatasource(Datasource):
     def __init__(self, n: int, *, tensor_shape: Optional[tuple] = None):
         self._n = n
         self._tensor_shape = tensor_shape
+
+    def plan_row_count(self) -> Optional[int]:
+        return self._n
 
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
         n = self._n
@@ -80,6 +90,9 @@ class ItemsDatasource(Datasource):
     def __init__(self, items: List[Any]):
         self._items = list(items)
 
+    def plan_row_count(self) -> Optional[int]:
+        return len(self._items)
+
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
         items = self._items
         n = len(items)
@@ -102,6 +115,9 @@ class BlocksDatasource(Datasource):
 
     def __init__(self, blocks: List[Block]):
         self._blocks = blocks
+
+    def plan_row_count(self) -> Optional[int]:
+        return sum(b.num_rows for b in self._blocks)
 
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
         tasks = []
@@ -137,6 +153,9 @@ class FileBasedDatasource(Datasource):
     def __init__(self, paths, **reader_args):
         self._paths = _expand_paths(paths, self._suffixes)
         self._reader_args = reader_args
+        # per-path plan-metadata memo: footers are immutable per path,
+        # and count() + execution would otherwise fetch each twice
+        self._meta_memo: dict = {}
 
     def _read_file(self, path: str, **kwargs) -> Block:
         raise NotImplementedError
@@ -148,10 +167,31 @@ class FileBasedDatasource(Datasource):
         parquet_meta_provider.py vs DefaultFileMetadataProvider)."""
         return None
 
+    def _plan_metadata_memo(self, path: str):
+        if path not in self._meta_memo:
+            try:
+                self._meta_memo[path] = self._plan_metadata(path)
+            except Exception:
+                self._meta_memo[path] = None
+        return self._meta_memo[path]
+
     # footer reads at plan time are capped: past this many files the
     # per-file row counts are extrapolated from the sampled mean (the
     # reference's meta provider samples similarly for huge file lists)
     _PLAN_META_SAMPLE = 32
+
+    def plan_row_count(self) -> Optional[int]:
+        """Exact count from per-file plan metadata (parquet footers) —
+        only when EVERY file is inside the sample cap and answers."""
+        if len(self._paths) > self._PLAN_META_SAMPLE:
+            return None
+        total = 0
+        for p in self._paths:
+            m = self._plan_metadata_memo(p)
+            if m is None:
+                return None
+            total += m[0]
+        return total
 
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
         from ray_tpu._private import fileio
@@ -167,10 +207,7 @@ class FileBasedDatasource(Datasource):
         meta_by_path = {}
         sample = paths[:self._PLAN_META_SAMPLE]
         for p in sample:
-            try:
-                meta_by_path[p] = self._plan_metadata(p)
-            except Exception:
-                meta_by_path[p] = None
+            meta_by_path[p] = self._plan_metadata_memo(p)
         sampled = [m for m in meta_by_path.values() if m is not None]
         mean_rows = (sum(m[0] for m in sampled) / len(sampled)
                      if sampled else None)

@@ -231,7 +231,11 @@ def test_materialize_reuse(ray_cluster):
 
 def test_stats_populated(ray_cluster):
     ds = rd.range(10, override_num_blocks=2)
-    ds.count()
+    # count() on a bare read is now a metadata fast path (no execution);
+    # materializing populates stats
+    assert ds.count() == 10
+    assert ds.stats() == "(not executed)"
+    ds.take_all()
     assert "Read" in ds.stats()
 
 

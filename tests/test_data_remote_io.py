@@ -89,6 +89,22 @@ def test_parquet_plan_metadata_extrapolates_past_sample(tmp_path):
     assert not all(t.metadata.exec_stats.get("rows_exact") for t in tasks)
 
 
+def test_count_fast_path_from_parquet_footers(ray_cluster, tmp_path):
+    """ds.count() on a bare parquet read answers from footers without
+    executing read tasks (reference: Dataset.count's metadata shortcut);
+    transforms disable the shortcut."""
+    root, total = _seed_parquet(tmp_path, n_files=3, rows_per=9)
+    ds = rd.read_parquet(root)
+    assert ds.count() == total
+    assert ds._dag.datasource.plan_row_count() == total
+    # a transform means executing (filter changes the count)
+    assert ds.filter(lambda r: r["x"] % 2 == 0).count() == \
+        sum(1 for i in range(total) if i % 2 == 0)
+    # range/items know their counts too
+    assert rd.range(123).count() == 123
+    assert rd.from_items([{"a": 1}] * 7).count() == 7
+
+
 def test_csv_plan_metadata_falls_back_to_bytes(tmp_path):
     root = _uri(tmp_path, "csvs")
     fs, p = fileio.fs_for(root)
