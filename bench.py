@@ -1047,8 +1047,20 @@ if __name__ == "__main__":
         table = bench_table()
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_TABLE.json")
+        # preserve sections other benches own (resource_sync_delta from
+        # scripts/bench_resource_sync.py) — a table refresh must not
+        # erase their recorded results
+        try:
+            with open(path) as f:
+                prev = json.load(f)
+            for k, v in prev.items():
+                if k not in table:
+                    table[k] = v
+        except Exception:
+            pass
         with open(path, "w") as f:
             json.dump(table, f, indent=2)
+            f.write("\n")
         print(json.dumps(table, indent=2))
     else:
         main()

@@ -126,10 +126,10 @@ async function j(path) {
   if (!r.ok) throw new Error(`${path}: ${r.status} ${await r.text()}`);
   return r.json();
 }
-function drillSafe(fn) {   // surface drill-down failures in #err
+function drillSafe(fn) {   // surface drill-down failures in the panel
   return async row => {
     try { await fn(row); }
-    catch (e) { document.getElementById("err").textContent = " " + e; }
+    catch (e) { panel("error", `<pre>${esc(String(e))}</pre>`); }
   };
 }
 
@@ -264,14 +264,16 @@ async function openNode(n) {
     `<pre id="logview" style="display:none"></pre>`);
   document.getElementById("panel-body").querySelectorAll(".loglink")
     .forEach(a => a.addEventListener("click", async () => {
-      const r = await j("/api/log_tail?node_id=" +
-        encodeURIComponent(n.node_id) + "&name=" +
-        encodeURIComponent(a.dataset.log));
       const v = document.getElementById("logview");
       v.style.display = "block";
-      // textContent: no HTML sink
-      v.textContent = r.error ? "ERROR: " + r.error
-                              : (r.text || "(empty)");
+      try {
+        const r = await j("/api/log_tail?node_id=" +
+          encodeURIComponent(n.node_id) + "&name=" +
+          encodeURIComponent(a.dataset.log));
+        // textContent: no HTML sink
+        v.textContent = r.error ? "ERROR: " + r.error
+                                : (r.text || "(empty)");
+      } catch (e) { v.textContent = "ERROR: " + e; }
     }));
 }
 async function openActor(a) {

@@ -170,9 +170,12 @@ class FileBasedDatasource(Datasource):
     def _plan_metadata_memo(self, path: str):
         if path not in self._meta_memo:
             try:
-                self._meta_memo[path] = self._plan_metadata(path)
+                result = self._plan_metadata(path)
             except Exception:
-                self._meta_memo[path] = None
+                # transient IO failure: DON'T cache — the next planning
+                # call retries (None-by-design results do cache)
+                return None
+            self._meta_memo[path] = result
         return self._meta_memo[path]
 
     # footer reads at plan time are capped: past this many files the

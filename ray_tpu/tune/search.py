@@ -142,7 +142,11 @@ DEFER = object()
 class Searcher:
     DEFER = DEFER
 
-    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None):
+        # mode=None means "not explicitly set": Tuner backfills it from
+        # TuneConfig (an explicit mode on an inner searcher of a
+        # wrapper chain always wins); consumers treat None as "max"
         self.metric = metric
         self.mode = mode
 
@@ -274,7 +278,7 @@ class TPESearch(Searcher):
     """
 
     def __init__(self, param_space: Dict[str, Any], metric: str,
-                 mode: str = "max", n_initial: int = 8, gamma: float = 0.25,
+                 mode: Optional[str] = None, n_initial: int = 8, gamma: float = 0.25,
                  n_candidates: int = 24, num_samples: int = 64,
                  seed: Optional[int] = None):
         super().__init__(metric, mode)
@@ -376,7 +380,7 @@ class BayesOptSearch(Searcher):
     """
 
     def __init__(self, param_space: Dict[str, Any], metric: str,
-                 mode: str = "max", n_initial: int = 6,
+                 mode: Optional[str] = None, n_initial: int = 6,
                  n_candidates: int = 256, num_samples: int = 64,
                  length_scale: float = 0.2, noise: float = 1e-4,
                  xi: float = 0.01, seed: Optional[int] = None):
@@ -542,7 +546,7 @@ class SearcherWrapper(Searcher):
     """
 
     def __init__(self, opt, metric: Optional[str] = None,
-                 mode: str = "max", *, to_config=None,
+                 mode: Optional[str] = None, *, to_config=None,
                  minimize: bool = True):
         super().__init__(metric=metric, mode=mode)
         for attr in ("ask", "tell"):
@@ -585,8 +589,9 @@ class SearcherWrapper(Searcher):
             # fake number — skip the tell
             return
         value = float(result[self.metric])
-        if self._minimize and self.mode == "max":
+        mode = self.mode or "max"
+        if self._minimize and mode == "max":
             value = -value
-        elif not self._minimize and self.mode == "min":
+        elif not self._minimize and mode == "min":
             value = -value
         self._opt.tell(token, value)

@@ -324,10 +324,34 @@ class TuneController:
 
     # -- the loop ----------------------------------------------------------
 
+    def _effective_max_concurrent(self) -> int:
+        """Trial-start pacing.  max_concurrent=0 ("unlimited") paces to
+        cluster CPU capacity instead of literally unlimited: eagerly
+        draining the searcher turns every future trial into a pending
+        actor record at once (an unbounded ask/tell searcher made this
+        an infinite loop), and lazy suggestion also means ask/tell
+        searchers observe completed results before later asks."""
+        if self._max_concurrent:
+            return self._max_concurrent
+        now = time.time()
+        if now - getattr(self, "_cap_ts", 0.0) > 5.0:
+            try:
+                total = ray_tpu.cluster_resources().get("CPU", 0)
+            except Exception:
+                total = 0
+            per = float(self._trial_resources.get("CPU", 1))
+            if per <= 0:
+                # trainer adapters request CPU:0 at the trial layer (the
+                # worker group inside holds the real CPUs) — assume one
+                # core per trial rather than dividing by ~zero
+                per = 1.0
+            self._cap = max(2, int(total / per)) if total else 16
+            self._cap_ts = now
+        return self._cap
+
     def _fill(self):
         while True:
-            if (self._max_concurrent
-                    and self._running_count() >= self._max_concurrent):
+            if self._running_count() >= self._effective_max_concurrent():
                 return
             nxt = self._scheduler.choose_trial_to_run(
                 [t for t in self.trials if t.status == PENDING])

@@ -130,18 +130,14 @@ class Tuner:
             # results.  Walk .searcher chains: ConcurrencyLimiter/
             # Repeater delegate completion to the INNER searcher
             s = searcher
-            outermost = True
             while s is not None:
                 if getattr(s, "metric", None) is None:
                     s.metric = cfg.metric
-                    if outermost:
-                        # inner searchers keep an explicitly-set mode
-                        # (mode has no unset sentinel — 'max' is both
-                        # the default and a valid choice, so only the
-                        # outermost inherits cfg.mode)
-                        s.mode = cfg.mode
+                if getattr(s, "mode", None) is None:
+                    # None = never explicitly set (the Searcher default);
+                    # an explicit mode on an inner searcher always wins
+                    s.mode = cfg.mode
                 s = getattr(s, "searcher", None)
-                outermost = False
         scheduler = cfg.scheduler
         if scheduler is not None and scheduler.metric is None:
             scheduler.metric = cfg.metric
