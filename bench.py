@@ -1056,8 +1056,12 @@ if __name__ == "__main__":
             for k, v in prev.items():
                 if k not in table:
                     table[k] = v
-        except Exception:
+        except FileNotFoundError:
             pass
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"WARNING: could not merge prior {path} sections "
+                  f"({e}); foreign bench results (resource_sync_delta) "
+                  f"are lost in this refresh", file=sys.stderr)
         with open(path, "w") as f:
             json.dump(table, f, indent=2)
             f.write("\n")

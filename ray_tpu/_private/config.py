@@ -70,6 +70,9 @@ CONFIG_DEFS: List[Tuple[str, type, Any, str]] = [
      "export task lifecycle events to the control plane"),
     ("max_task_events", int, 10000,
      "task events retained by the control plane"),
+    ("max_dead_actors", int, 10000,
+     "destroyed actor records kept for introspection (reference: "
+     "maximum_gcs_destroyed_actor_cached_count)"),
     ("max_cluster_events", int, 10000,
      "structured cluster events retained by the control plane "
      "(node/actor/pg/job lifecycle; separate from task events so "

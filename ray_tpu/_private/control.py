@@ -199,6 +199,10 @@ class ControlServer:
         self._event_thread = threading.Thread(
             target=self._event_merge_loop, name="control-task-events",
             daemon=True)
+        # destroyed-actor cache bound (reference:
+        # maximum_gcs_destroyed_actor_cached_count)
+        self._dead_actor_order: deque = deque()
+        self._max_dead_actors = _cfg().max_dead_actors
         # structured cluster events (reference: src/ray/util/event.h):
         # bounded, seq-ordered; fed by publish() + h_report_event
         self.events: deque = deque(maxlen=_cfg().max_cluster_events)
@@ -278,6 +282,13 @@ class ControlServer:
     # -- persistence -------------------------------------------------------
 
     def _persist_actor(self, rec: ActorRecord):
+        if rec.state == DEAD:
+            # bound the destroyed-actor cache (reference: the GCS keeps
+            # maximum_gcs_destroyed_actor_cached_count records): an
+            # actor-churning workload (one Tune trial = one actor) would
+            # otherwise grow self.actors — and every state_dump reply —
+            # forever
+            self._note_dead_actor(rec)
         if self.pstore is None:
             return
         # snapshot + write under the table lock so disk ordering can't
@@ -718,6 +729,19 @@ class ControlServer:
             msg += f": {str(err)[:300]}"
         self.record_event(severity=sev, source=topic, event_type=ev,
                           message=msg, entity_id=entity)
+
+    def _note_dead_actor(self, rec: ActorRecord):
+        with self.lock:
+            self._dead_actor_order.append(rec.actor_id)
+            while len(self._dead_actor_order) > self._max_dead_actors:
+                aid = self._dead_actor_order.popleft()
+                old = self.actors.get(aid)
+                if old is not None and old.state == DEAD:
+                    del self.actors[aid]
+                    if old.name:
+                        key = _named_key(old.namespace, old.name)
+                        if self.named_actors.get(key) == aid:
+                            del self.named_actors[key]
 
     def record_event(self, *, severity: str, source: str, event_type: str,
                      message: str, entity_id: str = "",

@@ -350,6 +350,15 @@ class TuneController:
         return self._cap
 
     def _fill(self):
+        # FINITE searchers (grid/random expose total_trials) materialize
+        # every remaining suggestion as a PENDING record up front: trial
+        # records are cheap, save_state persists them, so an interrupted
+        # run's restore() sees the full budget.  Actor STARTS are paced
+        # below either way; infinite ask/tell searchers stay lazy (their
+        # internal state was never resumable).
+        if hasattr(self._searcher, "total_trials"):
+            while not self._searcher_done and self._new_trial() is not None:
+                pass
         while True:
             if self._running_count() >= self._effective_max_concurrent():
                 return

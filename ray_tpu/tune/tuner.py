@@ -123,21 +123,21 @@ class Tuner:
             searcher = cfg.search_alg or BasicVariantGenerator(
                 self._param_space, num_samples=cfg.num_samples, seed=cfg.seed,
                 metric=cfg.metric, mode=cfg.mode)
-        if cfg.metric:
-            # user-supplied search_alg without an explicit metric inherits
-            # the TuneConfig's (same backfill the scheduler gets below) —
-            # otherwise ask/tell searchers silently never observe
-            # results.  Walk .searcher chains: ConcurrencyLimiter/
-            # Repeater delegate completion to the INNER searcher
-            s = searcher
-            while s is not None:
-                if getattr(s, "metric", None) is None:
-                    s.metric = cfg.metric
-                if getattr(s, "mode", None) is None:
-                    # None = never explicitly set (the Searcher default);
-                    # an explicit mode on an inner searcher always wins
-                    s.mode = cfg.mode
-                s = getattr(s, "searcher", None)
+        # user-supplied search_alg inherits unset metric/mode from the
+        # TuneConfig (same backfill the scheduler gets below) — an unset
+        # metric silently drops every observation, an unset mode
+        # silently optimizes the wrong direction.  Walk .searcher
+        # chains (ConcurrencyLimiter/Repeater delegate completion to
+        # the INNER searcher); explicit inner settings always win.
+        # Independent gates: TuneConfig(mode=...) must apply even when
+        # the searcher carries its own metric.
+        s = searcher
+        while s is not None:
+            if cfg.metric and getattr(s, "metric", None) is None:
+                s.metric = cfg.metric
+            if cfg.mode and getattr(s, "mode", None) is None:
+                s.mode = cfg.mode
+            s = getattr(s, "searcher", None)
         scheduler = cfg.scheduler
         if scheduler is not None and scheduler.metric is None:
             scheduler.metric = cfg.metric

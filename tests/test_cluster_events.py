@@ -110,3 +110,31 @@ def test_cli_lists_cluster_events(ray_cluster):
 
     rows = json.loads(out.stdout)
     assert rows and all("event_type" in r for r in rows)
+
+def test_dead_actor_records_bounded(monkeypatch, private_cluster_slot):
+    """Destroyed actors are kept for introspection only up to a bound
+    (reference: maximum_gcs_destroyed_actor_cached_count) — actor-churn
+    workloads must not grow control memory forever."""
+    monkeypatch.setenv("RAY_TPU_MAX_DEAD_ACTORS", "5")
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    class Brief:
+        def ping(self):
+            return 1
+
+    for _ in range(12):
+        a = Brief.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+        ray_tpu.kill(a, no_restart=True)
+
+    from ray_tpu._private.core import current_core
+
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        st = current_core().control.call("state_dump", {}, timeout=10)
+        dead = [a for a in st["actors"] if a["state"] == "DEAD"]
+        if len(dead) <= 5 and len(dead) > 0:
+            break
+        time.sleep(0.3)
+    assert 0 < len(dead) <= 5, f"{len(dead)} dead records retained"
