@@ -139,6 +139,16 @@ def read_images(paths, *, size=None, mode=None, include_paths: bool = False,
         override_num_blocks=override_num_blocks)
 
 
+def read_avro(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    """reference: read_api.py read_avro — Object Container Files, read
+    with a dependency-free spec-level codec (datasource.AvroDatasource;
+    null + deflate codecs, nullable unions)."""
+    from .datasource import AvroDatasource
+
+    return read_datasource(AvroDatasource(paths),
+                           override_num_blocks=override_num_blocks)
+
+
 def read_sql(sql: str, connection_factory, *,
              override_num_blocks: Optional[int] = None) -> Dataset:
     """reference: python/ray/data/read_api.py read_sql — any DB-API
@@ -278,7 +288,6 @@ read_delta_sharing_tables = _unavailable("read_delta_sharing_tables",
                                          "delta-sharing")
 read_iceberg = _unavailable("read_iceberg", "pyiceberg")
 read_lance = _unavailable("read_lance", "lance")
-read_avro = _unavailable("read_avro", "fastavro")
 from_spark = _unavailable("from_spark", "pyspark")
 from_dask = _unavailable("from_dask", "dask")
 from_mars = _unavailable("from_mars", "mars")
@@ -292,7 +301,8 @@ __all__ = [
     "read_datasource", "range", "range_tensor", "from_items", "from_numpy",
     "from_pandas", "from_arrow", "read_parquet", "read_csv", "read_json",
     "read_text", "read_binary_files", "read_numpy", "aggregate",
-    "read_tfrecords", "read_images", "read_sql", "read_parquet_bulk",
+    "read_avro", "read_tfrecords", "read_images", "read_sql",
+    "read_parquet_bulk",
     "from_blocks", "from_arrow_refs", "from_pandas_refs", "from_numpy_refs",
     "from_huggingface", "from_torch", "from_tf",
     "ActorPoolStrategy", "TaskPoolStrategy",

@@ -240,6 +240,11 @@ class Dataset:
                 return n
         return sum(b.metadata.num_rows for b in self._execute())
 
+    def num_blocks(self) -> int:
+        """Block count (reference: Dataset.num_blocks — execution-backed
+        on a lazy dataset; MaterializedDataset answers from its refs)."""
+        return sum(1 for _ in self._execute())
+
     def schema(self) -> Optional[pa.Schema]:
         for bundle in self.limit(1)._execute():
             if bundle.metadata.schema is not None:
@@ -446,6 +451,9 @@ class Dataset:
 
     def write_numpy(self, path: str, **kw) -> List[str]:
         return self._write(path, "npy", **kw)
+
+    def write_avro(self, path: str, **kw) -> List[str]:
+        return self._write(path, "avro", **kw)
 
     def write_tfrecords(self, path: str, **kw) -> List[str]:
         """reference: dataset.py write_tfrecords (tf.train.Example files,

@@ -376,6 +376,13 @@ def write_block(block: Block, path: str, file_format: str,
         with fileio.open_file(fname, "wb") as f:
             for row in BlockAccessor(block).iter_rows():
                 _tfrecord_write(f, _example_encode(row))
+    elif file_format == "avro":
+        from ._avro import write_container
+        from .block import BlockAccessor
+
+        rows = list(BlockAccessor(block).iter_rows())
+        with fileio.open_file(fname, "wb") as f:
+            f.write(write_container(rows, **writer_args))
     else:
         raise ValueError(f"unknown write format {file_format}")
     return fname
@@ -580,6 +587,20 @@ class TFRecordsDatasource(FileBasedDatasource):
             for payload in _tfrecord_read(f):
                 rows.append(_example_decode(payload))
         return rows_to_block(rows)
+
+
+class AvroDatasource(FileBasedDatasource):
+    """reference: read_api.py read_avro (delegates to fastavro there;
+    here the container format + binary encoding are implemented directly
+    — see _avro.py — so the connector needs no third-party library)."""
+
+    _suffixes = [".avro"]
+
+    def _read_file(self, path: str, **kw) -> Block:
+        from ._avro import read_container
+
+        with _open(path) as f:
+            return rows_to_block(read_container(f.read()))
 
 
 class ImagesDatasource(FileBasedDatasource):

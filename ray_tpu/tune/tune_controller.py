@@ -350,12 +350,15 @@ class TuneController:
         return self._cap
 
     def _fill(self):
-        # FINITE searchers (grid/random expose total_trials) materialize
-        # every remaining suggestion as a PENDING record up front: trial
-        # records are cheap, save_state persists them, so an interrupted
-        # run's restore() sees the full budget.  Actor STARTS are paced
-        # below either way; infinite ask/tell searchers stay lazy (their
-        # internal state was never resumable).
+        # FINITE bare searchers (grid/random expose total_trials)
+        # materialize every remaining suggestion as a PENDING record up
+        # front: records are cheap, save_state persists them, so an
+        # interrupted run's restore() sees the full budget.  Actor
+        # STARTS are paced below either way.  Wrapped finite searchers
+        # (ConcurrencyLimiter(grid)) and ask/tell searchers stay lazy —
+        # limiting/learning means suggestions must wait on completions,
+        # and their internal state was never resumable (same restore
+        # semantics those shapes always had).
         if hasattr(self._searcher, "total_trials"):
             while not self._searcher_done and self._new_trial() is not None:
                 pass
@@ -423,7 +426,14 @@ class TuneController:
                 self._handle_failure(trial, e)
                 continue
             self._handle_result(trial, kind, metrics, ckpt)
-        self.save_state()
+        # periodic, not per-step: serializing every trial record each
+        # iteration is O(total_trials) — a 50k-sample sweep would spend
+        # its steps writing JSON (reference: TUNE_GLOBAL_CHECKPOINT_S
+        # periodic experiment snapshots); run() writes a final one
+        now = time.time()
+        if now - getattr(self, "_last_save", 0.0) > 5.0:
+            self._last_save = now
+            self.save_state()
         return True
 
     def run(self):
