@@ -105,7 +105,10 @@ def _write_datum(out: io.BytesIO, schema: Any, v: Any) -> None:
         _w_bytes(out, str(v).encode())
     elif t == "record":
         for f in schema["fields"]:
-            _write_datum(out, f["type"], v[f["name"]])
+            # .get: a row missing a column writes null through the
+            # field's nullable union (inference marks absent-anywhere
+            # columns nullable)
+            _write_datum(out, f["type"], v.get(f["name"]))
     elif t == "array":
         items = list(v)
         if items:
@@ -269,11 +272,14 @@ def _infer_schema(rows: List[Dict[str, Any]], name: str = "row") -> Dict:
     nullable unions."""
     fields = []
     cols: Dict[str, set] = {}
+    present: Dict[str, int] = {}
     for r in rows:
         for k, v in r.items():
             cols.setdefault(k, set()).add(_type_of(v))
+            present[k] = present.get(k, 0) + 1
     for k, types in cols.items():
-        nullable = "null" in types       # the first pass already saw it
+        # nullable if any row held None OR lacked the column entirely
+        nullable = "null" in types or present[k] < len(rows)
         types.discard("null")
         if not types:
             t: Any = "null"
@@ -282,9 +288,12 @@ def _infer_schema(rows: List[Dict[str, Any]], name: str = "row") -> Dict:
         else:
             # mixed int/float widens to double; else a union
             t = "double" if types <= {"long", "double"} else sorted(types)
-        fields.append({"name": k,
-                       "type": (["null", t] if nullable and t != "null"
-                                else t)})
+        if nullable and t != "null":
+            # flatten: unions may not nest unions (Avro spec) — a
+            # nullable mixed-type column is ["null", a, b], never
+            # ["null", [a, b]]
+            t = ["null"] + (t if isinstance(t, list) else [t])
+        fields.append({"name": k, "type": t})
     return {"type": "record", "name": name, "fields": fields}
 
 

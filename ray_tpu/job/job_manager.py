@@ -83,6 +83,19 @@ class JobSupervisor:
         if status in JobStatus.TERMINAL:
             info["end_time"] = time.time()
         _kv_put_job(self._core(), self.submission_id, info)
+        try:
+            # structured cluster event per transition (reference: the
+            # job manager's event emission, dashboard event module)
+            self._core().control.notify("report_event", {
+                "severity": ("ERROR" if status == JobStatus.FAILED
+                             else "INFO"),
+                "source": "job", "event_type": status.lower(),
+                "entity_id": self.submission_id,
+                "message": (f"job {self.submission_id} {status}"
+                            + (f": {message[:200]}" if message else "")),
+            })
+        except Exception:
+            pass
 
     def run(self) -> str:
         """Run the entrypoint to completion; returns the terminal status."""

@@ -460,11 +460,67 @@ class TuneController:
         path = storage.join(self._experiment_dir, "experiment_state.json")
         if storage.is_uri(path):
             storage.write_text(path, json.dumps(state, default=str))
+        else:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(state, f, default=str)
+            os.replace(tmp, path)
+        # searcher AFTER the trial records: a crash between the writes
+        # then means a stale searcher that re-suggests (benign
+        # duplicates) rather than a fresh cursor whose already-consumed
+        # suggestions have no trial records (silent budget loss)
+        self._save_searcher()
+
+    def _save_searcher(self):
+        """Pickle the searcher next to the experiment state (reference:
+        Searcher.save/restore + experiment_state searcher checkpointing)
+        so Tuner.restore continues it — cursor position for grid/random,
+        learned observations for TPE/GP/ask-tell wrappers.  Best-effort:
+        an unpicklable user optimizer just skips (restore then reruns
+        saved trials only, the pre-existing semantics)."""
+        import cloudpickle
+
+        from ray_tpu.train import storage
+
+        try:
+            blob = cloudpickle.dumps(self._searcher)
+        except Exception:
             return
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(state, f, default=str)
-        os.replace(tmp, path)
+        path = storage.join(self._experiment_dir, "searcher_state.pkl")
+        try:
+            if storage.is_uri(path):
+                fs, p = storage._fs_and_path(path)
+                with fs.open(p, "wb") as f:
+                    f.write(blob)
+            else:
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, path)
+        except Exception:
+            logger.debug("searcher state save failed", exc_info=True)
+
+    @staticmethod
+    def load_searcher(experiment_dir: str):
+        """The pickled searcher of an interrupted run, or None."""
+        import cloudpickle
+
+        from ray_tpu.train import storage
+
+        path = storage.join(experiment_dir, "searcher_state.pkl")
+        try:
+            if storage.is_uri(path):
+                fs, p = storage._fs_and_path(path)
+                with fs.open(p, "rb") as f:
+                    return cloudpickle.loads(f.read())
+            with open(path, "rb") as f:
+                return cloudpickle.loads(f.read())
+        except FileNotFoundError:
+            return None
+        except Exception:
+            logger.warning("searcher state unreadable; resuming saved "
+                           "trials only", exc_info=True)
+            return None
 
     @staticmethod
     def load_trials(experiment_dir: str) -> List[Trial]:

@@ -115,10 +115,14 @@ class Tuner:
                                    name))
         storage.makedirs(exp_dir)
         if self._resumed_trials is not None:
-            # restored experiments rerun their saved trials only; the
-            # searcher's remaining budget was consumed by the original run
-            searcher = BasicVariantGenerator({}, num_samples=0,
-                                             metric=cfg.metric, mode=cfg.mode)
+            # resumed run: continue the ORIGINAL searcher if its pickled
+            # state was saved (reference: Searcher.save/restore — an
+            # ask/tell optimizer picks up with everything it learned);
+            # otherwise rerun the saved trials only
+            searcher = TuneController.load_searcher(exp_dir)
+            if searcher is None:
+                searcher = BasicVariantGenerator(
+                    {}, num_samples=0, metric=cfg.metric, mode=cfg.mode)
         else:
             searcher = cfg.search_alg or BasicVariantGenerator(
                 self._param_space, num_samples=cfg.num_samples, seed=cfg.seed,

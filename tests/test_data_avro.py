@@ -74,6 +74,20 @@ def test_explicit_schema_arrays_maps_enums():
     assert read_container(write_container(rows, schema=schema)) == rows
 
 
+def test_missing_columns_and_mixed_types_flat_union():
+    """Rows missing a column write null (absence => nullable), and a
+    nullable mixed-type column infers a FLAT union — Avro forbids
+    unions nested in unions."""
+    rows = [{"a": 1}, {"a": 2, "b": 3}, {"a": None, "b": "s"}]
+    sch = _infer_schema(rows)
+    by_name = {f["name"]: f["type"] for f in sch["fields"]}
+    assert by_name["a"] == ["null", "long"]
+    assert by_name["b"] == ["null", "long", "string"]   # flat, not nested
+    back = read_container(write_container(rows))
+    assert back == [{"a": 1, "b": None}, {"a": 2, "b": 3},
+                    {"a": None, "b": "s"}]
+
+
 def test_corrupt_sync_marker_rejected():
     blob = bytearray(write_container(ROWS))
     blob[-1] ^= 0xFF                     # trailing sync byte
