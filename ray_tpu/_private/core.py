@@ -1360,6 +1360,11 @@ class CoreWorker:
                 and spec.task_id not in self.streams:
             self.streams[spec.task_id] = StreamState(spec)
         refs = []
+        key = self._pool_key(spec)
+        rec = TaskRecord(spec, key, retries_left)
+        # ONE lock acquisition for all submission bookkeeping: this path
+        # runs once per .remote() and ping-pongs the core lock with the
+        # reply thread during 100k-task bursts
         with self.lock:
             for oid in spec.return_ids():
                 e = self.objects.get(oid)
@@ -1373,9 +1378,6 @@ class CoreWorker:
                 e.lineage = spec
                 e.attempts += 1
                 refs.append(ObjectRef(oid, self.addr, self.worker_id))
-        key = self._pool_key(spec)
-        rec = TaskRecord(spec, key, retries_left)
-        with self.lock:
             pool = self.pools.get(key)
             if pool is None:
                 pool = self.pools[key] = SchedPool(key)

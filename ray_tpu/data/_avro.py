@@ -105,10 +105,15 @@ def _write_datum(out: io.BytesIO, schema: Any, v: Any) -> None:
         _w_bytes(out, str(v).encode())
     elif t == "record":
         for f in schema["fields"]:
-            # .get: a row missing a column writes null through the
-            # field's nullable union (inference marks absent-anywhere
-            # columns nullable)
-            _write_datum(out, f["type"], v.get(f["name"]))
+            ft = f["type"]
+            if isinstance(ft, list) and "null" in ft:
+                # nullable field: a missing key writes null (inference
+                # marks absent-anywhere columns nullable)
+                _write_datum(out, ft, v.get(f["name"]))
+            else:
+                # required field: a missing key must RAISE (KeyError),
+                # not silently write "None"/False through coercion
+                _write_datum(out, ft, v[f["name"]])
     elif t == "array":
         items = list(v)
         if items:

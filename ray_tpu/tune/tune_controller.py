@@ -480,6 +480,7 @@ class TuneController:
         saved trials only, the pre-existing semantics)."""
         import cloudpickle
 
+        from ray_tpu._private import fileio
         from ray_tpu.train import storage
 
         try:
@@ -488,15 +489,14 @@ class TuneController:
             return
         path = storage.join(self._experiment_dir, "searcher_state.pkl")
         try:
-            if storage.is_uri(path):
-                fs, p = storage._fs_and_path(path)
-                with fs.open(p, "wb") as f:
+            if fileio.is_uri(path):
+                with fileio.open_file(path, "wb") as f:
                     f.write(blob)
             else:
                 tmp = path + ".tmp"
                 with open(tmp, "wb") as f:
                     f.write(blob)
-                os.replace(tmp, path)
+                os.replace(tmp, path)   # atomic locally
         except Exception:
             logger.debug("searcher state save failed", exc_info=True)
 
@@ -505,15 +505,12 @@ class TuneController:
         """The pickled searcher of an interrupted run, or None."""
         import cloudpickle
 
+        from ray_tpu._private import fileio
         from ray_tpu.train import storage
 
         path = storage.join(experiment_dir, "searcher_state.pkl")
         try:
-            if storage.is_uri(path):
-                fs, p = storage._fs_and_path(path)
-                with fs.open(p, "rb") as f:
-                    return cloudpickle.loads(f.read())
-            with open(path, "rb") as f:
+            with fileio.open_file(path, "rb") as f:
                 return cloudpickle.loads(f.read())
         except FileNotFoundError:
             return None

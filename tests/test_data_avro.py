@@ -88,6 +88,16 @@ def test_missing_columns_and_mixed_types_flat_union():
                     {"a": None, "b": "s"}]
 
 
+def test_required_field_missing_raises():
+    """An explicit schema's REQUIRED field missing from a row raises —
+    never silently writes 'None'/False through coercion."""
+    schema = {"type": "record", "name": "r", "fields": [
+        {"name": "a", "type": "string"},
+        {"name": "b", "type": "boolean"}]}
+    with pytest.raises(KeyError):
+        write_container([{"a": "x", "b": True}, {}], schema=schema)
+
+
 def test_corrupt_sync_marker_rejected():
     blob = bytearray(write_container(ROWS))
     blob[-1] ^= 0xFF                     # trailing sync byte
