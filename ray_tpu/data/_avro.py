@@ -72,6 +72,16 @@ def _r_bytes(buf: memoryview, pos: int) -> Tuple[bytes, int]:
 # schema-driven encode/decode
 
 
+def _holds_null(schema: Any) -> bool:
+    """Whether `schema` admits null: bare "null" (str or dict form — what
+    inference emits for all-None columns) or a union with a null branch
+    in either spelling."""
+    if isinstance(schema, list):
+        return any(_holds_null(b) for b in schema)
+    t = schema.get("type") if isinstance(schema, dict) else schema
+    return t == "null"
+
+
 def _write_datum(out: io.BytesIO, schema: Any, v: Any) -> None:
     if isinstance(schema, list):             # union: pick the branch
         for i, branch in enumerate(schema):
@@ -106,7 +116,7 @@ def _write_datum(out: io.BytesIO, schema: Any, v: Any) -> None:
     elif t == "record":
         for f in schema["fields"]:
             ft = f["type"]
-            if isinstance(ft, list) and "null" in ft:
+            if _holds_null(ft):
                 # nullable field: a missing key writes null (inference
                 # marks absent-anywhere columns nullable)
                 _write_datum(out, ft, v.get(f["name"]))

@@ -136,10 +136,12 @@ def _batch_norm(x, p, s, training: bool, momentum: float, eps: float):
 
 
 def _conv(x, w, stride: int = 1, padding="SAME"):
+    # no preferred_element_type: with bf16 operands the MXU already
+    # accumulates in f32, and an explicit f32 output breaks the VJP
+    # (conv transpose would see an f32 cotangent against bf16 weights)
     return jax.lax.conv_general_dilated(
         x, w.astype(x.dtype), (stride, stride), padding,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 def apply(params, state, images, cfg: ResNetConfig, training: bool = False):

@@ -53,6 +53,22 @@ def test_nullable_union_coerces_like_plain_columns():
     assert back[1]["y"] == "[1, 2]" and back[0]["x"] is None
 
 
+def test_all_none_column_round_trips():
+    """A column that is None everywhere it appears infers the bare
+    "null" type; rows missing the key entirely must still serialize
+    (regression: the required-field KeyError path fired for bare-null
+    and dict-wrapped null-union fields)."""
+    back = read_container(write_container([{"a": 1}, {"a": 2, "b": None}]))
+    assert back == [{"a": 1, "b": None}, {"a": 2, "b": None}]
+
+
+def test_dict_wrapped_null_union_is_optional():
+    sch = {"type": "record", "name": "r", "fields": [
+        {"name": "x", "type": [{"type": "null"}, "string"]}]}
+    back = read_container(write_container([{"x": "hi"}, {}], schema=sch))
+    assert back == [{"x": "hi"}, {"x": None}]
+
+
 def test_schema_inference_nullable_union():
     sch = _infer_schema(ROWS)
     by_name = {f["name"]: f["type"] for f in sch["fields"]}
