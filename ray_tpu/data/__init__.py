@@ -149,6 +149,23 @@ def read_avro(paths, *, override_num_blocks: Optional[int] = None) -> Dataset:
                            override_num_blocks=override_num_blocks)
 
 
+def read_webdataset(paths, *, decoder=True, fileselect=None, filerename=None,
+                    suffixes=None, include_paths: bool = False,
+                    override_num_blocks: Optional[int] = None) -> Dataset:
+    """reference: read_api.py:1840 read_webdataset — tar shards of
+    key-grouped samples, read with a tar-native dependency-free codec
+    (datasource.WebDatasetDatasource).  `decoder` True applies per-
+    extension defaults (txt/cls/json/npy/images), False keeps raw bytes,
+    a callable (or list of callables) maps each sample dict."""
+    from .datasource import WebDatasetDatasource
+
+    return read_datasource(
+        WebDatasetDatasource(paths, decoder=decoder, fileselect=fileselect,
+                             filerename=filerename, suffixes=suffixes,
+                             include_paths=include_paths),
+        override_num_blocks=override_num_blocks)
+
+
 def read_sql(sql: str, connection_factory, *,
              override_num_blocks: Optional[int] = None) -> Dataset:
     """reference: python/ray/data/read_api.py read_sql — any DB-API
@@ -302,6 +319,7 @@ __all__ = [
     "from_pandas", "from_arrow", "read_parquet", "read_csv", "read_json",
     "read_text", "read_binary_files", "read_numpy", "aggregate",
     "read_avro", "read_tfrecords", "read_images", "read_sql",
+    "read_webdataset",
     "read_parquet_bulk",
     "from_blocks", "from_arrow_refs", "from_pandas_refs", "from_numpy_refs",
     "from_huggingface", "from_torch", "from_tf",

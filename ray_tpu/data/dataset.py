@@ -460,6 +460,13 @@ class Dataset:
         written with the dependency-free codec in datasource.py)."""
         return self._write(path, "tfrecords", **kw)
 
+    def write_webdataset(self, path: str, **kw) -> List[str]:
+        """reference: dataset.py write_webdataset — one tar shard per
+        block; rows become key-grouped members (`__key__` or the row
+        index), columns encoded by extension (datasource.py
+        _wds_encode_field); `encoder=` maps each row dict first."""
+        return self._write(path, "tar", **kw)
+
     # -- additional consumption / conversion surface ----------------------
 
     def take_batch(self, batch_size: int = 20,

@@ -383,6 +383,27 @@ def write_block(block: Block, path: str, file_format: str,
         rows = list(BlockAccessor(block).iter_rows())
         with fileio.open_file(fname, "wb") as f:
             f.write(write_container(rows, **writer_args))
+    elif file_format == "tar":        # webdataset shard
+        import io as _io
+        import tarfile
+
+        from .block import BlockAccessor
+
+        encoder = writer_args.get("encoder")
+        with fileio.open_file(fname, "wb") as f, \
+                tarfile.open(fileobj=f, mode="w") as tf:
+            for i, row in enumerate(BlockAccessor(block).iter_rows()):
+                if callable(encoder):
+                    row = encoder(row)
+                key = str(row.get("__key__", f"{i:08d}"))
+                for col, v in row.items():
+                    if col in ("__key__", "__url__") or v is None:
+                        continue
+                    payload = (v if isinstance(v, bytes)
+                               else _wds_encode_field(col, v))
+                    info = tarfile.TarInfo(name=f"{key}.{col}")
+                    info.size = len(payload)
+                    tf.addfile(info, _io.BytesIO(payload))
     else:
         raise ValueError(f"unknown write format {file_format}")
     return fname
@@ -601,6 +622,166 @@ class AvroDatasource(FileBasedDatasource):
 
         with _open(path) as f:
             return rows_to_block(read_container(f.read()))
+
+
+_WDS_IMAGE_EXTS = ("jpg", "jpeg", "png", "bmp", "gif", "ppm")
+
+
+def _wds_decode_field(ext: str, data: bytes, decoder):
+    """Default per-field decoder (reference:
+    _internal/datasource/webdataset_datasource.py default_decoder):
+    extension picks the codec; unknown extensions stay raw bytes."""
+    if decoder is False or decoder is None:
+        return data
+    base = ext.rsplit(".", 1)[-1].lower()
+    if base in ("txt", "text", "transcript"):
+        return data.decode("utf-8")
+    if base in ("cls", "cls2", "index", "inx", "id"):
+        return int(data.decode("utf-8").strip())
+    if base in ("json", "jsn"):
+        import json as _json
+
+        return _json.loads(data.decode("utf-8"))
+    if base in ("npy", "npz"):
+        import io as _io
+
+        return np.load(_io.BytesIO(data), allow_pickle=False)
+    if base in _WDS_IMAGE_EXTS:
+        try:
+            import io as _io
+
+            from PIL import Image
+        except ImportError:
+            return data            # no PIL: hand back the encoded bytes
+        img = Image.open(_io.BytesIO(data))
+        img.load()
+        return np.asarray(img)
+    return data
+
+
+class WebDatasetDatasource(FileBasedDatasource):
+    """WebDataset tar shards (reference: read_api.py:1840 read_webdataset,
+    _internal/datasource/webdataset_datasource.py — which wraps the
+    webdataset library's tar iterator; here the format is read directly:
+    a sample is the run of consecutive tar members sharing a basename up
+    to its first dot, fields keyed by the remaining extension)."""
+
+    _suffixes = [".tar"]
+
+    def _read_file(self, path: str, decoder=True, fileselect=None,
+                   filerename=None, suffixes=None, include_paths=False,
+                   **kw) -> Block:
+        import tarfile
+
+        def renamed(ext: str) -> str:
+            if callable(filerename):
+                return filerename(ext)
+            for old, new in filerename or []:
+                if ext == old:
+                    return new
+            return ext
+
+        def keep(ext: str) -> bool:
+            for flt in (fileselect, suffixes):
+                if flt is None:
+                    continue
+                if callable(flt) and not flt(ext):
+                    return False
+                if isinstance(flt, (list, tuple, set)):
+                    # suffix-match like the reference: "png" keeps both
+                    # "png" and compound extensions like "seg.png"
+                    if not any(ext == s or ext.endswith("." + s)
+                               for s in flt):
+                        return False
+            return True
+
+        rows: List[dict] = []
+
+        def flush(key, fields):
+            if key is None or not fields:
+                return
+            row = {"__key__": key}
+            if include_paths:
+                row["__url__"] = path
+            row.update(fields)
+            rows.append(row)
+
+        # custom decoders (single callable or a chain) see the RAW bytes
+        # sample — default per-extension decoding applies only when
+        # decoder is True
+        custom = callable(decoder) or isinstance(decoder, (list, tuple))
+
+        with _open(path) as f, tarfile.open(fileobj=f, mode="r|*") as tf:
+            cur_key, cur = None, {}
+            for member in tf:
+                if not member.isfile():
+                    continue
+                name = member.name
+                name = name[2:] if name.startswith("./") else name
+                dirpart, _, base = name.rpartition("/")
+                if base.startswith("."):
+                    continue
+                # the key keeps the directory prefix (reference
+                # base_plus_ext: two subdirs may reuse a basename and
+                # must stay distinct samples); ext splits at the FIRST
+                # dot of the basename only
+                stem, _, ext = base.partition(".")
+                key = f"{dirpart}/{stem}" if dirpart else stem
+                # the key change must be observed BEFORE any field
+                # filtering: a filtered-out member still delimits samples
+                # (else two same-key runs separated only by filtered
+                # members would silently merge)
+                if key != cur_key:
+                    flush(cur_key, cur)
+                    cur_key, cur = key, {}
+                ext = renamed(ext)
+                if not ext or not keep(ext):
+                    continue
+                data = tf.extractfile(member).read()
+                cur[ext] = (data if custom
+                            else _wds_decode_field(ext, data, decoder))
+            flush(cur_key, cur)
+        if callable(decoder):
+            rows = [decoder(r) for r in rows]
+        elif isinstance(decoder, (list, tuple)):
+            for fn in decoder:
+                rows = [fn(r) for r in rows]
+        return rows_to_block(rows)
+
+
+def _wds_encode_field(ext: str, value) -> bytes:
+    """Default per-field encoder for write_webdataset (reference:
+    _internal/datasource/webdataset_datasink.py default_encoder)."""
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return bytes(value)
+    base = ext.rsplit(".", 1)[-1].lower()
+    if isinstance(value, np.generic) and not isinstance(value, np.ndarray):
+        # arrow blocks yield numpy scalars (np.float32/np.bool_/...),
+        # which neither the int branch nor json.dumps accepts
+        value = value.item()
+    if isinstance(value, str):
+        return value.encode("utf-8")
+    if isinstance(value, (bool, int)):
+        return str(int(value)).encode("utf-8")
+    if isinstance(value, np.ndarray) and base in _WDS_IMAGE_EXTS:
+        import io as _io
+
+        from PIL import Image
+
+        fmt = {"png": "PNG", "jpg": "JPEG", "jpeg": "JPEG", "bmp": "BMP",
+               "ppm": "PPM", "gif": "GIF"}[base]
+        buf = _io.BytesIO()
+        Image.fromarray(value).save(buf, format=fmt)
+        return buf.getvalue()
+    if isinstance(value, np.ndarray):
+        import io as _io
+
+        buf = _io.BytesIO()
+        np.save(buf, value, allow_pickle=False)
+        return buf.getvalue()
+    import json as _json
+
+    return _json.dumps(value).encode("utf-8")
 
 
 class ImagesDatasource(FileBasedDatasource):

@@ -1,6 +1,7 @@
 from .attention import (attention, blockwise_attention, flash_attention,
                         mha_reference)
-from .layers import (apply_rope, gelu_mlp, layer_norm, rms_norm, rope_table,
+from .layers import (apply_rope, fused_softmax_cross_entropy, gelu_mlp,
+                     layer_norm, rms_norm, rope_table,
                      softmax_cross_entropy, swiglu)
 from .ring_attention import ring_attention, ring_attention_sharded
 from .ulysses import ulysses_attention, ulysses_attention_sharded
@@ -10,5 +11,5 @@ __all__ = [
     "ring_attention", "ring_attention_sharded",
     "ulysses_attention", "ulysses_attention_sharded",
     "rms_norm", "layer_norm", "rope_table", "apply_rope", "swiglu",
-    "gelu_mlp", "softmax_cross_entropy",
+    "gelu_mlp", "softmax_cross_entropy", "fused_softmax_cross_entropy",
 ]

@@ -71,6 +71,40 @@ def gelu_mlp(x, w_in, b_in, w_out, b_out):
     return jnp.einsum("...f,fd->...d", jax.nn.gelu(h), w_out) + b_out
 
 
+def fused_softmax_cross_entropy(x, unembed, labels, z_loss: float = 0.0,
+                                chunk: int = 128):
+    """Vocab-projected CE WITHOUT materializing [B, S, V] logits: scan
+    over sequence chunks; each chunk's logits exist only inside its
+    (checkpointed) scan step, so peak memory is [B, chunk, V] and the
+    bwd pass recomputes chunk logits instead of reading a stored f32
+    logits tensor — on HBM-bandwidth-bound steps the recompute is
+    cheaper than the traffic.  Numerically identical to the dense path:
+    both einsum in x.dtype and upcast to f32 for the logsumexp.
+
+    x [B, S, D] (compute dtype), unembed [D, V], labels [B, S] int.
+    Returns per-token loss [B, S] (f32).
+    """
+    B, S, D = x.shape
+    assert S % chunk == 0, (S, chunk)
+    n = S // chunk
+    xs = jnp.moveaxis(x.reshape(B, n, chunk, D), 1, 0)     # [n, B, c, D]
+    ls = jnp.moveaxis(labels.reshape(B, n, chunk), 1, 0)   # [n, B, c]
+
+    @jax.checkpoint
+    def chunk_loss(xc, lc):
+        logits = jnp.einsum("bcd,dv->bcv", xc, unembed).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        out = lse - jnp.take_along_axis(logits, lc[..., None],
+                                        axis=-1)[..., 0]
+        if z_loss:
+            out = out + z_loss * jnp.square(lse)
+        return out
+
+    _, losses = jax.lax.scan(lambda _, t: (None, chunk_loss(*t)),
+                             None, (xs, ls))               # [n, B, c]
+    return jnp.moveaxis(losses, 0, 1).reshape(B, S)
+
+
 def softmax_cross_entropy(logits, labels, z_loss: float = 0.0):
     """Token-level CE in f32 with optional z-loss (stabilizes large-vocab
     training); logits [..., V], labels [...] int."""

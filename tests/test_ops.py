@@ -133,3 +133,40 @@ def test_cross_entropy():
     loss = softmax_cross_entropy(logits, labels)
     p = np.exp(2.0) / (np.exp(2.0) + np.exp(1.0) + np.exp(0.1))
     assert np.allclose(np.asarray(loss), -np.log(p), atol=1e-5)
+
+
+@pytest.mark.parametrize("z_loss", [0.0, 1e-4])
+def test_fused_cross_entropy_matches_dense(z_loss):
+    """fused_softmax_cross_entropy (chunked vocab projection inside the
+    loss) == dense project-then-CE, for the loss AND the grads wrt both
+    hidden states and the unembed table."""
+    from ray_tpu.ops import fused_softmax_cross_entropy
+
+    B, S, D, V, chunk = 2, 64, 16, 37, 16
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
+    x = jax.random.normal(k1, (B, S, D))
+    w = jax.random.normal(k2, (D, V)) * 0.1
+    labels = jax.random.randint(k3, (B, S), 0, V)
+
+    def dense(x, w):
+        return jnp.mean(softmax_cross_entropy(
+            jnp.einsum("bsd,dv->bsv", x, w), labels, z_loss=z_loss))
+
+    def fused(x, w):
+        return jnp.mean(fused_softmax_cross_entropy(
+            x, w, labels, z_loss=z_loss, chunk=chunk))
+
+    ld, (gxd, gwd) = jax.value_and_grad(dense, argnums=(0, 1))(x, w)
+    lf, (gxf, gwf) = jax.value_and_grad(fused, argnums=(0, 1))(x, w)
+    assert np.allclose(float(ld), float(lf), atol=1e-6)
+    assert np.allclose(np.asarray(gxd), np.asarray(gxf), atol=1e-5)
+    assert np.allclose(np.asarray(gwd), np.asarray(gwf), atol=1e-5)
+
+
+def test_fused_cross_entropy_rejects_indivisible_seq():
+    from ray_tpu.ops import fused_softmax_cross_entropy
+
+    with pytest.raises(AssertionError):
+        fused_softmax_cross_entropy(jnp.zeros((1, 10, 4)),
+                                    jnp.zeros((4, 7)),
+                                    jnp.zeros((1, 10), jnp.int32), chunk=16)
