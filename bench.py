@@ -221,10 +221,11 @@ def bench_gpt_step():
     logs), so any failure of the no-remat attempt triggers the retry;
     a non-memory error will fail the remat attempt too and propagate."""
     forced = os.environ.get("BENCH_GPT_REMAT", "").strip().lower()
+    forced_policy = os.environ.get("BENCH_GPT_REMAT_POLICY", "full")
     if forced in ("0", "false", "no"):   # perf sweeps: pin the policy
         return _gpt_step_run(remat=False)
     if forced in ("1", "true", "yes"):
-        return _gpt_step_run(remat=True)
+        return _gpt_step_run(remat=True, policy=forced_policy)
     # attempt ladder, fastest-first (v5e measurements, GPT-2-small@512
     # B=16: no-remat OOMs; remat+dots 76.0k tok/s; remat+full 74.6k)
     errs, last = [], None
@@ -262,7 +263,7 @@ def _gpt_step_run(remat: bool, policy: str = "full"):
     lc = os.environ.get("BENCH_GPT_LOSS_CHUNK")
     cfg = gpt.GPTConfig.gpt2_small(
         vocab_size=50304, max_seq=seq, remat=remat,
-        remat_policy=os.environ.get("BENCH_GPT_REMAT_POLICY", policy),
+        remat_policy=policy,
         loss_chunk=int(lc) if lc else None,
         dtype=(jax.numpy.bfloat16 if on_tpu else jax.numpy.float32))
     n_dev = jax.device_count()

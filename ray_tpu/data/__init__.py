@@ -176,6 +176,37 @@ def read_sql(sql: str, connection_factory, *,
                            override_num_blocks=override_num_blocks)
 
 
+def read_delta(table_uri: str, *, version: Optional[int] = None,
+               columns: Optional[List[str]] = None,
+               override_num_blocks: Optional[int] = None) -> Dataset:
+    """Read a Delta Lake table snapshot (with `version=` time travel).
+
+    reference: read_api.py read_delta_sharing_tables — here the open
+    table protocol (_delta_log replay + checkpoints) is read directly,
+    local or remote (lake.DeltaDatasource)."""
+    from .lake import DeltaDatasource
+
+    return read_datasource(
+        DeltaDatasource(table_uri, version=version, columns=columns),
+        override_num_blocks=override_num_blocks)
+
+
+def read_iceberg(table_uri: str, *, snapshot_id: Optional[int] = None,
+                 columns: Optional[List[str]] = None,
+                 override_num_blocks: Optional[int] = None) -> Dataset:
+    """Read an Apache Iceberg v1/v2 table snapshot.
+
+    reference: read_api.py read_iceberg (pyiceberg) — here the
+    metadata.json -> manifest-list -> manifest avro chain is walked with
+    the bundled codec (lake.IcebergDatasource)."""
+    from .lake import IcebergDatasource
+
+    return read_datasource(
+        IcebergDatasource(table_uri, snapshot_id=snapshot_id,
+                          columns=columns),
+        override_num_blocks=override_num_blocks)
+
+
 def read_parquet_bulk(paths, *, columns: Optional[List[str]] = None,
                       override_num_blocks: Optional[int] = None) -> Dataset:
     """reference: read_parquet_bulk — one file per read unit, skipping
@@ -303,7 +334,6 @@ read_databricks_tables = _unavailable("read_databricks_tables",
                                       "databricks-sql-connector")
 read_delta_sharing_tables = _unavailable("read_delta_sharing_tables",
                                          "delta-sharing")
-read_iceberg = _unavailable("read_iceberg", "pyiceberg")
 read_lance = _unavailable("read_lance", "lance")
 from_spark = _unavailable("from_spark", "pyspark")
 from_dask = _unavailable("from_dask", "dask")
@@ -320,7 +350,7 @@ __all__ = [
     "read_text", "read_binary_files", "read_numpy", "aggregate",
     "read_avro", "read_tfrecords", "read_images", "read_sql",
     "read_webdataset",
-    "read_parquet_bulk",
+    "read_parquet_bulk", "read_delta", "read_iceberg",
     "from_blocks", "from_arrow_refs", "from_pandas_refs", "from_numpy_refs",
     "from_huggingface", "from_torch", "from_tf",
     "ActorPoolStrategy", "TaskPoolStrategy",

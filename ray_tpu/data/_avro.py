@@ -99,6 +99,9 @@ def _write_datum(out: io.BytesIO, schema: Any, v: Any) -> None:
                 return
         raise TypeError(f"value {v!r} matches no union branch {schema}")
     t = schema["type"] if isinstance(schema, dict) else schema
+    if isinstance(t, (dict, list)):         # {"type": <schema>} wrapper
+        _write_datum(out, t, v)
+        return
     if t == "null":
         return
     if t == "boolean":
@@ -178,11 +181,47 @@ def _coercible(schema: Any, v: Any) -> bool:
     return False
 
 
+def _resolve_named(schema: Any, names: Dict[str, Any] = None) -> Any:
+    """Replace references to previously defined named types (record/enum/
+    fixed, Avro spec §Names) with their definition dicts, in schema-DFS
+    order.  Iceberg manifest schemas reference the partition record type
+    by name (e.g. "r102"), so the registry is required to read them.
+    Replacement is by shared reference, which keeps recursive record
+    schemas (linked-list shapes) well-defined."""
+    if names is None:
+        names = {}
+    if isinstance(schema, str):
+        return names.get(schema, schema)
+    if isinstance(schema, list):
+        return [_resolve_named(s, names) for s in schema]
+    if isinstance(schema, dict):
+        t = schema.get("type")
+        if t in ("record", "enum", "fixed", "error"):
+            nm = schema.get("name")
+            if nm:
+                names[nm] = schema
+                ns = schema.get("namespace")
+                if ns:
+                    names[f"{ns}.{nm}"] = schema
+            if t == "record":
+                for f in schema.get("fields", ()):
+                    f["type"] = _resolve_named(f["type"], names)
+        elif t == "array":
+            schema["items"] = _resolve_named(schema.get("items"), names)
+        elif t == "map":
+            schema["values"] = _resolve_named(schema.get("values"), names)
+        elif isinstance(t, (dict, list, str)):
+            schema["type"] = _resolve_named(t, names)
+    return schema
+
+
 def _read_datum(buf: memoryview, pos: int, schema: Any) -> Tuple[Any, int]:
     if isinstance(schema, list):
         i, pos = _r_long(buf, pos)
         return _read_datum(buf, pos, schema[i])
     t = schema["type"] if isinstance(schema, dict) else schema
+    if isinstance(t, (dict, list)):   # {"type": <schema>} wrapper
+        return _read_datum(buf, pos, t)
     if t == "null":
         return None, pos
     if t == "boolean":
@@ -240,6 +279,27 @@ def _read_datum(buf: memoryview, pos: int, schema: Any) -> Tuple[Any, int]:
 # container file
 
 
+def container_schema(data: bytes) -> Dict[str, Any]:
+    """The schema JSON embedded in a container file's header, verbatim."""
+    buf = memoryview(data)
+    if bytes(buf[:4]) != MAGIC:
+        raise ValueError("not an Avro object container file")
+    pos = 4
+    while True:
+        n, pos = _r_long(buf, pos)
+        if n == 0:
+            break
+        if n < 0:
+            n = -n
+            _, pos = _r_long(buf, pos)
+        for _ in range(n):
+            k, pos = _r_bytes(buf, pos)
+            v, pos = _r_bytes(buf, pos)
+            if k == b"avro.schema":
+                return json.loads(v)
+    raise ValueError("container file has no avro.schema header")
+
+
 def read_container(data: bytes) -> List[Dict[str, Any]]:
     """All records of one Object Container File."""
     buf = memoryview(data)
@@ -257,7 +317,7 @@ def read_container(data: bytes) -> List[Dict[str, Any]]:
         for _ in range(n):
             k, pos = _r_bytes(buf, pos)
             meta[k.decode()], pos = _r_bytes(buf, pos)
-    schema = json.loads(meta["avro.schema"])
+    schema = _resolve_named(json.loads(meta["avro.schema"]))
     codec = meta.get("avro.codec", b"null").decode()
     if codec not in ("null", "deflate"):
         raise ValueError(f"unsupported avro codec {codec!r}")
@@ -348,6 +408,11 @@ def write_container(rows: List[Dict[str, Any]], *, schema: Dict = None,
     """Rows -> one Object Container File (schema inferred if absent)."""
     rows = [{k: _plain(v) for k, v in r.items()} for r in rows]
     schema = schema or _infer_schema(rows)
+    # embed the schema as given (named refs stay refs — re-dumping the
+    # resolved form would illegally redefine named types), but encode
+    # datums against the resolved view
+    schema_json = json.dumps(schema)
+    schema = _resolve_named(json.loads(schema_json))
     body = io.BytesIO()
     for r in rows:
         _write_datum(body, schema, r)
@@ -360,7 +425,7 @@ def write_container(rows: List[Dict[str, Any]], *, schema: Dict = None,
     sync = os.urandom(16)
     out = io.BytesIO()
     out.write(MAGIC)
-    meta = {"avro.schema": json.dumps(schema).encode(),
+    meta = {"avro.schema": schema_json.encode(),
             "avro.codec": codec.encode()}
     _w_long(out, len(meta))
     for k, v in meta.items():

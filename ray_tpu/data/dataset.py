@@ -467,6 +467,20 @@ class Dataset:
         _wds_encode_field); `encoder=` maps each row dict first."""
         return self._write(path, "tar", **kw)
 
+    def write_delta(self, table_uri: str, *, mode: str = "append",
+                    **kw) -> int:
+        """Write this dataset as one Delta Lake commit: part files go
+        through the normal distributed parquet write, then the driver
+        commits them to `_delta_log` atomically (lake.commit_delta_write).
+        mode='append'|'overwrite'.  Returns the committed version.
+        reference surface: read_api.py's Delta integration is read-only
+        (delta-sharing); the writer here makes the round trip testable
+        and lets pod jobs publish snapshots consumers can time-travel."""
+        from .lake import commit_delta_write
+
+        parts = self._write(table_uri, "parquet", **kw)
+        return commit_delta_write(table_uri, parts, mode=mode)
+
     # -- additional consumption / conversion surface ----------------------
 
     def take_batch(self, batch_size: int = 20,
