@@ -50,15 +50,54 @@ class _DeviceArrayStandIn:
 
     def __init__(self, np_value, sharding_desc):
         self.np_value = np_value
-        self.sharding_desc = sharding_desc  # (mesh axes, spec) description or None
+        # portable descriptor: {"spec": nested PartitionSpec entries}
+        # (older pickles carry a str(sharding); treated as no descriptor)
+        self.sharding_desc = sharding_desc
+
+
+def _pspec_entries(spec) -> Optional[list]:
+    """PartitionSpec -> JSON-ish nested lists (axis name, tuple of names,
+    or None per dim); None when any entry is not mesh-axis-shaped."""
+    out = []
+    for e in tuple(spec):
+        if e is None or isinstance(e, str):
+            out.append(e)
+        elif isinstance(e, (tuple, list)) and \
+                all(isinstance(a, str) for a in e):
+            out.append(list(e))
+        else:
+            return None
+    return out
 
 
 def _restore_device_array(stand_in: _DeviceArrayStandIn):
     jax = _jax_types()
     if jax is None:
         return stand_in.np_value
-    # Restore to default device; callers that need a specific sharding
-    # re-place explicitly (device placement is process-local).
+    desc = stand_in.sharding_desc
+    if isinstance(desc, dict) and desc.get("spec") is not None:
+        # re-place onto the receiving process's declared mesh when its
+        # axes cover the spec (mesh geometry is process-local, so the
+        # sender's mesh object itself can never travel)
+        from ray_tpu.parallel import get_default_mesh
+
+        mesh = get_default_mesh()
+        if mesh is not None:
+            entries = [tuple(e) if isinstance(e, list) else e
+                       for e in desc["spec"]]
+            used = {a for e in entries
+                    for a in (e if isinstance(e, tuple)
+                              else (e,) if e else ())}
+            if used <= set(mesh.axis_names):
+                try:
+                    return jax.device_put(
+                        stand_in.np_value,
+                        jax.sharding.NamedSharding(
+                            mesh, jax.sharding.PartitionSpec(*entries)))
+                except Exception:
+                    pass  # shape indivisible on this mesh: fall through
+    # no declared mesh (or incompatible): default device placement;
+    # callers that need a specific sharding re-place explicitly
     return jax.numpy.asarray(stand_in.np_value)
 
 
@@ -73,8 +112,13 @@ class _Pickler(cloudpickle.Pickler):
         if jax is not None and isinstance(obj, jax.Array):
             import numpy as np
 
+            desc = None
             try:
-                desc = str(obj.sharding)
+                sh = obj.sharding
+                if isinstance(sh, jax.sharding.NamedSharding):
+                    entries = _pspec_entries(sh.spec)
+                    if entries is not None:
+                        desc = {"spec": entries}
             except Exception:
                 desc = None
             host = np.asarray(obj)

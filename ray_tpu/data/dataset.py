@@ -478,7 +478,13 @@ class Dataset:
         and lets pod jobs publish snapshots consumers can time-travel."""
         from .lake import commit_delta_write
 
-        parts = self._write(table_uri, "parquet", **kw)
+        def write(block: Block, _path=table_uri, _wa=kw):
+            fname = write_block(block, _path, "parquet", **_wa)
+            n = block.num_rows if hasattr(block, "num_rows") else len(block)
+            return pa.table({"path": [fname], "rows": [n]})
+
+        ds = self._with(L.MapBlocks(self._dag, write, name="Write(delta)"))
+        parts = [(r["path"], r["rows"]) for r in ds.take_all()]
         return commit_delta_write(table_uri, parts, mode=mode)
 
     # -- additional consumption / conversion surface ----------------------

@@ -159,8 +159,12 @@ def _delta_snapshot(table: str, version: Optional[int]) -> Dict[str, Any]:
         raise NotImplementedError(
             f"Delta minReaderVersion {proto['minReaderVersion']} > 3")
     for feat in (proto.get("readerFeatures") or []):
-        if feat not in ("columnMapping", "timestampNtz", "v2Checkpoint",
-                        "vacuumProtocolCheck"):
+        # only features whose semantics this reader actually honors may
+        # pass: columnMapping would silently surface physical column
+        # names, v2Checkpoint uses UUID checkpoint names + sidecars the
+        # discovery regex can't see — both must fail loudly, not read
+        # wrong data
+        if feat not in ("timestampNtz", "vacuumProtocolCheck"):
             raise NotImplementedError(f"Delta reader feature {feat!r}")
     meta = state.get("metaData") or {}
     schema = json.loads(meta["schemaString"]) if meta.get("schemaString") \
@@ -300,15 +304,16 @@ def _spark_schema_string(arrow_schema) -> str:
     return json.dumps({"type": "struct", "fields": fields})
 
 
-def commit_delta_write(table: str, part_paths: List[str], *,
-                       mode: str = "append") -> int:
+def commit_delta_write(table: str, parts, *, mode: str = "append") -> int:
     """Commit already-written parquet part files as one Delta version.
 
-    `part_paths` are absolute paths/URIs under `table` (as returned by the
-    distributed write).  mode='append' adds them; mode='overwrite' also
-    removes every file in the current snapshot.  Creates the table
-    (protocol + metaData actions) when no log exists.  Returns the
-    committed version.
+    `parts` is a list of absolute paths/URIs under `table`, or of
+    (path, num_rows) pairs — when row counts travel with the paths (as
+    Dataset.write_delta sends them) only ONE part's footer is opened
+    (for the schema) instead of every part's.  mode='append' adds them;
+    mode='overwrite' also removes every file in the current snapshot.
+    Creates the table (protocol + metaData actions) when no log exists.
+    Returns the committed version.
     """
     import uuid
 
@@ -327,12 +332,17 @@ def commit_delta_write(table: str, part_paths: List[str], *,
     actions: List[Dict[str, Any]] = []
     arrow_schema = None
     adds = []
-    for p in part_paths:
-        with fileio.open_file(p, "rb") as f:
-            pf = pq.ParquetFile(f)
-            n_rows = pf.metadata.num_rows
-            if arrow_schema is None:
-                arrow_schema = pf.schema_arrow
+    for part in parts:
+        p, n_rows = part if isinstance(part, (tuple, list)) else (part, None)
+        if n_rows is not None:
+            n_rows = int(n_rows)  # arrow scalars are not JSON-encodable
+        if n_rows is None or arrow_schema is None:
+            with fileio.open_file(p, "rb") as f:
+                pf = pq.ParquetFile(f)
+                if n_rows is None:
+                    n_rows = pf.metadata.num_rows
+                if arrow_schema is None:
+                    arrow_schema = pf.schema_arrow
         rel = p[len(table):].lstrip("/") if p.startswith(table) else p
         adds.append({"add": {
             "path": urllib.parse.quote(rel),
@@ -366,10 +376,23 @@ def commit_delta_write(table: str, part_paths: List[str], *,
     log_dir = _join(table, "_delta_log")
     fileio.makedirs(log_dir)
     commit_path = _join(log_dir, f"{version:020d}.json")
+    payload = "\n".join(json.dumps(a) for a in actions).encode()
+    if not fileio.is_uri(commit_path):
+        # O_EXCL create: a concurrent writer racing to the same version
+        # loses with FileExistsError instead of silently overwriting
+        try:
+            with open(commit_path, "xb") as f:
+                f.write(payload)
+        except FileExistsError:
+            raise RuntimeError(
+                f"concurrent Delta commit at version {version}") from None
+        return version
+    # object stores: best-effort existence check (put-if-absent is not in
+    # the fsspec surface; a true CAS needs the store's conditional put)
     if fileio.exists(commit_path):
         raise RuntimeError(f"concurrent Delta commit at version {version}")
     with fileio.open_file(commit_path, "wb") as f:
-        f.write("\n".join(json.dumps(a) for a in actions).encode())
+        f.write(payload)
     return version
 
 

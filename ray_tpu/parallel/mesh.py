@@ -34,6 +34,39 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 
+# Process-default mesh: where device arrays crossing the object plane are
+# re-placed on deserialization (serialization.py consults it).  The
+# reference has no analog — its GPU tensors move through NCCL groups; here
+# placement is a mesh property, so the receiving process declares its mesh
+# once and every inbound array lands sharded instead of host-replicated.
+_default_mesh: Optional[Mesh] = None
+
+
+def set_default_mesh(mesh: Optional[Mesh]) -> None:
+    """Install (or clear, with None) this process's default mesh."""
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_default_mesh() -> Optional[Mesh]:
+    return _default_mesh
+
+
+class default_mesh:
+    """Context manager: `with default_mesh(mesh): ...`"""
+
+    def __init__(self, mesh: Optional[Mesh]):
+        self._mesh = mesh
+
+    def __enter__(self):
+        self._prev = get_default_mesh()
+        set_default_mesh(self._mesh)
+        return self._mesh
+
+    def __exit__(self, *exc):
+        set_default_mesh(self._prev)
+        return False
+
 
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:

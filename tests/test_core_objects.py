@@ -74,6 +74,62 @@ def test_jax_array_task_return(ray_cluster):
     assert np.allclose(np.asarray(out), 3.0)
 
 
+def test_jax_array_sharding_restored_on_default_mesh(ray_cluster):
+    """A NamedSharding-ed array crossing the object plane lands sharded
+    on the RECEIVER's declared mesh (serialization.py records the
+    PartitionSpec; parallel.set_default_mesh declares the mesh) instead
+    of replicated on one device."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel import default_mesh, make_mesh
+
+    @ray_tpu.remote
+    def make_sharded():
+        mesh = make_mesh(dp=4, tp=2)
+        x = jnp.arange(64.0).reshape(8, 8)
+        return jax.device_put(x, NamedSharding(mesh, P("dp", "tp")))
+
+    ref = make_sharded.remote()
+    # driver declares a mesh with the same axes: placement is restored
+    with default_mesh(make_mesh(dp=4, tp=2)):
+        out = ray_tpu.get(ref, timeout=120)
+    assert isinstance(out.sharding, NamedSharding)
+    assert tuple(out.sharding.spec) == ("dp", "tp")
+    assert len(out.sharding.device_set) == 8
+    assert np.allclose(np.asarray(out), np.arange(64.0).reshape(8, 8))
+    # without a declared mesh the same bytes still deserialize (default
+    # placement), so the descriptor is advisory, never load-bearing
+    out2 = ray_tpu.get(make_sharded.remote(), timeout=120)
+    assert np.allclose(np.asarray(out2), np.arange(64.0).reshape(8, 8))
+
+
+def test_jax_array_sharding_mismatched_mesh_falls_back(ray_cluster):
+    """Spec axes absent from the receiver's mesh, or indivisible shapes,
+    degrade to default placement — never an error."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel import default_mesh, make_mesh
+    from ray_tpu._private import serialization as ser
+
+    mesh = make_mesh(dp=8)
+    x = jax.device_put(jnp.arange(8.0), NamedSharding(mesh, P("dp")))
+    blob, bufs = ser.dumps_oob(x)
+    # receiver mesh lacks 'dp' entirely
+    with default_mesh(make_mesh(tp=8)):
+        y = ser.loads_oob(blob, [b.raw() for b in bufs])
+    assert np.allclose(np.asarray(y), np.arange(8.0))
+    # receiver mesh has dp but the dim is indivisible (7 % 8): the
+    # device_put fails and the restore falls back to default placement
+    stand_in = ser._DeviceArrayStandIn(np.arange(7.0), {"spec": ["dp"]})
+    with default_mesh(make_mesh(dp=8)):
+        y7 = ser._restore_device_array(stand_in)
+    assert np.allclose(np.asarray(y7), np.arange(7.0))
+
+
 def test_plain_pickle_of_ref_forbidden(ray_cluster):
     import pickle
 

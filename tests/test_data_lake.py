@@ -158,6 +158,46 @@ def test_delta_deletion_vectors_rejected(ray_cluster, tmp_path):
         rd.read_delta(table)
 
 
+def test_delta_unhonored_reader_features_rejected(ray_cluster, tmp_path):
+    """columnMapping/v2Checkpoint change on-disk semantics this reader
+    does not implement — reading anyway would return wrong data, so the
+    protocol gate must refuse."""
+    for feat in ("columnMapping", "v2Checkpoint"):
+        table = str(tmp_path / feat)
+        add = _write_part(table, "a.parquet", [1])
+        _commit(table, 0, [{"protocol": {"minReaderVersion": 3,
+                                         "readerFeatures": [feat]}},
+                           _meta_action(), {"add": add}])
+        with pytest.raises(NotImplementedError):
+            rd.read_delta(table)
+
+
+def test_delta_concurrent_commit_loses_cleanly(ray_cluster, tmp_path,
+                                               monkeypatch):
+    """Two writers race to the same version: the loser must get a
+    RuntimeError from the O_EXCL create, never silently overwrite the
+    winner's commit (the TOCTOU the exists()-check alone would have)."""
+    from ray_tpu.data import lake
+
+    table = str(tmp_path / "race")
+    rd.from_items([{"x": 1}]).write_delta(table)
+    _write_part(table, "z.parquet", [2])
+    # freeze this writer's snapshot at version 0, then land the rival's
+    # commit for version 1 inside the window
+    real = lake._delta_snapshot
+    monkeypatch.setattr(lake, "_delta_snapshot",
+                        lambda t, v: dict(real(t, v), version=0))
+    os.link(os.path.join(table, "_delta_log", f"{0:020d}.json"),
+            os.path.join(table, "_delta_log", f"{1:020d}.json"))
+    before = open(os.path.join(table, "_delta_log",
+                               f"{1:020d}.json")).read()
+    with pytest.raises(RuntimeError, match="concurrent"):
+        lake.commit_delta_write(table, [os.path.join(table, "z.parquet")])
+    after = open(os.path.join(table, "_delta_log",
+                              f"{1:020d}.json")).read()
+    assert after == before                  # winner's commit untouched
+
+
 def test_delta_write_read_roundtrip(ray_cluster, tmp_path):
     table = str(tmp_path / "w")
     v = rd.from_items([{"x": i, "part": 0} for i in range(20)]) \
