@@ -433,12 +433,17 @@ class Dataset:
         return self._split_rows_at(self.take_all(), indices)
 
     def _write(self, path: str, fmt: str, **writer_args) -> List[str]:
+        return [p for p, _ in self._write_parts(path, fmt, **writer_args)]
+
+    def _write_parts(self, path: str, fmt: str, **writer_args):
+        """Distributed write; one (file path, row count) pair per block."""
         def write(block: Block, _path=path, _fmt=fmt, _wa=writer_args):
             fname = write_block(block, _path, _fmt, **_wa)
-            return pa.table({"path": [fname]})
+            n = block.num_rows if hasattr(block, "num_rows") else len(block)
+            return pa.table({"path": [fname], "rows": [n]})
 
         ds = self._with(L.MapBlocks(self._dag, write, name=f"Write({fmt})"))
-        return [r["path"] for r in ds.take_all()]
+        return [(r["path"], r["rows"]) for r in ds.take_all()]
 
     def write_parquet(self, path: str, **kw) -> List[str]:
         return self._write(path, "parquet", **kw)
@@ -478,13 +483,7 @@ class Dataset:
         and lets pod jobs publish snapshots consumers can time-travel."""
         from .lake import commit_delta_write
 
-        def write(block: Block, _path=table_uri, _wa=kw):
-            fname = write_block(block, _path, "parquet", **_wa)
-            n = block.num_rows if hasattr(block, "num_rows") else len(block)
-            return pa.table({"path": [fname], "rows": [n]})
-
-        ds = self._with(L.MapBlocks(self._dag, write, name="Write(delta)"))
-        parts = [(r["path"], r["rows"]) for r in ds.take_all()]
+        parts = self._write_parts(table_uri, "parquet", **kw)
         return commit_delta_write(table_uri, parts, mode=mode)
 
     # -- additional consumption / conversion surface ----------------------

@@ -141,9 +141,50 @@ def iter_jax_batches(batch_iter: Iterator[Dict[str, np.ndarray]], *,
     """Move numpy batches onto device with double buffering.
 
     With a `jax.sharding.Sharding` (e.g. NamedSharding over a data axis),
-    each batch is placed sharded across the mesh; otherwise it goes to the
-    default device.
+    each batch is placed sharded across the mesh.  With no explicit
+    sharding but a process default mesh declared
+    (`ray_tpu.parallel.set_default_mesh`), batches land batch-sharded
+    over its data axes — the Data->Train hot path needs no per-callsite
+    sharding plumbing.  Otherwise batches go to the default device.
+
+    The auto path only engages when every mesh device is addressable
+    from this process: in multi-process SPMD each worker iterates its
+    OWN data shard, and a device_put onto a global mesh would treat the
+    local batch as the (assumed process-identical) global array —
+    silently assembling an incoherent mix.  SPMD callers pass an
+    explicit sharding (or build global arrays with
+    jax.make_array_from_process_local_data).
     """
+    # mesh capture happens NOW, at call time — inside a generator body it
+    # would be deferred to the first next(), after a `with default_mesh`
+    # block may already have exited
+    auto_sharding, auto_divisor = None, 1
+    if sharding is None:
+        from ray_tpu.parallel import data_axes, get_default_mesh
+
+        mesh = get_default_mesh()
+        if mesh is not None:
+            import jax as _jax
+            import math
+
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            pidx = _jax.process_index()
+            addressable = all(d.process_index == pidx
+                              for d in mesh.devices.flat)
+            # batch (dim 0) over the mesh's data axes; trailing dims stay
+            # unsharded so 1-D labels and N-D images both place cleanly
+            axes = tuple(a for a in data_axes(mesh)
+                         if mesh.shape.get(a, 1) > 1)
+            if axes and addressable:
+                auto_sharding = NamedSharding(mesh, PartitionSpec(axes))
+                auto_divisor = math.prod(mesh.shape[a] for a in axes)
+    return _iter_jax_batches_inner(batch_iter, sharding, auto_sharding,
+                                   auto_divisor, dtypes, prefetch)
+
+
+def _iter_jax_batches_inner(batch_iter, sharding, auto_sharding,
+                            auto_divisor, dtypes, prefetch):
     import jax
 
     def put(batch):
@@ -153,6 +194,11 @@ def iter_jax_batches(batch_iter: Iterator[Dict[str, np.ndarray]], *,
                 arr = arr.astype(dtype)
             if sharding is not None:
                 return jax.device_put(arr, sharding)
+            if auto_sharding is not None and arr.ndim >= 1 \
+                    and arr.shape[0] % auto_divisor == 0:
+                # indivisible batches (e.g. a short final batch) take the
+                # default-device path instead of crashing the iterator
+                return jax.device_put(arr, auto_sharding)
             return jax.device_put(arr)
 
         if isinstance(batch, dict):

@@ -187,6 +187,12 @@ class JaxConfig(BackendConfig):
     mode: str = "auto"
     coordinator_port: int = 8476
     collective_group: str = "train"
+    # e.g. {"dp": -1}: after jax init every worker builds this mesh over
+    # its visible devices and installs it as the process default
+    # (parallel.set_default_mesh) — iter_jax_batches then auto-shards
+    # batches and inbound jax.Arrays restore their shardings with no
+    # per-callsite plumbing
+    mesh_shape: Optional[Dict[str, int]] = None
 
     def backend_cls(self):
         return _JaxBackend
@@ -201,6 +207,14 @@ def _setup_jax_spmd(coordinator: str, num_processes: int, process_id: int):
     return {"process_index": jax.process_index(),
             "device_count": jax.device_count(),
             "local_device_count": jax.local_device_count()}
+
+
+def _install_default_mesh(shape: Dict[str, int]):
+    from ray_tpu.parallel import make_mesh, set_default_mesh
+
+    mesh = make_mesh(**shape)
+    set_default_mesh(mesh)
+    return {"mesh": {a: int(s) for a, s in mesh.shape.items()}}
 
 
 def _setup_jax_local(group_name: str, world_size: int, rank: int):
@@ -246,6 +260,14 @@ class _JaxBackend(Backend):
             refs = [w.actor.execute.remote(_setup_jax_local, group, n, i)
                     for i, w in enumerate(worker_group.workers)]
             ray_tpu.get(refs)
+        if backend_config.mesh_shape:
+            # after jax init so spmd workers see the global device set
+            meshes = ray_tpu.get([
+                w.actor.execute.remote(_install_default_mesh,
+                                       dict(backend_config.mesh_shape))
+                for w in worker_group.workers])
+            logger.info("default mesh installed on %d workers: %s",
+                        n, meshes[0])
 
     def on_shutdown(self, worker_group, backend_config: JaxConfig):
         if getattr(self, "mode", None) == "local" and worker_group.workers:

@@ -221,6 +221,40 @@ def test_iter_jax_batches_sharded(ray_cluster):
     assert batches and batches[0]["id"].sharding == sharding
 
 
+def test_iter_jax_batches_default_mesh_auto_shard(ray_cluster):
+    """With a declared process mesh and no explicit sharding, batches
+    land batch-sharded over the mesh's data axes; an indivisible final
+    batch degrades to default placement instead of crashing."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from ray_tpu.parallel import default_mesh, make_mesh
+
+    ds = rd.range(36, override_num_blocks=2)   # 36 = 4*8 + short 4
+    with default_mesh(make_mesh(dp=8)):
+        # drop_last=False on purpose: the short batch must take the
+        # default-placement path (jit callers keep the drop_last=True
+        # default for static shapes)
+        batches = list(ds.iter_jax_batches(batch_size=8, drop_last=False))
+    assert len(batches) == 5
+    full = batches[0]["id"]
+    assert isinstance(full.sharding, NamedSharding)
+    assert len(full.sharding.device_set) == 8
+    short = batches[-1]["id"]
+    assert short.shape[0] == 4                  # 36 % 8: default placement
+    total = sum(int(b["id"].sum()) for b in batches)
+    assert total == sum(range(36))
+    # no declared mesh: unchanged default behavior
+    b2 = list(ds.iter_jax_batches(batch_size=8))
+    assert isinstance(b2[0]["id"], jax.Array)
+    # the mesh is captured when the iterator is BUILT, not when it is
+    # first consumed (generators defer their body to next())
+    with default_mesh(make_mesh(dp=8)):
+        it = ds.iter_jax_batches(batch_size=8)
+    late = list(it)
+    assert len(late[0]["id"].sharding.device_set) == 8
+
+
 def test_materialize_reuse(ray_cluster):
     mat = rd.range(40, override_num_blocks=4).materialize()
     assert mat.count() == 40

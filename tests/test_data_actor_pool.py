@@ -3,6 +3,7 @@ python/ray/data/_internal/execution/operators/actor_pool_map_operator.py:34
 and python/ray/data/tests/test_actor_pool_map_operator.py shapes)."""
 
 import os
+import time
 import uuid
 
 import numpy as np
@@ -78,13 +79,24 @@ def test_actor_pool_constructor_args(ray_cluster):
         [i + 100 for i in range(10)]
 
 
+class SlowAddUDF(AddUDF):
+    """Holds each batch briefly so the queue stays visibly deep: scale-up
+    must trigger on saturation, not on a race against instant batches
+    (an instant UDF lets one actor drain the queue before the autoscale
+    check runs on a slow/contended box)."""
+
+    def __call__(self, batch):
+        time.sleep(0.15)
+        return super().__call__(batch)
+
+
 def test_actor_pool_autoscales(ray_cluster):
     """min=1,max=3 with a deep queue: the pool grows past min while all
     live actors are saturated."""
     from ray_tpu.data.execution import ActorPoolMapOperator, build_executor
 
     ds = rd.range(240, override_num_blocks=24).map_batches(
-        AddUDF, concurrency=(1, 3))
+        SlowAddUDF, concurrency=(1, 3))
     executor = build_executor(ds._dag)
     pool_ops = [op for op in executor.ops
                 if isinstance(op, ActorPoolMapOperator)]

@@ -70,6 +70,38 @@ def test_trainer_two_workers(ray_cluster, tmp_path):
     assert os.path.isdir(result.path)
 
 
+def _loop_mesh(config):
+    import numpy as np
+
+    from ray_tpu.data.iterator import iter_jax_batches
+    from ray_tpu.parallel import get_default_mesh
+
+    mesh = get_default_mesh()
+    assert mesh is not None, "JaxConfig(mesh_shape=...) did not install"
+    # iter_jax_batches auto-shards over the declared mesh's data axes
+    batches = list(iter_jax_batches(
+        iter([{"x": np.arange(16.0)}])))
+    sh = batches[0]["x"].sharding
+    train.report({"mesh_dp": int(mesh.shape["dp"]),
+                  "n_shards": len(sh.device_set)})
+
+
+def test_trainer_installs_default_mesh(ray_cluster, tmp_path):
+    """JaxConfig(mesh_shape=...): every train worker declares the mesh as
+    its process default, so data iteration and object-plane arrays are
+    mesh-aware with zero plumbing in the user loop."""
+    trainer = JaxTrainer(
+        _loop_mesh, train_loop_config={},
+        backend_config=JaxConfig(mode="local", mesh_shape={"dp": -1}),
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="mesh", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["mesh_dp"] >= 1
+    assert result.metrics["n_shards"] == result.metrics["mesh_dp"]
+
+
 def _loop_ckpt(config):
     import tempfile
 
