@@ -10,6 +10,7 @@ to stderr for the record without breaking the one-line contract.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -213,13 +214,13 @@ def _peak_flops(device) -> float | None:
 def bench_gpt_step():
     """GPT-2-small train-step tokens/s (+MFU) on the local accelerator.
 
-    Runs remat=False first — cheaper when activations fit — falling back
-    to remat=True when the first attempt fails.  OOM wording varies by
-    path (direct PJRT says RESOURCE_EXHAUSTED; the axon remote-compile
-    tunnel surfaces it as an INTERNAL HTTP 500 from tpu_compile_helper
-    with the 'Ran out of memory in memory space hbm' detail only in
-    logs), so any failure of the no-remat attempt triggers the retry;
-    a non-memory error will fail the remat attempt too and propagate."""
+    Tries remat+dots first (the measured-fastest config on v5e), then
+    remat+full, then no-remat last.  OOM wording varies by path (direct
+    PJRT says RESOURCE_EXHAUSTED; the axon remote-compile tunnel
+    surfaces it as an INTERNAL HTTP 500 from tpu_compile_helper with
+    the 'Ran out of memory in memory space hbm' detail only in logs),
+    so ANY failure moves to the next rung; a non-memory error fails
+    every rung and propagates."""
     forced = os.environ.get("BENCH_GPT_REMAT", "").strip().lower()
     forced_policy = os.environ.get("BENCH_GPT_REMAT_POLICY", "full")
     if forced in ("0", "false", "no"):   # perf sweeps: pin the policy
@@ -227,9 +228,11 @@ def bench_gpt_step():
     if forced in ("1", "true", "yes"):
         return _gpt_step_run(remat=True, policy=forced_policy)
     # attempt ladder, fastest-first (v5e measurements, GPT-2-small@512
-    # B=16: no-remat OOMs; remat+dots 76.0k tok/s; remat+full 74.6k)
+    # B=16: remat+dots 76.0k tok/s; remat+full 74.6k; NO-remat is LAST —
+    # when it fits at all it is HBM-bandwidth-bound and slower (52-71k),
+    # so "skip recompute" is not the fast path on this chip
     errs, last = [], None
-    for remat, policy in ((False, "full"), (True, "dots"), (True, "full")):
+    for remat, policy in ((True, "dots"), (True, "full"), (False, "full")):
         try:
             return _gpt_step_run(remat=remat, policy=policy)
         except Exception as e:
@@ -265,6 +268,7 @@ def _gpt_step_run(remat: bool, policy: str = "full"):
         vocab_size=50304, max_seq=seq, remat=remat,
         remat_policy=policy,
         loss_chunk=int(lc) if lc else None,
+        attention_impl=os.environ.get("BENCH_GPT_ATTN", "auto"),
         dtype=(jax.numpy.bfloat16 if on_tpu else jax.numpy.float32))
     n_dev = jax.device_count()
     mesh = make_mesh(dp=n_dev)
@@ -476,7 +480,7 @@ def bench_resnet_step():
          "label": jax.device_put(labels, data_sharding)}
     params, state, opt = jax.device_put((params, state, opt), repl)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def step(params, state, opt, b):
         (loss, (new_state, metrics)), grads = jax.value_and_grad(
             resnet.loss_fn, has_aux=True)(params, state, b, cfg)
