@@ -264,7 +264,8 @@ def _gpt_step_run(remat: bool, policy: str = "full"):
     per_dev_batch = int(os.environ.get("BENCH_GPT_BATCH", "16"))
     steps = int(os.environ.get("BENCH_GPT_STEPS", "10"))
     lc = os.environ.get("BENCH_GPT_LOSS_CHUNK")
-    cfg = gpt.GPTConfig.gpt2_small(
+    arch = os.environ.get("BENCH_GPT_ARCH", "gpt2_small")
+    cfg = getattr(gpt.GPTConfig, arch)(
         vocab_size=50304, max_seq=seq, remat=remat,
         remat_policy=policy,
         loss_chunk=int(lc) if lc else None,
@@ -546,19 +547,27 @@ def _gpt_only_main():
         jax.config.update("jax_platforms", "cpu")
 
     tps, loss, mfu = bench_gpt_step()
+    arch = os.environ.get("BENCH_GPT_ARCH", "gpt2_small")
     row = {
         "gpt_platform": jax.default_backend(),
         "device_kind": jax.devices()[0].device_kind,
         "n_devices": jax.device_count(),
         "seq": int(os.environ.get("BENCH_GPT_SEQ", "512")),
-        "gpt2_small_train_tokens_per_s": round(tps, 1),
-        "gpt2_small_loss": round(loss, 3),
+        f"{arch}_train_tokens_per_s": round(tps, 1),
+        f"{arch}_loss": round(loss, 3),
     }
     if mfu is not None:
-        row["gpt2_small_mfu"] = round(mfu, 4)
-    if jax.default_backend() != "cpu":
-        # the child owns the cache write: every consumer of a real-chip
-        # number (extras stage, scripts/tpu_watch.sh) goes through here
+        row[f"{arch}_mfu"] = round(mfu, 4)
+    # the child owns the cache write: every consumer of a real-chip
+    # number (extras stage, scripts/tpu_watch.sh) goes through here.
+    # ONLY the untouched headline config may overwrite the headline
+    # cache row — any sweep pin (arch, seq, batch, attention impl,
+    # remat override) means this run is an experiment, not the headline
+    sweep_pins = ("BENCH_GPT_ARCH", "BENCH_GPT_SEQ", "BENCH_GPT_BATCH",
+                  "BENCH_GPT_ATTN", "BENCH_GPT_REMAT",
+                  "BENCH_GPT_REMAT_POLICY", "BENCH_GPT_LOSS_CHUNK")
+    if jax.default_backend() != "cpu" \
+            and not any(os.environ.get(k) for k in sweep_pins):
         _cache_store(row)
     print(json.dumps(row), flush=True)
 

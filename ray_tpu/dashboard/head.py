@@ -174,6 +174,30 @@ class DashboardHead:
                 if info is None:
                     return 404, "text/plain", f"no job {rest}"
                 return self._json(info)
+            if path == "/api/train":
+                # run states the trainer publishes (train/trainer.py
+                # _publish_state); newest first.  Fetches are BOUNDED —
+                # run keys accumulate over a cluster's life, and the page
+                # polls this every tick inside one Promise.all, so an
+                # unbounded N+1 here would stall every other panel.
+                keys = self.control.call(
+                    "kv_keys", {"ns": "train", "prefix": ""}, timeout=10.0)
+                runs = []
+                for k in list(keys)[-200:]:
+                    raw = self.control.call(
+                        "kv_get", {"ns": "train", "key": k}, timeout=10.0)
+                    if raw:
+                        runs.append(json.loads(raw))
+                runs.sort(key=lambda r: -(r.get("ts") or 0))
+                return self._json(runs[:100])
+            if path == "/api/serve":
+                # snapshot the serve controller publishes each reconcile
+                # pass (serve/_controller.py _publish_status)
+                raw = self.control.call(
+                    "kv_get", {"ns": "serve", "key": "status"},
+                    timeout=10.0)
+                return self._json(json.loads(raw) if raw
+                                  else {"ts": None, "apps": []})
             if path == "/api/events":
                 # structured cluster events (reference: dashboard
                 # modules/event); ?severity=&source=&limit=

@@ -79,6 +79,8 @@ PAGE = """<!doctype html>
           style="background:#fff;border-radius:8px;width:100%"></canvas>
   <h2>Actors</h2><table id="actors"></table>
   <h2>Jobs</h2><table id="jobs"></table>
+  <h2>Serve deployments</h2><table id="serve"></table>
+  <h2>Train runs</h2><table id="train"></table>
   <h2>Placement groups</h2><table id="pgs"></table>
   <h2>Recent task events</h2><table id="tasks"></table>
   <h2>Cluster events</h2><table id="events"></table>
@@ -294,12 +296,13 @@ document.addEventListener("keydown", e => { if (e.key === "Escape") closePanel()
 
 async function tick() {
   try {
-    const [cs, nodes, actors, jobs, pgs, tasks, events, ver] =
+    const [cs, nodes, actors, jobs, pgs, tasks, events, ver, serve,
+           train] =
       await Promise.all([
       j("/api/cluster_status"), j("/api/nodes"), j("/api/actors"),
       j("/api/jobs"), j("/api/placement_groups"),
       j("/api/tasks?limit=50"), j("/api/events?limit=30"),
-      j("/api/version")]);
+      j("/api/version"), j("/api/serve"), j("/api/train")]);
     document.getElementById("addr").textContent = ver.control_address;
     const total = cs.total_resources || {}, avail = cs.available_resources || {};
     const card = (k, v) => `<div class="card"><div class="v">${v}</div><div class="k">${k}</div></div>`;
@@ -316,6 +319,17 @@ async function tick() {
     table("nodes", nodes, ["node_id", "addr", "state", "total", "available", "util", "labels"]);
     table("actors", actors, ["actor_id", "class_name", "name", "state", "node_id", "restarts"]);
     table("jobs", jobs, ["submission_id", "entrypoint", "status", "message"]);
+    const srows = [];
+    for (const a of (serve.apps || []))
+      for (const d of (a.deployments || []))
+        srows.push({app: a.app, route: a.route_prefix, ...d,
+                    app_status: a.status});
+    table("serve", srows, ["app", "route", "deployment", "status",
+                           "replicas", "ongoing", "message"]);
+    const trows = (train || []).map(r => ({...r,
+      metrics: r.last_metrics ? JSON.stringify(r.last_metrics).slice(0, 70) : ""}));
+    table("train", trows, ["name", "trial", "status", "workers",
+                           "rounds", "metrics"]);
     table("pgs", pgs, ["pg_id", "name", "state", "bundles", "strategy"]);
     table("tasks", tasks.records || [], ["task_id", "name", "state", "actor_id", "error"]);
     const evs = (events || []).slice().reverse().map(e => ({

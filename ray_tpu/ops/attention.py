@@ -181,13 +181,19 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
 
     def fit(block, seq):
         # shrink to a divisor so seq lengths like 768 (divisible by 256
-        # but not the 512/1024 defaults) keep working
+        # but not the 512/1024 defaults) keep working — but never below
+        # 128 lanes: a seq like 520 would "fit" at block 8, turning the
+        # grid into thousands of tiny sequential programs (an orders-of-
+        # magnitude perf cliff, and sub-sublane blocks may not even
+        # lower); such lengths must pad instead, loudly
+        floor = min(128, seq)
         block = min(block, seq)
-        while block > 8 and seq % block:
+        while block > floor and seq % block:
             block //= 2
         if seq % block:
-            raise ValueError(f"seq length {seq} has no power-of-two "
-                             f"block divisor >= 8")
+            raise ValueError(
+                f"seq length {seq} has no block divisor >= {floor}; pad "
+                f"the sequence to a multiple of 128 for the pallas path")
         return block
 
     block_q = fit(block_q, sq)

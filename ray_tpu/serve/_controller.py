@@ -167,6 +167,13 @@ class ServeController:
             names = list(self._apps)
         for name in names:
             self.delete_app(name, drain_s=0.5)
+        try:
+            # final publish with the (now empty) app set — otherwise the
+            # dashboard renders the last pre-shutdown snapshot's apps as
+            # HEALTHY forever
+            self._publish_status()
+        except Exception:
+            pass
         return True
 
     # -- read API (proxies / handles / status) ------------------------------
@@ -320,7 +327,51 @@ class ServeController:
             except Exception:
                 logger.error("serve reconcile error:\n%s",
                              traceback.format_exc())
+            try:
+                self._publish_status()
+            except Exception:
+                logger.debug("serve status publish failed", exc_info=True)
             time.sleep(RECONCILE_PERIOD_S)
+
+    def _publish_status(self):
+        """Push a plain-dict snapshot to the control-plane KV (ns
+        'serve') so the dashboard — which holds only a control client,
+        not a driver — can render serve state without calling into this
+        actor (reference shape: the controller checkpoints state the
+        serve dashboard module reads)."""
+        import json as _json
+
+        from ray_tpu._private.api import current_core
+
+        snap = {"ts": time.time(), "apps": []}
+        with self._lock:
+            for app_name, app in self._apps.items():
+                deps = []
+                for dname, ds in app["deployments"].items():
+                    running = sum(1 for r in ds.replicas.values()
+                                  if r.state == RUNNING)
+                    deps.append({
+                        "deployment": dname,
+                        "status": "HEALTHY"
+                        if running >= ds.target_num_replicas
+                        else "UPDATING",
+                        "replicas": f"{running}/{ds.target_num_replicas}",
+                        "ongoing": sum(r.ongoing
+                                       for r in ds.replicas.values()),
+                        "message": ds.message or "",
+                    })
+                snap["apps"].append({
+                    "app": app_name, "status": app["status"],
+                    "route_prefix": app["route_prefix"],
+                    "message": app["message"] or "",
+                    "deployments": deps,
+                })
+        # single kv_put (the internal_kv wrapper's overwrite path pays an
+        # extra kv_exists round-trip per publish for a return value
+        # nobody reads)
+        current_core().control.call("kv_put", {
+            "ns": "serve", "key": "status",
+            "val": _json.dumps(snap).encode()})
 
     def _reconcile_once(self):
         with self._lock:

@@ -108,6 +108,29 @@ class JaxTrainer:
 
     # -- the run loop (shared by fit() and the Tune trainable) -------------
 
+    def _publish_state(self, trial_name: str, status: str,
+                       metrics: Optional[Dict[str, Any]], rounds: int):
+        """Run-state snapshot into the control KV (ns 'train') for the
+        dashboard (reference: TrainStateActor feeding
+        dashboard/modules/train/train_head.py) — advisory, never fails
+        the run."""
+        try:
+            import json as _json
+
+            from ray_tpu._private.api import current_core
+
+            current_core().control.call("kv_put", {
+                "ns": "train", "key": trial_name,
+                "val": _json.dumps({
+                    "name": self.run_config.name, "trial": trial_name,
+                    "status": status,
+                    "workers": self.scaling_config.num_workers,
+                    "rounds": rounds,
+                    "last_metrics": metrics, "ts": time.time(),
+                }).encode()})
+        except Exception:
+            pass
+
     def _run(self, trial_dir: str, experiment_name: str, trial_name: str,
              on_report: Optional[Callable[[Dict[str, Any]], None]] = None,
              ) -> Result:
@@ -121,6 +144,7 @@ class JaxTrainer:
         error: Optional[BaseException] = None
         n = self.scaling_config.num_workers
         rounds = 0  # report rounds consumed, survives restarts
+        self._publish_state(trial_name, "RUNNING", None, 0)
         try:
             while True:
                 try:
@@ -147,6 +171,8 @@ class JaxTrainer:
                             ckpt_mgr.register_checkpoint(ckpt, metrics or {})
                         if on_report is not None and metrics is not None:
                             on_report(metrics)
+                        self._publish_state(trial_name, "RUNNING",
+                                            metrics, rounds)
                     executor.finish_training()
                     break
                 except TrainingWorkerError as e:
@@ -170,6 +196,9 @@ class JaxTrainer:
                     break
         finally:
             executor.shutdown()
+            self._publish_state(trial_name,
+                                "ERRORED" if error else "FINISHED",
+                                last_metrics, rounds)
         return Result(metrics=last_metrics,
                       checkpoint=ckpt_mgr.latest_checkpoint,
                       path=trial_dir, error=error,

@@ -21,6 +21,70 @@ def dashboard(ray_cluster):
     head.stop()
 
 
+def test_serve_status_panel(dashboard):
+    """The serve controller publishes reconcile-time status into the
+    control KV; /api/serve surfaces it and the page renders it."""
+    import json
+    import time
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class Hello:
+        async def __call__(self, request):
+            return {"ok": True}
+
+    serve.run(Hello.bind(), name="dash_app", route_prefix="/dash")
+    try:
+        deadline = time.time() + 60
+        apps = []
+        while time.time() < deadline:
+            with urllib.request.urlopen(dashboard.url + "/api/serve",
+                                        timeout=30) as r:
+                snap = json.loads(r.read().decode())
+            apps = snap.get("apps") or []
+            if any(a["app"] == "dash_app" and a["deployments"]
+                   for a in apps):
+                break
+            time.sleep(0.5)
+        app = next(a for a in apps if a["app"] == "dash_app")
+        assert app["route_prefix"] == "/dash"
+        dep = app["deployments"][0]
+        assert dep["replicas"].endswith("/1")
+        # the page itself carries the panel
+        with urllib.request.urlopen(dashboard.url + "/", timeout=30) as r:
+            body = r.read().decode()
+        assert "/api/serve" in body and 'id="serve"' in body
+    finally:
+        serve.shutdown()
+
+
+def test_train_runs_panel(dashboard, tmp_path):
+    """Trainer runs publish their state into the control KV; /api/train
+    lists them newest-first."""
+    import json
+
+    from ray_tpu import train
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def loop(config):
+        train.report({"loss": 1.5})
+
+    JaxTrainer(loop, train_loop_config={},
+               scaling_config=ScalingConfig(num_workers=1),
+               run_config=RunConfig(name="dash_run",
+                                    storage_path=str(tmp_path))).fit()
+    with urllib.request.urlopen(dashboard.url + "/api/train",
+                                timeout=30) as r:
+        runs = json.loads(r.read().decode())
+    run = next(x for x in runs if x["name"] == "dash_run")
+    assert run["status"] == "FINISHED"
+    assert run["workers"] == 1 and run["rounds"] == 1
+    assert run["last_metrics"]["loss"] == 1.5
+    with urllib.request.urlopen(dashboard.url + "/", timeout=30) as r:
+        assert 'id="train"' in r.read().decode()
+
+
 def test_root_serves_html_ui(dashboard):
     with urllib.request.urlopen(dashboard.url + "/", timeout=30) as r:
         assert r.status == 200

@@ -36,6 +36,19 @@ def test_blockwise_nondivisible_block():
     assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_flash_block_fitting():
+    """Defaults shrink to a divisor for awkward-but-reasonable lengths
+    (768 -> 256); lengths with only tiny divisors (520 -> 8) must raise,
+    not silently run a degenerate grid."""
+    q, k, v = _qkv(b=1, h=1, s=768, d=32)
+    out = flash_attention(q, k, v, True, None, 512, 1024, True)
+    ref = mha_reference(q, k, v, causal=True)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+    q2, k2, v2 = _qkv(b=1, h=1, s=520, d=32)
+    with pytest.raises(ValueError, match="pad"):
+        flash_attention(q2, k2, v2, True, None, 512, 1024, True)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_pallas_interpret_matches_reference(causal):
     # interpret mode runs the Pallas kernel on CPU — validates kernel logic
