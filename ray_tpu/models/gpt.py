@@ -227,6 +227,41 @@ def _attention_op(q, k, v, cfg: GPTConfig, mesh, allow_manual: bool = True):
     return attention(q, k, v, causal=True, impl=cfg.attention_impl)
 
 
+def _qkv_proj(x, layer, cfg: GPTConfig, rope, positions=None):
+    """Pre-norm + QKV projection + rope — the one source of truth shared
+    by the training forward and the KV-cache decode path (a recipe tweak
+    made in only one of them would silently break decode==forward
+    parity, which test_gpt_decode_matches_full_forward enforces)."""
+    h = _norm(x, layer["attn_norm"], layer.get("attn_norm_b"), cfg.norm)
+    h = h.astype(cfg.dtype)
+    q = jnp.einsum("bsd,dhk->bhsk", h, layer["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bhsk", h, layer["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bhsk", h, layer["wv"].astype(cfg.dtype))
+    if rope is not None:
+        q = apply_rope(q, *rope, positions=positions)
+        k = apply_rope(k, *rope, positions=positions)
+    return q, k, v
+
+
+def _attn_out_and_mlp(x, o, layer, cfg: GPTConfig):
+    """Output projection + residual + MLP sublayer (shared, see
+    _qkv_proj)."""
+    att = jnp.einsum("bhsk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
+    x = x + att
+    h2 = _norm(x, layer["mlp_norm"], layer.get("mlp_norm_b"), cfg.norm)
+    h2 = h2.astype(cfg.dtype)
+    if cfg.act == "swiglu":
+        m = swiglu(h2, layer["mlp_gate"].astype(cfg.dtype),
+                   layer["mlp_up"].astype(cfg.dtype),
+                   layer["mlp_out"].astype(cfg.dtype))
+    else:
+        m = gelu_mlp(h2, layer["mlp_in"].astype(cfg.dtype),
+                     layer["mlp_in_b"].astype(cfg.dtype),
+                     layer["mlp_out"].astype(cfg.dtype),
+                     layer["mlp_out_b"].astype(cfg.dtype))
+    return x + m
+
+
 def apply_hidden(params, tokens, cfg: GPTConfig, mesh=None):
     """Transformer stack up to (and including) the final norm: tokens
     [B, S] int32 -> hidden [B, S, D].  The vocab projection is split out
@@ -243,32 +278,12 @@ def apply_hidden(params, tokens, cfg: GPTConfig, mesh=None):
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
 
     def block(x, layer):
-        h = _norm(x, layer["attn_norm"], layer.get("attn_norm_b"), cfg.norm)
-        h = h.astype(cfg.dtype)
-        q = jnp.einsum("bsd,dhk->bhsk", h, layer["wq"].astype(cfg.dtype))
-        k = jnp.einsum("bsd,dhk->bhsk", h, layer["wk"].astype(cfg.dtype))
-        v = jnp.einsum("bsd,dhk->bhsk", h, layer["wv"].astype(cfg.dtype))
-        if rope is not None:
-            q = apply_rope(q, *rope)
-            k = apply_rope(k, *rope)
+        q, k, v = _qkv_proj(x, layer, cfg, rope)
         q = _constrain(q, "batch", "heads", "seq", "head_dim")
         k = _constrain(k, "batch", "heads", "seq", "head_dim")
         v = _constrain(v, "batch", "heads", "seq", "head_dim")
         o = _attention_op(q, k, v, cfg, mesh, allow_manual=(pp == 1))
-        att = jnp.einsum("bhsk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
-        x = x + att
-        h2 = _norm(x, layer["mlp_norm"], layer.get("mlp_norm_b"), cfg.norm)
-        h2 = h2.astype(cfg.dtype)
-        if cfg.act == "swiglu":
-            m = swiglu(h2, layer["mlp_gate"].astype(cfg.dtype),
-                       layer["mlp_up"].astype(cfg.dtype),
-                       layer["mlp_out"].astype(cfg.dtype))
-        else:
-            m = gelu_mlp(h2, layer["mlp_in"].astype(cfg.dtype),
-                         layer["mlp_in_b"].astype(cfg.dtype),
-                         layer["mlp_out"].astype(cfg.dtype),
-                         layer["mlp_out_b"].astype(cfg.dtype))
-        x = x + m
+        x = _attn_out_and_mlp(x, o, layer, cfg)
         return _constrain(x, "batch", "seq", "embed")
 
     def scan_body(x, layer):
@@ -344,6 +359,130 @@ def loss_fn(params, batch, cfg: GPTConfig, mesh=None):
         mask = batch["mask"].astype(jnp.float32)
         return jnp.sum(loss * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     return jnp.mean(loss)
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decoding (inference).  The reference delegates generation to
+# torch/vLLM; here decode is a first-class jit program: per-layer KV
+# buffers carried through a lax.scan over the stacked layer params, one
+# dynamic_update_slice per step — static shapes throughout, so the whole
+# generate loop compiles once for a given (batch, max_seq).
+
+
+def init_cache(cfg: GPTConfig, batch: int, max_seq: Optional[int] = None
+               ) -> Dict[str, Any]:
+    """Empty KV cache: [L, B, H, max_seq, d_head] per side + a scalar
+    write position."""
+    S = max_seq or cfg.max_seq
+    shape = (cfg.n_layers, batch, cfg.n_heads, S, cfg.d_head)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def _decode_hidden(params, cache, tokens, cfg: GPTConfig, rope=None):
+    """One decode position through the stack: tokens [B] at position
+    cache['pos'] -> (final-norm hidden [B, D], updated cache).  The
+    layer recipe is the shared _qkv_proj/_attn_out_and_mlp (identical to
+    the training forward); only the attention inner product runs against
+    the cache with a position mask.  `rope` may be precomputed by the
+    caller (generate hoists it out of its scans)."""
+    S = cache["k"].shape[3]
+    pos = cache["pos"]
+    x = params["embed"][tokens].astype(cfg.dtype)          # [B, D]
+    if cfg.pos == "learned":
+        x = x + jnp.take(params["pos_embed"], pos, axis=0)[None].astype(
+            cfg.dtype)
+        rope = None
+    elif rope is None:
+        rope = rope_table(S, cfg.d_head, dtype=jnp.float32)
+    x = x[:, None]                                         # [B, 1, D]
+    mask = (jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, S), 3)
+            <= pos)                                        # causal @ pos
+
+    def block(x, inp):
+        layer, kc, vc = inp                                # kc/vc [B,H,S,Dh]
+        q, k, v = _qkv_proj(x, layer, cfg, rope, positions=pos[None])
+        kc = jax.lax.dynamic_update_slice(kc, k, (0, 0, pos, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v, (0, 0, pos, 0))
+        s = jnp.einsum("bhqk,bhsk->bhqs", q.astype(jnp.float32),
+                       kc.astype(jnp.float32)) * (cfg.d_head ** -0.5)
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqs,bhsk->bhqk", p.astype(cfg.dtype), vc)
+        return _attn_out_and_mlp(x, o, layer, cfg), (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        block, x, (params["layers"], cache["k"], cache["v"]))
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"), cfg.norm)
+    return x[:, 0], {"k": k_new, "v": v_new, "pos": pos + 1}
+
+
+def decode_step(params, cache, tokens, cfg: GPTConfig, rope=None):
+    """One decode position: tokens [B] int32 at position cache['pos'] ->
+    (logits [B, V], updated cache)."""
+    x, cache = _decode_hidden(params, cache, tokens, cfg, rope)
+    logits = jnp.einsum("bd,dv->bv", x.astype(cfg.dtype),
+                        _unembed_table(params, cfg))
+    return logits, cache
+
+
+def generate(params, cfg: GPTConfig, prompt, max_new_tokens: int, *,
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             rng=None, max_seq: Optional[int] = None):
+    """Autoregressive generation: prompt [B, S] int32 -> [B, S + new].
+
+    temperature == 0 is greedy argmax; otherwise categorical sampling
+    over logits/temperature (optionally top_k-truncated).  The prefill
+    and decode loops are both lax.scans of decode_step, so the entire
+    call jits to one program with static shapes.
+    """
+    B, S = prompt.shape
+    total = S + max_new_tokens
+    if max_seq is None:
+        max_seq = total
+    if total > max_seq:
+        raise ValueError(f"prompt ({S}) + max_new_tokens "
+                         f"({max_new_tokens}) > max_seq ({max_seq})")
+    if cfg.pos == "learned" and total > cfg.max_seq:
+        raise ValueError(f"learned positions stop at {cfg.max_seq}")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    cache = init_cache(cfg, B, max_seq)
+    # hoisted out of both scan bodies: the table is position-invariant
+    rope = (rope_table(max_seq, cfg.d_head, dtype=jnp.float32)
+            if cfg.pos != "learned" else None)
+
+    def prefill(cache, tok):
+        # hidden only — projecting [B, V] logits per prompt position
+        # would throw away all but the last (D x V is the fattest matmul
+        # in a small-model decode step)
+        x, cache = _decode_hidden(params, cache, tok, cfg, rope)
+        return cache, x
+
+    cache, hidden_all = jax.lax.scan(prefill, cache, prompt.T)
+    last_logits = jnp.einsum("bd,dv->bv",
+                             hidden_all[-1].astype(cfg.dtype),
+                             _unembed_table(params, cfg))
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        logits = logits / temperature
+        if top_k is not None:
+            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        return jax.random.categorical(key, logits).astype(prompt.dtype)
+
+    def step(carry, key):
+        cache, logits = carry
+        tok = sample(logits, key)
+        new_logits, cache = decode_step(params, cache, tok, cfg, rope)
+        return (cache, new_logits), tok
+
+    keys = jax.random.split(rng, max_new_tokens)
+    (_, _), new_tokens = jax.lax.scan(step, (cache, last_logits), keys)
+    return jnp.concatenate([prompt, new_tokens.T], axis=1)
 
 
 def num_params(cfg: GPTConfig) -> int:
