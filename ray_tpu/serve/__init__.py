@@ -18,6 +18,7 @@ from ._proxy import Request, Response, RpcClient
 from .api import (delete, get_app_handle, get_deployment_handle, run,
                   shutdown, start, start_grpc, start_rpc_proxy, status)
 from .batching import batch
+from . import llm  # noqa: F401  (serve.llm.LLMServer / build_llm_app)
 from .multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [

@@ -1,0 +1,214 @@
+"""LLM serving: batched KV-cache generation behind a Serve deployment.
+
+The reference serves LLMs by delegating to an external engine (vLLM) and
+wiring it into Serve; here decoding is the framework's own jit program
+(models/gpt.py: init_cache/decode_step/generate), so the deployment is a
+thin batching + streaming shell around compiled code:
+
+  * non-streaming requests are micro-batched (serve.batch) and grouped
+    by (prompt_len, max_new, sampling params, seed) so each group runs
+    as ONE compiled generate() call.  Requests batch together only when
+    prompt lengths match exactly (token-id prompts are not padded —
+    left-pads would enter the causal window); the KV-cache length is
+    bucketed to multiples of 128 so max_new variations reuse compiles;
+  * streaming requests run a Python decode loop over the jitted
+    decode_step (one compile per cache bucket) and yield tokens as they
+    are sampled — through Serve's generator streaming this is SSE/
+    chunked-transfer token streaming end to end.
+
+Prompts and completions are token-id lists: tokenizers are deliberately
+out of scope (bring your own; nothing here depends on one).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+from ._deployment import deployment
+from .api import run
+from .batching import batch
+
+__all__ = ["LLMServer", "build_llm_app"]
+
+
+def _bucket(n: int, step: int = 128) -> int:
+    return ((n + step - 1) // step) * step
+
+
+class _LLMServerImpl:
+    """Deployment body.  cfg_kwargs are GPTConfig fields (or pass
+    `preset="gpt2_small"`); params_loader() -> params lets checkpoints
+    load lazily on the replica (it runs on the replica's host, so the
+    driver never materializes the weights)."""
+
+    def __init__(self, preset: str = "nano", cfg_kwargs: Optional[dict] = None,
+                 params_loader=None, max_seq: int = 512):
+        import jax
+
+        from ray_tpu.models import gpt
+
+        self._gpt = gpt
+        cfg_kwargs = dict(cfg_kwargs or {})
+        cfg_kwargs.setdefault("max_seq", max_seq)
+        self._cfg = getattr(gpt.GPTConfig, preset)(**cfg_kwargs)
+        self._params = (params_loader() if params_loader is not None
+                        else gpt.init(jax.random.PRNGKey(0), self._cfg))
+        self._max_seq = max_seq
+        self._jax = jax
+        self._step = jax.jit(functools.partial(gpt.decode_step,
+                                               cfg=self._cfg))
+        # per-instance (NOT lru_cache on the method: a class-level cache
+        # keyed by self would pin replaced replicas' full weights)
+        self._gen_cache: Dict[tuple, Any] = {}
+
+    def _gen_fn(self, max_new: int, temperature: float,
+                top_k: Optional[int], max_seq: int):
+        key = (max_new, temperature, top_k, max_seq)
+        fn = self._gen_cache.get(key)
+        if fn is None:
+            fn = self._gen_cache[key] = self._jax.jit(functools.partial(
+                self._gpt.generate, cfg=self._cfg, max_new_tokens=max_new,
+                temperature=temperature, top_k=top_k, max_seq=max_seq))
+        return fn
+
+    def _check_capacity(self, plen: int, max_new: int):
+        if self._cfg.pos == "learned" and plen + max_new > self._cfg.max_seq:
+            raise ValueError(
+                f"prompt ({plen}) + max_new_tokens ({max_new}) exceeds "
+                f"the model's learned-position capacity "
+                f"({self._cfg.max_seq})")
+
+    async def generate_batch(self, requests: List[Dict[str, Any]]
+                             ) -> List[Dict[str, Any]]:
+        """Group by (prompt_len, max_new, temperature, top_k): each group
+        is one stacked generate() call."""
+        import numpy as np
+
+        groups: Dict[tuple, List[int]] = {}
+        for i, r in enumerate(requests):
+            key = (len(r["tokens"]), int(r.get("max_new_tokens", 16)),
+                   float(r.get("temperature", 0.0)),
+                   r.get("top_k"), int(r.get("seed", 0)))
+            groups.setdefault(key, []).append(i)
+        out: List[Optional[Dict[str, Any]]] = [None] * len(requests)
+        for (plen, max_new, temp, top_k, seed), idxs in groups.items():
+            self._check_capacity(plen, max_new)
+            prompts = np.asarray([requests[i]["tokens"] for i in idxs],
+                                 np.int32)
+            fn = self._gen_fn(max_new, temp, top_k,
+                              _bucket(plen + max_new))
+            toks = np.asarray(fn(self._params, prompt=prompts,
+                                 rng=self._jax.random.PRNGKey(seed)))
+            for row, i in enumerate(idxs):
+                out[i] = {"tokens": toks[row].tolist(),
+                          "completion": toks[row, plen:].tolist(),
+                          "batch_size": len(idxs)}
+        return out
+
+    def stream_tokens(self, tokens: List[int], max_new_tokens: int = 16,
+                      temperature: float = 0.0, seed: int = 0,
+                      top_k: Optional[int] = None):
+        """Yield one sampled token id at a time (generator => Serve
+        streams it as SSE/chunked over HTTP, itemwise over handles).
+        Sampling semantics match the batched route exactly."""
+        import numpy as np
+
+        jax, gpt, cfg = self._jax, self._gpt, self._cfg
+        self._check_capacity(len(tokens), max_new_tokens)
+        total = _bucket(len(tokens) + max_new_tokens)
+        cache = gpt.init_cache(cfg, 1, total)
+        logits = None
+        for t in tokens:                      # prefill, one jit program
+            logits, cache = self._step(self._params, cache,
+                                       np.asarray([t], np.int32))
+        key = jax.random.PRNGKey(seed)
+        for i in range(max_new_tokens):
+            lg = np.asarray(logits, np.float32)[0]
+            if temperature == 0.0:
+                tok = int(lg.argmax(-1))
+            else:
+                lg = lg / temperature
+                if top_k is not None:
+                    kth = np.sort(lg)[-top_k]
+                    lg = np.where(lg < kth, -1e30, lg)
+                key, sub = jax.random.split(key)
+                tok = int(jax.random.categorical(
+                    sub, self._jax.numpy.asarray(lg)))
+            yield tok
+            if i < max_new_tokens - 1:       # the last sample needs no
+                logits, cache = self._step(  # further forward pass
+                    self._params, cache, np.asarray([tok], np.int32))
+
+    async def __call__(self, request):
+        # handle calls pass the body dict directly; HTTP passes a Request
+        is_http = not isinstance(request, dict)
+        body = await request.json() if is_http else request
+        if body.get("stream"):
+            if is_http:
+                # the HTTP proxy streams only ingresses whose __call__
+                # is itself a generator function — that is the dedicated
+                # stream app build_llm_app deploys next door
+                raise ValueError(
+                    "token streaming over HTTP lives on the companion "
+                    "'<route>-stream' endpoint; this route is the "
+                    "micro-batched JSON API")
+            return self.stream_tokens(
+                body["tokens"], int(body.get("max_new_tokens", 16)),
+                float(body.get("temperature", 0.0)),
+                int(body.get("seed", 0)), body.get("top_k"))
+        return await self.generate_batch(body)
+
+
+def LLMServer(**deployment_kwargs):
+    """`LLMServer().bind(preset=..., ...)`-style factory: returns the
+    deployment (decorate-once so serve.batch wraps generate_batch)."""
+    cls = type("LLMServer", (_LLMServerImpl,), {})
+    cls.generate_batch = batch(
+        _LLMServerImpl.generate_batch,
+        max_batch_size=deployment_kwargs.pop("max_batch_size", 8),
+        batch_wait_timeout_s=deployment_kwargs.pop(
+            "batch_wait_timeout_s", 0.02))
+    return deployment(cls, **deployment_kwargs) \
+        if deployment_kwargs else deployment(cls)
+
+
+class _LLMStreamIngress:
+    """HTTP token-streaming ingress: an async-GENERATOR __call__ (the
+    proxy streams chunked/SSE only for generator ingresses), relaying
+    the shared engine's stream_tokens through a streaming handle —
+    weights live once, in the engine deployment."""
+
+    def __init__(self, engine_app: str):
+        self._engine_app = engine_app
+        self._h = None
+
+    async def __call__(self, request):
+        import json as _json
+
+        from .api import get_app_handle
+
+        body = request if isinstance(request, dict) else \
+            await request.json()
+        if self._h is None:
+            self._h = get_app_handle(self._engine_app)
+        gen = self._h.options(stream=True).stream_tokens.remote(
+            body["tokens"], int(body.get("max_new_tokens", 16)),
+            float(body.get("temperature", 0.0)),
+            int(body.get("seed", 0)), body.get("top_k"))
+        async for tok in gen:
+            yield _json.dumps({"token": int(tok)}) + "\n"
+
+
+def build_llm_app(preset: str = "nano", *, route_prefix: str = "/llm",
+                  name: str = "llm", stream: bool = True, **init_kwargs):
+    """Deploy a generation endpoint: POST {tokens, max_new_tokens, ...}
+    -> {tokens, completion} at `route_prefix` (micro-batched), plus a
+    token-streaming endpoint at `route_prefix`-stream."""
+    dep = LLMServer()
+    h = run(dep.bind(preset=preset, **init_kwargs), name=name,
+            route_prefix=route_prefix)
+    if stream:
+        run(deployment(_LLMStreamIngress).bind(name),
+            name=f"{name}-stream", route_prefix=f"{route_prefix}-stream")
+    return h
