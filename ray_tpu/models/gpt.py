@@ -60,6 +60,9 @@ class GPTConfig:
     # Ignored (dense fallback) when S isn't divisible or under sp.
     loss_chunk: Optional[int] = None
     attention_impl: str = "auto"
+    # q/k/v/o projection biases (real GPT-2 checkpoints have them; our
+    # from-scratch recipes don't need them)
+    attn_bias: bool = False
     sp_mode: str = "ring"     # how to handle a >1 sp axis: "ring" | "none"
     z_loss: float = 1e-4
     tie_embeddings: bool = True
@@ -115,6 +118,11 @@ def logical_axes(cfg: GPTConfig) -> Dict[str, Any]:
     if cfg.norm == "ln":
         lp["attn_norm_b"] = Logical("layers", None)
         lp["mlp_norm_b"] = Logical("layers", None)
+    if cfg.attn_bias:
+        lp["wq_b"] = Logical("layers", "heads", "head_dim")
+        lp["wk_b"] = Logical("layers", "heads", "head_dim")
+        lp["wv_b"] = Logical("layers", "heads", "head_dim")
+        lp["wo_b"] = Logical("layers", None)
     out = {
         # vocab-only sharding: the table's lookup is a gather, and an
         # fsdp-sharded embed dim makes the partitioner emit embed-sharded
@@ -168,6 +176,11 @@ def init(key, cfg: GPTConfig) -> Dict[str, Any]:
     if cfg.norm == "ln":
         lp["attn_norm_b"] = jnp.zeros((L, D), pd)
         lp["mlp_norm_b"] = jnp.zeros((L, D), pd)
+    if cfg.attn_bias:
+        lp["wq_b"] = jnp.zeros((L, H, dh), pd)
+        lp["wk_b"] = jnp.zeros((L, H, dh), pd)
+        lp["wv_b"] = jnp.zeros((L, H, dh), pd)
+        lp["wo_b"] = jnp.zeros((L, D), pd)
     params = {
         "embed": jax.random.normal(next(k), (V, D), pd) * 0.02,
         "layers": lp,
@@ -237,6 +250,10 @@ def _qkv_proj(x, layer, cfg: GPTConfig, rope, positions=None):
     q = jnp.einsum("bsd,dhk->bhsk", h, layer["wq"].astype(cfg.dtype))
     k = jnp.einsum("bsd,dhk->bhsk", h, layer["wk"].astype(cfg.dtype))
     v = jnp.einsum("bsd,dhk->bhsk", h, layer["wv"].astype(cfg.dtype))
+    if cfg.attn_bias:
+        q = q + layer["wq_b"].astype(cfg.dtype)[None, :, None]
+        k = k + layer["wk_b"].astype(cfg.dtype)[None, :, None]
+        v = v + layer["wv_b"].astype(cfg.dtype)[None, :, None]
     if rope is not None:
         q = apply_rope(q, *rope, positions=positions)
         k = apply_rope(k, *rope, positions=positions)
@@ -247,6 +264,8 @@ def _attn_out_and_mlp(x, o, layer, cfg: GPTConfig):
     """Output projection + residual + MLP sublayer (shared, see
     _qkv_proj)."""
     att = jnp.einsum("bhsk,hkd->bsd", o, layer["wo"].astype(cfg.dtype))
+    if cfg.attn_bias:
+        att = att + layer["wo_b"].astype(cfg.dtype)
     x = x + att
     h2 = _norm(x, layer["mlp_norm"], layer.get("mlp_norm_b"), cfg.norm)
     h2 = h2.astype(cfg.dtype)

@@ -1,0 +1,115 @@
+"""HuggingFace GPT-2 checkpoint interop.
+
+`from_hf_gpt2` maps a `transformers` GPT-2 model's weights into this
+framework's param tree (models/gpt.py layout: layers stacked on a
+leading axis for the scan), so HF checkpoints train, decode, and serve
+here natively — the reference reaches HF models by running torch inside
+its workers (reference: python/ray/train/huggingface/); here the weights
+cross once into jax and everything downstream is the TPU-native path.
+
+Layout notes (verified against transformers' GPT2 implementation):
+  * HF Conv1D stores weight as [in_features, out_features] (already the
+    orientation our einsums want — no transposes);
+  * c_attn packs q/k/v along the output axis: split thirds;
+  * GPT-2 uses the tanh-approximate GELU, which is jax.nn.gelu's
+    default, and layer-norm eps 1e-5, which matches ops/layers.py;
+  * lm_head is tied to wte (tie_embeddings=True).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import gpt
+
+__all__ = ["from_hf_gpt2"]
+
+
+def from_hf_gpt2(model: Any, *, dtype=jnp.bfloat16, param_dtype=jnp.float32,
+                 **cfg_overrides) -> Tuple[gpt.GPTConfig, Dict[str, Any]]:
+    """transformers GPT2LMHeadModel (or a name to load) -> (cfg, params).
+
+    Pass a model instance to stay offline; a string name delegates to
+    transformers.AutoModelForCausalLM.from_pretrained (needs the weights
+    to be locally cached in a zero-egress environment).
+    """
+    if isinstance(model, str):
+        from transformers import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_pretrained(model)
+    hc = model.config
+    # variants that change the math this converter hardcodes must fail
+    # loudly, not produce silently-divergent logits
+    act = getattr(hc, "activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh"):
+        raise NotImplementedError(
+            f"activation_function={act!r} (converter assumes the tanh "
+            f"GELU GPT-2 ships with)")
+    for flag in ("scale_attn_by_inverse_layer_idx",
+                 "reorder_and_upcast_attn"):
+        if getattr(hc, flag, False):
+            raise NotImplementedError(f"{flag}=True is not supported")
+    sd = {k: np.asarray(v.detach().cpu().numpy())
+          for k, v in model.state_dict().items()}
+    prefix = "transformer." if any(k.startswith("transformer.")
+                                   for k in sd) else ""
+
+    D, H, L = hc.n_embd, hc.n_head, hc.n_layer
+    dh = D // H
+    F = getattr(hc, "n_inner", None) or 4 * D
+    cfg = gpt.GPTConfig(
+        n_layers=L, d_model=D, n_heads=H, d_head=dh, d_ff=F,
+        vocab_size=hc.vocab_size, max_seq=hc.n_positions,
+        norm="ln", act="gelu", pos="learned", tie_embeddings=True,
+        attn_bias=True, dtype=dtype, param_dtype=param_dtype,
+        **cfg_overrides)
+
+    def g(name):
+        return sd[prefix + name].astype(np.float32)
+
+    def stack(fmt, reshape=None):
+        arrs = [g(fmt.format(i)) for i in range(L)]
+        if reshape is not None:
+            arrs = [a.reshape(reshape) for a in arrs]
+        return jnp.asarray(np.stack(arrs), param_dtype)
+
+    # one pass over c_attn per layer (not one per q/k/v: gpt2-xl's
+    # [1600, 4800] f32 copies are worth not tripling)
+    qkv_w = [[], [], []]
+    qkv_b = [[], [], []]
+    for i in range(L):
+        w = g(f"h.{i}.attn.c_attn.weight")            # [D, 3D]
+        b = g(f"h.{i}.attn.c_attn.bias")              # [3D]
+        for which in range(3):
+            qkv_w[which].append(
+                w[:, which * D:(which + 1) * D].reshape(D, H, dh))
+            qkv_b[which].append(
+                b[which * D:(which + 1) * D].reshape(H, dh))
+    (wq, wk, wv), (wq_b, wk_b, wv_b) = (
+        [jnp.asarray(np.stack(a), param_dtype) for a in qkv_w],
+        [jnp.asarray(np.stack(a), param_dtype) for a in qkv_b])
+    lp = {
+        "attn_norm": stack("h.{}.ln_1.weight"),
+        "attn_norm_b": stack("h.{}.ln_1.bias"),
+        "wq": wq, "wk": wk, "wv": wv,
+        "wq_b": wq_b, "wk_b": wk_b, "wv_b": wv_b,
+        "wo": stack("h.{}.attn.c_proj.weight", reshape=(H, dh, D)),
+        "wo_b": stack("h.{}.attn.c_proj.bias"),
+        "mlp_norm": stack("h.{}.ln_2.weight"),
+        "mlp_norm_b": stack("h.{}.ln_2.bias"),
+        "mlp_in": stack("h.{}.mlp.c_fc.weight"),
+        "mlp_in_b": stack("h.{}.mlp.c_fc.bias"),
+        "mlp_out": stack("h.{}.mlp.c_proj.weight"),
+        "mlp_out_b": stack("h.{}.mlp.c_proj.bias"),
+    }
+    params = {
+        "embed": jnp.asarray(g("wte.weight"), param_dtype),
+        "pos_embed": jnp.asarray(g("wpe.weight"), param_dtype),
+        "layers": lp,
+        "final_norm": jnp.asarray(g("ln_f.weight"), param_dtype),
+        "final_norm_b": jnp.asarray(g("ln_f.bias"), param_dtype),
+    }
+    return cfg, params

@@ -38,9 +38,11 @@ def _bucket(n: int, step: int = 128) -> int:
 
 class _LLMServerImpl:
     """Deployment body.  cfg_kwargs are GPTConfig fields (or pass
-    `preset="gpt2_small"`); params_loader() -> params lets checkpoints
-    load lazily on the replica (it runs on the replica's host, so the
-    driver never materializes the weights)."""
+    `preset="gpt2_small"`); params_loader() runs ON THE REPLICA (the
+    driver never materializes the weights) and may return either a
+    params tree, or a (GPTConfig, params) pair — which is exactly what
+    models/hf.from_hf_gpt2 returns, so serving an HF checkpoint is
+    `LLMServer().bind(params_loader=lambda: from_hf_gpt2("gpt2"))`."""
 
     def __init__(self, preset: str = "nano", cfg_kwargs: Optional[dict] = None,
                  params_loader=None, max_seq: int = 512):
@@ -49,12 +51,27 @@ class _LLMServerImpl:
         from ray_tpu.models import gpt
 
         self._gpt = gpt
-        cfg_kwargs = dict(cfg_kwargs or {})
+        user_cfg_kwargs = dict(cfg_kwargs or {})
+        cfg_kwargs = dict(user_cfg_kwargs)
         cfg_kwargs.setdefault("max_seq", max_seq)
         self._cfg = getattr(gpt.GPTConfig, preset)(**cfg_kwargs)
-        self._params = (params_loader() if params_loader is not None
-                        else gpt.init(jax.random.PRNGKey(0), self._cfg))
-        self._max_seq = max_seq
+        loaded = params_loader() if params_loader is not None else None
+        if params_loader is not None and loaded is None:
+            raise ValueError("params_loader returned None (missing "
+                             "return?) — refusing to serve random "
+                             "weights in its place")
+        if isinstance(loaded, tuple):
+            self._cfg, self._params = loaded
+            if user_cfg_kwargs:
+                # user overrides still apply on top of the loaded config
+                import dataclasses
+
+                self._cfg = dataclasses.replace(self._cfg,
+                                                **user_cfg_kwargs)
+        elif loaded is not None:
+            self._params = loaded
+        else:
+            self._params = gpt.init(jax.random.PRNGKey(0), self._cfg)
         self._jax = jax
         self._step = jax.jit(functools.partial(gpt.decode_step,
                                                cfg=self._cfg))
