@@ -1,0 +1,28 @@
+"""Root conftest: degrade gracefully when pytest-xdist is absent.
+
+pytest.ini's `addopts = -n 2 --dist loadfile` assumes the xdist plugin;
+without this hook a plain `pytest` in an xdist-less environment dies on
+"unrecognized arguments" instead of running serially.  Initial conftests
+load before option parsing, so the flags can be stripped here.
+"""
+
+
+def pytest_load_initial_conftests(early_config, parser, args):
+    try:
+        import xdist  # noqa: F401
+        return
+    except ImportError:
+        pass
+    cleaned = []
+    skip_next = False
+    for a in args:
+        if skip_next:
+            skip_next = False
+            continue
+        if a in ("-n", "--dist"):
+            skip_next = True
+        elif a.startswith(("-n", "--dist=")):
+            pass  # joined forms: -n2, --dist=loadfile
+        else:
+            cleaned.append(a)
+    args[:] = cleaned

@@ -457,11 +457,33 @@ class IcebergDatasource(Datasource):
         snaps = {s["snapshot-id"]: s for s in meta.get("snapshots", [])}
         if snap_id is None or snap_id == -1 or not snaps:
             self._files: List[Dict[str, Any]] = []
+            self._field_ids: Dict[str, int] = {}
             return
         if snap_id not in snaps:
             raise ValueError(f"snapshot {snap_id} not in table "
                              f"({sorted(snaps)})")
+        self._field_ids = self._schema_field_ids(meta, snaps[snap_id])
         self._files = self._resolve_snapshot(snaps[snap_id])
+
+    @staticmethod
+    def _schema_field_ids(meta: Dict[str, Any],
+                          snap: Dict[str, Any]) -> Dict[str, int]:
+        """Column name -> Iceberg field-id for the snapshot's schema.
+
+        The Iceberg spec resolves columns by field-id, not name, so
+        renames survive: the name a reader asks for is looked up in the
+        TABLE schema, and the id is matched against each data file's
+        parquet field_id metadata (get_read_tasks)."""
+        schemas = meta.get("schemas") or []
+        sid = snap.get("schema-id", meta.get("current-schema-id"))
+        schema = next((s for s in schemas if s.get("schema-id") == sid),
+                      None) or (schemas[-1] if schemas
+                                else meta.get("schema") or {})
+        out: Dict[str, int] = {}
+        for f in schema.get("fields", []):
+            if "id" in f and "name" in f:
+                out[f["name"]] = int(f["id"])
+        return out
 
     def _remap(self, path: str) -> str:
         """Manifest paths are absolute URIs from the writer's vantage;
@@ -522,9 +544,32 @@ class IcebergDatasource(Datasource):
             return []
         files = sorted(self._files, key=lambda f: f["file_path"])
         columns = self._columns
+        field_ids = self._field_ids
         remap = self._remap
         n_tasks = max(1, min(parallelism, len(files)))
         groups = [files[i::n_tasks] for i in range(n_tasks)]
+
+        def resolve_parquet_columns(file_schema):
+            """Requested name -> physical column name in THIS file via
+            field-id (spec-correct under renames); falls back to the
+            name itself when neither side carries an id.  A column the
+            file predates (ADD COLUMN evolution) resolves to None — the
+            reader projects it as all-null, per the Iceberg spec."""
+            by_id: Dict[int, str] = {}
+            for field in file_schema:
+                fid = (field.metadata or {}).get(b"PARQUET:field_id")
+                if fid is not None:
+                    by_id[int(fid)] = field.name
+            pairs = []
+            for c in columns:
+                fid = field_ids.get(c)
+                if fid is not None and fid in by_id:
+                    pairs.append((c, by_id[fid]))
+                elif c in file_schema.names:
+                    pairs.append((c, c))
+                else:
+                    pairs.append((c, None))
+            return pairs
 
         def make(group):
             paths = [(remap(f["file_path"]),
@@ -541,11 +586,23 @@ class IcebergDatasource(Datasource):
                 for path, fmt in paths:
                     if fmt == "PARQUET":
                         with fileio.open_file(path, "rb") as f:
-                            t = pq.read_table(f, columns=columns)
+                            pf = pq.ParquetFile(f)
+                            if columns is not None:
+                                pairs = resolve_parquet_columns(
+                                    pf.schema_arrow)
+                                nrows = pf.metadata.num_rows
+                                t = pf.read(columns=[p for _, p in pairs
+                                                     if p is not None])
+                                t = pa.table(
+                                    {c: (t.column(p) if p is not None
+                                         else pa.nulls(nrows))
+                                     for c, p in pairs})
+                            else:
+                                t = pf.read()
                     elif fmt == "AVRO":
                         rows = _avro.read_container(_read_bytes(path))
                         t = pa.Table.from_pylist(rows)
-                        if columns:
+                        if columns is not None:
                             t = t.select(columns)
                     else:
                         raise NotImplementedError(

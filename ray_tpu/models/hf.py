@@ -52,6 +52,14 @@ def from_hf_gpt2(model: Any, *, dtype=jnp.bfloat16, param_dtype=jnp.float32,
                  "reorder_and_upcast_attn"):
         if getattr(hc, flag, False):
             raise NotImplementedError(f"{flag}=True is not supported")
+    ln_eps = float(getattr(hc, "layer_norm_epsilon", 1e-5))
+    if abs(ln_eps - 1e-5) > 1e-12:
+        # ops/layers.py layer_norm hardcodes eps=1e-5; converting such a
+        # checkpoint would silently produce divergent logits
+        raise NotImplementedError(
+            f"layer_norm_epsilon={ln_eps!r} (converter assumes GPT-2's "
+            f"default 1e-5, which is what this framework's layer_norm "
+            f"uses)")
     sd = {k: np.asarray(v.detach().cpu().numpy())
           for k, v in model.state_dict().items()}
     prefix = "transformer." if any(k.startswith("transformer.")

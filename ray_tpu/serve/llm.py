@@ -23,6 +23,7 @@ out of scope (bring your own; nothing here depends on one).
 from __future__ import annotations
 
 import functools
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 from ._deployment import deployment
@@ -76,8 +77,11 @@ class _LLMServerImpl:
         self._step = jax.jit(functools.partial(gpt.decode_step,
                                                cfg=self._cfg))
         # per-instance (NOT lru_cache on the method: a class-level cache
-        # keyed by self would pin replaced replicas' full weights)
-        self._gen_cache: Dict[tuple, Any] = {}
+        # keyed by self would pin replaced replicas' full weights), and
+        # bounded: a long-lived replica facing varied (max_new, temp,
+        # top_k) tuples must not grow compile-cache memory without limit
+        self._gen_cache: "OrderedDict[tuple, Any]" = OrderedDict()
+        self._gen_cache_cap = 8
 
     def _gen_fn(self, max_new: int, temperature: float,
                 top_k: Optional[int], max_seq: int):
@@ -87,6 +91,10 @@ class _LLMServerImpl:
             fn = self._gen_cache[key] = self._jax.jit(functools.partial(
                 self._gpt.generate, cfg=self._cfg, max_new_tokens=max_new,
                 temperature=temperature, top_k=top_k, max_seq=max_seq))
+            while len(self._gen_cache) > self._gen_cache_cap:
+                self._gen_cache.popitem(last=False)
+        else:
+            self._gen_cache.move_to_end(key)
         return fn
 
     def _check_capacity(self, plen: int, max_new: int):
@@ -132,6 +140,9 @@ class _LLMServerImpl:
         import numpy as np
 
         jax, gpt, cfg = self._jax, self._gpt, self._cfg
+        if not tokens:
+            raise ValueError("empty prompt: stream_tokens needs at "
+                             "least one prompt token")
         self._check_capacity(len(tokens), max_new_tokens)
         total = _bucket(len(tokens) + max_new_tokens)
         cache = gpt.init_cache(cfg, 1, total)
@@ -139,7 +150,10 @@ class _LLMServerImpl:
         for t in tokens:                      # prefill, one jit program
             logits, cache = self._step(self._params, cache,
                                        np.asarray([t], np.int32))
-        key = jax.random.PRNGKey(seed)
+        # same key schedule as the batched route (gpt.generate splits
+        # rng into max_new_tokens keys up front): seed parity holds for
+        # sampled decodes, not just greedy
+        keys = jax.random.split(jax.random.PRNGKey(seed), max_new_tokens)
         for i in range(max_new_tokens):
             lg = np.asarray(logits, np.float32)[0]
             if temperature == 0.0:
@@ -149,9 +163,8 @@ class _LLMServerImpl:
                 if top_k is not None:
                     kth = np.sort(lg)[-top_k]
                     lg = np.where(lg < kth, -1e30, lg)
-                key, sub = jax.random.split(key)
                 tok = int(jax.random.categorical(
-                    sub, self._jax.numpy.asarray(lg)))
+                    keys[i], self._jax.numpy.asarray(lg)))
             yield tok
             if i < max_new_tokens - 1:       # the last sample needs no
                 logits, cache = self._step(  # further forward pass

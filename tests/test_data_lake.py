@@ -395,6 +395,56 @@ def test_iceberg_not_a_table(tmp_path):
         rd.read_iceberg(str(tmp_path / "nope"))
 
 
+def test_iceberg_field_id_rename_and_add(tmp_path):
+    """Spec-correct column resolution: names resolve via field-id, so a
+    rename still reads files written under the old name, and a column
+    added after a file was written projects as nulls (not an error)."""
+    table = str(tmp_path / "ice2")
+    meta_dir, data_dir = table + "/metadata", table + "/data"
+    os.makedirs(meta_dir), os.makedirs(data_dir)
+    sch = pa.schema([
+        pa.field("old_name", pa.int64(),
+                 metadata={b"PARQUET:field_id": b"1"}),
+        pa.field("b", pa.int64(), metadata={b"PARQUET:field_id": b"2"})])
+    fpath = data_dir + "/f1.parquet"
+    pq.write_table(
+        pa.table({"old_name": [1, 2, 3], "b": [4, 5, 6]}).cast(sch), fpath)
+    man = _avro.write_container([{"status": 1, "snapshot_id": 1,
+        "data_file": {"content": 0, "file_path": fpath,
+                      "file_format": "PARQUET", "partition": {"p": 0},
+                      "record_count": 3,
+                      "file_size_in_bytes": os.path.getsize(fpath)}}],
+        schema=_MANIFEST_SCHEMA)
+    with open(meta_dir + "/m.avro", "wb") as f:
+        f.write(man)
+    ml = _avro.write_container([{
+        "manifest_path": meta_dir + "/m.avro", "manifest_length": len(man),
+        "partition_spec_id": 0, "content": 0, "added_snapshot_id": 1,
+        "partitions": [{"contains_null": False}]}],
+        schema=_MANIFEST_LIST_SCHEMA)
+    with open(meta_dir + "/ml.avro", "wb") as f:
+        f.write(ml)
+    meta = {"format-version": 2, "location": table,
+            "current-snapshot-id": 1, "current-schema-id": 5,
+            "schemas": [{"schema-id": 5, "fields": [
+                {"id": 1, "name": "new_name", "type": "long"},
+                {"id": 2, "name": "b", "type": "long"},
+                {"id": 3, "name": "later", "type": "long"}]}],
+            "snapshots": [{"snapshot-id": 1, "schema-id": 5,
+                           "manifest-list": meta_dir + "/ml.avro"}]}
+    with open(meta_dir + "/v1.metadata.json", "w") as f:
+        json.dump(meta, f)
+    with open(meta_dir + "/version-hint.text", "w") as f:
+        f.write("1")
+    ds = IcebergDatasource(table, columns=["new_name", "later", "b"])
+    tbl = pa.concat_tables(
+        blk for t in ds.get_read_tasks(2) for blk in t.read_fn())
+    assert tbl.column_names == ["new_name", "later", "b"]
+    assert tbl.column("new_name").to_pylist() == [1, 2, 3]
+    assert tbl.column("later").to_pylist() == [None, None, None]
+    assert tbl.column("b").to_pylist() == [4, 5, 6]
+
+
 # ---------------------------------------------------------------------------
 # avro named-type registry (what iceberg manifests rely on)
 # ---------------------------------------------------------------------------
