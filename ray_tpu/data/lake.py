@@ -436,6 +436,31 @@ def _iceberg_latest_metadata(table: str) -> str:
     return best
 
 
+def _iceberg_arrow_type(iceberg_type):
+    """Iceberg primitive type string -> arrow type, for typing the
+    all-null back-fill of ADD-COLUMN evolution (blocks from pre- and
+    post-evolution files must carry the same schema or concat fails).
+    Unknown/nested types fall back to arrow's null type."""
+    import pyarrow as pa
+
+    t = iceberg_type if isinstance(iceberg_type, str) else None
+    prim = {"boolean": pa.bool_(), "int": pa.int32(), "long": pa.int64(),
+            "float": pa.float32(), "double": pa.float64(),
+            "date": pa.date32(), "time": pa.time64("us"),
+            "timestamp": pa.timestamp("us"),
+            "timestamptz": pa.timestamp("us", tz="UTC"),
+            "string": pa.string(), "uuid": pa.binary(16),
+            "binary": pa.binary()}
+    if t in prim:
+        return prim[t]
+    if t and t.startswith("decimal("):
+        p, s = t[len("decimal("):-1].split(",")
+        return pa.decimal128(int(p), int(s))
+    if t and t.startswith("fixed("):
+        return pa.binary(int(t[len("fixed("):-1]))
+    return pa.null()
+
+
 class IcebergDatasource(Datasource):
     """Snapshot reads of an Iceberg v1/v2 table (parquet or avro data
     files), with `snapshot_id=` time travel.
@@ -467,22 +492,25 @@ class IcebergDatasource(Datasource):
 
     @staticmethod
     def _schema_field_ids(meta: Dict[str, Any],
-                          snap: Dict[str, Any]) -> Dict[str, int]:
-        """Column name -> Iceberg field-id for the snapshot's schema.
+                          snap: Dict[str, Any]) -> Dict[str, tuple]:
+        """Column name -> (field-id, iceberg type) for the snapshot's
+        schema.
 
         The Iceberg spec resolves columns by field-id, not name, so
         renames survive: the name a reader asks for is looked up in the
         TABLE schema, and the id is matched against each data file's
-        parquet field_id metadata (get_read_tasks)."""
+        parquet field_id metadata (get_read_tasks).  The type rides
+        along so ADD-COLUMN back-fill nulls are typed consistently with
+        blocks from post-evolution files."""
         schemas = meta.get("schemas") or []
         sid = snap.get("schema-id", meta.get("current-schema-id"))
         schema = next((s for s in schemas if s.get("schema-id") == sid),
                       None) or (schemas[-1] if schemas
                                 else meta.get("schema") or {})
-        out: Dict[str, int] = {}
+        out: Dict[str, tuple] = {}
         for f in schema.get("fields", []):
             if "id" in f and "name" in f:
-                out[f["name"]] = int(f["id"])
+                out[f["name"]] = (int(f["id"]), f.get("type"))
         return out
 
     def _remap(self, path: str) -> str:
@@ -552,9 +580,11 @@ class IcebergDatasource(Datasource):
         def resolve_parquet_columns(file_schema):
             """Requested name -> physical column name in THIS file via
             field-id (spec-correct under renames); falls back to the
-            name itself when neither side carries an id.  A column the
-            file predates (ADD COLUMN evolution) resolves to None — the
-            reader projects it as all-null, per the Iceberg spec."""
+            name itself when neither side carries an id.  A TABLE-schema
+            column the file predates (ADD COLUMN evolution) resolves to
+            None — projected as typed nulls, per the Iceberg spec; a
+            name in neither the table schema nor the file is an error
+            (typos must not come back as null columns)."""
             by_id: Dict[int, str] = {}
             for field in file_schema:
                 fid = (field.metadata or {}).get(b"PARQUET:field_id")
@@ -562,13 +592,18 @@ class IcebergDatasource(Datasource):
                     by_id[int(fid)] = field.name
             pairs = []
             for c in columns:
-                fid = field_ids.get(c)
+                fid, _ = field_ids.get(c, (None, None))
                 if fid is not None and fid in by_id:
                     pairs.append((c, by_id[fid]))
                 elif c in file_schema.names:
                     pairs.append((c, c))
-                else:
+                elif c in field_ids:
                     pairs.append((c, None))
+                else:
+                    raise KeyError(
+                        f"column {c!r} is in neither the table schema "
+                        f"nor the data file (schema columns: "
+                        f"{sorted(field_ids)})")
             return pairs
 
         def make(group):
@@ -587,7 +622,14 @@ class IcebergDatasource(Datasource):
                     if fmt == "PARQUET":
                         with fileio.open_file(path, "rb") as f:
                             pf = pq.ParquetFile(f)
-                            if columns is not None:
+                            if columns is None:
+                                t = pf.read()
+                            elif not columns:
+                                # zero-column projection keeps num_rows
+                                # (count()-style reads); a pa.table({})
+                                # rebuild would report 0 rows
+                                t = pf.read(columns=[])
+                            else:
                                 pairs = resolve_parquet_columns(
                                     pf.schema_arrow)
                                 nrows = pf.metadata.num_rows
@@ -595,10 +637,11 @@ class IcebergDatasource(Datasource):
                                                      if p is not None])
                                 t = pa.table(
                                     {c: (t.column(p) if p is not None
-                                         else pa.nulls(nrows))
+                                         else pa.nulls(
+                                             nrows,
+                                             _iceberg_arrow_type(
+                                                 field_ids[c][1])))
                                      for c, p in pairs})
-                            else:
-                                t = pf.read()
                     elif fmt == "AVRO":
                         rows = _avro.read_container(_read_bytes(path))
                         t = pa.Table.from_pylist(rows)

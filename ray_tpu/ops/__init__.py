@@ -1,5 +1,5 @@
 from .attention import (attention, blockwise_attention, flash_attention,
-                        mha_reference)
+                        flash_attention_with_lse, mha_reference)
 from .layers import (apply_rope, fused_softmax_cross_entropy, gelu_mlp,
                      layer_norm, rms_norm, rope_table,
                      softmax_cross_entropy, swiglu)
@@ -7,7 +7,8 @@ from .ring_attention import ring_attention, ring_attention_sharded
 from .ulysses import ulysses_attention, ulysses_attention_sharded
 
 __all__ = [
-    "attention", "flash_attention", "blockwise_attention", "mha_reference",
+    "attention", "flash_attention", "flash_attention_with_lse",
+    "blockwise_attention", "mha_reference",
     "ring_attention", "ring_attention_sharded",
     "ulysses_attention", "ulysses_attention_sharded",
     "rms_norm", "layer_norm", "rope_table", "apply_rope", "swiglu",

@@ -442,7 +442,21 @@ def test_iceberg_field_id_rename_and_add(tmp_path):
     assert tbl.column_names == ["new_name", "later", "b"]
     assert tbl.column("new_name").to_pylist() == [1, 2, 3]
     assert tbl.column("later").to_pylist() == [None, None, None]
+    # back-fill nulls carry the TABLE schema's type so these blocks
+    # concat cleanly with blocks from post-ADD-COLUMN files
+    assert tbl.schema.field("later").type == pa.int64()
     assert tbl.column("b").to_pylist() == [4, 5, 6]
+    # a name in neither the table schema nor the file is a loud error,
+    # not a silently-null column
+    bogus = IcebergDatasource(table, columns=["new_nam"])
+    with pytest.raises(KeyError, match="new_nam"):
+        [blk for t in bogus.get_read_tasks(1) for blk in t.read_fn()]
+    # columns=[] keeps row counts (count()-style reads); asserted per
+    # block — pa.concat_tables itself zeroes 0-column tables' num_rows
+    empty = IcebergDatasource(table, columns=[])
+    blocks0 = [blk for t in empty.get_read_tasks(1) for blk in t.read_fn()]
+    assert sum(b.num_rows for b in blocks0) == 3
+    assert all(b.num_columns == 0 for b in blocks0)
 
 
 # ---------------------------------------------------------------------------
