@@ -427,6 +427,66 @@ def _run_resnet_subprocess(timeout_s: float, cpu: bool) -> dict:
          "BENCH_RESNET_STEPS": "2", "BENCH_RESNET_ARCH": "resnet18"})
 
 
+def _run_decode_subprocess(timeout_s: float, cpu: bool) -> dict:
+    return _run_model_subprocess(
+        "--decode-only", timeout_s, cpu,
+        {"BENCH_DECODE_BATCH": "2", "BENCH_DECODE_NEW": "8",
+         "BENCH_DECODE_PROMPT": "4", "BENCH_DECODE_ARCH": "nano"})
+
+
+def bench_decode():
+    """KV-cache decode steps/s (the serving hot loop): gpt2-small B=8,
+    32-token prefill + 128 greedy decode inside one jit program, cache
+    bucketed to 160 — the same protocol as BENCH_TABLE.gpt2_small_decode
+    so rounds compare.  Sync via host transfer (tunnel: block_until_ready
+    returns early)."""
+    import functools
+    import time
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt
+
+    arch = os.environ.get("BENCH_DECODE_ARCH", "gpt2_small")
+    B = int(os.environ.get("BENCH_DECODE_BATCH", "8"))
+    n_prompt = int(os.environ.get("BENCH_DECODE_PROMPT", "32"))
+    n_new = int(os.environ.get("BENCH_DECODE_NEW", "128"))
+    cfg = getattr(gpt.GPTConfig, arch)(vocab_size=50304, max_seq=512) \
+        if arch != "nano" else gpt.GPTConfig.nano()
+    params = gpt.init(jax.random.PRNGKey(0), cfg)
+    # cache length exactly prompt+new (160 at the defaults) — round 4's
+    # protocol, kept so decode rows compare across rounds
+    total = n_prompt + n_new
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (B, n_prompt)),
+        jnp.int32)
+    fn = jax.jit(functools.partial(gpt.generate, cfg=cfg,
+                                   max_new_tokens=n_new, temperature=0.0,
+                                   max_seq=total))
+    np.asarray(fn(params, prompt=prompt))     # compile + settle
+    iters = int(os.environ.get("BENCH_DECODE_ITERS", "5"))
+    t0 = time.time()
+    for _ in range(iters):
+        out = fn(params, prompt=prompt)
+    np.asarray(out)
+    dt = (time.time() - t0) / iters
+    steps = n_prompt + n_new
+    return {
+        "decode_arch": arch, "decode_batch": B,
+        "decode_platform": jax.default_backend(),
+        "decode_steps_per_s": round(steps / dt, 1),
+        "decode_tokens_per_s_batched": round(B * n_new / dt, 1),
+        "decode_ms_per_generation": round(dt * 1e3, 2),
+    }
+
+
+def _decode_only_main():
+    print(json.dumps(bench_decode()), flush=True)
+
+
 def _compiled_flops(compiled) -> float | None:
     """FLOPs/step from XLA's own cost analysis (exact for the compiled
     graph, convs included — no hand-derived conv arithmetic)."""
@@ -615,6 +675,14 @@ def _extras_main():
         else:
             print(json.dumps(
                 {"resnet_bench_error": rrow.get("error", "unknown")}),
+                flush=True)
+        drow = _run_decode_subprocess(timeout_s=300.0, cpu=False)
+        if "decode_steps_per_s" in drow:
+            print(json.dumps({**drow, "decode_row_source": "tpu_live"}),
+                  flush=True)
+        else:
+            print(json.dumps(
+                {"decode_bench_error": drow.get("error", "unknown")}),
                 flush=True)
         return landed
 
@@ -1075,6 +1143,8 @@ if __name__ == "__main__":
         _gpt_only_main()
     elif "--resnet-only" in sys.argv:
         _resnet_only_main()
+    elif "--decode-only" in sys.argv:
+        _decode_only_main()
     elif "--extras-only" in sys.argv:
         _extras_main()
     elif "--table" in sys.argv:

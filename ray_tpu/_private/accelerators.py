@@ -93,6 +93,13 @@ def num_tpu_chips() -> int:
     chips = glob.glob("/dev/accel*")
     if chips:
         return len(chips)
+    # axon remote-TPU tunnel (dev boxes): one chip endpoint per pool IP.
+    # Without this the tunnel chip is invisible to the scheduler, so no
+    # actor can ever be granted the TPU resource that node.py uses to
+    # gate device access.
+    pool = os.environ.get("PALLAS_AXON_POOL_IPS")
+    if pool:
+        return len([ip for ip in pool.split(",") if ip.strip()])
     # vfio-bound chips (reference: tpu.py get_current_node_num_accelerators)
     try:
         vfio = [e for e in os.listdir("/dev/vfio") if e.isdigit()]

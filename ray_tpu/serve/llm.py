@@ -11,10 +11,11 @@ thin batching + streaming shell around compiled code:
     prompt lengths match exactly (token-id prompts are not padded —
     left-pads would enter the causal window); the KV-cache length is
     bucketed to multiples of 128 so max_new variations reuse compiles;
-  * streaming requests run a Python decode loop over the jitted
-    decode_step (one compile per cache bucket) and yield tokens as they
-    are sampled — through Serve's generator streaming this is SSE/
-    chunked-transfer token streaming end to end.
+  * streaming requests prefill the whole prompt as ONE jit program,
+    then loop a fused on-device sample+decode step (one compile per
+    cache bucket; only the 4-byte token id crosses to host per step)
+    and yield tokens as they are sampled — through Serve's generator
+    streaming this is SSE/chunked-transfer token streaming end to end.
 
 Prompts and completions are token-id lists: tokenizers are deliberately
 out of scope (bring your own; nothing here depends on one).
@@ -74,8 +75,6 @@ class _LLMServerImpl:
         else:
             self._params = gpt.init(jax.random.PRNGKey(0), self._cfg)
         self._jax = jax
-        self._step = jax.jit(functools.partial(gpt.decode_step,
-                                               cfg=self._cfg))
         # per-instance (NOT lru_cache on the method: a class-level cache
         # keyed by self would pin replaced replicas' full weights), and
         # bounded: a long-lived replica facing varied (max_new, temp,
@@ -83,19 +82,26 @@ class _LLMServerImpl:
         self._gen_cache: "OrderedDict[tuple, Any]" = OrderedDict()
         self._gen_cache_cap = 8
 
-    def _gen_fn(self, max_new: int, temperature: float,
-                top_k: Optional[int], max_seq: int):
-        key = (max_new, temperature, top_k, max_seq)
+    def _cached(self, key, build):
+        """LRU-bounded compiled-program cache (every jitted variant a
+        replica ever builds goes through here, so the cap holds no
+        matter which routes a client exercises)."""
         fn = self._gen_cache.get(key)
         if fn is None:
-            fn = self._gen_cache[key] = self._jax.jit(functools.partial(
-                self._gpt.generate, cfg=self._cfg, max_new_tokens=max_new,
-                temperature=temperature, top_k=top_k, max_seq=max_seq))
+            fn = self._gen_cache[key] = build()
             while len(self._gen_cache) > self._gen_cache_cap:
                 self._gen_cache.popitem(last=False)
         else:
             self._gen_cache.move_to_end(key)
         return fn
+
+    def _gen_fn(self, max_new: int, temperature: float,
+                top_k: Optional[int], max_seq: int):
+        return self._cached(
+            (max_new, temperature, top_k, max_seq),
+            lambda: self._jax.jit(functools.partial(
+                self._gpt.generate, cfg=self._cfg, max_new_tokens=max_new,
+                temperature=temperature, top_k=top_k, max_seq=max_seq)))
 
     def _check_capacity(self, plen: int, max_new: int):
         if self._cfg.pos == "learned" and plen + max_new > self._cfg.max_seq:
@@ -131,6 +137,71 @@ class _LLMServerImpl:
                           "batch_size": len(idxs)}
         return out
 
+    def _prefill_fn(self, total: int):
+        """One jit program for the whole prompt (a per-token Python
+        prefill loop costs one dispatch + host sync per position —
+        measured 75 ms/step through a remote-TPU tunnel vs one program
+        for the lot).  Hidden-only through the stack; the D x V vocab
+        projection (the fattest matmul in a small-model decode step)
+        runs once, on the final position."""
+        jax, gpt, cfg = self._jax, self._gpt, self._cfg
+
+        def build():
+            def prefill(params, cache, toks):        # toks [S] int32
+                def body(c, t):
+                    x, c = gpt._decode_hidden(params, c, t[None], cfg)
+                    return c, x
+
+                cache, xs = jax.lax.scan(body, cache, toks)
+                logits = jax.numpy.einsum(
+                    "bd,dv->bv", xs[-1].astype(cfg.dtype),
+                    gpt._unembed_table(params, cfg))
+                return logits, cache
+
+            return jax.jit(prefill)
+
+        return self._cached(("prefill", total), build)
+
+    def _sample_body(self, logits, rkey, temperature, top_k):
+        jax, jnp = self._jax, self._jax.numpy
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lg = logits / temperature
+        if top_k is not None:
+            kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+            lg = jnp.where(lg < kth, -1e30, lg)
+        return jax.random.categorical(rkey, lg).astype(jnp.int32)
+
+    def _stream_step_fn(self, temperature: float, top_k: Optional[int],
+                        total: int):
+        """Fused sample+decode step: returns (token [1], next logits,
+        cache).  Sampling runs ON DEVICE so the stream loop transfers a
+        4-byte token id per step, not [1, V] logits; the sample recipe
+        mirrors gpt.generate's exactly (same key schedule => identical
+        completions for the same seed)."""
+        jax, gpt, cfg = self._jax, self._gpt, self._cfg
+
+        def build():
+            def step(params, cache, logits, rkey):
+                tok = self._sample_body(logits, rkey, temperature, top_k)
+                new_logits, cache = gpt.decode_step(params, cache, tok,
+                                                    cfg)
+                return tok, new_logits, cache
+
+            return jax.jit(step)
+
+        return self._cached(("stream_step", temperature, top_k, total),
+                            build)
+
+    def _sample_fn(self, temperature: float, top_k: Optional[int]):
+        """Sample-only program for the LAST token of a stream — it
+        needs no further forward pass or cache write."""
+        return self._cached(
+            ("sample", temperature, top_k),
+            lambda: self._jax.jit(functools.partial(
+                self._sample_body, temperature=temperature,
+                top_k=top_k)))
+
     def stream_tokens(self, tokens: List[int], max_new_tokens: int = 16,
                       temperature: float = 0.0, seed: int = 0,
                       top_k: Optional[int] = None):
@@ -146,29 +217,21 @@ class _LLMServerImpl:
         self._check_capacity(len(tokens), max_new_tokens)
         total = _bucket(len(tokens) + max_new_tokens)
         cache = gpt.init_cache(cfg, 1, total)
-        logits = None
-        for t in tokens:                      # prefill, one jit program
-            logits, cache = self._step(self._params, cache,
-                                       np.asarray([t], np.int32))
+        logits, cache = self._prefill_fn(total)(
+            self._params, cache, np.asarray(tokens, np.int32))
         # same key schedule as the batched route (gpt.generate splits
         # rng into max_new_tokens keys up front): seed parity holds for
         # sampled decodes, not just greedy
         keys = jax.random.split(jax.random.PRNGKey(seed), max_new_tokens)
-        for i in range(max_new_tokens):
-            lg = np.asarray(logits, np.float32)[0]
-            if temperature == 0.0:
-                tok = int(lg.argmax(-1))
-            else:
-                lg = lg / temperature
-                if top_k is not None:
-                    kth = np.sort(lg)[-top_k]
-                    lg = np.where(lg < kth, -1e30, lg)
-                tok = int(jax.random.categorical(
-                    keys[i], self._jax.numpy.asarray(lg)))
-            yield tok
-            if i < max_new_tokens - 1:       # the last sample needs no
-                logits, cache = self._step(  # further forward pass
-                    self._params, cache, np.asarray([tok], np.int32))
+        step = self._stream_step_fn(temperature, top_k, total)
+        for i in range(max_new_tokens - 1):
+            tok, logits, cache = step(self._params, cache, logits,
+                                      keys[i])
+            yield int(tok[0])
+        if max_new_tokens > 0:   # the last sample needs no further
+            tok = self._sample_fn(temperature, top_k)(  # forward pass
+                logits, keys[max_new_tokens - 1])
+            yield int(tok[0])
 
     async def __call__(self, request):
         # handle calls pass the body dict directly; HTTP passes a Request

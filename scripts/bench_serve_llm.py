@@ -1,0 +1,92 @@
+"""Serve-path LLM latency/throughput rows (BENCH_TABLE.serve_llm).
+
+Measures through the real deployment stack — controller, router,
+replica actor, streaming handle — not the bare model:
+
+  * first_token_ms: stream request -> first sampled token (includes
+    prefill; jit caches are warmed by a throwaway request first, so this
+    is steady-state serving latency, not compile time)
+  * stream_tokens_per_s: steady-state single-stream decode rate
+  * batched_tokens_per_s: the micro-batched JSON route at B=8 (one
+    compiled generate() per group; serve.batch groups identical shapes)
+
+Run on the TPU box: python scripts/bench_serve_llm.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import LLMServer
+
+    ray_tpu.init(num_cpus=4)
+
+    prompt = np.random.RandomState(0).randint(
+        0, 50000, (32,)).tolist()
+    # the replica must hold the TPU resource: device access is granted
+    # per-worker by the raylet (node.py), exactly like TPU_VISIBLE_CHIPS
+    h = serve.run(
+        LLMServer(ray_actor_options={"resources": {"TPU": 1}}).bind(
+            preset="gpt2_small", cfg_kwargs={"vocab_size": 50304}),
+        name="bench_llm", route_prefix=None)
+    try:
+        n_new = 64
+        # warm both routes' compile caches with the SAME request shapes
+        # AND batch size as the measurement (the jitted generate traces
+        # on the stacked [B, S] prompt shape, so B=1 warming would leave
+        # the B=8 group cold)
+        warm = [h.remote({"tokens": prompt, "max_new_tokens": n_new})
+                for _ in range(8)]
+        [f.result(timeout_s=600) for f in warm]
+        for _ in h.options(stream=True).stream_tokens.remote(
+                prompt, n_new):
+            pass
+        t0 = time.time()
+        first = None
+        count = 0
+        for _ in h.options(stream=True).stream_tokens.remote(
+                prompt, n_new):
+            count += 1
+            if first is None:
+                first = time.time() - t0
+        total = time.time() - t0
+        assert count == n_new
+        steady = (n_new - 1) / (total - first) if total > first else 0.0
+
+        B, bn = 8, 64
+        futs = [h.remote({"tokens": prompt, "max_new_tokens": bn,
+                          "seed": 0})
+                for _ in range(B)]
+        t0 = time.time()
+        outs = [f.result(timeout_s=600) for f in futs]
+        bt = time.time() - t0
+        # the batcher may split across compiled groups; report what ran
+        bsizes = sorted(o["batch_size"] for o in outs)
+        row = {
+            "first_token_ms": round(first * 1e3, 1),
+            "stream_tokens_per_s": round(steady, 1),
+            "batched_tokens_per_s": round(B * bn / bt, 1),
+            "batched_group_sizes": bsizes,
+            "protocol": f"gpt2_small random weights, {len(prompt)}-token "
+                        f"prompt, {n_new} new (stream) / {bn} new x {B} "
+                        f"reqs (batched), greedy",
+        }
+        print(json.dumps(row), flush=True)
+        return row
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

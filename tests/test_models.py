@@ -113,6 +113,21 @@ def test_gpt_decode_matches_full_forward(cfg):
                        atol=2e-4)
 
 
+def test_gpt_generate_fast_path_matches_generic(monkeypatch):
+    """The decode-view fast path (fused QKV, unrolled layers) and the
+    generic shared-recipe path must sample IDENTICAL tokens — same key
+    schedule, same logits (f32 here, so argmax/categorical agree)."""
+    params = gpt.init(jax.random.PRNGKey(0), CFG_GPT2)
+    prompt = jnp.asarray(TOKENS[:3, :8])
+    kwargs = dict(temperature=0.8, top_k=20, rng=jax.random.PRNGKey(7),
+                  max_seq=32)
+    assert gpt._decode_fast_eligible(CFG_GPT2)
+    fast = gpt.generate(params, CFG_GPT2, prompt, 6, **kwargs)
+    monkeypatch.setattr(gpt, "_decode_fast_eligible", lambda c: False)
+    generic = gpt.generate(params, CFG_GPT2, prompt, 6, **kwargs)
+    assert np.array_equal(np.asarray(fast), np.asarray(generic))
+
+
 def test_gpt_generate_sampling_reproducible():
     params = gpt.init(jax.random.PRNGKey(0), CFG)
     prompt = jnp.asarray(TOKENS[:2, :4])
