@@ -22,6 +22,7 @@ counter, keys are f"{group}/{op_idx}/{rank}"; readers poll-and-delete.
 
 from __future__ import annotations
 
+import functools
 import pickle
 import time
 from typing import Any, Dict, List, Optional
@@ -62,6 +63,7 @@ class GroupHandle:
         self.rank = rank
         self.backend = backend
         self.op_idx = 0
+        self._xla_jit_cache: Dict[tuple, Any] = {}
 
     def _key(self, op: str, rank: int) -> str:
         return f"{self.name}/{self.op_idx}/{op}/{rank}"
@@ -117,12 +119,100 @@ def barrier(group_name: str = "default"):
         _kv_get(g._key("bar", r))
 
 
+def _xla_stacked(g: GroupHandle, x: np.ndarray):
+    """Global [world, *shape] jax.Array whose rank-r shard is rank r's
+    tensor, over a mesh of one device per member process.  Requires the
+    members to be processes of ONE jax.distributed runtime (the Train
+    spmd backend sets that up); the compiled ops below are then real
+    XLA collectives over that runtime — the NCCL-group analog, not the
+    KV mailbox."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    if jax.process_count() < g.world_size:
+        raise RuntimeError(
+            f"xla collective group needs a jax.distributed runtime "
+            f"spanning all {g.world_size} members; this process sees "
+            f"only {jax.process_count()} process(es) — initialize "
+            f"jax.distributed first (Train's JaxConfig(mode='spmd') "
+            f"does this), or use backend='kv'")
+    if g.rank != jax.process_index():
+        # the mesh maps member r to process r's first device; data for
+        # another process's device is not addressable from here
+        raise RuntimeError(
+            f"xla collective rank ({g.rank}) must equal "
+            f"jax.process_index() ({jax.process_index()}): the compiled "
+            f"backend identifies members with jax.distributed "
+            f"processes; renumbered or subset groups need backend='kv'")
+    first = {}
+    for d in jax.devices():
+        first.setdefault(d.process_index, d)
+    devs = [first[i] for i in range(g.world_size)]
+    mesh = Mesh(np.array(devs), ("cc",))
+    arr = jax.make_array_from_single_device_arrays(
+        (g.world_size,) + x.shape, NamedSharding(mesh, P("cc")),
+        [jax.device_put(x[None], devs[g.rank])])
+    return arr, mesh
+
+
+def _xla_run(g: GroupHandle, x: np.ndarray, op_key: str, fn):
+    """jit fn over the stacked global array with a replicated output,
+    fetched back to host — every member executes the same program (SPMD:
+    all members must call in the same order, like NCCL).  The jitted
+    program is cached per (op, shape, dtype) on the handle; without
+    that, per-call lambdas would re-trace+compile every invocation and
+    the 'compiled' backend would lose to the KV mailbox it replaces."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    arr, mesh = _xla_stacked(g, x)
+    cache_key = (op_key, x.shape, str(x.dtype))
+    jitted = g._xla_jit_cache.get(cache_key)
+    if jitted is None:
+        jitted = g._xla_jit_cache[cache_key] = jax.jit(
+            fn, out_shardings=NamedSharding(mesh, P()))
+    return np.asarray(jitted(arr))
+
+
+def _xla_sum(a):
+    return a.sum(0)
+
+
+def _xla_mean(a):
+    return a.mean(0)
+
+
+def _xla_max(a):
+    return a.max(0)
+
+
+def _xla_min(a):
+    return a.min(0)
+
+
+def _xla_identity(a):
+    return a
+
+
+def _xla_take_row(a, src: int):
+    return a[src]
+
+
+_XLA_REDUCE = {"sum": _xla_sum, "mean": _xla_mean, "max": _xla_max,
+               "min": _xla_min}
+
+
 def allreduce(tensor, group_name: str = "default", op: str = "sum"):
-    """CPU allreduce through the KV plane; returns the reduced array
-    (reference: collective.py:258).  Rank 0 reduces, others fetch."""
+    """Allreduce; returns the reduced array (reference: collective.py:258).
+    kv backend: rank 0 reduces through the KV plane, others fetch.
+    xla backend: one compiled XLA all-reduce over the members' devices."""
     g = get_group_handle(group_name)
     g.op_idx += 1
     x = _as_numpy(tensor)
+    if g.backend == "xla":
+        if op not in _XLA_REDUCE:
+            raise ValueError(f"unknown op {op}")
+        return _xla_run(g, x, f"allreduce-{op}", _XLA_REDUCE[op])
     _kv_put(g._key("ar", g.rank), pickle.dumps(x, protocol=5))
     if g.rank == 0:
         acc = x.copy()
@@ -148,6 +238,9 @@ def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
     (reference: collective.py:423)."""
     g = get_group_handle(group_name)
     g.op_idx += 1
+    if g.backend == "xla":
+        stacked = _xla_run(g, _as_numpy(tensor), "allgather", _xla_identity)
+        return [stacked[r] for r in range(g.world_size)]
     _kv_put(g._key("ag", g.rank), pickle.dumps(_as_numpy(tensor), protocol=5))
     return [pickle.loads(_kv_get(g._key("ag", r))) for r in range(g.world_size)]
 
@@ -165,6 +258,9 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     """Root's tensor to everyone (reference: collective.py:373)."""
     g = get_group_handle(group_name)
     g.op_idx += 1
+    if g.backend == "xla":
+        return _xla_run(g, _as_numpy(tensor), f"broadcast-{src_rank}",
+                        functools.partial(_xla_take_row, src=src_rank))
     if g.rank == src_rank:
         _kv_put(g._key("bc", src_rank), pickle.dumps(_as_numpy(tensor),
                                                      protocol=5))
@@ -175,6 +271,11 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
 def send(tensor, dst_rank: int, group_name: str = "default"):
     """P2P send via KV mailbox (reference: collective.py:531)."""
     g = get_group_handle(group_name)
+    if g.backend == "xla":
+        raise NotImplementedError(
+            "send/recv are not SPMD ops (only two members participate); "
+            "use backend='kv' for p2p, or ppermute inside a shard_map "
+            "program for the compiled path")
     g.op_idx += 1
     _kv_put(g._key(f"p2p-{g.rank}-{dst_rank}", g.rank),
             pickle.dumps(_as_numpy(tensor), protocol=5))
@@ -184,5 +285,10 @@ def recv(src_rank: int, group_name: str = "default"):
     """P2P recv (reference: collective.py:594).  The sender and receiver
     must issue matching op sequences (same as NCCL send/recv pairing)."""
     g = get_group_handle(group_name)
+    if g.backend == "xla":
+        raise NotImplementedError(
+            "send/recv are not SPMD ops (only two members participate); "
+            "use backend='kv' for p2p, or ppermute inside a shard_map "
+            "program for the compiled path")
     g.op_idx += 1
     return pickle.loads(_kv_get(g._key(f"p2p-{src_rank}-{g.rank}", src_rank)))

@@ -542,6 +542,21 @@ def _decode_hidden_fast(view, cfg: GPTConfig, kcache, vcache, pos, toks):
     return x.astype(cfg.dtype), kcache, vcache
 
 
+def sample_logits(logits, key, temperature: float = 0.0,
+                  top_k: Optional[int] = None, dtype=jnp.int32):
+    """The ONE sampling recipe (greedy argmax at temperature 0, else
+    temperature-scaled, optionally top-k-truncated categorical) — shared
+    by generate() and the serving stream step so seed parity between
+    routes can't drift."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(dtype)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(key, logits).astype(dtype)
+
+
 def generate(params, cfg: GPTConfig, prompt, max_new_tokens: int, *,
              temperature: float = 0.0, top_k: Optional[int] = None,
              rng=None, max_seq: Optional[int] = None):
@@ -552,8 +567,9 @@ def generate(params, cfg: GPTConfig, prompt, max_new_tokens: int, *,
     and decode loops are both lax.scans of decode_step, so the entire
     call jits to one program with static shapes.  GPT-2-family configs
     take a decode-view fast path (fused QKV, compute-dtype weights,
-    unrolled layers) measured ~2x the generic path on v5e; sampling
-    semantics are identical on both paths.
+    unrolled layers) measured ~2x the generic path on v5e; both paths
+    share sample_logits and the key schedule (token-exact in f32; at
+    bf16, fusion-order rounding can flip near-tie logits).
     """
     B, S = prompt.shape
     total = S + max_new_tokens
@@ -568,13 +584,8 @@ def generate(params, cfg: GPTConfig, prompt, max_new_tokens: int, *,
         rng = jax.random.PRNGKey(0)
 
     def sample(logits, key):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-        logits = logits / temperature
-        if top_k is not None:
-            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-            logits = jnp.where(logits < kth, -1e30, logits)
-        return jax.random.categorical(key, logits).astype(prompt.dtype)
+        return sample_logits(logits, key, temperature, top_k,
+                             dtype=prompt.dtype)
 
     keys = jax.random.split(rng, max_new_tokens)
 

@@ -163,14 +163,9 @@ class _LLMServerImpl:
         return self._cached(("prefill", total), build)
 
     def _sample_body(self, logits, rkey, temperature, top_k):
-        jax, jnp = self._jax, self._jax.numpy
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        lg = logits / temperature
-        if top_k is not None:
-            kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
-            lg = jnp.where(lg < kth, -1e30, lg)
-        return jax.random.categorical(rkey, lg).astype(jnp.int32)
+        # gpt.sample_logits is the one sampling recipe — sharing it is
+        # what makes stream/batched seed parity structural, not luck
+        return self._gpt.sample_logits(logits, rkey, temperature, top_k)
 
     def _stream_step_fn(self, temperature: float, top_k: Optional[int],
                         total: int):
@@ -207,7 +202,9 @@ class _LLMServerImpl:
                       top_k: Optional[int] = None):
         """Yield one sampled token id at a time (generator => Serve
         streams it as SSE/chunked over HTTP, itemwise over handles).
-        Sampling semantics match the batched route exactly."""
+        Sampling shares gpt.sample_logits and the batched route's key
+        schedule (token-exact in f32; at bf16, fusion-order rounding
+        can flip near-tie logits)."""
         import numpy as np
 
         jax, gpt, cfg = self._jax, self._gpt, self._cfg

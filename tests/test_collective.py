@@ -68,3 +68,68 @@ def test_kv_collectives(ray_cluster):
 
     sr = ray_tpu.get([w.do_sendrecv.remote("g1") for w in workers], timeout=120)
     assert sr[0] is None and np.allclose(sr[1], 7.0)
+
+
+@ray_tpu.remote
+class XlaCollectiveWorker:
+    """Member of a jax.distributed runtime: the xla backend's compiled
+    collectives run as real XLA all-reduces over the gang's devices
+    (the NCCL-group analog), not through the KV mailbox."""
+
+    def __init__(self, rank, world, coordinator):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=world, process_id=rank)
+        self.rank = rank
+        self.world = world
+
+    def setup(self, group):
+        from ray_tpu import collective as col
+
+        col.init_collective_group(self.world, self.rank, backend="xla",
+                                  group_name=group)
+        return True
+
+    def do_all(self, group):
+        import numpy as np
+
+        from ray_tpu import collective as col
+
+        red = col.allreduce(np.full(4, float(self.rank + 1)), group)
+        mx = col.allreduce(np.array([float(self.rank)]), group, op="max")
+        gath = col.allgather(np.array([self.rank]), group)
+        bc = col.broadcast(np.array([42.0]) if self.rank == 1
+                           else np.zeros(1), src_rank=1, group_name=group)
+        try:
+            col.send(np.zeros(1), dst_rank=0, group_name=group)
+            p2p_raises = False
+        except NotImplementedError:
+            p2p_raises = True
+        return {"sum": red.tolist(), "max": mx.tolist(),
+                "gather": [int(a[0]) for a in gath], "bcast": bc.tolist(),
+                "p2p_raises": p2p_raises}
+
+
+def test_xla_collectives_cross_process(ray_cluster):
+    """Mirror of test_kv_collectives on the COMPILED backend: two actor
+    processes form a jax.distributed gang and every op below executes
+    as one XLA program spanning both (reference model:
+    util/collective/tests/distributed_cpu_tests, NCCL group there)."""
+    from ray_tpu._private.protocol import free_port
+
+    world = 2
+    coord = f"127.0.0.1:{free_port()}"
+    workers = [XlaCollectiveWorker.remote(r, world, coord)
+               for r in range(world)]
+    assert all(ray_tpu.get([w.setup.remote("gx") for w in workers],
+                           timeout=180))
+    outs = ray_tpu.get([w.do_all.remote("gx") for w in workers],
+                       timeout=180)
+    for o in outs:
+        assert o["sum"] == [3.0, 3.0, 3.0, 3.0]
+        assert o["max"] == [1.0]
+        assert o["gather"] == [0, 1]
+        assert o["bcast"] == [42.0]
+        assert o["p2p_raises"]

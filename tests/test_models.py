@@ -113,19 +113,30 @@ def test_gpt_decode_matches_full_forward(cfg):
                        atol=2e-4)
 
 
-def test_gpt_generate_fast_path_matches_generic(monkeypatch):
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+def test_gpt_generate_fast_path_matches_generic(monkeypatch, dtype):
     """The decode-view fast path (fused QKV, unrolled layers) and the
-    generic shared-recipe path must sample IDENTICAL tokens — same key
-    schedule, same logits (f32 here, so argmax/categorical agree)."""
-    params = gpt.init(jax.random.PRNGKey(0), CFG_GPT2)
+    generic shared-recipe path share the sampling recipe and key
+    schedule: in f32 the sampled tokens are IDENTICAL; in bf16, fusion-
+    order rounding can flip near-tie logits (random weights make ties
+    common), so the bf16 case is a high-agreement canary against recipe
+    drift rather than an exactness claim."""
+    cfg = gpt.GPTConfig.nano(pos="learned", norm="ln", act="gelu",
+                             dtype=dtype)
+    params = gpt.init(jax.random.PRNGKey(0), cfg)
     prompt = jnp.asarray(TOKENS[:3, :8])
     kwargs = dict(temperature=0.8, top_k=20, rng=jax.random.PRNGKey(7),
                   max_seq=32)
-    assert gpt._decode_fast_eligible(CFG_GPT2)
-    fast = gpt.generate(params, CFG_GPT2, prompt, 6, **kwargs)
+    assert gpt._decode_fast_eligible(cfg)
+    fast = gpt.generate(params, cfg, prompt, 6, **kwargs)
     monkeypatch.setattr(gpt, "_decode_fast_eligible", lambda c: False)
-    generic = gpt.generate(params, CFG_GPT2, prompt, 6, **kwargs)
-    assert np.array_equal(np.asarray(fast), np.asarray(generic))
+    generic = gpt.generate(params, cfg, prompt, 6, **kwargs)
+    agree = np.mean(np.asarray(fast) == np.asarray(generic))
+    if dtype == jnp.float32:
+        assert agree == 1.0
+    else:
+        assert agree >= 0.7, agree
 
 
 def test_gpt_generate_sampling_reproducible():
