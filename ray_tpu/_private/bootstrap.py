@@ -92,6 +92,7 @@ class Cluster:
         os.makedirs(self.session_dir, exist_ok=True)
         self.log_dir = os.path.join(self.session_dir, "logs")
         self.control_proc: Optional[subprocess.Popen] = None
+        self.standby_proc: Optional[subprocess.Popen] = None
         self.control_addr: Optional[Tuple[str, int]] = None
         self.nodes: List[NodeHandle] = []
         self._n = 0
@@ -103,9 +104,14 @@ class Cluster:
         _wait_ping(self.control_addr, what="control plane")
         return self.control_addr
 
+    @property
+    def control_addr_file(self) -> str:
+        return os.path.join(self.session_dir, "control_addr")
+
     def _spawn_control(self, port: int):
         cmd = [sys.executable, "-m", "ray_tpu._private.control",
-               "--host", "127.0.0.1", "--port", str(port)]
+               "--host", "127.0.0.1", "--port", str(port),
+               "--addr-file", self.control_addr_file]
         # RAY_TPU_CONTROL_PERSIST also works via inherited env; the flag
         # keeps the subprocess's configuration visible in `ps`
         persist = os.environ.get("RAY_TPU_CONTROL_PERSIST")
@@ -113,6 +119,27 @@ class Cluster:
             cmd += ["--persist", persist]
         self.control_proc = _spawn(
             cmd, os.path.join(self.log_dir, "control.log"))
+
+    def start_standby(self) -> "subprocess.Popen":
+        """Spawn a warm-standby controller: it watches the primary and,
+        when the primary stops answering, loads the persisted state,
+        starts serving on its own port, and rewrites the addr-file —
+        raylets and drivers re-home to it on their next reconnect
+        (reference analog: Redis-backed GCS fault tolerance, promoted
+        to an active standby)."""
+        assert self.control_addr is not None, "start_control() first"
+        persist = os.environ.get("RAY_TPU_CONTROL_PERSIST")
+        assert persist, "standby needs RAY_TPU_CONTROL_PERSIST"
+        self.standby_port = free_port()
+        cmd = [sys.executable, "-m", "ray_tpu._private.control",
+               "--host", "127.0.0.1", "--port", str(self.standby_port),
+               "--persist", persist,
+               "--addr-file", self.control_addr_file,
+               "--standby-of",
+               f"{self.control_addr[0]}:{self.control_addr[1]}"]
+        self.standby_proc = _spawn(
+            cmd, os.path.join(self.log_dir, "control-standby.log"))
+        return self.standby_proc
 
     def kill_control(self):
         """Hard-kill the control daemon (GCS failure injection)."""
@@ -140,7 +167,8 @@ class Cluster:
         cmd = [sys.executable, "-m", "ray_tpu._private.node",
                "--control", f"{self.control_addr[0]}:{self.control_addr[1]}",
                "--host", "127.0.0.1", "--port", str(port),
-               "--node-id", nid, "--session-dir", node_session]
+               "--node-id", nid, "--session-dir", node_session,
+               "--addr-file", self.control_addr_file]
         if resources is not None:
             cmd += ["--resources", json.dumps(resources)]
         env = {}
@@ -165,6 +193,13 @@ class Cluster:
         for h in list(self.nodes):
             h.terminate()
         self.nodes.clear()
+        if self.standby_proc is not None and self.standby_proc.poll() is None:
+            self.standby_proc.kill()
+            try:
+                self.standby_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+        self.standby_proc = None
         if self.control_proc is not None and self.control_proc.poll() is None:
             self.control_proc.terminate()
             try:

@@ -298,3 +298,25 @@ class TaskCancelledError(RayTpuError):
 
 class GetTimeoutError(RayTpuError, TimeoutError):
     pass
+
+
+# -- control-plane rendezvous file (failover re-homing) ----------------------
+# One format, one reader, one writer: control.py publishes, raylets /
+# workers / drivers re-resolve.  rsplit tolerates IPv6-ish hosts.
+
+def write_addr_file(path: str, addr: Tuple[str, int]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(f"{addr[0]}:{addr[1]}")
+    os.replace(tmp, path)    # atomic: readers see old or new, never half
+
+
+def read_addr_file(path: Optional[str]) -> Optional[Tuple[str, int]]:
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            host, port = f.read().strip().rsplit(":", 1)
+        return (host, int(port))
+    except Exception:
+        return None

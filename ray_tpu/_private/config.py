@@ -40,6 +40,10 @@ CONFIG_DEFS: List[Tuple[str, type, Any, str]] = [
     ("restore_owner_grace_s", float, 60.0,
      "window for a driver job to re-register after a control restart "
      "before its restored non-detached actors are reaped"),
+    ("actor_adopt_grace_s", float, 15.0,
+     "window after a control restart/failover for raylets to re-home "
+     "and adopt their still-running actor workers in place before the "
+     "control plane falls back to rescheduling them fresh"),
     # -- task submission (NOTE: bound at module import in the driver's
     # own process — set via env or _system_config before daemons spawn)
     ("pipeline_depth", int, 8,

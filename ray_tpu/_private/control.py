@@ -167,8 +167,16 @@ class _NullDeferred:
 
 class ControlServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 persist_path: Optional[str] = None):
+                 persist_path: Optional[str] = None,
+                 addr_file: Optional[str] = None):
         self.server = Server(host, port, name="control")
+        self._addr_file = addr_file
+        if addr_file:
+            # the cluster's control-plane rendezvous: raylets and drivers
+            # re-read this on reconnect, which is how they re-home to a
+            # promoted standby at a different address (reference analog:
+            # the Redis bootstrap address raylets resolve the GCS from)
+            common.write_addr_file(addr_file, self.server.addr)
         self.lock = threading.RLock()
         self.kv: Dict[str, Dict[str, bytes]] = {}  # namespace -> key -> value
         self.nodes: Dict[str, NodeRecord] = {}
@@ -270,6 +278,14 @@ class ControlServer:
         # actor_id -> reap deadline (monotonic)
         self._restored_unclaimed: Dict[str, float] = {}
 
+        # restored ALIVE actors awaiting re-adoption by the raylet that
+        # still hosts their live worker (warm-standby failover / in-place
+        # restart): actor_id -> reschedule deadline.  A re-registering
+        # raylet reports its live actor workers; matches are adopted in
+        # place (same incarnation, state preserved); the rest are
+        # rescheduled when the deadline passes.
+        self._adoptable: Dict[str, float] = {}
+
         # durable metadata store (reference: redis_store_client.h role —
         # GCS fault tolerance).  Off unless a path is configured.
         from . import persist
@@ -328,11 +344,15 @@ class ControlServer:
         """Reload durable tables after a control-plane restart
         (reference: GcsInitData reload, gcs_init_data.h).
 
-        Raylets reconnect and re-register with wiped actor workers, so
-        every surviving actor record is rescheduled fresh (incarnation
-        bumped; restart budget NOT charged — the failure was ours, not
-        the actor's); live placement groups re-run 2-phase reservation
-        once nodes return."""
+        Non-PG actors whose workers may still be alive get an ADOPTION
+        window first: reconnecting raylets report live actor workers
+        (register_node live_actors) and matches resume in place — same
+        incarnation, state preserved (the warm-standby promise).  Only
+        unclaimed records are rescheduled fresh after the window
+        (incarnation bumped; restart budget NOT charged — the failure
+        was ours, not the actor's).  PG-placed actors skip adoption and
+        reschedule with their group: live placement groups re-run
+        2-phase reservation once nodes return."""
         self.kv = self.pstore.load_kv()
         self.functions = self.pstore.load_table("function")
         self.jobs = self.pstore.load_table("job")
@@ -358,7 +378,11 @@ class ControlServer:
             rec.incarnation += 1
             if rec.name:
                 self.named_actors[_named_key(rec.namespace, rec.name)] = aid
-            self.pending_actors.append(rec)
+            if rec.pg_id is None:
+                self._adoptable[aid] = \
+                    time.monotonic() + _cfg().actor_adopt_grace_s
+            else:
+                self.pending_actors.append(rec)
             # non-detached actors die with their owner in the reference;
             # reschedule optimistically but reap unless the owning driver
             # job re-registers within the grace window (h_register_job
@@ -449,14 +473,39 @@ class ControlServer:
     def h_register_node(self, conn, p):
         rec = NodeRecord(p["node_id"], p["addr"], normalize_resources(p["resources"]),
                          p.get("labels"))
+        adopted, rejected = [], []
         with self.lock:
             self.nodes[rec.node_id] = rec
             if self.nsched is not None:
                 self.nsched.upsert_node(rec.node_id, rec.total)
+            # a re-homing raylet reports actor workers that are still
+            # alive on it; records waiting in the adoption window resume
+            # in place — live incarnation, state preserved.  Anything
+            # else (already rescheduled elsewhere, reaped, unknown) is
+            # rejected and the raylet kills that worker.
+            for la in p.get("live_actors") or []:
+                a = self.actors.get(la["actor_id"])
+                if (a is not None and a.state == RESTARTING
+                        and la["actor_id"] in self._adoptable):
+                    a.state = ALIVE
+                    a.node_id = rec.node_id
+                    a.worker_addr = tuple(la["worker_addr"]) \
+                        if la.get("worker_addr") else None
+                    a.incarnation = la.get("incarnation", a.incarnation)
+                    self._adoptable.pop(la["actor_id"], None)
+                    adopted.append(a)
+                else:
+                    rejected.append(la["actor_id"])
         conn.meta["node_id"] = rec.node_id
         logger.info("node %s registered at %s: %s", rec.node_id[:12], rec.addr, p["resources"])
         self.publish("node", {"event": "added", "node": rec.view()})
-        return {"ok": True, "cluster_start_time": self.start_time}
+        for a in adopted:
+            self._persist_actor(a)
+            self.publish("actor", {"event": "update", "actor": a.view()})
+            logger.info("adopted live actor %s on %s (incarnation %d)",
+                        a.actor_id[:12], rec.node_id[:12], a.incarnation)
+        return {"ok": True, "cluster_start_time": self.start_time,
+                "rejected_actors": rejected}
 
     def h_heartbeat(self, conn, p):
         with self.lock:
@@ -1319,6 +1368,47 @@ class ControlServer:
                 self.publish("node", {"event": "removed", "node": rec.view()})
                 self._on_node_death(rec.node_id)
             self._reap_unclaimed_restored(now)
+            self._reschedule_unadopted(now)
+            self._check_fenced()
+
+    def _check_fenced(self):
+        """Split-brain fencing: the addr-file is the single source of
+        truth for who the controller is.  If a standby promoted while
+        this (slow-but-alive) process was stalled, the file no longer
+        names our address — step down immediately rather than serve a
+        second, diverging control plane against the same persisted
+        store."""
+        if not self._addr_file:
+            return
+        cur = common.read_addr_file(self._addr_file)
+        if cur is not None and tuple(cur) != tuple(self.server.addr):
+            logger.critical(
+                "fenced: addr-file %s now names %s (a standby promoted "
+                "over us); stepping down", self._addr_file, cur)
+            try:
+                self.stop()
+            finally:
+                os._exit(3)
+
+    def _reschedule_unadopted(self, now: float):
+        """Adoption window expired with no raylet claiming the live
+        worker: fall back to a fresh reschedule (the round-4 restart
+        semantics)."""
+        fell_through = []
+        with self.lock:
+            expired = [aid for aid, dl in self._adoptable.items()
+                       if now > dl]
+            for aid in expired:
+                self._adoptable.pop(aid, None)
+                rec = self.actors.get(aid)
+                if rec is not None and rec.state == RESTARTING \
+                        and rec not in self.pending_actors:
+                    self.pending_actors.append(rec)
+                    fell_through.append(aid)
+        if fell_through:
+            logger.warning("adoption window expired for %d restored "
+                           "actor(s); rescheduling fresh", len(fell_through))
+            self._sched_event.set()
 
     def _reap_unclaimed_restored(self, now: float):
         """Destroy restored non-detached actors whose owning driver job
@@ -1352,6 +1442,7 @@ class ControlServer:
                     _named_key(rec.namespace, rec.name), None)
             if rec in self.pending_actors:
                 self.pending_actors.remove(rec)
+            self._adoptable.pop(aid, None)
             nid = rec.node_id
             view = rec.view()
         self._persist_actor(rec)
@@ -1521,6 +1612,36 @@ class ControlServer:
         self._defer(d, run)
 
 
+def _standby_watch(peer: str, interval: float, misses_to_promote: int):
+    """Block until the primary at `peer` is unreachable for
+    `misses_to_promote` consecutive probes, then return (the caller
+    promotes).  The warm-standby analog of the reference's GCS
+    fault-tolerance supervisor: state is already on shared disk, so
+    promotion is just 'load the store and start serving'."""
+    from .protocol import Client
+
+    host, port = peer.rsplit(":", 1)
+    addr = (host, int(port))
+    misses = 0
+    logger.info("standby: watching primary at %s", peer)
+    while True:
+        try:
+            cli = Client(addr, name="standby->primary", connect_timeout=2.0)
+            try:
+                cli.call("ping", timeout=2.0)
+            finally:
+                cli.close()
+            misses = 0
+        except Exception:
+            misses += 1
+            logger.warning("standby: primary probe failed (%d/%d)",
+                           misses, misses_to_promote)
+            if misses >= misses_to_promote:
+                logger.warning("standby: promoting — primary declared dead")
+                return
+        time.sleep(interval)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
@@ -1528,10 +1649,28 @@ def main():
     ap.add_argument("--persist", default=None,
                     help="sqlite path for durable control-plane state "
                          "(GCS fault-tolerance equivalent)")
+    ap.add_argument("--addr-file", default=None,
+                    help="file to publish this control plane's address "
+                         "in (the re-homing rendezvous for failover)")
+    ap.add_argument("--standby-of", default=None, metavar="HOST:PORT",
+                    help="run as a warm standby: watch the primary at "
+                         "this address and take over (load the persisted "
+                         "state, serve, rewrite --addr-file) when it "
+                         "stops answering")
+    ap.add_argument("--standby-interval", type=float, default=0.5)
+    ap.add_argument("--standby-misses", type=int, default=3)
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s control %(levelname)s %(message)s")
-    srv = ControlServer(args.host, args.port, persist_path=args.persist)
+    if args.standby_of:
+        if not args.persist:
+            ap.error("--standby-of requires --persist (takeover state)")
+        if not args.addr_file:
+            ap.error("--standby-of requires --addr-file (re-homing)")
+        _standby_watch(args.standby_of, args.standby_interval,
+                       args.standby_misses)
+    srv = ControlServer(args.host, args.port, persist_path=args.persist,
+                        addr_file=args.addr_file)
     srv.start(block=True)
 
 

@@ -404,7 +404,13 @@ class CoreWorker:
         self.addr = self.server.addr
 
         self.control_addr = tuple(control_addr)
-        self.control = Client(control_addr, name=f"{mode}->control",
+        # rendezvous file outranks the configured address (a driver
+        # started after a failover must reach the promoted controller)
+        file_addr = common.read_addr_file(
+            os.environ.get("RAY_TPU_CONTROL_ADDR_FILE"))
+        if file_addr and file_addr != self.control_addr:
+            self.control_addr = file_addr
+        self.control = Client(self.control_addr, name=f"{mode}->control",
                               on_push=self._on_control_push)
         self.raylet: Optional[Client] = None
         self.raylet_addr = None
@@ -530,7 +536,15 @@ class CoreWorker:
         grace = _cfg().control_reconnect_s
         deadline = time.monotonic() + grace
         last: Optional[BaseException] = None
+        addr_file = os.environ.get("RAY_TPU_CONTROL_ADDR_FILE")
         while time.monotonic() < deadline and not self._shutdown:
+            # failover re-homing: a promoted standby publishes its
+            # address in the rendezvous file
+            new_addr = common.read_addr_file(addr_file)
+            if new_addr and new_addr != tuple(self.control_addr):
+                logger.warning("control plane moved: %s -> %s",
+                               self.control_addr, new_addr)
+                self.control_addr = new_addr
             try:
                 cli = Client(self.control_addr,
                              name=f"{self.mode}->control(re)",

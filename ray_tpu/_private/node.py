@@ -56,6 +56,7 @@ class WorkerRecord:
         self.bundle_demand: Dict[str, int] = {}  # PG actors: placed demand
         self.lent: Dict[str, int] = {}  # CPUs lent to the pool while blocked
         self.tpu = False  # spawned with TPU device visibility
+        self.incarnation = 0  # actor incarnation this worker hosts
 
 
 class PendingLease:
@@ -74,9 +75,12 @@ class Raylet:
     def __init__(self, control_addr: Tuple[str, int], host: str = "127.0.0.1",
                  port: int = 0, resources: Optional[Dict[str, float]] = None,
                  session_dir: Optional[str] = None, labels: Optional[Dict[str, str]] = None,
-                 node_id: Optional[str] = None):
+                 node_id: Optional[str] = None,
+                 control_addr_file: Optional[str] = None):
         self.node_id = node_id or common.node_id()
         self.control_addr = tuple(control_addr)
+        self.control_addr_file = control_addr_file \
+            or os.environ.get("RAY_TPU_CONTROL_ADDR_FILE")
         self.server = Server(host, port, name="raylet")
         self.session_dir = session_dir or f"/dev/shm/ray_tpu/{self.node_id}"
         self.store = ShmObjectStore(os.path.join(self.session_dir, "objects"))
@@ -185,6 +189,14 @@ class Raylet:
 
     def start(self, block: bool = False):
         self.server.start()
+        # the rendezvous file outranks the bootstrap --control address: a
+        # node added AFTER a failover must join the promoted controller,
+        # not the dead primary it was configured with
+        file_addr = self._read_addr_file()
+        if file_addr and file_addr != self.control_addr:
+            logger.info("control addr-file overrides bootstrap address: "
+                        "%s -> %s", self.control_addr, file_addr)
+            self.control_addr = file_addr
         self.control = Client(self.control_addr, name="raylet->control",
                               on_disconnect=self._on_control_lost)
         self.control.call("register_node", {
@@ -247,12 +259,24 @@ class Raylet:
         threading.Thread(target=self._reconnect_control, args=(grace,),
                          name="raylet-reconnect", daemon=True).start()
 
+    def _read_addr_file(self):
+        """Current control-plane address from the rendezvous file, or
+        None.  A promoted standby rewrites the file (atomically) with
+        its own address — re-reading it per retry is what re-homes this
+        raylet across a failover."""
+        return common.read_addr_file(self.control_addr_file)
+
     def _reconnect_control(self, grace: float):
         try:
             deadline = time.monotonic() + grace
             logger.warning("control connection lost; retrying for %.0fs",
                            grace)
             while not self._stop.is_set() and time.monotonic() < deadline:
+                new_addr = self._read_addr_file()
+                if new_addr and new_addr != self.control_addr:
+                    logger.warning("control plane moved: %s -> %s",
+                                   self.control_addr, new_addr)
+                    self.control_addr = new_addr
                 try:
                     cli = Client(self.control_addr, name="raylet->control",
                                  on_disconnect=self._on_control_lost,
@@ -261,13 +285,15 @@ class Raylet:
                 except Exception:
                     time.sleep(0.5)
                     continue
+                connected_at = time.monotonic()
                 old, self.control = self.control, cli
                 if old is not None:
                     old.close()
-                # the restarted control has no node table entry for us:
-                # full re-register with a clean actor slate (it will
-                # reschedule restored actors)
-                self._resurrect()
+                # the restarted/promoted control has no node entry for
+                # us: re-register, REPORTING live actor workers so the
+                # control adopts them in place (state preserved) instead
+                # of rescheduling; it replies with any it refuses
+                self._rehome(if_stale_since=connected_at)
                 logger.info("reconnected to control plane at %s",
                             self.control_addr)
                 return
@@ -277,6 +303,83 @@ class Raylet:
                 self.shutdown()
         finally:
             self._reconnecting.release()
+
+    def _rehome(self, if_stale_since: Optional[float] = None):
+        """Re-register after a control restart/failover WITHOUT wiping
+        actor workers: live non-PG actors are offered for adoption
+        (same incarnation, state preserved — the warm-standby promise);
+        the control rejects any it already rescheduled and those workers
+        are reaped.  PG-placed actors take the reschedule path with
+        their group (bundle reservations re-run 2-phase commit), same
+        as the round-4 restart semantics.
+
+        if_stale_since: skip if a registration already landed at/after
+        this time — a second rehome racing the first would find its
+        just-adopted actors ALIVE (not adoptable), get them rejected,
+        and kill the workers the first rehome saved.  Checked UNDER the
+        serializing lock (the check-outside variant was exactly that
+        race)."""
+        with self._resurrect_lock:
+            if if_stale_since is not None \
+                    and self._registered_at >= if_stale_since:
+                return
+            with self.lock:
+                live = [{"actor_id": r.actor_id,
+                         "incarnation": r.incarnation,
+                         "worker_addr": r.addr,
+                         "worker_id": r.worker_id}
+                        for r in self.workers.values()
+                        if r.actor_id is not None and r.state != "dead"
+                        and r.addr is not None and r.bundle_key is None]
+                pg_actor_workers = [
+                    r for r in self.workers.values()
+                    if r.actor_id is not None and r.state != "dead"
+                    and r.bundle_key is not None]
+                bundles = list(self.bundles.keys())
+            for rec in pg_actor_workers:
+                try:
+                    if rec.conn is not None:
+                        rec.conn.push("shutdown", {})
+                    self._kill_worker(rec)
+                except Exception:
+                    pass
+            with self.lock:
+                for key in bundles:
+                    self.bundles.pop(key, None)
+                self.available = dict(self.total)
+                for rec in self.workers.values():
+                    if rec.state != "dead" and rec.lease_resources:
+                        subtract(self.available, rec.lease_resources)
+                        if rec.blocked and rec.lent:
+                            add(self.available, rec.lent)
+            try:
+                resp = self.control.call("register_node", {
+                    "node_id": self.node_id,
+                    "addr": self.server.addr,
+                    "resources": common.denormalize_resources(self.total),
+                    "labels": self.labels,
+                    "live_actors": live,
+                }, timeout=30.0)
+                self._registered_at = time.monotonic()
+            except Exception:
+                logger.warning("re-registration failed; will retry on "
+                               "next heartbeat")
+                return
+            rejected = set((resp or {}).get("rejected_actors") or ())
+            if rejected:
+                with self.lock:
+                    victims = [r for r in self.workers.values()
+                               if r.actor_id in rejected]
+                for rec in victims:
+                    logger.warning("control rejected adoption of actor "
+                                   "%s; reaping its worker",
+                                   rec.actor_id[:12])
+                    try:
+                        if rec.conn is not None:
+                            rec.conn.push("shutdown", {})
+                        self._kill_worker(rec)
+                    except Exception:
+                        pass
 
     def shutdown(self):
         if self._stop.is_set():
@@ -339,6 +442,10 @@ class Raylet:
             "RAY_TPU_NODE_ID": self.node_id,
             "RAY_TPU_SESSION_DIR": self.session_dir,
         }
+        if self.control_addr_file:
+            # workers re-home to a promoted standby controller through
+            # the same rendezvous file the raylet uses
+            worker_vars["RAY_TPU_CONTROL_ADDR_FILE"] = self.control_addr_file
         if "JAX_PLATFORMS" in env and env.get("JAX_PLATFORMS") == "cpu":
             worker_vars["JAX_PLATFORMS"] = "cpu"
         if actor_id:
@@ -900,6 +1007,7 @@ class Raylet:
             if w is not None:
                 w.state = "actor"
                 w.actor_id = p["actor_id"]
+                w.incarnation = p.get("incarnation", 0)
                 w.lease_resources = demand if not from_bundle else {}
                 w.bundle_demand = demand if from_bundle else {}
                 if from_bundle:
@@ -940,6 +1048,7 @@ class Raylet:
             return
         rec.lease_resources = demand if not from_bundle else {}
         rec.bundle_demand = demand if from_bundle else {}
+        rec.incarnation = p.get("incarnation", 0)
         if from_bundle:
             rec.bundle_key = bundle_key
 
@@ -1251,69 +1360,21 @@ class Raylet:
                     # if our own view hasn't changed
                     last_acked = None
                 if r and not r.get("ok") and r.get("reregister"):
-                    # a heartbeat that raced with a concurrent re-register
-                    # (e.g. the reconnect thread after a control restart)
-                    # may be rejected even though we ARE registered now —
-                    # resurrecting again would reap actors the restored
-                    # control just placed here
+                    # not in the control's node table (restart/failover
+                    # we haven't re-registered for, OR a false
+                    # declared-dead while the control kept running).
+                    # _rehome handles both: the control adopts live
+                    # actors it restored, and rejects ones it already
+                    # rescheduled elsewhere (those workers are reaped —
+                    # the old clean-slate resurrect semantics).  The
+                    # staleness guard skips if a racing reconnect-path
+                    # rehome registered after this beat was sent.
                     last_acked = None   # new control: resend full view
-                    if self._registered_at < sent:
-                        self._resurrect()
+                    self._rehome(if_stale_since=sent)
             except Exception:
                 if not self._stop.is_set():
                     logger.warning("heartbeat to control failed")
             time.sleep(HEARTBEAT_INTERVAL_S)
-
-    def _resurrect(self):
-        """The control plane declared this (live) node dead — e.g. our
-        heartbeat thread stalled past the death timeout.  The reference
-        raylet exits and gets restarted; we do the in-process equivalent:
-        reap actor workers (the control already restarted those actors
-        elsewhere), reset accounting to a clean slate, re-register.
-
-        Serialized: concurrent resurrects (reconnect thread + heartbeat
-        rejection) would otherwise reap actor workers placed right after
-        the first re-registration."""
-        with self._resurrect_lock:
-            self._resurrect_locked()
-
-    def _resurrect_locked(self):
-        logger.warning("declared dead by control; resurrecting %s",
-                       self.node_id[:12])
-        with self.lock:
-            actor_workers = [r for r in self.workers.values()
-                             if r.actor_id is not None and r.state != "dead"]
-            bundles = list(self.bundles.keys())
-        for rec in actor_workers:
-            try:
-                if rec.conn is not None:
-                    rec.conn.push("shutdown", {})
-                self._kill_worker(rec)
-            except Exception:
-                pass
-        with self.lock:
-            for key in bundles:
-                self.bundles.pop(key, None)
-            # recompute from surviving leases: plain task workers keep
-            # running through a resurrect, so their holds must stay booked
-            self.available = dict(self.total)
-            for rec in self.workers.values():
-                if rec.state != "dead" and rec.lease_resources:
-                    subtract(self.available, rec.lease_resources)
-                    if rec.blocked and rec.lent:
-                        # its CPU loan is live: re-credit it
-                        add(self.available, rec.lent)
-        try:
-            self.control.call("register_node", {
-                "node_id": self.node_id,
-                "addr": self.server.addr,
-                "resources": common.denormalize_resources(self.total),
-                "labels": self.labels,
-            }, timeout=30.0)
-            self._registered_at = time.monotonic()
-        except Exception:
-            logger.warning("re-registration failed; will retry on next "
-                           "heartbeat")
 
 
 def main():
@@ -1324,6 +1385,10 @@ def main():
     ap.add_argument("--resources", default=None, help="JSON resource dict")
     ap.add_argument("--node-id", default=None)
     ap.add_argument("--session-dir", default=None)
+    ap.add_argument("--addr-file", default=None,
+                    help="control-plane rendezvous file; re-read on "
+                         "reconnect so the raylet re-homes to a promoted "
+                         "standby controller")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s raylet %(levelname)s %(message)s")
@@ -1336,7 +1401,8 @@ def main():
         labels = json.loads(os.environ["RAY_TPU_NODE_LABELS"])
     r = Raylet((host, int(port)), host=args.host, port=args.port,
                resources=resources, session_dir=args.session_dir,
-               node_id=args.node_id, labels=labels)
+               node_id=args.node_id, labels=labels,
+               control_addr_file=args.addr_file)
     r.start(block=True)
 
 

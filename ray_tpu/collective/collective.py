@@ -255,10 +255,19 @@ def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
-    """Root's tensor to everyone (reference: collective.py:373)."""
+    """Root's tensor to everyone (reference: collective.py:373).
+
+    xla backend: SPMD — EVERY rank must pass a tensor of the same shape
+    and dtype (non-root values are ignored but shape the program); the
+    kv backend only reads the root's tensor."""
     g = get_group_handle(group_name)
     g.op_idx += 1
     if g.backend == "xla":
+        if tensor is None:
+            raise TypeError(
+                "broadcast on the xla backend is an SPMD op: every rank "
+                "must pass a same-shape/dtype tensor (non-root values "
+                "are ignored); got None — pass e.g. np.zeros_like(root)")
         return _xla_run(g, _as_numpy(tensor), f"broadcast-{src_rank}",
                         functools.partial(_xla_take_row, src=src_rank))
     if g.rank == src_rank:

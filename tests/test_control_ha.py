@@ -99,9 +99,10 @@ def test_control_restart_resumes_cluster(multi_node_cluster, tmp_path,
             time.sleep(0.5)
         assert any(n["state"] == "ALIVE" for n in nodes), nodes
 
-        # the named actor was restarted from its persisted record;
-        # its in-memory state is fresh (new incarnation), like a
-        # max_restarts actor restart in the reference
+        # the raylet re-homed and offered its live actor worker for
+        # adoption: the actor SURVIVES the control restart in place —
+        # same worker, same incarnation, in-memory state preserved
+        # (stronger than the reference's restart-from-record semantics)
         view = None
         deadline = time.monotonic() + 60
         while time.monotonic() < deadline:
@@ -114,7 +115,7 @@ def test_control_restart_resumes_cluster(multi_node_cluster, tmp_path,
 
         aid2 = core.get_actor_by_name("survivor")["actor_id"]
         assert core.get(core.submit_actor_task(aid2, "inc", (), {})[0],
-                        timeout=60) == 1
+                        timeout=60) == 2
 
         # tasks still run end-to-end after the restart
         def add(a, b):
@@ -124,3 +125,110 @@ def test_control_restart_resumes_cluster(multi_node_cluster, tmp_path,
         assert core.get(ref, timeout=60) == 5
     finally:
         core.shutdown()
+
+
+def test_standby_failover_preserves_actors(multi_node_cluster, tmp_path,
+                                           monkeypatch):
+    """Warm-standby failover: a second controller tails the persisted
+    state, takes over when the primary dies (health-probe timeout),
+    rewrites the addr-file, and raylets/drivers re-home to it — running
+    actors SURVIVE (adopted in place: same incarnation, state intact)
+    and in-flight tasks complete (reference: Redis-backed GCS fault
+    tolerance, redis_store_client.h + ha_integration, promoted here to
+    an active standby with no supervisor in the loop)."""
+    monkeypatch.setenv("RAY_TPU_CONTROL_PERSIST",
+                       str(tmp_path / "control.db"))
+    c = multi_node_cluster()
+    # before add_node: the raylet (and the workers it spawns) inherit
+    # the rendezvous file path, which is how they re-home post-failover
+    monkeypatch.setenv("RAY_TPU_CONTROL_ADDR_FILE", c.control_addr_file)
+    node = c.add_node(resources={"CPU": 2})
+    core = _driver(c, node)
+    try:
+        Counter = _counter_actor()
+        h = core.create_actor(Counter, (), {}, name="survivor",
+                              max_restarts=-1, resources={"CPU": 1})
+        assert core.get(core.submit_actor_task(h, "inc", (), {})[0],
+                        timeout=60) == 1
+        view0 = core._control_call("get_actor", {"name": "survivor"},
+                                   timeout=10.0)
+
+        c.start_standby()
+        time.sleep(1.5)          # standby begins probing the primary
+
+        # a task in flight ACROSS the failover: result delivery is
+        # owner<->worker, off the control path, so it must complete
+        def slow_add(a, b):
+            import time as _t
+            _t.sleep(5.0)
+            return a + b
+
+        inflight = core.submit_task(slow_add, (20, 22), {},
+                                    resources={"CPU": 1})[0]
+
+        c.kill_control()
+
+        # promotion: the standby rewrites the rendezvous file
+        old = f"{c.control_addr[0]}:{c.control_addr[1]}"
+        cur = old
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                with open(c.control_addr_file) as f:
+                    cur = f.read().strip()
+            except FileNotFoundError:
+                pass
+            if cur != old:
+                break
+            time.sleep(0.2)
+        assert cur != old, "standby never promoted"
+
+        assert core.get(inflight, timeout=60) == 42
+
+        # driver re-homes on its next control call; the actor was
+        # ADOPTED: ALIVE with the incarnation it was born with
+        view = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                view = core._control_call("get_actor",
+                                          {"name": "survivor"},
+                                          timeout=10.0)
+                if view and view["state"] == "ALIVE":
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert view and view["state"] == "ALIVE", view
+        assert view["incarnation"] == view0["incarnation"], \
+            (view0, view)
+
+        # in-memory actor state survived the failover
+        assert core.get(core.submit_actor_task(h, "inc", (), {})[0],
+                        timeout=60) == 2
+
+        # and the promoted controller schedules new work
+        def add(a, b):
+            return a + b
+
+        ref = core.submit_task(add, (2, 3), {}, resources={"CPU": 1})[0]
+        assert core.get(ref, timeout=60) == 5
+    finally:
+        core.shutdown()
+
+
+def test_primary_steps_down_when_fenced(multi_node_cluster):
+    """Split-brain guard: if the addr-file stops naming the primary
+    (a standby promoted over it while it was stalled), the primary
+    must exit rather than keep serving a second control plane."""
+    c = multi_node_cluster()
+    # simulate a standby having promoted: rewrite the rendezvous file
+    from ray_tpu._private.common import write_addr_file
+    write_addr_file(c.control_addr_file, ("127.0.0.1", 1))
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        rc = c.control_proc.poll()
+        if rc is not None:
+            break
+        time.sleep(0.25)
+    assert c.control_proc.poll() == 3, "fenced primary did not step down"
