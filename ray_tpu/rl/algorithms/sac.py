@@ -1,11 +1,19 @@
-"""SAC (discrete): soft actor-critic with twin Q-nets and learned
-temperature.
+"""SAC: soft actor-critic with twin Q-nets and learned temperature,
+for BOTH action-space families.
 
 Analog of the reference's SAC (reference: rllib/algorithms/sac/sac.py,
-torch/sac_torch_learner.py).  Discrete-action variant (Christodoulou
-2019): the soft value and the policy objective take exact expectations
-over the action set instead of reparameterized samples — everything stays
-a dense matmul over [batch, actions], which is the TPU-friendly shape.
+torch/sac_torch_learner.py):
+
+  * continuous (Box) — the canonical SAC: SquashedGaussian policy with
+    reparameterized sampling through twin Q(s, a) critics (reference:
+    sac.py:320-322 requires SquashedGaussian for bounded continuous
+    spaces); demonstrated learning on Pendulum in the suite.
+  * discrete — the Christodoulou 2019 variant: soft value and policy
+    objectives as exact expectations over the action set — everything
+    stays a dense matmul over [batch, actions], the TPU-friendly shape.
+
+The algorithm picks the module/learner pair from the env spec
+(action_dim => continuous, num_actions => discrete).
 """
 
 from __future__ import annotations
@@ -18,7 +26,7 @@ import numpy as np
 
 from ray_tpu.rl.core.learner import Learner, LearnerGroup
 from ray_tpu.rl.core.rl_module import (MODULE_REGISTRY, RLModule, _mlp_apply,
-                                       _mlp_init)
+                                       _mlp_init, module_for_env)
 from ray_tpu.rl.utils.replay_buffer import ReplayBuffer
 
 from .algorithm import Algorithm, AlgorithmConfig
@@ -56,6 +64,80 @@ class SACModule(RLModule):
 
 
 MODULE_REGISTRY["sac"] = SACModule
+
+
+class SACContinuousModule(RLModule):
+    """Squashed-Gaussian policy + twin Q(s,a) critics for Box action
+    spaces (reference: rllib/algorithms/sac/sac.py:320-322 — continuous
+    spaces get a SquashedGaussian distribution; torch/sac_torch_learner
+    uses the reparameterized sample).  Actions are tanh-squashed and
+    affine-mapped to [low, high]; log-probs carry the tanh + scale
+    Jacobian corrections."""
+
+    LOG_STD_MIN = -20.0
+    LOG_STD_MAX = 2.0
+
+    def __init__(self, obs_dim: int, action_dim: int, hidden=(64, 64), *,
+                 low: float = -1.0, high: float = 1.0):
+        super().__init__(obs_dim, action_dim, hidden)
+        self.action_dim = action_dim
+        self.low = float(low)
+        self.high = float(high)
+        self.scale = (self.high - self.low) / 2.0
+        self.center = (self.high + self.low) / 2.0
+
+    def init(self, rng):
+        pi_rng, q1_rng, q2_rng = jax.random.split(rng, 3)
+        pi_sizes = (self.obs_dim, *self.hidden, 2 * self.action_dim)
+        q_sizes = (self.obs_dim + self.action_dim, *self.hidden, 1)
+        q1 = _mlp_init(q1_rng, q_sizes, out_scale=0.01)
+        q2 = _mlp_init(q2_rng, q_sizes, out_scale=0.01)
+        return {
+            "pi": _mlp_init(pi_rng, pi_sizes),
+            "q1": q1,
+            "q2": q2,
+            "target_q1": jax.tree_util.tree_map(jnp.copy, q1),
+            "target_q2": jax.tree_util.tree_map(jnp.copy, q2),
+            "log_alpha": jnp.zeros(()),
+        }
+
+    def pi_dist(self, params, obs):
+        out = _mlp_apply(params["pi"], obs)
+        mu, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, self.LOG_STD_MIN, self.LOG_STD_MAX)
+        return mu, log_std
+
+    def sample_and_logp(self, params, obs, rng):
+        """Reparameterized squashed sample -> (env-scaled action [B, A],
+        log-prob [B])."""
+        mu, log_std = self.pi_dist(params, obs)
+        std = jnp.exp(log_std)
+        u = mu + std * jax.random.normal(rng, mu.shape)
+        a = jnp.tanh(u)
+        logp = jnp.sum(
+            -0.5 * (((u - mu) / std) ** 2 + 2 * log_std
+                    + jnp.log(2 * jnp.pi))
+            - jnp.log(1.0 - a ** 2 + 1e-6)
+            - jnp.log(self.scale), axis=-1)
+        return a * self.scale + self.center, logp
+
+    def q_values(self, params, obs, action, which: str):
+        """Q(s, a) with the action normalized back to [-1, 1] (the net
+        should not have to learn the env's scale)."""
+        a_n = (action - self.center) / self.scale
+        return _mlp_apply(params[which],
+                          jnp.concatenate([obs, a_n], axis=-1))[..., 0]
+
+    def forward_exploration(self, params, obs, rng):
+        action, _ = self.sample_and_logp(params, obs, rng)
+        return action, {}
+
+    def forward_inference(self, params, obs):
+        mu, _ = self.pi_dist(params, obs)
+        return jnp.tanh(mu) * self.scale + self.center
+
+
+MODULE_REGISTRY["sac_continuous"] = SACContinuousModule
 
 
 class SACLearner(Learner):
@@ -129,6 +211,68 @@ class SACLearner(Learner):
         return params
 
 
+class SACContinuousLearner(Learner):
+    """Continuous-action SAC losses: reparameterized policy gradient
+    through min-Q, twin-critic TD targets with entropy bonus, learned
+    temperature toward target entropy -|A| (the SAC paper default)."""
+
+    def __init__(self, module: SACContinuousModule, *, gamma: float = 0.99,
+                 tau: float = 0.005, target_entropy: float = None,
+                 **kwargs):
+        self.gamma = gamma
+        self.tau = tau
+        self.target_entropy = (target_entropy if target_entropy is not None
+                               else -float(module.action_dim))
+        super().__init__(module, **kwargs)
+
+    _trainable = SACLearner._trainable
+    _merge = SACLearner._merge
+    extra_update = SACLearner.extra_update
+
+    def compute_loss(self, params, batch, rng):
+        m: SACContinuousModule = self.module
+        next_rng, pi_rng = jax.random.split(rng)
+        alpha = jax.lax.stop_gradient(jnp.exp(params["log_alpha"]))
+
+        # critic target: r + gamma (min target-Q(s', a') - alpha logp')
+        next_a, next_logp = m.sample_and_logp(params, batch["next_obs"],
+                                              next_rng)
+        next_q = jnp.minimum(
+            m.q_values(params, batch["next_obs"], next_a, "target_q1"),
+            m.q_values(params, batch["next_obs"], next_a, "target_q2"))
+        target = batch["reward"] + self.gamma \
+            * (next_q - alpha * next_logp) \
+            * (1.0 - batch["done"].astype(jnp.float32))
+        target = jax.lax.stop_gradient(target)
+
+        action = batch["action"]
+        if action.ndim == 1:
+            action = action[..., None]
+        q1 = m.q_values(params, batch["obs"], action, "q1")
+        q2 = m.q_values(params, batch["obs"], action, "q2")
+        q_loss = 0.5 * (jnp.mean((q1 - target) ** 2)
+                        + jnp.mean((q2 - target) ** 2))
+
+        # actor: reparameterized sample through min-Q (critics frozen)
+        pi_a, logp = m.sample_and_logp(params, batch["obs"], pi_rng)
+        q_min = jnp.minimum(
+            m.q_values(jax.lax.stop_gradient(params), batch["obs"],
+                       pi_a, "q1"),
+            m.q_values(jax.lax.stop_gradient(params), batch["obs"],
+                       pi_a, "q2"))
+        pi_loss = jnp.mean(alpha * logp - q_min)
+
+        # temperature: entropy (-logp) toward target_entropy
+        alpha_loss = jnp.mean(
+            params["log_alpha"]
+            * jax.lax.stop_gradient(-logp - self.target_entropy))
+
+        loss = q_loss + pi_loss + alpha_loss
+        return loss, {"q_loss": q_loss, "pi_loss": pi_loss,
+                      "alpha": jnp.exp(params["log_alpha"]),
+                      "entropy": -jnp.mean(logp)}
+
+
 class SACConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__()
@@ -151,11 +295,17 @@ class SAC(Algorithm):
         cfg: SACConfig = self.config
 
         def factory():
-            module = SACModule(self.env_spec["obs_dim"],
-                               self.env_spec["num_actions"], cfg.hidden)
-            return SACLearner(module, gamma=cfg.gamma, tau=cfg.tau,
-                              target_entropy=cfg.target_entropy,
-                              lr=cfg.lr, seed=cfg.seed)
+            # module_for_env owns the continuous-vs-discrete dispatch
+            # (and the action-bound defaults) — the learner follows the
+            # module type, so runner and learner can't desynchronize
+            module = module_for_env(self.env_spec, "sac",
+                                    hidden=cfg.hidden)
+            learner_cls = (SACContinuousLearner
+                           if isinstance(module, SACContinuousModule)
+                           else SACLearner)
+            return learner_cls(module, gamma=cfg.gamma, tau=cfg.tau,
+                               target_entropy=cfg.target_entropy,
+                               lr=cfg.lr, seed=cfg.seed)
 
         self.learner_group = LearnerGroup(factory, cfg.num_learners)
         self.buffer = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
@@ -170,6 +320,14 @@ class SAC(Algorithm):
         next_obs = np.roll(obs, -1, axis=0)
         valid = np.ones(obs.shape[:2], bool)
         valid[-1] = False
+        if self.env_spec.get("time_limit_only"):
+            # done here is pure TRUNCATION (Pendulum-style: no terminal
+            # states, episodes just expire) — a done-masked TD target
+            # would wrongly treat indistinguishable states as terminal,
+            # and bootstrapping through the auto-reset boundary would
+            # pair a truncated obs with the NEXT episode's reset obs.
+            # Dropping the boundary transitions is the unbiased option.
+            valid &= ~np.asarray(batch["done"], bool)
         flat_idx = valid.reshape(-1)
         flatten = lambda a: a.reshape(-1, *a.shape[2:])[flat_idx]  # noqa
         self.buffer.add_batch({

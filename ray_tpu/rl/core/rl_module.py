@@ -136,6 +136,17 @@ MODULE_REGISTRY: Dict[str, type] = {}
 
 def module_for_env(env_spec: Dict[str, Any], kind: str = "policy",
                    hidden: Sequence[int] = (64, 64), **kwargs) -> RLModule:
+    if "action_dim" in env_spec and "num_actions" not in env_spec:
+        # continuous (Box) action space: dispatch to the kind's
+        # continuous-action module (e.g. sac -> sac_continuous)
+        cls = MODULE_REGISTRY.get(f"{kind}_continuous")
+        if cls is None:
+            raise ValueError(
+                f"algorithm kind {kind!r} has no continuous-action "
+                f"module registered (env spec: {sorted(env_spec)})")
+        return cls(env_spec["obs_dim"], env_spec["action_dim"], hidden,
+                   low=env_spec.get("action_low", -1.0),
+                   high=env_spec.get("action_high", 1.0), **kwargs)
     cls = MODULE_REGISTRY.get(kind) or (
         DiscretePolicyModule if kind == "policy" else QModule)
     return cls(env_spec["obs_dim"], env_spec["num_actions"], hidden,

@@ -175,8 +175,14 @@ class GymEnvRunner:
         obs_space = self.envs.single_observation_space
         act_space = self.envs.single_action_space
         self.spec = {"obs_dim": int(np.prod(obs_space.shape)),
-                     "num_actions": int(act_space.n),
                      "max_episode_steps": 0}
+        if hasattr(act_space, "n"):                 # Discrete
+            self.spec["num_actions"] = int(act_space.n)
+        else:                                       # Box (continuous)
+            self.spec.update(
+                action_dim=int(np.prod(act_space.shape)),
+                action_low=float(np.min(act_space.low)),
+                action_high=float(np.max(act_space.high)))
         self.module = module_for_env(self.spec,
                                      kind=module_spec.get("kind", "policy"),
                                      **module_spec.get("kwargs", {}),

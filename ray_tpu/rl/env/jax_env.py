@@ -106,8 +106,71 @@ class CartPole(JaxEnv):
         return new, self._obs(new), jnp.ones(()), done
 
 
+class PendulumState(NamedTuple):
+    th: jnp.ndarray
+    thdot: jnp.ndarray
+    t: jnp.ndarray
+    rng: jnp.ndarray
+
+
+class Pendulum(JaxEnv):
+    """Pendulum-v1 dynamics (gymnasium classic_control pendulum: same
+    constants, semi-implicit Euler, ±8 rad/s speed clip), as pure jax.
+    The canonical continuous-control benchmark: obs [cos th, sin th,
+    thdot], one torque action in [-2, 2], reward
+    -(angle^2 + 0.1 thdot^2 + 0.001 u^2); 200-step episodes
+    (truncation only, auto-reset)."""
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+
+    # time_limit_only: done is truncation, never a terminal state —
+    # value-based learners must not cut bootstrap targets on it
+    spec = {"obs_dim": 3, "action_dim": 1,
+            "action_low": -2.0, "action_high": 2.0,
+            "max_episode_steps": 200, "time_limit_only": True}
+
+    def reset(self, rng):
+        rng, sub = jax.random.split(rng)
+        vals = jax.random.uniform(sub, (2,),
+                                  minval=jnp.asarray([-jnp.pi, -1.0]),
+                                  maxval=jnp.asarray([jnp.pi, 1.0]))
+        state = PendulumState(vals[0], vals[1],
+                              jnp.zeros((), jnp.int32), rng)
+        return state, self._obs(state)
+
+    def _obs(self, s: PendulumState):
+        return jnp.stack([jnp.cos(s.th), jnp.sin(s.th), s.thdot])
+
+    def step(self, s: PendulumState, action):
+        u = jnp.clip(jnp.reshape(action, ()), -self.MAX_TORQUE,
+                     self.MAX_TORQUE)
+        th_norm = ((s.th + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        cost = th_norm ** 2 + 0.1 * s.thdot ** 2 + 0.001 * u ** 2
+        thdot = s.thdot + (3 * self.G / (2 * self.L) * jnp.sin(s.th)
+                           + 3.0 / (self.M * self.L ** 2) * u) * self.DT
+        thdot = jnp.clip(thdot, -self.MAX_SPEED, self.MAX_SPEED)
+        th = s.th + thdot * self.DT
+        t = s.t + 1
+        done = t >= self.spec["max_episode_steps"]
+        rng, sub = jax.random.split(s.rng)
+        reset_vals = jax.random.uniform(
+            sub, (2,), minval=jnp.asarray([-jnp.pi, -1.0]),
+            maxval=jnp.asarray([jnp.pi, 1.0]))
+        new = PendulumState(
+            jnp.where(done, reset_vals[0], th),
+            jnp.where(done, reset_vals[1], thdot),
+            jnp.where(done, 0, t), rng)
+        return new, self._obs(new), -cost, done
+
+
 _REGISTRY: Dict[str, Callable[[], JaxEnv]] = {
     "CartPole-v1": CartPole,
+    "Pendulum-v1": Pendulum,
 }
 
 

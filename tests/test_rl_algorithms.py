@@ -59,6 +59,33 @@ def test_impala_learns_cartpole_local():
         algo.stop()
 
 
+def test_sac_continuous_learns_pendulum():
+    """Continuous-action SAC (SquashedGaussian + reparameterized twin-Q,
+    reference: rllib/algorithms/sac/sac.py:320-322) demonstrably LEARNS
+    its canonical domain: Pendulum swing-up from ~-1350 (random) to
+    >= -300 mean episode return (the conventional solved band is
+    >= -200; -300 keeps the test fast and flake-proof)."""
+    cfg = (SACConfig().environment("Pendulum-v1")
+           .env_runners(0, num_envs_per_runner=8)
+           .training(rollout_len=64, learn_starts=1000,
+                     updates_per_iter=48, train_batch_size=256, lr=1e-3))
+    algo = cfg.build()
+    try:
+        best = -np.inf
+        for _ in range(220):
+            r = algo.train()
+            if "episode_return_mean" in r:
+                best = max(best, r["episode_return_mean"])
+            if best >= -300.0:
+                break
+        assert best >= -300.0, best
+        w = algo.learner_group.get_weights()
+        assert {"pi", "q1", "q2", "target_q1", "target_q2",
+                "log_alpha"} <= set(w)
+    finally:
+        algo.stop()
+
+
 def test_sac_smoke_local():
     cfg = (SACConfig().environment("CartPole-v1")
            .env_runners(0, num_envs_per_runner=8)
