@@ -326,10 +326,43 @@ def _unavailable(name: str, dep: str):
     return fn
 
 
+def read_bigquery(project_id: str, dataset: Optional[str] = None,
+                  query: Optional[str] = None, *,
+                  client_factory=None,
+                  override_num_blocks: Optional[int] = None) -> Dataset:
+    """reference: python/ray/data/read_api.py read_bigquery (:523).
+
+    Table reads fan out over storage-API read streams; query reads run
+    server-side.  `client_factory` injects a duck-typed client (tests /
+    alternative transports); omitted, the google client library is
+    imported lazily and its absence raises ImportError."""
+    from .external import BigQueryDatasource
+
+    return read_datasource(
+        BigQueryDatasource(project_id, dataset, query,
+                           client_factory=client_factory),
+        override_num_blocks=override_num_blocks)
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline: Optional[List[dict]] = None,
+               client_factory=None,
+               override_num_blocks: Optional[int] = None) -> Dataset:
+    """reference: python/ray/data/read_api.py read_mongo (:423).
+
+    Partitioned server-side aggregation reads.  `client_factory(uri)`
+    injects a pymongo-shaped client; omitted, pymongo is imported
+    lazily and its absence raises ImportError."""
+    from .external import MongoDatasource
+
+    return read_datasource(
+        MongoDatasource(uri, database, collection, pipeline,
+                        client_factory=client_factory),
+        override_num_blocks=override_num_blocks)
+
+
 # external-service connectors: present for API parity, gated on their
 # client libraries exactly like the reference gates them
-read_bigquery = _unavailable("read_bigquery", "google-cloud-bigquery")
-read_mongo = _unavailable("read_mongo", "pymongo")
 read_databricks_tables = _unavailable("read_databricks_tables",
                                       "databricks-sql-connector")
 read_delta_sharing_tables = _unavailable("read_delta_sharing_tables",
@@ -349,7 +382,7 @@ __all__ = [
     "from_pandas", "from_arrow", "read_parquet", "read_csv", "read_json",
     "read_text", "read_binary_files", "read_numpy", "aggregate",
     "read_avro", "read_tfrecords", "read_images", "read_sql",
-    "read_webdataset",
+    "read_webdataset", "read_bigquery", "read_mongo",
     "read_parquet_bulk", "read_delta", "read_iceberg",
     "from_blocks", "from_arrow_refs", "from_pandas_refs", "from_numpy_refs",
     "from_huggingface", "from_torch", "from_tf",

@@ -21,32 +21,53 @@ _lock = threading.Lock()
 _cache: dict = {}
 
 
+def _compile(out: str, srcs: list, flags: list, timeout: float) -> str:
+    """mtime-cached g++ compile-and-swap shared by every build target.
+    Raises RuntimeError on any failure mode (missing compiler included)."""
+    if os.path.exists(out):
+        out_mtime = os.path.getmtime(out)
+        if all(os.path.getmtime(s) <= out_mtime for s in srcs):
+            return out
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = out + ".tmp.%d" % os.getpid()
+    cmd = ["g++", *flags, "-o", tmp, *srcs, "-lpthread"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise RuntimeError(f"native build failed to run: {e}") from e
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build of {os.path.basename(out)} failed:\n"
+            f"{proc.stderr[-4000:]}")
+    os.replace(tmp, out)  # atomic: concurrent builders race benignly
+    return out
+
+
 def build_extension(name: str, sources: list, extra_flags: list = ()) -> str:
     """Compile sources into _build/lib<name>.so; returns the path.
 
     Rebuilds when any source is newer than the cached .so.  Raises
     RuntimeError if the compiler fails.
     """
-    out = os.path.join(_BUILD_DIR, f"lib{name}.so")
-    srcs = [os.path.join(_SRC_DIR, s) for s in sources]
-    if os.path.exists(out):
-        so_mtime = os.path.getmtime(out)
-        if all(os.path.getmtime(s) <= so_mtime for s in srcs):
-            return out
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    tmp = out + ".tmp.%d" % os.getpid()
-    cmd = ["g++", "-O2", "-g", "-std=c++17", "-shared", "-fPIC",
-           "-o", tmp, *srcs, "-lpthread", *extra_flags]
-    try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=120)
-    except (OSError, subprocess.TimeoutExpired) as e:
-        raise RuntimeError(f"native build failed to run: {e}") from e
-    if proc.returncode != 0:
-        raise RuntimeError(
-            f"native build of {name} failed:\n{proc.stderr[-4000:]}")
-    os.replace(tmp, out)  # atomic: concurrent builders race benignly
-    return out
+    return _compile(
+        os.path.join(_BUILD_DIR, f"lib{name}.so"),
+        [os.path.join(_SRC_DIR, s) for s in sources],
+        ["-O2", "-g", "-std=c++17", "-shared", "-fPIC", *extra_flags],
+        timeout=120)
+
+
+def build_sanitized_selftest() -> str:
+    """Build the ASAN+UBSAN self-test binary (reference: the C++ tests'
+    bazel asan/tsan configs in .bazelrc); returns the binary path.
+    Rebuilds when any native source is newer."""
+    sources = ["selftest.cc", "shm_arena.cc", "shm_channel.cc", "sched.cc"]
+    return _compile(
+        os.path.join(_BUILD_DIR, "native_selftest_san"),
+        [os.path.join(_SRC_DIR, s) for s in sources],
+        ["-std=c++17", "-g", "-O1", "-fno-omit-frame-pointer",
+         "-fsanitize=address,undefined", "-fno-sanitize-recover=all"],
+        timeout=300)
 
 
 def load_library(name: str, sources: list) -> ctypes.CDLL:
@@ -58,3 +79,14 @@ def load_library(name: str, sources: list) -> ctypes.CDLL:
         lib = ctypes.CDLL(path)
         _cache[name] = lib
         return lib
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--sanitize" in sys.argv:
+        path = build_sanitized_selftest()
+        print(path)
+        rc = subprocess.run([path, "/tmp"]).returncode
+        sys.exit(rc)
+    print("usage: python -m ray_tpu.native.build --sanitize")

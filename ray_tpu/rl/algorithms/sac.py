@@ -78,11 +78,19 @@ class SACContinuousModule(RLModule):
     LOG_STD_MAX = 2.0
 
     def __init__(self, obs_dim: int, action_dim: int, hidden=(64, 64), *,
-                 low: float = -1.0, high: float = 1.0):
+                 low=-1.0, high=1.0):
         super().__init__(obs_dim, action_dim, hidden)
         self.action_dim = action_dim
-        self.low = float(low)
-        self.high = float(high)
+        # scalar or per-dimension bounds (heterogeneous Boxes like
+        # [steer, gas, brake] scale each dim independently)
+        self.low = jnp.broadcast_to(jnp.asarray(low, jnp.float32),
+                                    (action_dim,))
+        self.high = jnp.broadcast_to(jnp.asarray(high, jnp.float32),
+                                     (action_dim,))
+        if not (bool(jnp.all(jnp.isfinite(self.low)))
+                and bool(jnp.all(jnp.isfinite(self.high)))):
+            raise ValueError("squashed-Gaussian SAC needs finite action "
+                             f"bounds; got low={low} high={high}")
         self.scale = (self.high - self.low) / 2.0
         self.center = (self.high + self.low) / 2.0
 
@@ -318,16 +326,22 @@ class SAC(Algorithm):
 
         obs = np.asarray(batch["obs"])          # [T, B, D]
         next_obs = np.roll(obs, -1, axis=0)
+        done = np.asarray(batch["done"], bool)
+        # terminated vs truncated: a TRUNCATION boundary must neither
+        # cut the TD target (the state isn't terminal) nor bootstrap
+        # through the auto-reset (next_obs is the NEXT episode's reset
+        # state) — dropping those transitions is the unbiased option.
+        # Runners that report `terminated` give the split directly
+        # (gym); time_limit_only jax envs are all-truncation.
+        if "terminated" in batch:
+            terminated = np.asarray(batch["terminated"], bool)
+        elif self.env_spec.get("time_limit_only"):
+            terminated = np.zeros_like(done)
+        else:
+            terminated = done
         valid = np.ones(obs.shape[:2], bool)
         valid[-1] = False
-        if self.env_spec.get("time_limit_only"):
-            # done here is pure TRUNCATION (Pendulum-style: no terminal
-            # states, episodes just expire) — a done-masked TD target
-            # would wrongly treat indistinguishable states as terminal,
-            # and bootstrapping through the auto-reset boundary would
-            # pair a truncated obs with the NEXT episode's reset obs.
-            # Dropping the boundary transitions is the unbiased option.
-            valid &= ~np.asarray(batch["done"], bool)
+        valid &= ~(done & ~terminated)
         flat_idx = valid.reshape(-1)
         flatten = lambda a: a.reshape(-1, *a.shape[2:])[flat_idx]  # noqa
         self.buffer.add_batch({
@@ -335,7 +349,7 @@ class SAC(Algorithm):
             "next_obs": flatten(next_obs),
             "action": flatten(np.asarray(batch["action"])),
             "reward": flatten(np.asarray(batch["reward"])),
-            "done": flatten(np.asarray(batch["done"])),
+            "done": flatten(terminated),
         })
 
         metrics: Dict[str, float] = {}

@@ -179,10 +179,22 @@ class GymEnvRunner:
         if hasattr(act_space, "n"):                 # Discrete
             self.spec["num_actions"] = int(act_space.n)
         else:                                       # Box (continuous)
+            low = np.asarray(act_space.low, np.float64).reshape(-1)
+            high = np.asarray(act_space.high, np.float64).reshape(-1)
+            if not (np.isfinite(low).all() and np.isfinite(high).all()):
+                raise ValueError(
+                    f"Box action space has non-finite bounds "
+                    f"(low={low}, high={high}): the squashed-Gaussian "
+                    f"policy needs a bounded range — wrap the env with "
+                    f"a RescaleAction/ClipAction wrapper")
             self.spec.update(
+                # per-dimension bounds (lists: specs cross process
+                # boundaries) — collapsing to scalars would mis-scale
+                # heterogeneous spaces like CarRacing's [steer, gas,
+                # brake]
                 action_dim=int(np.prod(act_space.shape)),
-                action_low=float(np.min(act_space.low)),
-                action_high=float(np.max(act_space.high)))
+                action_low=low.tolist(),
+                action_high=high.tolist())
         self.module = module_for_env(self.spec,
                                      kind=module_spec.get("kind", "policy"),
                                      **module_spec.get("kwargs", {}),
@@ -230,6 +242,10 @@ class GymEnvRunner:
             rows.append({"obs": np.asarray(obs), "action": action_np,
                          "reward": np.asarray(reward, np.float32),
                          "done": done,
+                         # terminated vs truncated matters to value
+                         # learners: a time-limit hit must not cut the
+                         # bootstrap target (gymnasium's own distinction)
+                         "terminated": np.asarray(term, bool),
                          **{k: np.asarray(v) for k, v in extras.items()}})
             self.obs = next_obs
         batch = {k: np.stack([r[k] for r in rows]) for k in rows[0]}
