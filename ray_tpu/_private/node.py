@@ -1399,9 +1399,13 @@ def main():
     labels = None
     if os.environ.get("RAY_TPU_NODE_LABELS"):
         labels = json.loads(os.environ["RAY_TPU_NODE_LABELS"])
+    # on Kubernetes the provider injects the pod name via the downward
+    # API so control-plane node ids match pod names (idle scale-down
+    # resolves idleness per control node id)
+    node_id = args.node_id or os.environ.get("RAY_TPU_NODE_ID")
     r = Raylet((host, int(port)), host=args.host, port=args.port,
                resources=resources, session_dir=args.session_dir,
-               node_id=args.node_id, labels=labels,
+               node_id=node_id, labels=labels,
                control_addr_file=args.addr_file)
     r.start(block=True)
 

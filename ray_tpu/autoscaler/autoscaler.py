@@ -163,11 +163,21 @@ class StandardAutoscaler:
         self.num_terminations = 0
 
     def _workers_by_type(self) -> Dict[str, List[str]]:
+        """One entry per SCHEDULABLE UNIT: a TPU slice's host nodes
+        collapse to one representative (the type's resources describe
+        the whole slice, terminate_node releases the whole slice) — so
+        max_workers/min_workers count slices, not hosts, and idle
+        scale-down can't shave a slice below usability."""
         out: Dict[str, List[str]] = {}
+        seen_units = set()
         for nid in self.provider.non_terminated_nodes(
                 {TAG_NODE_KIND: "worker"}):
-            t = self.provider.node_tags(nid).get(TAG_NODE_TYPE, "?")
-            out.setdefault(t, []).append(nid)
+            tags = self.provider.node_tags(nid)
+            unit = tags.get("tpu-slice", nid)
+            if unit in seen_units:
+                continue
+            seen_units.add(unit)
+            out.setdefault(tags.get(TAG_NODE_TYPE, "?"), []).append(nid)
         return out
 
     def update(self):

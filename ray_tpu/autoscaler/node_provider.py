@@ -220,6 +220,249 @@ class GCPTpuNodeProvider(NodeProvider):
             rec["tags"].get(TAG_NODE_STATUS) == "up-to-date"
 
 
+class KubernetesNodeProvider(NodeProvider):
+    """KubeRay/GKE-shaped provider: one ray worker = one pod, managed
+    through the Kubernetes API (reference:
+    autoscaler/_private/kuberay/node_provider.py — pods carry ray.io/*
+    labels; the autoscaler reconciles by creating/deleting pods, and
+    the kubelet/scheduler does the rest).
+
+    TPU pod slices follow the GKE recipe: a node type with
+    `accelerator_type` (e.g. "v5e-16") + `topology` (e.g. "4x4")
+    creates ONE POD PER SLICE HOST, each pinned to the slice's node
+    pool via the cloud.google.com/gke-tpu-* selectors and requesting
+    google.com/tpu chips — that is how real TPU pods are provisioned
+    on GKE, and the slice/worker labels are what gang placement needs
+    to land a whole slice on one ICI domain.
+
+    provider_config:
+      namespace: k8s namespace (default "default")
+      api_client: duck-typed API server client —
+          create_pod(namespace, manifest) -> manifest (server fills
+              metadata.name if generateName was used)
+          list_pods(namespace, label_selector) -> [pod dicts]
+          delete_pod(namespace, name)
+        a real kubernetes.client.CoreV1Api adapter in production, a
+        fake in tests (zero egress here).
+      pod_template: optional baseline pod manifest merged under ours.
+    """
+
+    RAY_CLUSTER_LABEL = "ray.io/cluster"
+    RAY_TYPE_LABEL = "ray.io/node-type"
+    RAY_KIND_LABEL = "ray.io/node-kind"
+    #: GKE TPU node-pool selectors (the published GKE TPU recipe)
+    GKE_ACCEL_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
+    GKE_TOPO_SELECTOR = "cloud.google.com/gke-tpu-topology"
+    #: accelerator_type generation -> GKE accelerator selector value
+    GKE_ACCEL_NAMES = {"v4": "tpu-v4-podslice",
+                       "v5e": "tpu-v5-lite-podslice",
+                       "v5p": "tpu-v5p-slice",
+                       "v6e": "tpu-v6e-slice"}
+
+    #: pod-list cache TTL: a reconcile tick calls node_tags once per
+    #: node, and each would otherwise LIST every cluster pod — O(P^2)
+    #: API-server requests per tick at scale
+    LIST_CACHE_TTL_S = 2.0
+
+    def __init__(self, provider_config, cluster_name):
+        super().__init__(provider_config, cluster_name)
+        self.api = provider_config.get("api_client")
+        if self.api is None:
+            self.api = _default_kubernetes_client()
+        self.namespace = provider_config.get("namespace", "default")
+        self.pod_template = provider_config.get("pod_template") or {}
+        self._pods_cache: Optional[Dict[str, Dict]] = None
+        self._pods_cache_at = 0.0
+
+    # -- pod <-> node mapping ---------------------------------------------
+
+    def _selector(self, tag_filters: Dict[str, str]) -> str:
+        sel = {self.RAY_CLUSTER_LABEL: self.cluster_name}
+        for k, v in tag_filters.items():
+            sel[_tag_to_label(k)] = v
+        return ",".join(f"{k}={v}" for k, v in sorted(sel.items()))
+
+    def _cluster_pods(self) -> Dict[str, Dict]:
+        now = time.monotonic()
+        if self._pods_cache is None \
+                or now - self._pods_cache_at > self.LIST_CACHE_TTL_S:
+            pods = self.api.list_pods(
+                self.namespace,
+                f"{self.RAY_CLUSTER_LABEL}={self.cluster_name}")
+            self._pods_cache = {p["metadata"]["name"]: p for p in pods}
+            self._pods_cache_at = now
+        return self._pods_cache
+
+    def _invalidate(self):
+        self._pods_cache = None
+
+    def non_terminated_nodes(self, tag_filters):
+        want = {_tag_to_label(k): v for k, v in tag_filters.items()}
+        return [name for name, p in self._cluster_pods().items()
+                if p.get("status", {}).get("phase")
+                not in ("Succeeded", "Failed")
+                and all(p["metadata"]["labels"].get(k) == v
+                        for k, v in want.items())]
+
+    def node_tags(self, node_id):
+        p = self._cluster_pods().get(node_id)
+        if p is None:
+            # a pod deleted mid-reconcile (e.g. its slice peer was
+            # terminated this tick) is just gone, not an error
+            return {}
+        return {_label_to_tag(k): v
+                for k, v in p["metadata"]["labels"].items()}
+
+    def is_running(self, node_id):
+        p = self._cluster_pods().get(node_id)
+        return p is not None and \
+            p.get("status", {}).get("phase") == "Running"
+
+    # -- create / delete ---------------------------------------------------
+
+    def create_node(self, node_config, tags, count):
+        acc = node_config.get("accelerator_type")
+        created = []
+        for _ in range(count):
+            if acc:
+                created += self._create_tpu_slice_pods(node_config, tags)
+            else:
+                created.append(self._create_pod(node_config, tags, {}))
+        return created
+
+    def _create_tpu_slice_pods(self, node_config, tags) -> List[str]:
+        acc = node_config["accelerator_type"]
+        gen = acc.rsplit("-", 1)[0]
+        hosts = GCPTpuNodeProvider.slice_hosts(acc)
+        per_host = GCPTpuNodeProvider.CHIPS_PER_HOST.get(gen, 4)
+        topology = node_config.get("topology")
+        slice_name = f"{self.cluster_name}-{uuid.uuid4().hex[:8]}"
+        names = []
+        for w in range(hosts):
+            extra = {
+                "labels": {"tpu-slice": slice_name,
+                           "tpu-worker-id": str(w),
+                           "tpu-accelerator-type": acc},
+                "nodeSelector": {
+                    self.GKE_ACCEL_SELECTOR:
+                        self.GKE_ACCEL_NAMES.get(gen, gen),
+                    **({self.GKE_TOPO_SELECTOR: topology}
+                       if topology else {}),
+                },
+                "resources": {"google.com/tpu": per_host},
+            }
+            names.append(self._create_pod(node_config, tags, extra,
+                                          name=f"{slice_name}-w{w}"))
+        return names
+
+    def _create_pod(self, node_config, tags, extra,
+                    name: Optional[str] = None) -> str:
+        labels = {self.RAY_CLUSTER_LABEL: self.cluster_name}
+        for k, v in tags.items():
+            labels[_tag_to_label(k)] = v
+        labels.update(extra.get("labels", {}))
+        spec = dict(self.pod_template.get("spec", {}))
+        if extra.get("nodeSelector"):
+            spec["nodeSelector"] = {**spec.get("nodeSelector", {}),
+                                    **extra["nodeSelector"]}
+        containers = spec.get("containers") or [{"name": "ray-worker"}]
+        c0 = dict(containers[0])
+        limits = dict(node_config.get("custom_resources", {}))
+        limits.update(extra.get("resources", {}))
+        if limits:
+            # merge INTO the template's resources: clobbering the dict
+            # would drop its requests and the kube scheduler would place
+            # the pod as if it needed no cpu/memory
+            res = dict(c0.get("resources", {}))
+            res["limits"] = {**res.get("limits", {}), **limits}
+            c0["resources"] = res
+        # downward API: the raylet inside the pod registers with the
+        # POD NAME as its control-plane node id (node.py honors
+        # RAY_TPU_NODE_ID), which is what lets the autoscaler match
+        # control-plane idleness back to a pod for scale-down
+        env = [e for e in c0.get("env", [])
+               if e.get("name") != "RAY_TPU_NODE_ID"]
+        env.append({"name": "RAY_TPU_NODE_ID", "valueFrom": {
+            "fieldRef": {"fieldPath": "metadata.name"}}})
+        c0["env"] = env
+        containers = [c0, *containers[1:]]
+        spec["containers"] = containers
+        manifest = {
+            "metadata": {
+                **({"name": name} if name
+                   else {"generateName": f"{self.cluster_name}-worker-"}),
+                "labels": labels,
+            },
+            "spec": spec,
+        }
+        out = self.api.create_pod(self.namespace, manifest)
+        self._invalidate()
+        return out["metadata"]["name"]
+
+    def terminate_node(self, node_id):
+        """TPU slice pods release as a unit (a partial slice is
+        unusable), matching GCPTpuNodeProvider semantics."""
+        tags = self.node_tags(node_id)
+        if not tags:
+            return
+        slice_name = tags.get("tpu-slice")
+        if slice_name:
+            sel = (f"{self.RAY_CLUSTER_LABEL}={self.cluster_name},"
+                   f"tpu-slice={slice_name}")
+            for p in self.api.list_pods(self.namespace, sel):
+                self.api.delete_pod(self.namespace,
+                                    p["metadata"]["name"])
+        else:
+            self.api.delete_pod(self.namespace, node_id)
+        self._invalidate()
+
+
+def _tag_to_label(tag: str) -> str:
+    # node-kind/node-type/node-status ride as ray.io/* labels (kuberay
+    # convention); anything else passes through as-is
+    if tag in (TAG_NODE_KIND, TAG_NODE_TYPE, TAG_NODE_STATUS):
+        return f"ray.io/{tag}"
+    return tag
+
+
+def _label_to_tag(label: str) -> str:
+    return label[len("ray.io/"):] if label.startswith("ray.io/") else label
+
+
+def _default_kubernetes_client():
+    """Adapt kubernetes.client.CoreV1Api to the duck surface (in-cluster
+    config first, kubeconfig fallback) — only importable where the k8s
+    client library exists."""
+    try:
+        from kubernetes import client, config  # type: ignore
+    except ImportError as e:
+        raise ImportError(
+            "KubernetesNodeProvider needs the kubernetes client library "
+            "(not available in this environment) — or pass "
+            "provider_config['api_client'] with a compatible client") from e
+    try:
+        config.load_incluster_config()
+    except Exception:
+        config.load_kube_config()
+    v1 = client.CoreV1Api()
+
+    class _Adapter:
+        def create_pod(self, namespace, manifest):
+            out = v1.create_namespaced_pod(namespace, manifest)
+            return client.ApiClient().sanitize_for_serialization(out)
+
+        def list_pods(self, namespace, label_selector):
+            out = v1.list_namespaced_pod(namespace,
+                                         label_selector=label_selector)
+            return client.ApiClient().sanitize_for_serialization(
+                out)["items"]
+
+        def delete_pod(self, namespace, name):
+            v1.delete_namespaced_pod(name, namespace)
+
+    return _Adapter()
+
+
 def make_node_provider(provider_config: Dict[str, Any],
                        cluster_name: str) -> NodeProvider:
     """Provider factory keyed by provider.type (reference:
@@ -229,4 +472,6 @@ def make_node_provider(provider_config: Dict[str, Any],
         return LocalNodeProvider(provider_config, cluster_name)
     if kind in ("gcp_tpu", "gcp"):
         return GCPTpuNodeProvider(provider_config, cluster_name)
+    if kind in ("kubernetes", "kuberay", "gke"):
+        return KubernetesNodeProvider(provider_config, cluster_name)
     raise ValueError(f"unknown node provider type {kind!r}")

@@ -48,12 +48,17 @@ class BigQueryDatasource(Datasource):
         self._dataset = dataset
         self._query = query
         self._factory = client_factory or _default_bigquery_client
-        # plan-time metadata comes from one control call, not a scan
         self._session = None
-        if query is None:
-            client = self._factory()
-            self._session = client.create_read_session(
-                table=f"{project_id}.{dataset}", max_stream_count=0)
+
+    def _meta_session(self):
+        """Lazy plan-time metadata session (one control call, no scan):
+        constructing a never-executed lazy Dataset must not hit the
+        network."""
+        if self._session is None and self._query is None:
+            self._session = self._factory().create_read_session(
+                table=f"{self._project}.{self._dataset}",
+                max_stream_count=0)
+        return self._session
 
     def get_name(self) -> str:
         return "BigQuery"
@@ -65,11 +70,11 @@ class BigQueryDatasource(Datasource):
         return None
 
     def estimated_row_count(self) -> Optional[int]:
-        n = getattr(self._session, "estimated_row_count", None)
+        n = getattr(self._meta_session(), "estimated_row_count", None)
         return int(n) if n is not None else None
 
     def estimate_inmemory_data_size(self) -> Optional[int]:
-        n = getattr(self._session, "estimated_total_bytes", None)
+        n = getattr(self._meta_session(), "estimated_total_bytes", None)
         return int(n) if n is not None else None
 
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
@@ -131,12 +136,38 @@ class MongoDatasource(Datasource):
     def estimated_row_count(self) -> Optional[int]:
         return self._count if not self._pipeline else None
 
+    #: pipeline stages after which document order is the stage's own
+    #: (or undefined) — a leading _id sort no longer pins the windows
+    _ORDER_DESTROYING = {"$sort", "$group", "$sample", "$unionWith",
+                         "$unwind", "$project", "$unset",
+                         "$replaceRoot", "$replaceWith"}
+
+    def _partitionable(self) -> bool:
+        return not any(set(st) & self._ORDER_DESTROYING
+                       for st in self._pipeline)
+
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
-        n_tasks = max(1, min(parallelism, self._count or 1))
-        base = (self._count // n_tasks) if self._count else 0
         uri, db, coll_name = self._uri, self._db, self._coll
         pipeline, factory = self._pipeline, self._factory
 
+        # Partitioned windows need a stable document order.  A LEADING
+        # `$sort: {_id: 1}` walks the _id index (cheap, no in-memory
+        # sort) and $match after it preserves order; pipelines with
+        # order-destroying or _id-dropping stages can't be windowed
+        # safely and fall back to ONE task (correct, not parallel —
+        # the reference partitions on _id ranges with the same caveat).
+        if not self._partitionable():
+            def read_single():
+                coll = factory(uri)[db][coll_name]
+                rows = [{k: v for k, v in r.items() if k != "_id"}
+                        for r in coll.aggregate(list(pipeline))]
+                yield rows_to_block(rows)
+
+            return [ReadTask(read_single,
+                             BlockMetadata(num_rows=0, size_bytes=0))]
+
+        n_tasks = max(1, min(parallelism, self._count or 1))
+        base = (self._count // n_tasks) if self._count else 0
         tasks = []
         for i in range(n_tasks):
             skip = i * base
@@ -146,14 +177,8 @@ class MongoDatasource(Datasource):
 
             def make(skip=skip, limit=limit):
                 def read():
-                    # $sort on _id pins a stable order BEFORE the window
-                    # stages: without it MongoDB guarantees no document
-                    # order, so independent per-task aggregations could
-                    # overlap or gap (the _id index makes this cheap;
-                    # the reference partitions on _id ranges for the
-                    # same reason)
-                    stages = list(pipeline) + [{"$sort": {"_id": 1}},
-                                               {"$skip": skip}]
+                    stages = [{"$sort": {"_id": 1}}, *pipeline,
+                              {"$skip": skip}]
                     if limit is not None:
                         stages.append({"$limit": limit})
                     coll = factory(uri)[db][coll_name]

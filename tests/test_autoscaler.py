@@ -121,3 +121,131 @@ def test_autoscaler_scales_up_and_down(multi_node_cluster):
         provider.shutdown()
     finally:
         core.shutdown()
+
+
+class _FakeKubeApi:
+    """In-memory API server: create assigns names, pods go Running
+    immediately (the fake kubelet), list filters by label selector."""
+
+    def __init__(self):
+        self.pods = {}          # name -> manifest
+        self.deleted = []
+        self._n = 0
+
+    def create_pod(self, namespace, manifest):
+        meta = manifest["metadata"]
+        name = meta.get("name")
+        if not name:
+            self._n += 1
+            name = meta["generateName"] + f"{self._n:04d}"
+        manifest = {**manifest,
+                    "metadata": {**meta, "name": name},
+                    "status": {"phase": "Running"}}
+        self.pods[name] = manifest
+        return manifest
+
+    def list_pods(self, namespace, label_selector):
+        want = dict(kv.split("=", 1)
+                    for kv in label_selector.split(",") if kv)
+        return [p for p in self.pods.values()
+                if all(p["metadata"]["labels"].get(k) == v
+                       for k, v in want.items())]
+
+    def delete_pod(self, namespace, name):
+        self.pods.pop(name, None)
+        self.deleted.append(name)
+
+
+class _FakeControl:
+    """Control-plane stub for LoadMetrics: scripted get_nodes /
+    state_dump responses."""
+
+    def __init__(self):
+        self.nodes = []
+        self.pending_pg_bundles = []
+
+    def call(self, method, payload=None, timeout=None):
+        if method == "get_nodes":
+            return self.nodes
+        if method == "state_dump":
+            return {"actors": [],
+                    "pgs": ([{"state": "PENDING",
+                              "bundles": self.pending_pg_bundles}]
+                            if self.pending_pg_bundles else [])}
+        raise AssertionError(method)
+
+
+def test_kubernetes_provider_tpu_slice_e2e():
+    """KubeRay/GKE-shaped provider, fake API server end to end
+    (reference: autoscaler/_private/kuberay/node_provider.py): a
+    pending TPU gang drives `up` -> one v5e-16 slice = 4 pods with
+    GKE TPU selectors + slice topology labels; the demand then fits
+    (gang placement has its slice; no further launches); idleness
+    drives scale-down, which releases the WHOLE slice atomically."""
+    from ray_tpu.autoscaler.node_provider import (KubernetesNodeProvider,
+                                                  make_node_provider)
+
+    api = _FakeKubeApi()
+    provider = make_node_provider(
+        {"type": "kubernetes", "api_client": api, "namespace": "ray"},
+        "kube-tpu")
+    assert isinstance(provider, KubernetesNodeProvider)
+    control = _FakeControl()
+    autoscaler = StandardAutoscaler(
+        {"max_workers": 8, "idle_timeout_minutes": 0.005,   # 0.3 s
+         "available_node_types": {
+             # a node type is one SLICE (the schedulable gang unit)
+             "v5e_16_slice": {
+                 "resources": {"CPU": 384.0, "TPU": 16.0},
+                 "node_config": {"accelerator_type": "v5e-16",
+                                 "topology": "4x4"},
+                 "min_workers": 0, "max_workers": 2},
+         }},
+        provider, control)
+
+    # `up` with a pending 4-host TPU gang (a placement group of
+    # TPU:4 bundles, one per slice host)
+    control.pending_pg_bundles = [{"TPU": 4.0} for _ in range(4)]
+    autoscaler.update()
+    assert autoscaler.num_launches == 4          # 4 pods = ONE slice
+    pods = list(api.pods.values())
+    assert len(pods) == 4
+    slices = {p["metadata"]["labels"]["tpu-slice"] for p in pods}
+    assert len(slices) == 1                      # one ICI domain
+    workers = sorted(p["metadata"]["labels"]["tpu-worker-id"]
+                     for p in pods)
+    assert workers == ["0", "1", "2", "3"]
+    for p in pods:
+        sel = p["spec"]["nodeSelector"]
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == \
+            "tpu-v5-lite-podslice"
+        assert sel["cloud.google.com/gke-tpu-topology"] == "4x4"
+        limits = p["spec"]["containers"][0]["resources"]["limits"]
+        assert limits["google.com/tpu"] == 4
+        assert p["metadata"]["labels"]["ray.io/node-type"] == \
+            "v5e_16_slice"
+
+    # the gang PLACED on its slice: pg no longer pending, chips busy —
+    # a second reconcile neither launches nor scales down
+    control.pending_pg_bundles = []
+    control.nodes = [
+        {"node_id": p["metadata"]["name"], "state": "ALIVE",
+         "addr": ["127.0.0.1", 1],
+         "available": {"CPU": 96.0, "TPU": 0.0},   # gang occupies chips
+         "total": {"CPU": 96.0, "TPU": 4.0}}
+        for p in api.pods.values()]
+    autoscaler.update()
+    assert autoscaler.num_launches == 4
+    assert len(api.pods) == 4
+
+    # gang done: no demand, chips free -> idle timeout -> the WHOLE
+    # slice scales down together
+    for n in control.nodes:
+        n["available"] = dict(n["total"])
+    deadline = time.time() + 10
+    while time.time() < deadline and api.pods:
+        autoscaler.update()
+        time.sleep(0.1)
+    assert api.pods == {}
+    assert sorted(api.deleted) == sorted(
+        p["metadata"]["name"] for p in pods)

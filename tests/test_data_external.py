@@ -173,7 +173,9 @@ def test_mongo_plan_metadata():
 
 
 def test_missing_client_libs_raise_importerror():
+    # bigquery defers its session (lazy datasets must not hit the
+    # network at definition), so the ImportError surfaces on first use
     with pytest.raises(ImportError, match="google-cloud-bigquery"):
-        rd.read_bigquery("proj", "ds.tbl")
+        BigQueryDatasource("proj", "ds.tbl").estimated_row_count()
     with pytest.raises(ImportError, match="pymongo"):
         rd.read_mongo("mongodb://h", "appdb", "events")
