@@ -195,3 +195,50 @@ def visible_chip_env(assigned: Tuple[int, ...]) -> Dict[str, str]:
     """Env vars confining a worker to its assigned chips
     (reference: tpu.py:155-195 set_current_process_visible_accelerator_ids)."""
     return {"TPU_VISIBLE_CHIPS": ",".join(str(c) for c in assigned)}
+
+
+def tpu_device_paths() -> list:
+    """Host device nodes a TPU container must be granted
+    (reference: image_uri.py device propagation): /dev/accel* for
+    direct-attached chips, the vfio group nodes + /dev/vfio/vfio
+    control node for vfio-bound ones.  RAY_TPU_TPU_DEVICES overrides
+    (exotic device layouts, tests)."""
+    env = os.environ.get("RAY_TPU_TPU_DEVICES")
+    if env is not None:
+        return [p for p in env.split(",") if p]
+    devs = sorted(glob.glob("/dev/accel*"))
+    try:
+        vfio = [f"/dev/vfio/{e}" for e in os.listdir("/dev/vfio")
+                if e.isdigit()]
+        if vfio:
+            devs += ["/dev/vfio/vfio", *sorted(vfio)]
+    except FileNotFoundError:
+        pass
+    return devs
+
+
+#: host env a TPU container needs forwarded (the runtime does not
+#: inherit its client's environment): chip visibility + topology
+#: bounds + the axon-tunnel endpoint on tunnel dev boxes
+_TPU_FORWARD_ENV = ("TPU_VISIBLE_CHIPS", "TPU_CHIPS_PER_HOST_BOUNDS",
+                    "TPU_HOST_BOUNDS", "TPU_WORKER_ID",
+                    "TPU_WORKER_HOSTNAMES", "TPU_NAME",
+                    "PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")
+
+
+def tpu_container_env() -> Dict[str, str]:
+    """Env to forward into a TPU actor's container.  TPU_VISIBLE_CHIPS
+    defaults to every host chip when unset (one TPU worker per host
+    owns the slice's local chips, like the reference's whole-host TPU
+    scheduling)."""
+    out = {k: os.environ[k] for k in _TPU_FORWARD_ENV if k in os.environ}
+    if out.get("JAX_PLATFORMS", "").lower() == "cpu":
+        # a host pinned to CPU (dev boxes keep host processes off the
+        # chip) must NOT pin the TPU actor's container to CPU — that is
+        # the silent-fallback-while-holding-the-lease failure mode
+        del out["JAX_PLATFORMS"]
+    if "TPU_VISIBLE_CHIPS" not in out:
+        chips = num_tpu_chips()
+        if chips:
+            out.update(visible_chip_env(tuple(range(chips))))
+    return out

@@ -458,14 +458,6 @@ class Raylet:
                "--control", f"{self.control_addr[0]}:{self.control_addr[1]}"]
         try:
             if container:
-                if tpu:
-                    # device mounts + TPU plugin env forwarding are not
-                    # implemented — failing loudly beats JAX silently
-                    # falling back to CPU while holding the TPU lease
-                    raise RuntimeError(
-                        "containerized TPU actors are not supported yet "
-                        "(the container would see no /dev/accel devices); "
-                        "drop the container env or the TPU resource")
                 # containerized actor worker (reference: image_uri.py:106
                 # ImageURIPlugin wrapping the worker command): the runtime
                 # does not forward its client's env, so worker_vars ride
@@ -473,9 +465,31 @@ class Raylet:
                 # mounts keep the data/control planes reachable
                 from . import runtime_env as _rtenv
 
+                devices: list = []
+                if tpu:
+                    # TPU actors get the host's device nodes granted
+                    # into the container + the chip-visibility/topology
+                    # env forwarded (reference: image_uri.py device
+                    # propagation; TPU_VISIBLE_CHIPS scoping tpu.py:155).
+                    # A tunnel-attached chip (axon) needs only the env —
+                    # it is reached over TCP.  Rejection stays ONLY for
+                    # hosts with genuinely no device path: JAX silently
+                    # falling back to CPU while holding the TPU lease is
+                    # the failure mode this guards.
+                    devices = accelerators.tpu_device_paths()
+                    tpu_env = accelerators.tpu_container_env()
+                    if not devices and \
+                            "PALLAS_AXON_POOL_IPS" not in tpu_env:
+                        raise RuntimeError(
+                            "containerized TPU actor on a host with no "
+                            "TPU device nodes (/dev/accel*, vfio) and "
+                            "no tunnel endpoint — the container would "
+                            "silently run on CPU while holding the TPU "
+                            "lease")
+                    worker_vars = {**worker_vars, **tpu_env}
                 cmd = _rtenv.wrap_container_cmd(
                     cmd, worker_vars, container, self.session_dir,
-                    env["PYTHONPATH"])
+                    env["PYTHONPATH"], devices=devices)
             log_dir = os.path.join(self.session_dir, "logs")
             os.makedirs(log_dir, exist_ok=True)
             out = open(os.path.join(log_dir, f"worker-{wid[:12]}.log"), "ab")

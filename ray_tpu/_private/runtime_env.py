@@ -154,18 +154,22 @@ def resolve_container_runtime(explicit: Optional[str] = None) -> str:
 
 def wrap_container_cmd(cmd: List[str], env_delta: Dict[str, str],
                        spec: Dict[str, Any], session_dir: str,
-                       pythonpath: str) -> List[str]:
+                       pythonpath: str,
+                       devices: List[str] = ()) -> List[str]:
     """Worker argv -> containerized argv (reference: image_uri.py:106
     _modify_context building the podman invocation).
 
     Host network (the worker dials the raylet/control on host TCP),
     host /dev/shm (the plasma arena lives there), the session dir and
     every PYTHONPATH entry mounted read-only, env via -e (the runtime
-    does not forward its client's environment)."""
+    does not forward its client's environment).  `devices` become
+    --device grants — TPU actors get /dev/accel* / vfio nodes."""
     runtime = resolve_container_runtime(spec.get("runtime"))
     args = [runtime, "run", "--rm", "--network=host", "--ipc=host",
             "-v", "/dev/shm:/dev/shm",
             "-v", f"{session_dir}:{session_dir}"]
+    for dev in devices:
+        args += [f"--device={dev}"]
     for entry in [p for p in pythonpath.split(os.pathsep) if p]:
         args += ["-v", f"{entry}:{entry}:ro"]
     env_delta = dict(env_delta, RAY_TPU_IN_CONTAINER="1")

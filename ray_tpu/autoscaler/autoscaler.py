@@ -214,13 +214,29 @@ class StandardAutoscaler:
                 for pid in nodes:
                     if removable <= 0:
                         break
-                    ctrl_id = self.provider.node_tags(pid).get(
-                        "control-node-id", pid)
-                    if idle_s.get(ctrl_id, 0.0) > self.idle_timeout_s:
+                    if self._unit_idle_s(pid, idle_s) > self.idle_timeout_s:
                         logger.info("terminating idle node %s", pid)
                         self.provider.terminate_node(pid)
                         self.num_terminations += 1
                         removable -= 1
+
+    def _unit_idle_s(self, pid: str, idle_s: Dict[str, float]) -> float:
+        """Idle seconds of the SCHEDULABLE UNIT pid represents.  For a
+        TPU slice that is the LEAST idle of all its host nodes —
+        terminate_node releases the whole slice, so judging it by one
+        representative would kill work running on a peer host."""
+        tags = self.provider.node_tags(pid)
+        slice_name = tags.get("tpu-slice")
+        if not slice_name:
+            ctrl = tags.get("control-node-id", pid)
+            return idle_s.get(ctrl, 0.0)
+        vals = []
+        for peer in self.provider.non_terminated_nodes(
+                {"tpu-slice": slice_name}):
+            ctrl = self.provider.node_tags(peer).get(
+                "control-node-id", peer)
+            vals.append(idle_s.get(ctrl, 0.0))
+        return min(vals) if vals else 0.0
 
     def _launch(self, type_name: str, count: int):
         tcfg = self.config["available_node_types"][type_name]

@@ -275,12 +275,8 @@ class KubernetesNodeProvider(NodeProvider):
         self._pods_cache_at = 0.0
 
     # -- pod <-> node mapping ---------------------------------------------
-
-    def _selector(self, tag_filters: Dict[str, str]) -> str:
-        sel = {self.RAY_CLUSTER_LABEL: self.cluster_name}
-        for k, v in tag_filters.items():
-            sel[_tag_to_label(k)] = v
-        return ",".join(f"{k}={v}" for k, v in sorted(sel.items()))
+    # (one TTL-cached LIST per tick; tag filters apply client-side on
+    # the cached manifests rather than as server-side label selectors)
 
     def _cluster_pods(self) -> Dict[str, Dict]:
         now = time.monotonic()
@@ -387,11 +383,16 @@ class KubernetesNodeProvider(NodeProvider):
         c0["env"] = env
         containers = [c0, *containers[1:]]
         spec["containers"] = containers
+        tmeta = self.pod_template.get("metadata", {})
         manifest = {
+            "apiVersion": "v1",
+            "kind": "Pod",
             "metadata": {
                 **({"name": name} if name
                    else {"generateName": f"{self.cluster_name}-worker-"}),
-                "labels": labels,
+                "labels": {**tmeta.get("labels", {}), **labels},
+                **({"annotations": tmeta["annotations"]}
+                   if tmeta.get("annotations") else {}),
             },
             "spec": spec,
         }
@@ -403,9 +404,17 @@ class KubernetesNodeProvider(NodeProvider):
         """TPU slice pods release as a unit (a partial slice is
         unusable), matching GCPTpuNodeProvider semantics."""
         tags = self.node_tags(node_id)
-        if not tags:
-            return
         slice_name = tags.get("tpu-slice")
+        if not tags:
+            # the pod itself is gone (drained/evicted out-of-band) but
+            # its slice peers may survive as an unusable partial slice:
+            # slice pod names are <slice>-w<N>, so recover the slice
+            # label and release the peers too
+            base, sep, tail = node_id.rpartition("-w")
+            if sep and tail.isdigit():
+                slice_name = base
+            else:
+                return
         if slice_name:
             sel = (f"{self.RAY_CLUSTER_LABEL}={self.cluster_name},"
                    f"tpu-slice={slice_name}")

@@ -189,3 +189,77 @@ def test_actor_fails_loudly_without_runtime(tmp_path, monkeypatch,
     a = P.options(runtime_env={"image_uri": IMAGE}).remote()
     with pytest.raises(Exception, match="spawn failed|container"):
         ray_tpu.get(a.ping.remote(), timeout=90)
+
+
+# ---------------------------------------------------------------------------
+# containerized TPU actors: device grants + visibility env
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def tpu_container_cluster(tmp_path, monkeypatch, private_cluster_slot):
+    """Container cluster whose node advertises one (fake) TPU chip with
+    a fake device path — the shim records the exact runtime argv, which
+    is the assertion surface for device grants."""
+    log_file = tmp_path / "shim_calls.jsonl"
+    shim = _write_shim(tmp_path, log_file)
+    monkeypatch.setenv("RAY_TPU_CONTAINER_RUNTIME", shim)
+    monkeypatch.setenv("RAY_TPU_ALLOW_PKG_INSTALL", "1")
+    monkeypatch.setenv("RAY_TPU_NUM_CHIPS", "1")
+    monkeypatch.setenv("RAY_TPU_TPU_DEVICES", "/dev/null")
+    monkeypatch.delenv("TPU_VISIBLE_CHIPS", raising=False)
+    ray_tpu.init(num_cpus=2)
+    yield log_file
+
+
+def test_containerized_tpu_actor_gets_devices_and_env(
+        tpu_container_cluster):
+    """The round-4 'no device mounts' rejection is lifted: a TPU actor's
+    container gets --device grants for the host TPU nodes and the chip
+    visibility env forwarded (reference: image_uri.py device
+    propagation + tpu.py TPU_VISIBLE_CHIPS scoping)."""
+    log_file = tpu_container_cluster
+
+    @ray_tpu.remote
+    class TpuProbe:
+        def where(self):
+            return {"in_container": os.environ.get("RAY_TPU_IN_CONTAINER"),
+                    "visible": os.environ.get("TPU_VISIBLE_CHIPS")}
+
+    a = TpuProbe.options(
+        resources={"TPU": 1},
+        runtime_env={"container": {"image": IMAGE}}).remote()
+    got = ray_tpu.get(a.where.remote(), timeout=120)
+    assert got["in_container"] == "1"
+    # chip visibility rode the -e pairs into the worker
+    assert got["visible"] == "0"
+
+    argv = [json.loads(ln) for ln in open(log_file)][0]
+    assert "--device=/dev/null" in argv
+    assert "TPU_VISIBLE_CHIPS=0" in argv
+    ray_tpu.kill(a)
+
+
+def test_containerized_tpu_actor_rejected_without_devices(
+        tmp_path, monkeypatch, private_cluster_slot):
+    """Loud rejection remains ONLY when the host truly has no TPU
+    device path (no /dev nodes, no tunnel): JAX silently falling back
+    to CPU while holding the TPU lease is the guarded failure mode."""
+    log_file = tmp_path / "shim_calls.jsonl"
+    shim = _write_shim(tmp_path, log_file)
+    monkeypatch.setenv("RAY_TPU_CONTAINER_RUNTIME", shim)
+    monkeypatch.setenv("RAY_TPU_ALLOW_PKG_INSTALL", "1")
+    monkeypatch.setenv("RAY_TPU_NUM_CHIPS", "1")    # advertised...
+    monkeypatch.setenv("RAY_TPU_TPU_DEVICES", "")   # ...but no devices
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    class P:
+        def ping(self):
+            return 1
+
+    a = P.options(resources={"TPU": 1},
+                  runtime_env={"container": {"image": IMAGE}}).remote()
+    with pytest.raises(Exception, match="device|spawn failed"):
+        ray_tpu.get(a.ping.remote(), timeout=90)
