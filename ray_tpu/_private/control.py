@@ -1385,10 +1385,11 @@ class ControlServer:
             logger.critical(
                 "fenced: addr-file %s now names %s (a standby promoted "
                 "over us); stepping down", self._addr_file, cur)
-            try:
-                self.stop()
-            finally:
-                os._exit(3)
+            # immediate exit, no graceful stop: a fenced primary must
+            # not serve one more request, and a graceful stop races the
+            # blocking serve loop in main() returning 0 first (the WAL
+            # is crash-safe; the successor already owns the store)
+            os._exit(3)
 
     def _reschedule_unadopted(self, now: float):
         """Adoption window expired with no raylet claiming the live

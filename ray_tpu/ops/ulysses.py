@@ -61,10 +61,12 @@ def ulysses_attention_sharded(q, k, v, axis_name: str = "sp",
                               causal: bool = False,
                               scale: Optional[float] = None,
                               attn_fn: Optional[Callable] = None):
-    """Per-device body for use inside an existing shard_map program."""
-    from .attention import blockwise_attention
+    """Per-device body for use inside an existing shard_map program.
+    The inner attention goes through ops.attention's dispatch, so TPU
+    runs the Pallas flash kernels (same as the outer wrapper)."""
+    from .attention import attention as default_attn
 
-    inner = attn_fn or (lambda a, b, c: blockwise_attention(
+    inner = attn_fn or (lambda a, b, c: default_attn(
         a, b, c, causal=causal, scale=scale))
     qh = _seq_to_heads(q, axis_name)
     kh = _seq_to_heads(k, axis_name)
