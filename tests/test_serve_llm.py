@@ -100,3 +100,21 @@ def test_llm_http_endpoint_and_stream_route(serve_instance):
     assert [d["token"] for d in lines] == out["completion"]
     serve.delete("llm_http")
     serve.delete("llm_http-stream")
+
+
+def test_llm_compile_cache_is_bounded():
+    """Every jitted variant a replica builds (generate, prefill, stream
+    step, sampler) goes through one LRU-bounded cache — a long-lived
+    replica facing varied request shapes must not grow compile-cache
+    memory without limit."""
+    from ray_tpu.serve.llm import _LLMServerImpl
+
+    srv = _LLMServerImpl(preset="nano", max_seq=128)
+    cap = srv._gen_cache_cap
+    for i in range(cap * 3):
+        srv._gen_fn(max_new=4 + i, temperature=0.0, top_k=None,
+                    max_seq=128)
+        srv._stream_step_fn(0.5 + i, None, 128)
+    assert len(srv._gen_cache) <= cap
+    # LRU: the most recent entries survive
+    assert (4 + cap * 3 - 1, 0.0, None, 128) in srv._gen_cache
