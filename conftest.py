@@ -1,28 +1,71 @@
 """Root conftest: degrade gracefully when pytest-xdist is absent.
 
 pytest.ini's `addopts = -n 2 --dist loadfile` assumes the xdist plugin;
-without this hook a plain `pytest` in an xdist-less environment dies on
-"unrecognized arguments" instead of running serially.  Initial conftests
-load before option parsing, so the flags can be stripped here.
+without help a plain `pytest` in an xdist-less environment dies on
+"unrecognized arguments" instead of running serially.
+
+Two layers of defense:
+
+* ``pytest_addoption`` (the load-bearing one): rootdir conftests ARE
+  consulted for option registration, so when xdist is missing we
+  register `-n`/`--dist` as inert options and parsing succeeds — the
+  run simply executes serially.
+* ``pytest_load_initial_conftests`` arg-stripping: pytest does NOT call
+  this hook for conftest files (only for -p/entry-point plugins), so it
+  is inert under a plain `pytest` invocation; it is kept for harnesses
+  that load this module as a real plugin (`-p conftest`, pytest.main
+  with plugins=[...]), where early stripping also cleans `sys.argv`
+  echoes out of failure headers.
 """
+
+import re
+
+# joined numprocesses forms only: -n2, -n16, -nauto.  A bare
+# startswith("-n") would swallow any future -n-prefixed option.
+_XDIST_N = re.compile(r"^-n(\d+|auto)$")
+
+
+def _have_xdist() -> bool:
+    try:
+        import xdist  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def pytest_addoption(parser):
+    if _have_xdist():
+        return
+    group = parser.getgroup(
+        "xdist-fallback", "accepted-but-ignored xdist options "
+        "(pytest-xdist not installed; running serially)")
+    # _addoption: the public addoption() reserves lowercase short
+    # options for pytest itself; xdist registers -n the same way
+    group._addoption("-n", "--numprocesses", action="store", default=None,
+                     dest="_xdist_fallback_n",
+                     help="ignored: pytest-xdist is not installed")
+    group.addoption("--dist", action="store", default=None,
+                    dest="_xdist_fallback_dist",
+                    help="ignored: pytest-xdist is not installed")
+    group.addoption("--max-worker-restart", action="store", default=None,
+                    dest="_xdist_fallback_restart",
+                    help="ignored: pytest-xdist is not installed")
 
 
 def pytest_load_initial_conftests(early_config, parser, args):
-    try:
-        import xdist  # noqa: F401
+    if _have_xdist():
         return
-    except ImportError:
-        pass
     cleaned = []
     skip_next = False
     for a in args:
         if skip_next:
             skip_next = False
             continue
-        if a in ("-n", "--dist"):
+        if a in ("-n", "--dist", "--max-worker-restart"):
             skip_next = True
-        elif a.startswith(("-n", "--dist=")):
-            pass  # joined forms: -n2, --dist=loadfile
+        elif _XDIST_N.match(a) or a.startswith("--dist=") \
+                or a.startswith("--max-worker-restart="):
+            pass  # joined forms: -n2, -nauto, --dist=loadfile
         else:
             cleaned.append(a)
     args[:] = cleaned

@@ -158,17 +158,25 @@ class Cluster:
 
     def add_node(self, resources: Optional[Dict[str, float]] = None,
                  labels: Optional[Dict[str, str]] = None,
-                 wait: bool = True) -> NodeHandle:
+                 wait: bool = True,
+                 control_addr: Optional[Tuple[str, int]] = None,
+                 use_addr_file: bool = True) -> NodeHandle:
+        """control_addr/use_addr_file let partition tests route a raylet
+        through a fault-injection proxy (test_utils.SocketProxy): the
+        proxy address replaces the real control address, and the addr
+        file is withheld so reconnects can't re-home around the fault."""
         assert self.control_addr is not None, "start_control() first"
         self._n += 1
         nid = common.node_id()
         port = free_port()
         node_session = os.path.join(self.session_dir, f"node-{self._n}")
+        ctrl = tuple(control_addr) if control_addr else self.control_addr
         cmd = [sys.executable, "-m", "ray_tpu._private.node",
-               "--control", f"{self.control_addr[0]}:{self.control_addr[1]}",
+               "--control", f"{ctrl[0]}:{ctrl[1]}",
                "--host", "127.0.0.1", "--port", str(port),
-               "--node-id", nid, "--session-dir", node_session,
-               "--addr-file", self.control_addr_file]
+               "--node-id", nid, "--session-dir", node_session]
+        if use_addr_file:
+            cmd += ["--addr-file", self.control_addr_file]
         if resources is not None:
             cmd += ["--resources", json.dumps(resources)]
         env = {}

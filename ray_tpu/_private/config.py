@@ -37,6 +37,12 @@ CONFIG_DEFS: List[Tuple[str, type, Any, str]] = [
      "missed-heartbeat window before a node is declared dead"),
     ("control_reconnect_s", float, 20.0,
      "how long clients retry re-attaching to a restarted control plane"),
+    ("rpc_backoff_base_s", float, 0.05,
+     "initial delay of the jittered-exponential backoff used by RPC "
+     "reconnect/retry loops (raylet re-home, driver control rebuild, "
+     "idempotent lease replay)"),
+    ("rpc_backoff_cap_s", float, 2.0,
+     "ceiling of the jittered-exponential RPC reconnect/retry backoff"),
     ("restore_owner_grace_s", float, 60.0,
      "window for a driver job to re-register after a control restart "
      "before its restored non-detached actors are reaped"),

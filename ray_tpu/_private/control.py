@@ -60,6 +60,13 @@ class NodeRecord:
         self.labels = dict(labels or {})
         self.last_heartbeat = time.monotonic()
         self.state = ALIVE
+        #: bumped on every (re-)registration; h_disconnect ignores drops
+        #: of connections from superseded registrations
+        self.reg_epoch = 0
+        #: monotonic time of the last TCP drop observed while ALIVE.
+        #: A transient disconnect is NOT death — only the heartbeat
+        #: timeout (or an explicit unregister_node) declares that.
+        self.disconnected_at: Optional[float] = None
         #: last applied availability version (delta resource sync)
         self.avail_version = 0
         #: an optimistic reservation diverged this view from the
@@ -75,6 +82,11 @@ class NodeRecord:
             "available": common.denormalize_resources(self.available),
             "labels": self.labels,
             "state": self.state,
+            # observability for partition tolerance: how many times this
+            # node has (re-)registered, and whether its control link is
+            # currently down (disconnected but NOT dead)
+            "reg_epoch": self.reg_epoch,
+            "disconnected": self.disconnected_at is not None,
         }
 
 
@@ -238,6 +250,7 @@ class ControlServer:
         s.handle("kv_keys", self.h_kv_keys)
         s.handle("kv_exists", self.h_kv_exists)
         s.handle("register_node", self.h_register_node)
+        s.handle("unregister_node", self.h_unregister_node)
         s.handle("heartbeat", self.h_heartbeat)
         s.handle("get_nodes", self.h_get_nodes)
         s.handle("pick_node", self.h_pick_node)
@@ -471,19 +484,54 @@ class ControlServer:
     # -- nodes -------------------------------------------------------------
 
     def h_register_node(self, conn, p):
-        rec = NodeRecord(p["node_id"], p["addr"], normalize_resources(p["resources"]),
-                         p.get("labels"))
-        adopted, rejected = [], []
+        """Cold registration OR re-registration of a live node.
+
+        Re-registration — the control still holds a non-DEAD record for
+        this node_id (the raylet reconnected after a transient partition)
+        — is *resumed*: the record is refreshed in place, ALIVE actors
+        whose node_id matches are re-adopted idempotently (same worker,
+        same incarnation — nothing gets killed), and the reply carries
+        ``resumed=True`` plus ``assigned_bundles`` (the PG bundles this
+        control still places here) so the raylet preserves its PG state
+        and reconciles instead of tearing down.  Cold registration gets a
+        fresh record; only actors parked in the post-restart adoption
+        window can be claimed.
+        """
+        nid = p["node_id"]
+        adopted, rejected, lost = [], [], []
         with self.lock:
-            self.nodes[rec.node_id] = rec
+            prev = self.nodes.get(nid)
+            resumed = prev is not None and prev.state != DEAD
+            if resumed:
+                rec = prev
+                rec.addr = tuple(p["addr"])
+                rec.total = normalize_resources(p["resources"])
+                rec.labels = dict(p.get("labels") or {})
+                rec.last_heartbeat = time.monotonic()
+                rec.disconnected_at = None
+                # keep the availability view — the raylet's books
+                # survived with it; the next heartbeat resyncs truth
+                rec.needs_resync = True
+            else:
+                rec = NodeRecord(nid, p["addr"],
+                                 normalize_resources(p["resources"]),
+                                 p.get("labels"))
+                self.nodes[nid] = rec
+            rec.reg_epoch += 1
             if self.nsched is not None:
                 self.nsched.upsert_node(rec.node_id, rec.total)
+                if resumed:
+                    self.nsched.set_available(rec.node_id, rec.available)
             # a re-homing raylet reports actor workers that are still
-            # alive on it; records waiting in the adoption window resume
-            # in place — live incarnation, state preserved.  Anything
-            # else (already rescheduled elsewhere, reaped, unknown) is
-            # rejected and the raylet kills that worker.
+            # alive on it.  Adoptable: (a) records waiting in the
+            # post-restart adoption window, (b) on a resumed node, ALIVE
+            # records this control already places here — re-adopted
+            # idempotently.  Anything else (already rescheduled
+            # elsewhere, reaped, unknown) is rejected and the raylet
+            # kills that worker.
+            reported = set()
             for la in p.get("live_actors") or []:
+                reported.add(la["actor_id"])
                 a = self.actors.get(la["actor_id"])
                 if (a is not None and a.state == RESTARTING
                         and la["actor_id"] in self._adoptable):
@@ -494,17 +542,48 @@ class ControlServer:
                     a.incarnation = la.get("incarnation", a.incarnation)
                     self._adoptable.pop(la["actor_id"], None)
                     adopted.append(a)
+                elif (a is not None and a.state == ALIVE
+                        and a.node_id == nid
+                        and la.get("incarnation", a.incarnation)
+                            == a.incarnation):
+                    if la.get("worker_addr"):
+                        a.worker_addr = tuple(la["worker_addr"])
+                    adopted.append(a)
                 else:
                     rejected.append(la["actor_id"])
-        conn.meta["node_id"] = rec.node_id
-        logger.info("node %s registered at %s: %s", rec.node_id[:12], rec.addr, p["resources"])
+            if resumed:
+                # the inverse direction: actors this control believes
+                # are ALIVE here but the raylet no longer hosts died
+                # while we were partitioned — fail them now
+                lost = [a.actor_id for a in self.actors.values()
+                        if a.node_id == nid and a.state == ALIVE
+                        and a.actor_id not in reported]
+            # PG bundles this control still assigns to the node; the
+            # raylet releases anything beyond this set (a remove_pg
+            # whose release RPC was lost to the partition)
+            assigned = [[pgid, idx]
+                        for pgid, pg in self.pgs.items()
+                        if pg.state != DEAD
+                        for idx, bnid in pg.assignments.items()
+                        if bnid == nid]
+            conn.meta["node_id"] = rec.node_id
+            conn.meta["reg_epoch"] = rec.reg_epoch
+        logger.info("node %s %s at %s: %s", rec.node_id[:12],
+                    "re-registered (resumed)" if resumed else "registered",
+                    rec.addr, p["resources"])
         self.publish("node", {"event": "added", "node": rec.view()})
         for a in adopted:
             self._persist_actor(a)
             self.publish("actor", {"event": "update", "actor": a.view()})
             logger.info("adopted live actor %s on %s (incarnation %d)",
                         a.actor_id[:12], rec.node_id[:12], a.incarnation)
+        for aid in lost:
+            logger.warning("actor %s lost across re-registration of %s",
+                           aid[:12], nid[:12])
+            self._on_actor_failure(
+                aid, "actor worker lost across raylet re-registration")
         return {"ok": True, "cluster_start_time": self.start_time,
+                "resumed": resumed, "assigned_bundles": assigned,
                 "rejected_actors": rejected}
 
     def h_heartbeat(self, conn, p):
@@ -516,6 +595,7 @@ class ControlServer:
                 # raylet exits and is restarted by its process manager)
                 return {"ok": False, "reregister": True}
             rec.last_heartbeat = time.monotonic()
+            rec.disconnected_at = None
             if "available" in p:
                 # versioned delta sync (reference: ray_syncer.h:44-70):
                 # only snapshots newer than the last applied version
@@ -1468,16 +1548,44 @@ class ControlServer:
             for s in self.subs.values():
                 s.discard(conn)
         nid = conn.meta.get("node_id")
-        if nid:
-            with self.lock:
-                rec = self.nodes.get(nid)
-                if rec is not None and rec.state == ALIVE:
-                    rec.state = DEAD
-                    view = rec.view()
-                else:
-                    return
-            self.publish("node", {"event": "removed", "node": view})
-            self._on_node_death(nid)
+        if not nid:
+            return
+        with self.lock:
+            rec = self.nodes.get(nid)
+            # Partition tolerance: a dropped TCP connection is NOT node
+            # death.  The record stays ALIVE and its actors/bundles are
+            # untouched; only the heartbeat timeout (_health_loop,
+            # NODE_DEATH_TIMEOUT_S) or an explicit unregister_node
+            # declares death.  Drops of superseded connections (the
+            # raylet already re-registered over a fresh one) are ignored
+            # so a slow FIN can't mark a healthy node disconnected.
+            if rec is None or rec.state != ALIVE:
+                return
+            if conn.meta.get("reg_epoch") != rec.reg_epoch:
+                return
+            rec.disconnected_at = time.monotonic()
+            view = rec.view()
+        logger.warning(
+            "node %s connection dropped; keeping it ALIVE pending "
+            "heartbeat timeout (%.0fs)", nid[:12], NODE_DEATH_TIMEOUT_S)
+        self.publish("node", {"event": "disconnected", "node": view})
+
+    def h_unregister_node(self, conn, p):
+        """Graceful node departure (raylet shutdown / scale-down): death
+        is declared immediately.  The heartbeat-timeout grace exists for
+        *transient* faults — a deliberate exit must not strand its actors
+        for NODE_DEATH_TIMEOUT_S."""
+        nid = p["node_id"]
+        with self.lock:
+            rec = self.nodes.get(nid)
+            if rec is None or rec.state == DEAD:
+                return {"ok": True}
+            rec.state = DEAD
+            view = rec.view()
+        logger.info("node %s unregistered (graceful shutdown)", nid[:12])
+        self.publish("node", {"event": "removed", "node": view})
+        self._on_node_death(nid)
+        return {"ok": True}
 
     # -- state dump (state API source of truth) ---------------------------
 

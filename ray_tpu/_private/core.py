@@ -37,8 +37,9 @@ from .common import (INLINE_OBJECT_LIMIT, STREAMING_RETURNS, ActorDiedError,
                      GetTimeoutError, ObjectLostError, RayTpuError,
                      SerializedRef, TaskCancelledError, TaskError, TaskSpec,
                      WorkerCrashedError, normalize_resources)
-from .protocol import (Client, ConnectionLost, DaemonPool, Deferred,
-                       RpcError, Server, ServerConn)
+from .protocol import (IDEM_KEY, Backoff, Client, ConnectionLost,
+                       DaemonPool, Deferred, RpcError, Server, ServerConn,
+                       idem_token)
 from .shm_store import ShmObjectStore
 
 logger = logging.getLogger(__name__)
@@ -536,6 +537,7 @@ class CoreWorker:
         grace = _cfg().control_reconnect_s
         deadline = time.monotonic() + grace
         last: Optional[BaseException] = None
+        bo = Backoff(_cfg().rpc_backoff_base_s, _cfg().rpc_backoff_cap_s)
         addr_file = os.environ.get("RAY_TPU_CONTROL_ADDR_FILE")
         while time.monotonic() < deadline and not self._shutdown:
             # failover re-homing: a promoted standby publishes its
@@ -565,7 +567,9 @@ class CoreWorker:
                 return
             except Exception as e:
                 last = e
-                time.sleep(0.5)
+                # jittered exponential backoff: every driver and worker
+                # re-attaches at once after a control restart
+                bo.sleep(max_s=max(0.0, deadline - time.monotonic()))
         raise ConnectionLost(f"control plane unreachable: {last}")
 
     def _delete_loop(self):
@@ -1543,7 +1547,34 @@ class CoreWorker:
                                      if spec0 is not None else True)}
             if pg_id:
                 payload["bundle"] = (pg_id, bundle_index)
-            r = raylet_cli.call("request_lease", payload, timeout=120.0)
+            # Idempotency token: if the connection drops after the raylet
+            # granted the lease but before the reply lands, the blind
+            # retry below replays the SAME request and the raylet's replay
+            # cache answers with the original grant — a retry can never
+            # double-place a lease.
+            payload[IDEM_KEY] = idem_token()
+            lease_deadline = time.monotonic() + 120.0
+            bo = Backoff(_cfg().rpc_backoff_base_s,
+                         _cfg().rpc_backoff_cap_s)
+            while True:
+                try:
+                    r = raylet_cli.call(
+                        "request_lease", payload,
+                        timeout=max(1.0, lease_deadline - time.monotonic()))
+                    break
+                except (ConnectionLost, OSError) as lease_err:
+                    if self._shutdown or time.monotonic() >= lease_deadline:
+                        raise
+                    logger.warning("request_lease connection lost (%s); "
+                                   "replaying with idempotency token",
+                                   lease_err)
+                    bo.sleep(max_s=max(
+                        0.0, lease_deadline - time.monotonic()))
+                    if raylet_addr != self.raylet_addr:
+                        raylet_cli = self._remote_raylet_client(raylet_addr)
+                    elif self.raylet is not None \
+                            and not self.raylet.closed:
+                        raylet_cli = self.raylet
             if not (r and r.get("ok")):
                 if r and r.get("canceled"):
                     with self.lock:

@@ -1,0 +1,67 @@
+"""Version portability for jax APIs that moved between releases.
+
+The kernels and parallelism modules target the modern surface —
+``jax.shard_map`` (promoted to top-level in jax 0.6) with ``check_vma=``
+for the varying-manual-axes check and ``axis_names=`` for
+partial-manual regions.  Older runtimes (0.4.x) ship the same machinery
+as ``jax.experimental.shard_map.shard_map`` with a different spelling:
+``check_rep=`` for the (equivalent) replication check and ``auto=`` —
+the COMPLEMENT of ``axis_names`` over the mesh — for partial-manual.
+This shim translates so kernel code is written once, against the modern
+names.
+"""
+
+from typing import Optional
+
+import jax
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` (new in jax 0.6); on older runtimes the
+    classic spelling — a psum of 1 over the axis — constant-folds to the
+    same value inside the traced program."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+try:  # jax >= 0.6: top-level export
+    from jax import shard_map as _native_shard_map
+
+    def shard_map(f, *, mesh=None, in_specs, out_specs, check_vma=True,
+                  axis_names: Optional[frozenset] = None):
+        kw = {}
+        if mesh is not None:
+            kw["mesh"] = mesh
+        if axis_names is not None:
+            kw["axis_names"] = frozenset(axis_names)
+        return _native_shard_map(f, in_specs=in_specs, out_specs=out_specs,
+                                 check_vma=check_vma, **kw)
+
+except ImportError:  # jax 0.4.x: experimental module
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def _ambient_mesh():
+        # mesh=None means "the context mesh" on modern jax; the 0.4.x
+        # equivalent is the `with Mesh(...):` thread-local
+        from jax._src import mesh as _mesh_lib
+
+        m = _mesh_lib.thread_resources.env.physical_mesh
+        if m.empty:
+            raise ValueError(
+                "shard_map(mesh=None) needs an ambient mesh: wrap the "
+                "call in `with Mesh(...):` (this jax predates context-"
+                "mesh resolution)")
+        return m
+
+    def shard_map(f, *, mesh=None, in_specs, out_specs, check_vma=True,
+                  axis_names: Optional[frozenset] = None):
+        if mesh is None:
+            mesh = _ambient_mesh()
+        kw = {"check_rep": check_vma}
+        if axis_names is not None:
+            # partial-manual: modern names the MANUAL axes; 0.4.x names
+            # the AUTO (non-manual) remainder
+            kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _experimental_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                       out_specs=out_specs, **kw)

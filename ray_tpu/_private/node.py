@@ -28,7 +28,7 @@ from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
 from . import accelerators, common
 from .common import add, fits, normalize_resources, subtract
-from .protocol import Client, Deferred, Server, ServerConn
+from .protocol import Backoff, Client, Deferred, Server, ServerConn
 from .shm_store import ShmObjectStore
 
 logger = logging.getLogger(__name__)
@@ -268,7 +268,12 @@ class Raylet:
 
     def _reconnect_control(self, grace: float):
         try:
+            from .config import cfg
+
             deadline = time.monotonic() + grace
+            # jittered exponential backoff: a cluster of raylets re-homing
+            # after a control restart must not stampede it in lockstep
+            bo = Backoff(cfg().rpc_backoff_base_s, cfg().rpc_backoff_cap_s)
             logger.warning("control connection lost; retrying for %.0fs",
                            grace)
             while not self._stop.is_set() and time.monotonic() < deadline:
@@ -283,7 +288,7 @@ class Raylet:
                                  connect_timeout=2.0)
                     cli.call("ping", timeout=5.0)
                 except Exception:
-                    time.sleep(0.5)
+                    bo.sleep(max_s=max(0.0, deadline - time.monotonic()))
                     continue
                 connected_at = time.monotonic()
                 old, self.control = self.control, cli
@@ -305,13 +310,28 @@ class Raylet:
             self._reconnecting.release()
 
     def _rehome(self, if_stale_since: Optional[float] = None):
-        """Re-register after a control restart/failover WITHOUT wiping
-        actor workers: live non-PG actors are offered for adoption
-        (same incarnation, state preserved — the warm-standby promise);
-        the control rejects any it already rescheduled and those workers
-        are reaped.  PG-placed actors take the reschedule path with
-        their group (bundle reservations re-run 2-phase commit), same
-        as the round-4 restart semantics.
+        """Re-register after a control disconnect / restart / failover.
+
+        Registration happens FIRST, reporting EVERY live actor worker —
+        PG-placed ones included, tagged with their bundle.  The control's
+        reply says whether it still held our node record (``resumed``):
+
+        * resumed — transient disconnect (or failover to a standby that
+          restored us): NOTHING is torn down.  PG workers, self.bundles
+          and the availability books all survive; the only reconciliation
+          is releasing bundles the control no longer assigns here (a
+          remove_pg whose release RPC the partition ate) and reaping
+          workers of rejected actors.
+        * cold — the control lost our record (restart without
+          persistence) or declared us dead: the clean-slate semantics.
+          Live non-PG actors were offered for adoption (same incarnation,
+          state preserved — the warm-standby promise) and the control
+          rejected any it already rescheduled; PG-placed actors take the
+          reschedule path with their group (bundle reservations re-run
+          2-phase commit), so their workers are reaped and the bundle
+          books wiped.  Only bundle keys snapshotted BEFORE registration
+          are wiped — a bundle the control prepares concurrently with
+          the cleanup must survive it.
 
         if_stale_since: skip if a registration already landed at/after
         this time — a second rehome racing the first would find its
@@ -327,31 +347,12 @@ class Raylet:
                 live = [{"actor_id": r.actor_id,
                          "incarnation": r.incarnation,
                          "worker_addr": r.addr,
-                         "worker_id": r.worker_id}
+                         "worker_id": r.worker_id,
+                         "bundle": r.bundle_key}
                         for r in self.workers.values()
                         if r.actor_id is not None and r.state != "dead"
-                        and r.addr is not None and r.bundle_key is None]
-                pg_actor_workers = [
-                    r for r in self.workers.values()
-                    if r.actor_id is not None and r.state != "dead"
-                    and r.bundle_key is not None]
-                bundles = list(self.bundles.keys())
-            for rec in pg_actor_workers:
-                try:
-                    if rec.conn is not None:
-                        rec.conn.push("shutdown", {})
-                    self._kill_worker(rec)
-                except Exception:
-                    pass
-            with self.lock:
-                for key in bundles:
-                    self.bundles.pop(key, None)
-                self.available = dict(self.total)
-                for rec in self.workers.values():
-                    if rec.state != "dead" and rec.lease_resources:
-                        subtract(self.available, rec.lease_resources)
-                        if rec.blocked and rec.lent:
-                            add(self.available, rec.lent)
+                        and r.addr is not None]
+                bundles_before = list(self.bundles.keys())
             try:
                 resp = self.control.call("register_node", {
                     "node_id": self.node_id,
@@ -359,17 +360,60 @@ class Raylet:
                     "resources": common.denormalize_resources(self.total),
                     "labels": self.labels,
                     "live_actors": live,
-                }, timeout=30.0)
+                    "bundles": bundles_before,
+                }, timeout=30.0) or {}
                 self._registered_at = time.monotonic()
             except Exception:
                 logger.warning("re-registration failed; will retry on "
                                "next heartbeat")
                 return
-            rejected = set((resp or {}).get("rejected_actors") or ())
+            resumed = bool(resp.get("resumed"))
+            rejected = set(resp.get("rejected_actors") or ())
+            if resumed:
+                assigned = {tuple(k) for k in
+                            (resp.get("assigned_bundles") or ())}
+                stale = [k for k in bundles_before if k not in assigned]
+                if stale:
+                    logger.warning("releasing %d bundle(s) the control "
+                                   "dropped while we were disconnected: "
+                                   "%s", len(stale), stale)
+                for key in stale:
+                    self._release_bundle_local(key)
+                logger.info("re-registered with control (resumed): "
+                            "%d live actor(s) kept, %d rejected",
+                            len(live) - len(rejected), len(rejected))
+            else:
+                # clean slate: PG-placed workers reschedule with their
+                # group; their bundles re-run the 2-phase reservation
+                with self.lock:
+                    pg_actor_workers = [
+                        r for r in self.workers.values()
+                        if r.actor_id is not None and r.state != "dead"
+                        and r.bundle_key is not None]
+                for rec in pg_actor_workers:
+                    try:
+                        if rec.conn is not None:
+                            rec.conn.push("shutdown", {})
+                        self._kill_worker(rec)
+                    except Exception:
+                        pass
+                with self.lock:
+                    for key in bundles_before:
+                        self.bundles.pop(key, None)
+                    self.available = dict(self.total)
+                    for rec in self.workers.values():
+                        if rec.state != "dead" and rec.lease_resources:
+                            subtract(self.available, rec.lease_resources)
+                            if rec.blocked and rec.lent:
+                                add(self.available, rec.lent)
+                    # reservations prepared after the snapshot survive
+                    for b in self.bundles.values():
+                        subtract(self.available, b["resources"])
             if rejected:
                 with self.lock:
                     victims = [r for r in self.workers.values()
-                               if r.actor_id in rejected]
+                               if r.actor_id in rejected
+                               and r.state != "dead"]
                 for rec in victims:
                     logger.warning("control rejected adoption of actor "
                                    "%s; reaping its worker",
@@ -381,10 +425,39 @@ class Raylet:
                     except Exception:
                         pass
 
+    def _release_bundle_local(self, key: Tuple[str, int]):
+        """Release one PG bundle and reap workers placed on it — rehome
+        reconciliation for groups the control removed mid-partition."""
+        with self.lock:
+            victims = [r for r in self.workers.values()
+                       if r.bundle_key == key and r.state != "dead"]
+        for rec in victims:
+            try:
+                if rec.conn is not None:
+                    rec.conn.push("shutdown", {})
+                self._kill_worker(rec)
+            except Exception:
+                pass
+        with self.lock:
+            b = self.bundles.pop(key, None)
+            if b is not None:
+                add(self.available, b["resources"])
+
     def shutdown(self):
         if self._stop.is_set():
             return
         self._stop.set()
+        # graceful exit: tell the control immediately.  Death is otherwise
+        # only declared after the heartbeat timeout now that transient
+        # disconnects are tolerated — a deliberate exit must not leave its
+        # actors in limbo for that window.
+        cli = self.control
+        if cli is not None and not cli.closed:
+            try:
+                cli.call("unregister_node", {"node_id": self.node_id},
+                         timeout=2.0)
+            except Exception:
+                pass
         if getattr(self, "log_monitor", None) is not None:
             self.log_monitor.stop()
         with self.lock:
@@ -1303,6 +1376,10 @@ class Raylet:
                 "num_workers": len(self.workers),
                 "idle": len(self.idle),
                 "pending_leases": len(self.pending_leases),
+                "pid": os.getpid(),
+                "bundles": [{"pg_id": k[0], "index": k[1],
+                             "state": b["state"]}
+                            for k, b in self.bundles.items()],
             }
 
     # -- memory pressure ---------------------------------------------------
@@ -1421,6 +1498,22 @@ def main():
                resources=resources, session_dir=args.session_dir,
                node_id=node_id, labels=labels,
                control_addr_file=args.addr_file)
+
+    # SIGTERM (bootstrap remove_node / scale-down) exits gracefully so the
+    # control gets an immediate unregister_node instead of waiting out the
+    # heartbeat-timeout death window
+    import signal
+
+    def _term(_sig, _frm):
+        try:
+            r.shutdown()
+        finally:
+            os._exit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, _term)
+    except (OSError, ValueError):
+        pass
     r.start(block=True)
 
 

@@ -16,13 +16,16 @@ Wire format: 8-byte header (<II: payload length, flags) + pickled
 from __future__ import annotations
 
 import logging
+import os
 import pickle
+import random
 import selectors
 import socket
 import struct
 import threading
 import time
 import traceback
+from collections import OrderedDict
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -49,6 +52,50 @@ def _invoke(cb, value, exc) -> None:
 
 class ConnectionLost(Exception):
     """Peer went away before replying."""
+
+
+# Reserved payload key for idempotent requests: a caller stamps a dict
+# payload with a unique token and the Server records the first reply under
+# it, replaying the recording for duplicates.  This is what makes blind
+# retries after a reconnect safe — a re-sent request_lease whose original
+# reply was lost to the partition cannot place a second lease.
+IDEM_KEY = "_idem"
+
+
+def idem_token() -> str:
+    """Globally-unique idempotency token (96 random bits)."""
+    return os.urandom(12).hex()
+
+
+class Backoff:
+    """Jittered exponential backoff for reconnect/retry loops.
+
+    Attempt n sleeps uniform(d/2, d) with d = min(cap, base * 2**n): the
+    mean still doubles per attempt but a fleet of raylets re-homing after
+    a control restart decorrelates instead of stampeding in lockstep.
+    """
+
+    def __init__(self, base: float = 0.05, cap: float = 2.0,
+                 rng: Optional[random.Random] = None):
+        self.base = max(1e-4, float(base))
+        self.cap = max(self.base, float(cap))
+        self.attempt = 0
+        self._rng = rng or random.Random()
+
+    def next_delay(self) -> float:
+        d = min(self.cap, self.base * (2 ** min(self.attempt, 32)))
+        self.attempt += 1
+        return self._rng.uniform(d / 2, d)
+
+    def sleep(self, max_s: Optional[float] = None) -> float:
+        d = self.next_delay()
+        if max_s is not None:
+            d = max(0.0, min(d, max_s))
+        time.sleep(d)
+        return d
+
+    def reset(self) -> None:
+        self.attempt = 0
 
 
 def _dumps(obj: Any) -> bytes:
@@ -410,6 +457,128 @@ class Client:
                     logger.exception("push handler failed for %s", method)
 
 
+class ResilientClient:
+    """Self-healing RPC client: a Client that survives connection loss.
+
+    Three guarantees on top of the raw Client:
+
+    * reconnect with jittered exponential backoff (Backoff), re-resolving
+      the peer address via ``addr_source`` on every attempt so a failover
+      to a promoted standby is followed automatically;
+    * per-call deadlines: ``timeout`` bounds the WHOLE call — connect
+      time, reconnect retries and the in-flight wait all draw from one
+      budget;
+    * idempotent replay: ``call(..., idempotent=True)`` stamps the payload
+      with an IDEM_KEY token, so a blind retry after a reconnect is
+      answered from the server's replay cache instead of re-executing.
+
+    Non-idempotent calls never retry once the request may have been sent:
+    they surface ConnectionLost exactly like a plain Client.
+    """
+
+    def __init__(self, addr: Tuple[str, int], *,
+                 addr_source: Optional[Callable[[], Any]] = None,
+                 on_push: Optional[Callable[[str, Any], None]] = None,
+                 backoff_base_s: float = 0.05, backoff_cap_s: float = 2.0,
+                 seed: Optional[int] = None, name: str = ""):
+        self._addr = tuple(addr)
+        self._addr_source = addr_source
+        self._on_push = on_push
+        self.name = name
+        self._backoff_args = (backoff_base_s, backoff_cap_s)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._cli: Optional[Client] = None
+        self._closed = False
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return self._addr
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def _current_addr(self) -> Tuple[str, int]:
+        if self._addr_source is not None:
+            try:
+                a = self._addr_source()
+                if a:
+                    self._addr = tuple(a)
+            except Exception:
+                pass
+        return self._addr
+
+    def _ensure(self, deadline: float) -> Client:
+        with self._lock:
+            if self._closed:
+                raise ConnectionLost(f"{self.name or 'client'} closed")
+            cli = self._cli
+        if cli is not None and not cli.closed:
+            return cli
+        bo = Backoff(*self._backoff_args, rng=self._rng)
+        last: Optional[Exception] = None
+        while True:
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                raise ConnectionLost(
+                    f"{self.name or 'client'}: could not (re)connect to "
+                    f"{self._addr} before deadline: {last}")
+            try:
+                cli = Client(self._current_addr(), on_push=self._on_push,
+                             connect_timeout=min(2.0, max(0.1, budget)),
+                             name=f"{self.name}~resilient")
+            except Exception as e:
+                last = e
+                bo.sleep(max_s=max(0.0, deadline - time.monotonic()))
+                continue
+            with self._lock:
+                if self._closed:
+                    cli.close()
+                    raise ConnectionLost(f"{self.name or 'client'} closed")
+                old, self._cli = self._cli, cli
+            if old is not None and old is not cli:
+                old.close()
+            return cli
+
+    def call(self, method: str, payload: Any = None,
+             timeout: float = 30.0, idempotent: bool = False) -> Any:
+        deadline = time.monotonic() + timeout
+        if idempotent and isinstance(payload, dict) \
+                and IDEM_KEY not in payload:
+            payload = {**payload, IDEM_KEY: idem_token()}
+        bo = Backoff(*self._backoff_args, rng=self._rng)
+        while True:
+            cli = self._ensure(deadline)
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                raise ConnectionLost(
+                    f"deadline exceeded calling {method!r}")
+            try:
+                return cli.call(method, payload, timeout=budget)
+            except (ConnectionLost, OSError) as e:
+                # the request may or may not have executed; only a
+                # tokened (idempotent) call is safe to blind-retry
+                if not idempotent or self._closed:
+                    raise
+                if time.monotonic() >= deadline:
+                    raise ConnectionLost(
+                        f"deadline exceeded retrying {method!r}: {e}")
+                bo.sleep(max_s=max(0.0, deadline - time.monotonic()))
+
+    def notify(self, method: str, payload: Any = None,
+               timeout: float = 5.0) -> None:
+        cli = self._ensure(time.monotonic() + timeout)
+        cli.notify(method, payload)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            cli, self._cli = self._cli, None
+        if cli is not None:
+            cli.close()
+
+
 # ---------------------------------------------------------------------------
 # Server
 # ---------------------------------------------------------------------------
@@ -472,6 +641,39 @@ class Deferred:
             pass
 
 
+class _ReplayEntry:
+    """One recorded (or in-flight) idempotent execution (see IDEM_KEY)."""
+
+    __slots__ = ("done", "value", "is_error", "waiters")
+
+    def __init__(self):
+        self.done = False
+        self.value: Any = None
+        self.is_error = False
+        # (conn, msg_id) of duplicate callers parked until the first
+        # execution resolves — a retry can race the original in flight
+        self.waiters: list = []
+
+
+class _RecordingDeferred(Deferred):
+    """Deferred that records its outcome in the server's replay cache
+    (releasing parked duplicate callers) before replying."""
+
+    def __init__(self, server: "Server", token: str, conn: ServerConn,
+                 msg_id: int):
+        super().__init__(conn, msg_id)
+        self._server = server
+        self._token = token
+
+    def resolve(self, payload: Any = None) -> None:
+        self._server._replay_finish(self._token, payload)
+        super().resolve(payload)
+
+    def reject(self, err: str) -> None:
+        self._server._replay_fail(self._token, err)
+        super().reject(err)
+
+
 class Server:
     """Selector-based RPC server.
 
@@ -503,6 +705,12 @@ class Server:
         # Handlers run ON the loop thread, so a slow one stalls every
         # connection — these numbers find it.
         self._handler_stats: Dict[str, list] = {}
+        # Idempotency replay cache: token -> _ReplayEntry.  Bounded LRU;
+        # a duplicate of a still-running execution is parked, a duplicate
+        # of a finished one gets the recorded reply without re-executing.
+        self._replay: "OrderedDict[str, _ReplayEntry]" = OrderedDict()
+        self._replay_cap = 4096
+        self._replay_lock = threading.Lock()
         self.handle("rpc_stats", lambda c, p: self.stats())
 
     def handle(self, method: str, fn: Callable, deferred: bool = False) -> None:
@@ -615,12 +823,20 @@ class Server:
             conn.reply_error(msg_id, f"no handler for {method!r}")
             return
         fn, wants_deferred = entry
+        token = payload.get(IDEM_KEY) if isinstance(payload, dict) else None
+        if token is not None and msg_id != 0:
+            if self._replay_begin(conn, msg_id, token):
+                return  # duplicate: answered from the cache or parked
         t0 = time.perf_counter()
         try:
             if wants_deferred:
-                fn(conn, payload, Deferred(conn, msg_id))
+                d = (Deferred(conn, msg_id) if token is None
+                     else _RecordingDeferred(self, token, conn, msg_id))
+                fn(conn, payload, d)
             else:
                 result = fn(conn, payload)
+                if token is not None:
+                    self._replay_finish(token, result)
                 conn.reply(msg_id, result)
             dt = time.perf_counter() - t0
             st = self._handler_stats.get(method)
@@ -634,10 +850,71 @@ class Server:
         except Exception as e:
             tb = traceback.format_exc()
             logger.debug("%s: handler %s raised: %s", self.name, method, e)
+            err = f"{type(e).__name__}: {e}\n{tb}"
+            if token is not None:
+                self._replay_fail(token, err)
             try:
-                conn.reply_error(msg_id, f"{type(e).__name__}: {e}\n{tb}")
+                conn.reply_error(msg_id, err)
             except OSError:
                 self._drop(conn.sock)
+
+    # -- idempotency replay (see IDEM_KEY) ----------------------------------
+
+    def _replay_begin(self, conn: ServerConn, msg_id: int,
+                      token: str) -> bool:
+        """Returns True if this request was handled from the cache (the
+        caller must NOT execute the handler)."""
+        with self._replay_lock:
+            entry = self._replay.get(token)
+            if entry is None:
+                entry = _ReplayEntry()
+                self._replay[token] = entry
+                while len(self._replay) > self._replay_cap:
+                    old_tok, old = next(iter(self._replay.items()))
+                    if not old.done:
+                        break  # never evict an in-flight execution
+                    self._replay.pop(old_tok)
+                return False
+            self._replay.move_to_end(token)
+            if not entry.done:
+                entry.waiters.append((conn, msg_id))
+                return True
+            value, is_error = entry.value, entry.is_error
+        try:
+            if is_error:
+                conn.reply_error(msg_id, value)
+            else:
+                conn.reply(msg_id, value)
+        except OSError:
+            pass
+        return True
+
+    def _replay_finish(self, token: str, value: Any) -> None:
+        with self._replay_lock:
+            entry = self._replay.get(token)
+            if entry is None:
+                return
+            entry.done = True
+            entry.value = value
+            entry.is_error = False
+            waiters, entry.waiters = entry.waiters, []
+        for conn, msg_id in waiters:
+            try:
+                conn.reply(msg_id, value)
+            except OSError:
+                pass
+
+    def _replay_fail(self, token: str, err: str) -> None:
+        """A failed execution is NOT cached — the error may be transient
+        and a retry should re-execute; parked duplicates still get it."""
+        with self._replay_lock:
+            entry = self._replay.pop(token, None)
+            waiters = entry.waiters if entry is not None else []
+        for conn, msg_id in waiters:
+            try:
+                conn.reply_error(msg_id, err)
+            except OSError:
+                pass
 
     def _drop(self, sock: socket.socket) -> None:
         conn = self._conns.pop(sock, None)

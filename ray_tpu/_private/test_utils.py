@@ -14,9 +14,26 @@ from __future__ import annotations
 import os
 import random
 import signal
+import socket
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def resolve_chaos_seed(seed: Optional[int]) -> int:
+    """Chaos-run reproducibility: RAY_TPU_CHAOS_SEED overrides any seed
+    a test passed, so a failed chaos run can be replayed exactly; with no
+    env and no explicit seed, one is drawn and (like every injector seed)
+    printed at run() start so failures always name their seed."""
+    env = os.environ.get("RAY_TPU_CHAOS_SEED")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    if seed is None:
+        seed = random.Random().randrange(2 ** 31)
+    return int(seed)
 
 
 class KillerBase:
@@ -28,7 +45,8 @@ class KillerBase:
         self.kill_interval_s = kill_interval_s
         self.max_to_kill = max_to_kill
         self.killed: List[Dict[str, Any]] = []
-        self._rng = random.Random(seed)
+        self.seed = resolve_chaos_seed(seed)
+        self._rng = random.Random(self.seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -38,6 +56,9 @@ class KillerBase:
         """Start the kill loop (returns immediately; the loop runs on a
         thread so the actor stays responsive to stop()/get_total_killed)."""
         if self._thread is None:
+            print(f"[chaos] {type(self).__name__} seed={self.seed} "
+                  f"(rerun with RAY_TPU_CHAOS_SEED={self.seed})",
+                  flush=True)
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
         return True
@@ -179,6 +200,211 @@ def _pid_listening_on(port: int) -> Optional[int]:
         except OSError:
             continue
     return None
+
+
+# ---------------------------------------------------------------------------
+# Network fault injection (partitions, not process kills)
+# ---------------------------------------------------------------------------
+
+
+class SocketProxy:
+    """TCP forwarding proxy for network fault injection.
+
+    Sits between a client population and a real server: point the clients
+    at ``proxy.addr`` and the proxy relays byte streams to ``target``.
+    ``sever()`` drops every live link and refuses new ones — connects are
+    accepted then immediately closed, so peers observe a reset rather
+    than a hang — until ``resume()``; ``set_delay()`` adds per-chunk
+    forwarding latency.  This is how tests partition raylet<->control and
+    client<->control without touching the processes themselves.
+    """
+
+    def __init__(self, target: Tuple[str, int], host: str = "127.0.0.1"):
+        self.target = tuple(target)
+        self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind((host, 0))
+        self._listen.listen(64)
+        self.addr: Tuple[str, int] = self._listen.getsockname()
+        self._severed = threading.Event()
+        self._delay = 0.0
+        self._lock = threading.Lock()
+        self._links: set = set()
+        self._closed = False
+        self.drop_count = 0
+        threading.Thread(target=self._accept_loop, name="socket-proxy",
+                         daemon=True).start()
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                s, _ = self._listen.accept()
+            except OSError:
+                return
+            if self._closed or self._severed.is_set():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                continue
+            try:
+                up = socket.create_connection(self.target, timeout=5.0)
+            except OSError:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                continue
+            for sock in (s, up):
+                try:
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
+            with self._lock:
+                self._links.add(s)
+                self._links.add(up)
+            for src, dst in ((s, up), (up, s)):
+                threading.Thread(target=self._pump, args=(src, dst),
+                                 daemon=True).start()
+
+    def _pump(self, src, dst):
+        try:
+            while True:
+                data = src.recv(1 << 16)
+                if not data:
+                    break
+                if self._delay:
+                    time.sleep(self._delay)
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            with self._lock:
+                self._links.discard(src)
+                self._links.discard(dst)
+            for sock in (src, dst):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def sever(self):
+        """Open the partition: kill live links, refuse new ones."""
+        self._severed.set()
+        self.drop_count += 1
+        with self._lock:
+            links = list(self._links)
+            self._links.clear()
+        for sock in links:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def resume(self):
+        """Heal the partition: new connections forward again."""
+        self._severed.clear()
+
+    @property
+    def severed(self) -> bool:
+        return self._severed.is_set()
+
+    def set_delay(self, seconds: float):
+        self._delay = max(0.0, float(seconds))
+
+    def close(self):
+        self._closed = True
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+        self.sever()
+        self._severed.clear()
+
+
+class ConnectionDropper:
+    """Scoped connection drop over a SocketProxy: a context manager that
+    severs on enter and resumes on exit, plus a timed ``drop()`` for
+    fire-and-forget blips."""
+
+    def __init__(self, proxy: SocketProxy):
+        self.proxy = proxy
+
+    def __enter__(self) -> "ConnectionDropper":
+        self.proxy.sever()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.proxy.resume()
+        return False
+
+    def drop(self, duration_s: float) -> threading.Timer:
+        self.proxy.sever()
+        t = threading.Timer(duration_s, self.proxy.resume)
+        t.daemon = True
+        t.start()
+        return t
+
+
+class PartitionInjector:
+    """Flaps SocketProxy links on a seeded schedule — the network-fault
+    sibling of the killers (sever, hold, resume, repeat), with the same
+    run()/stop_run()/get_total_killed() surface so chaos tests drive
+    both kinds of injector identically.  Honors RAY_TPU_CHAOS_SEED."""
+
+    def __init__(self, proxies, interval_s: float = 1.0,
+                 drop_duration_s: float = 0.5, max_drops: int = 3,
+                 seed: Optional[int] = None, delay_s: float = 0.0):
+        if isinstance(proxies, SocketProxy):
+            proxies = [proxies]
+        self.proxies = list(proxies)
+        self.interval_s = interval_s
+        self.drop_duration_s = drop_duration_s
+        self.max_drops = max_drops
+        self.delay_s = delay_s
+        self.seed = resolve_chaos_seed(seed)
+        self._rng = random.Random(self.seed)
+        self.dropped: List[Dict[str, Any]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def run(self):
+        if self._thread is None:
+            print(f"[chaos] PartitionInjector seed={self.seed} "
+                  f"(rerun with RAY_TPU_CHAOS_SEED={self.seed})",
+                  flush=True)
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return True
+
+    def stop_run(self):
+        self._stop.set()
+        for p in self.proxies:
+            p.resume()  # never leave the cluster partitioned
+        return True
+
+    def get_total_killed(self) -> List[Dict[str, Any]]:
+        return list(self.dropped)
+
+    def _loop(self):
+        while not self._stop.is_set() \
+                and len(self.dropped) < self.max_drops:
+            # jittered schedule, fully determined by the seed
+            self._stop.wait(self._rng.uniform(0.5, 1.5) * self.interval_s)
+            if self._stop.is_set():
+                return
+            victim = self._rng.choice(self.proxies)
+            hold = self._rng.uniform(0.5, 1.5) * self.drop_duration_s
+            if self.delay_s:
+                victim.set_delay(self.delay_s)
+            victim.sever()
+            self._stop.wait(hold)
+            victim.resume()
+            victim.set_delay(0.0)
+            self.dropped.append({"kind": "partition",
+                                 "target": victim.target,
+                                 "held_s": round(hold, 3)})
 
 
 def get_and_run_killer(killer_cls, *, kill_interval_s: float = 2.0,

@@ -102,8 +102,11 @@ class WorkerMain:
     def _init_actor(self):
         err = None
         try:
-            blob = self.core.control.call("get_actor_spec",
-                                          {"actor_id": self.actor_id}, timeout=30.0)
+            # _control_call: a worker booting during a control-plane blip
+            # reconnects and retries instead of failing actor creation
+            blob = self.core._control_call("get_actor_spec",
+                                           {"actor_id": self.actor_id},
+                                           timeout=30.0)
             if blob is None:
                 raise RuntimeError("actor spec missing in control plane")
             spec = cloudpickle.loads(blob)
@@ -140,7 +143,7 @@ class WorkerMain:
             err = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
             logger.error("actor creation failed: %s", err)
         try:
-            self.core.control.call("actor_ready", {
+            self.core._control_call("actor_ready", {
                 "actor_id": self.actor_id,
                 "worker_addr": self.core.addr,
                 "incarnation": self.incarnation,
@@ -472,7 +475,7 @@ class WorkerMain:
                     # "restarted"), then exit.
                     d.resolve(self._store_reply(spec, None, t0))
                     try:
-                        self.core.control.call(
+                        self.core._control_call(
                             "kill_actor",
                             {"actor_id": spec.actor_id,
                              "no_restart": True}, timeout=10.0)

@@ -129,21 +129,37 @@ def _xla_stacked(g: GroupHandle, x: np.ndarray):
     import jax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    # The compiled backend identifies group member r with process r of
+    # the jax.distributed runtime: an xla group must be EXACTLY processes
+    # 0..world_size-1, with this process participating as its own
+    # process_index.  Anything else — runtime smaller than the group,
+    # this process outside the group's process range, or a renumbered /
+    # subset group — can't be expressed as a device mesh here and needs
+    # backend='kv'.
     if jax.process_count() < g.world_size:
         raise RuntimeError(
-            f"xla collective group needs a jax.distributed runtime "
-            f"spanning all {g.world_size} members; this process sees "
-            f"only {jax.process_count()} process(es) — initialize "
-            f"jax.distributed first (Train's JaxConfig(mode='spmd') "
-            f"does this), or use backend='kv'")
+            f"xla collective groups must be exactly processes "
+            f"0..world_size-1 of one jax.distributed runtime, but this "
+            f"group has world_size={g.world_size} while the runtime "
+            f"spans only {jax.process_count()} process(es) — initialize "
+            f"a large enough jax.distributed runtime first (Train's "
+            f"JaxConfig(mode='spmd') does this), or use backend='kv'")
+    if jax.process_index() >= g.world_size:
+        raise RuntimeError(
+            f"xla collective groups must be exactly processes "
+            f"0..world_size-1 of the jax.distributed runtime, but this "
+            f"process is process_index={jax.process_index()}, outside "
+            f"the group's range 0..{g.world_size - 1} — a subset group "
+            f"over other processes needs backend='kv'")
     if g.rank != jax.process_index():
         # the mesh maps member r to process r's first device; data for
         # another process's device is not addressable from here
         raise RuntimeError(
-            f"xla collective rank ({g.rank}) must equal "
-            f"jax.process_index() ({jax.process_index()}): the compiled "
-            f"backend identifies members with jax.distributed "
-            f"processes; renumbered or subset groups need backend='kv'")
+            f"xla collective groups must be exactly processes "
+            f"0..world_size-1 in process order: this process's rank "
+            f"({g.rank}) must equal its jax.process_index() "
+            f"({jax.process_index()}); renumbered groups need "
+            f"backend='kv'")
     first = {}
     for d in jax.devices():
         first.setdefault(d.process_index, d)

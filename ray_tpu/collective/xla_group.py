@@ -22,7 +22,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ray_tpu._private.jax_compat import shard_map
 
 
 def _axis(mesh: Mesh, axis_name: Optional[str]) -> str:

@@ -79,8 +79,8 @@ def _apply_stage(blocks: List[Block], stage: Dict) -> List[Block]:
                 if hasattr(res, "__next__") or (
                         hasattr(res, "__iter__")
                         and not isinstance(res, (dict, list, tuple))
-                        and type(res).__module__ not in ("numpy", "pandas",
-                                                         "pyarrow.lib")):
+                        and type(res).__module__.split(".")[0]
+                        not in ("numpy", "pandas", "pyarrow")):
                     for r in res:
                         builder.add_block(batch_to_block(r))
                 else:

@@ -226,7 +226,7 @@ def _attention_op(q, k, v, cfg: GPTConfig, mesh, allow_manual: bool = True):
     GSPMD partitioning there (exact, all-gathers KV over sp)."""
     if (allow_manual and mesh is not None and mesh.shape.get("sp", 1) > 1
             and cfg.sp_mode == "ring"):
-        from jax import shard_map
+        from ray_tpu._private.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         spec = P(None, None, "sp", None)

@@ -210,6 +210,18 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
 
     b, h, sq, d = q.shape
     sk = k.shape[-2]
+    if causal and sq != sk:
+        # the pallas kernels anchor the causal mask at row 0 (rows >=
+        # cols) while mha_reference anchors rectangular inputs bottom-
+        # right (tril with k=sk-sq, decode semantics: the last query row
+        # is position sk-1) — letting this through would silently
+        # diverge from the other impls
+        raise ValueError(
+            f"pallas flash attention does not support causal masking "
+            f"with sq ({sq}) != sk ({sk}): its mask is anchored at row "
+            f"0, while mha_reference/blockwise anchor rectangular "
+            f"inputs at sk-sq.  Use impl='xla' (blockwise_attention "
+            f"handles the query offset) or pad q to sk.")
     block_q = _fit_block(block_q, sq)
     block_k = _fit_block(block_k, sk)
     grid = (b, h, sq // block_q, sk // block_k)
@@ -509,6 +521,7 @@ def attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
     XLA scan wants small KV blocks (256 — deeper fusion per step), the
     pallas grid wants fat ones (512x1024 — fewer sequential programs).
     """
+    sq, sk = q.shape[-2], k.shape[-2]
     if impl == "auto":
         # v5e measurements (GPT-2-small training, tokens/s), with the
         # native FlashAttention-2 dq/dk/dv bwd kernels: pallas beats XLA
@@ -519,9 +532,11 @@ def attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
         # everywhere — that's why this dispatch was XLA-only through
         # round 4.)  XLA remains the portable path: CPU meshes, seqs not
         # a multiple of 128, and anything interpret-mode.
-        sq, sk = q.shape[-2], k.shape[-2]
+        # causal rectangular (sq != sk) routes to XLA: the pallas mask
+        # is row-0 anchored and would diverge from the reference
         if (jax.default_backend() == "tpu"
-                and sq % 128 == 0 and sk % 128 == 0):
+                and sq % 128 == 0 and sk % 128 == 0
+                and not (causal and sq != sk)):
             impl = "pallas"
         else:
             impl = "xla"
@@ -532,8 +547,11 @@ def attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
         return flash_attention(q, k, v, causal, scale, block_q or 512,
                                block_k or 1024, True)
     if impl == "xla":
+        # bottom-right-aligned causal mask for rectangular inputs,
+        # matching mha_reference's tril(k=sk-sq) decode semantics
         return blockwise_attention(q, k, v, causal=causal, scale=scale,
-                                   block_k=block_k or 256)
+                                   block_k=block_k or 256,
+                                   q_offset=(sk - sq) if causal else 0)
     if impl == "reference":
         return mha_reference(q, k, v, causal=causal, scale=scale)
     raise ValueError(f"unknown attention impl {impl!r}")

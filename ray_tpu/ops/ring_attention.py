@@ -21,7 +21,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ray_tpu._private.jax_compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .attention import (DEFAULT_MASK_VALUE, _block_stats_update,
@@ -38,7 +38,7 @@ def _ring_attention_local_pallas(q, k, v, axis_name: str, causal: bool,
     masked (KV strictly after Q) — so the offset-free flash kernels
     compose: each chunk call returns a per-chunk-normalized (o, lse)
     and steps combine in log space.  No offset-aware kernel needed."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     r = jax.lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
     scale_ = (d ** -0.5) if scale is None else scale
@@ -86,7 +86,7 @@ def _ring_attention_local_pallas(q, k, v, axis_name: str, causal: bool,
 def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
                           scale: Optional[float], block_k: int):
     """Runs inside shard_map: q,k,v are the local [B,H,S_loc,D] chunks."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     r = jax.lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
     scale_ = (d ** -0.5) if scale is None else scale

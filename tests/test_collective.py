@@ -133,3 +133,25 @@ def test_xla_collectives_cross_process(ray_cluster):
         assert o["gather"] == [0, 1]
         assert o["bcast"] == [42.0]
         assert o["p2p_raises"]
+
+
+def test_xla_group_membership_validation():
+    """The compiled backend identifies member r with jax.distributed
+    process r: groups that aren't exactly processes 0..world_size-1 must
+    fail with an error saying so (not a bare rank/process_index
+    mismatch).  Single-process jax exercises both rejection paths."""
+    from types import SimpleNamespace
+
+    import pytest
+
+    from ray_tpu.collective.collective import _xla_stacked
+
+    # runtime smaller than the group
+    g = SimpleNamespace(world_size=2, rank=0)
+    with pytest.raises(RuntimeError, match=r"0\.\.world_size-1"):
+        _xla_stacked(g, np.zeros(4))
+
+    # renumbered group: rank disagrees with process order
+    g = SimpleNamespace(world_size=1, rank=1)
+    with pytest.raises(RuntimeError, match=r"0\.\.world_size-1"):
+        _xla_stacked(g, np.zeros(4))

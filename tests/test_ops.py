@@ -50,6 +50,33 @@ def test_flash_block_fitting():
         flash_attention(q2, k2, v2, True, None, 512, 1024, True)
 
 
+def test_flash_causal_rectangular_raises():
+    """Pallas kernels anchor the causal mask at row 0; mha_reference
+    anchors rectangular inputs at sk-sq.  Causal sq != sk must raise in
+    the pallas path instead of silently diverging from the other impls."""
+    q, _, _ = _qkv(b=1, h=1, s=128, d=32)
+    k, v = _qkv(b=1, h=1, s=256, d=32, seed=1)[1:]
+    with pytest.raises(ValueError, match="sq"):
+        flash_attention(q, k, v, True, None, 64, 64, True)
+    # non-causal rectangular stays supported
+    out = flash_attention(q, k, v, False, None, 64, 64, True)
+    ref = mha_reference(q, k, v, causal=False)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_attention_causal_rectangular_routes_to_xla():
+    """The dispatcher must not hand causal rectangular inputs to pallas;
+    the xla path applies the bottom-right (decode-aligned) mask and
+    matches the reference."""
+    q, _, _ = _qkv(b=1, h=2, s=128, d=32)
+    k, v = _qkv(b=1, h=2, s=256, d=32, seed=1)[1:]
+    ref = mha_reference(q, k, v, causal=True)
+    out = attention(q, k, v, causal=True)  # auto -> xla on any backend
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    out_xla = attention(q, k, v, causal=True, impl="xla")
+    assert np.allclose(np.asarray(out_xla), np.asarray(ref), atol=2e-5)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_pallas_interpret_matches_reference(causal):
     # interpret mode runs the Pallas kernel on CPU — validates kernel logic

@@ -114,8 +114,9 @@ def test_multi_axis_collective():
             s = jax.lax.psum(shard, "dp")
             return jax.lax.psum(s, "tp")
 
-        return jax.shard_map(f, mesh=mesh, in_specs=P(("dp",), "tp"),
-                             out_specs=P(("dp",), "tp"))(v)
+        from ray_tpu._private.jax_compat import shard_map
+        return shard_map(f, mesh=mesh, in_specs=P(("dp",), "tp"),
+                         out_specs=P(("dp",), "tp"))(v)
 
     out = step(x)
     assert np.allclose(np.asarray(out), 8.0)
@@ -143,7 +144,7 @@ def test_multislice_mesh_layout():
 
     # a dp-psum over the multislice mesh compiles and runs
     import jax.numpy as jnp
-    from jax import shard_map
+    from ray_tpu._private.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def f(x):
