@@ -434,6 +434,97 @@ def _run_decode_subprocess(timeout_s: float, cpu: bool) -> dict:
          "BENCH_DECODE_PROMPT": "4", "BENCH_DECODE_ARCH": "nano"})
 
 
+def _run_collective_subprocess(timeout_s: float, cpu: bool) -> dict:
+    return _run_model_subprocess(
+        "--collective-only", timeout_s, cpu,
+        {"BENCH_COLLECTIVE_N": "131072", "BENCH_COLLECTIVE_ITERS": "3"})
+
+
+def bench_quantized_allreduce() -> dict:
+    """Quantized vs fp32 allreduce over the visible device mesh.
+
+    Measures the compressed-collectives subsystem end to end on the
+    compiled path: per-step time of the EQuARX-style two-phase int8
+    allreduce (block=256), wire bytes as a ratio of the fp32 baseline,
+    and the quantization error vs the exact fp32 reduction.  CPU runs
+    exercise the identical numerics via the XLA-fallback kernels."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_tpu.collective import xla_group
+    from ray_tpu.collective.compression import (CompressionConfig,
+                                                result_block_size,
+                                                wire_ratio)
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("dp",))
+    world = len(devs)
+    n_per_dev = int(os.environ.get("BENCH_COLLECTIVE_N", str(1 << 20)))
+    iters = int(os.environ.get("BENCH_COLLECTIVE_ITERS", "5"))
+    cc = CompressionConfig(min_size=0)
+
+    rng = np.random.default_rng(0)
+    g = rng.standard_normal((world, n_per_dev)).astype(np.float32)
+    arr = jax.device_put(jnp.asarray(g), NamedSharding(mesh, P("dp")))
+
+    def timed(fn):
+        fn().block_until_ready()            # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        out.block_until_ready()
+        return out, (time.perf_counter() - t0) / iters
+
+    full, dt_full = timed(
+        lambda: xla_group.mesh_allreduce(arr, mesh, "dp", op="mean"))
+    comp, dt_comp = timed(
+        lambda: xla_group.mesh_allreduce(arr, mesh, "dp", op="mean",
+                                         compression=cc))
+    fullh, comph = np.asarray(full), np.asarray(comp)
+    diff = np.abs(comph - fullh)
+    max_rel = float(diff.max() / (np.abs(fullh).max() + 1e-30))
+    l2_rel = float(np.linalg.norm(diff) / (np.linalg.norm(fullh) + 1e-30))
+
+    # wire accounting per synced element: contributions go out at
+    # block=256 int8+scales, the result comes back at the finer
+    # result-stage block — vs 4 bytes each way uncompressed
+    up = wire_ratio(n_per_dev, cc)
+    down = wire_ratio(
+        n_per_dev, CompressionConfig(
+            block_size=result_block_size(cc.block_size), min_size=0))
+    ratio = (up + down) / 2
+    return {
+        "wire_bytes_ratio": round(ratio, 4),
+        "gbps": round(g.nbytes / dt_comp / 1e9, 3),
+        "gbps_fp32": round(g.nbytes / dt_full / 1e9, 3),
+        "max_rel_err": round(max_rel, 5),
+        "l2_rel_err": round(l2_rel, 5),
+        "n_per_device": n_per_dev,
+        "world": world,
+        "block_size": cc.block_size,
+        "backend": jax.default_backend(),
+    }
+
+
+def _collective_only_main():
+    """Child-process entry: quantized-allreduce microbench; prints one
+    JSON line and records it in BENCH_COLLECTIVE.json."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    row = bench_quantized_allreduce()
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_COLLECTIVE.json")
+    with open(path, "w") as f:
+        json.dump({**row, "recorded_unix_time": int(time.time())}, f,
+                  indent=2)
+        f.write("\n")
+    print(json.dumps(row), flush=True)
+
+
 def bench_decode():
     """KV-cache decode steps/s (the serving hot loop): gpt2-small B=8,
     32-token prefill + 128 greedy decode inside one jit program, cache
@@ -650,6 +741,13 @@ def _extras_main():
     except Exception as e:
         put["put_bench_error"] = str(e)[:200]
     print(json.dumps(put), flush=True)
+
+    # compressed-collectives microbench: cheap, and the XLA-fallback
+    # numerics make the CPU retry a real measurement, not a mock
+    crow = _run_collective_subprocess(timeout_s=240.0, cpu=False)
+    if "error" in crow:
+        crow = _run_collective_subprocess(timeout_s=240.0, cpu=True)
+    print(json.dumps({"quantized_allreduce": crow}), flush=True)
 
     def run_real_models() -> dict:
         """GPT + ResNet on the live chip; returns which models landed.
@@ -1145,6 +1243,8 @@ if __name__ == "__main__":
         _resnet_only_main()
     elif "--decode-only" in sys.argv:
         _decode_only_main()
+    elif "--collective-only" in sys.argv:
+        _collective_only_main()
     elif "--extras-only" in sys.argv:
         _extras_main()
     elif "--table" in sys.argv:

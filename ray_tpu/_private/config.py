@@ -92,6 +92,12 @@ CONFIG_DEFS: List[Tuple[str, type, Any, str]] = [
      "max size of one runtime_env package"),
     ("allow_pkg_install", bool, False,
      "allow runtime_env pip/conda materialization"),
+    # -- collectives
+    ("collective_compression", str, "",
+     "default compression for collective ops: '' = off, or a spec like "
+     "'int8' / 'int8:block=512,stochastic=1,ef=0' (block-wise quantized "
+     "allreduce; see collective/compression.py).  Per-call compression= "
+     "and the Train backend's CompressionConfig override this"),
     # -- misc
     ("usage_stats_enabled", bool, True, "local usage tagging"),
     ("log_to_driver_batch_lines", int, 200,

@@ -1,6 +1,9 @@
 from .collective import (allgather, allreduce, barrier, broadcast,
                          destroy_collective_group, get_group_handle,
                          init_collective_group, recv, reducescatter, send)
+from .compression import (CompressionConfig, compress_array, decompress_array,
+                          parse_compression, resolve_compression,
+                          set_group_compression)
 from .xla_group import (mesh_allgather, mesh_allreduce, mesh_all_to_all,
                         mesh_broadcast, mesh_ppermute, mesh_reducescatter)
 
@@ -8,6 +11,8 @@ __all__ = [
     "init_collective_group", "destroy_collective_group", "get_group_handle",
     "allreduce", "allgather", "reducescatter", "broadcast", "barrier",
     "send", "recv",
+    "CompressionConfig", "parse_compression", "resolve_compression",
+    "set_group_compression", "compress_array", "decompress_array",
     "mesh_allreduce", "mesh_allgather", "mesh_reducescatter",
     "mesh_broadcast", "mesh_ppermute", "mesh_all_to_all",
 ]

@@ -1,0 +1,204 @@
+"""Compression config + host wire codec for compressed collectives.
+
+Two consumers share this module:
+
+  * The kv backend in `collective/collective.py` ships gradients through
+    the control plane as pickled payloads; the codec here turns an f32
+    ndarray into (int8 values, f32 per-block scales) — ~0.25x the wire
+    bytes at block=256 — using ONLY numpy, so importing it never drags
+    jax into the control-plane path.
+  * The xla backend and `parallel/sharding.py` consume `CompressionConfig`
+    (and its spec-string round-trip) to parameterize the in-graph
+    quantized collectives in `xla_group.py` / `ops/quantize.py`.
+
+The numerics here mirror `ops/quantize.py` bit-for-bit for deterministic
+rounding (same absmax/127 scale, numpy's round half-to-even matches
+jnp.round), which is what lets error feedback recompute the compression
+residual on the host without a second wire round trip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple, Union
+
+import numpy as np
+
+INT8_MAX = 127.0
+
+_TRUE = ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass(frozen=True)
+class CompressionConfig:
+    """How a collective compresses payloads.
+
+    dtype: quantized wire dtype — only "int8" today.
+    block_size: elements per scale block; smaller = lower error, more
+        scale overhead (wire ratio ~= 1/4 + 1/block_size at int8).
+    stochastic: unbiased stochastic rounding instead of round-to-even.
+        Useful without error feedback; with EF, deterministic rounding
+        lets the residual be recomputed exactly.
+    error_feedback: accumulate the per-parameter compression residual
+        and re-inject it next step (keeps compressed SGD convergent).
+        Consumed by `parallel/sharding.GradientSynchronizer`, not by the
+        one-shot collective calls.
+    min_size: arrays with fewer elements ship uncompressed (scale
+        overhead would beat the savings).
+    """
+
+    dtype: str = "int8"
+    block_size: int = 256
+    stochastic: bool = False
+    error_feedback: bool = True
+    min_size: int = 1024
+
+    def __post_init__(self):
+        if self.dtype != "int8":
+            raise ValueError(
+                f"unsupported compression dtype {self.dtype!r}; only 'int8'")
+        if self.block_size <= 0:
+            raise ValueError(
+                f"block_size must be positive, got {self.block_size}")
+
+    def to_spec(self) -> str:
+        """Inverse of parse_compression — env-var/CLI-safe string."""
+        return (f"{self.dtype}:block={self.block_size}"
+                f",stochastic={int(self.stochastic)}"
+                f",ef={int(self.error_feedback)}"
+                f",min={self.min_size}")
+
+
+def result_block_size(block_size: int) -> int:
+    """Block size for the second (result) quantization of a two-phase
+    allreduce.  The reduced value is quantized exactly once on its way
+    back, so finer per-block scales there buy error margin almost for
+    free: at block=256 contributions, a block/8 result stage moves
+    ~0.27x the baseline wire bytes total while cutting the result-stage
+    error by ~35% (two equal int8 stages sit right AT the 1e-2 line;
+    this keeps the end-to-end error near 0.009 with margin)."""
+    return max(16, block_size // 8)
+
+
+def parse_compression(
+    spec: Union[None, str, CompressionConfig]) -> Optional[CompressionConfig]:
+    """Parse "int8" / "int8:block=512,stochastic=1,ef=0,min=0" (or pass
+    through a config / None).  Empty string means off."""
+    if spec is None or isinstance(spec, CompressionConfig):
+        return spec
+    spec = spec.strip()
+    if not spec or spec.lower() in ("none", "off", "0", "false"):
+        return None
+    dtype, _, rest = spec.partition(":")
+    kw: Dict[str, object] = {"dtype": dtype.strip()}
+    if rest:
+        for item in rest.split(","):
+            k, sep, v = item.partition("=")
+            if not sep:
+                raise ValueError(f"bad compression spec item {item!r} "
+                                 f"in {spec!r} (want key=value)")
+            k, v = k.strip(), v.strip()
+            if k == "block":
+                kw["block_size"] = int(v)
+            elif k == "stochastic":
+                kw["stochastic"] = v.lower() in _TRUE
+            elif k == "ef":
+                kw["error_feedback"] = v.lower() in _TRUE
+            elif k == "min":
+                kw["min_size"] = int(v)
+            else:
+                raise ValueError(f"unknown compression spec key {k!r} in "
+                                 f"{spec!r} (known: block, stochastic, ef, "
+                                 f"min)")
+    return CompressionConfig(**kw)  # type: ignore[arg-type]
+
+
+# Per-process group default, installed by the Train backend so workers
+# compress without threading a config through every allreduce call.
+_group_default: Optional[CompressionConfig] = None
+
+
+def set_group_compression(
+        spec: Union[None, str, CompressionConfig]) -> Optional[CompressionConfig]:
+    global _group_default
+    _group_default = parse_compression(spec)
+    return _group_default
+
+
+def resolve_compression(
+    spec: Union[None, str, CompressionConfig] = None,
+    *, use_default: bool = True) -> Optional[CompressionConfig]:
+    """Precedence: explicit arg > group default > RAY_TPU_COLLECTIVE_COMPRESSION
+    flag.  Explicit "off"/"" disables even when a default is installed."""
+    if spec is not None:
+        return parse_compression(spec)
+    if not use_default:
+        return None
+    if _group_default is not None:
+        return _group_default
+    from ray_tpu._private.config import cfg
+    return parse_compression(cfg().collective_compression)
+
+
+# ---------------------------------------------------------------------------
+# Host wire codec (numpy; kv backend + error-feedback residuals)
+# ---------------------------------------------------------------------------
+
+
+def _host_blocks(x: np.ndarray, block_size: int) -> np.ndarray:
+    flat = np.asarray(x, dtype=np.float32).reshape(-1)
+    pad = (-flat.size) % block_size
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    return flat.reshape(-1, block_size)
+
+
+def compress_array(x: np.ndarray, config: CompressionConfig,
+                   rng: Optional[np.random.Generator] = None) -> dict:
+    """ndarray -> wire payload dict (pickles to ~0.25x the f32 bytes).
+
+    Payload keys: v (int8 [npad]), s (f32 [nblocks]), shape, dtype (str),
+    block.  `rng` drives stochastic rounding when config.stochastic.
+    """
+    x = np.asarray(x)
+    blocks = _host_blocks(x, config.block_size)
+    absmax = np.max(np.abs(blocks), axis=-1, keepdims=True)
+    scales = np.where(absmax > 0, absmax / INT8_MAX, 1.0).astype(np.float32)
+    y = blocks / scales
+    if config.stochastic:
+        rng = rng or np.random.default_rng(0)
+        y = np.floor(y + rng.random(y.shape, dtype=np.float32))
+    else:
+        y = np.round(y)  # numpy rounds half-to-even, matching jnp.round
+    q = np.clip(y, -INT8_MAX, INT8_MAX).astype(np.int8)
+    return {"v": q.reshape(-1), "s": scales[:, 0], "shape": x.shape,
+            "dtype": str(x.dtype), "block": config.block_size}
+
+
+def decompress_array(payload: dict) -> np.ndarray:
+    q = payload["v"].reshape(-1, payload["block"]).astype(np.float32)
+    out = q * payload["s"][:, None]
+    n = int(np.prod(payload["shape"])) if payload["shape"] else 1
+    return out.reshape(-1)[:n].reshape(payload["shape"]).astype(
+        payload["dtype"])
+
+
+def compression_residual(x: np.ndarray, config: CompressionConfig) -> np.ndarray:
+    """x - decompress(compress(x)) with deterministic rounding — the error
+    that error feedback carries to the next step."""
+    det = dataclasses.replace(config, stochastic=False)
+    return np.asarray(x, np.float32) - decompress_array(
+        compress_array(x, det)).astype(np.float32)
+
+
+def wire_bytes(payload: dict) -> int:
+    return payload["v"].nbytes + payload["s"].nbytes
+
+
+def wire_ratio(n_elements: int, config: CompressionConfig,
+               baseline_itemsize: int = 4) -> float:
+    """Compressed wire bytes / uncompressed, for n f32 elements."""
+    block = config.block_size
+    npad = n_elements + (-n_elements) % block
+    compressed = npad * 1 + (npad // block) * 4
+    return compressed / float(n_elements * baseline_itemsize)

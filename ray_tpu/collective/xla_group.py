@@ -17,12 +17,18 @@ arrays over the local mesh.  The multi-process story is the Train backend
 from __future__ import annotations
 
 import functools
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ray_tpu._private.jax_compat import shard_map
+
+from ray_tpu.collective.compression import (CompressionConfig,
+                                            parse_compression,
+                                            result_block_size)
+from ray_tpu.ops.quantize import (dequantize_blockwise, padded_len,
+                                  quantize_blockwise)
 
 
 def _axis(mesh: Mesh, axis_name: Optional[str]) -> str:
@@ -53,14 +59,151 @@ def _allreduce_impl(x, mesh: Mesh, axis: str, op: str):
 
 
 def mesh_allreduce(x, mesh: Mesh, axis_name: Optional[str] = None,
-                   op: str = "sum"):
+                   op: str = "sum",
+                   compression: Union[None, str, CompressionConfig] = None,
+                   seed: int = 0):
     """Allreduce a leading-axis-sharded array across a mesh axis.
 
     x has a per-device leading chunk layout [n_dev * k, ...]; each device's
     chunk is reduced with its peers' — the allreduce of the NCCL API, but
-    compiled (reference API: collective.py:258 allreduce)."""
+    compiled (reference API: collective.py:258 allreduce).
+
+    compression: a CompressionConfig / spec string ("int8", "int8:block=512")
+    switches to the EQuARX-style two-phase quantized path: blockwise int8
+    quantize → all_to_all (the reduce-scatter phase) → dequantize+reduce →
+    requantize → all_gather → dequantize once per block.  Wire traffic
+    drops ~4x; result carries quantization error (sum/mean only).  `seed`
+    feeds stochastic rounding when the config asks for it."""
     axis = _axis(mesh, axis_name)
-    return _allreduce_impl(x, mesh, axis, op)
+    cc = parse_compression(compression)
+    if cc is None:
+        return _allreduce_impl(x, mesh, axis, op)
+    if op not in ("sum", "mean"):
+        raise ValueError(f"compressed allreduce supports op in "
+                         f"('sum', 'mean'), got {op!r}")
+    return _q_allreduce_impl(x, jnp.int32(seed), mesh, axis, op,
+                             cc.block_size, cc.stochastic)
+
+
+# ---------------------------------------------------------------------------
+# Quantized (EQuARX-style) variants.  Same shard_map in/out contracts as the
+# full-precision impls above; inside the body the payload moves between
+# devices as int8 blocks + f32 per-block scales (ops/quantize.py layout).
+# ---------------------------------------------------------------------------
+
+
+def _fold_key(seed, axis: str, stochastic: bool):
+    if not stochastic:
+        return None
+    return jax.random.fold_in(jax.random.PRNGKey(seed),
+                              jax.lax.axis_index(axis))
+
+
+def _dequant_rows(q, s, world: int, block: int):
+    # q [world, nblk*block] int8, s [world, nblk] -> f32 [world, nblk, block]
+    return q.reshape(world, -1, block).astype(jnp.float32) * s[:, :, None]
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "op", "block",
+                                             "stochastic"))
+def _q_allreduce_impl(x, seed, mesh: Mesh, axis: str, op: str, block: int,
+                      stochastic: bool):
+    world = mesh.shape[axis]
+    spec = P(axis)
+
+    def f(shard, seed_):
+        shape, dtype = shard.shape, shard.dtype
+        flat = shard.reshape(-1).astype(jnp.float32)
+        n = flat.shape[0]
+        total = padded_len(n, world * block)
+        if total != n:
+            flat = jnp.pad(flat, (0, total - n))
+        sub = total // world
+        nblk = sub // block
+        idx = jax.lax.axis_index(axis)
+        key = _fold_key(seed_, axis, stochastic)
+        q, s = quantize_blockwise(flat.reshape(world, sub), block,
+                                  stochastic=stochastic, key=key,
+                                  seed=seed_ * world + idx)
+        # phase 1 (reduce-scatter): all_to_all hands device i every peer's
+        # sub-chunk i, still in int8
+        qx = jax.lax.all_to_all(q.reshape(world, sub), axis, split_axis=0,
+                                concat_axis=0, tiled=True)
+        sx = jax.lax.all_to_all(s.reshape(world, nblk), axis, split_axis=0,
+                                concat_axis=0, tiled=True)
+        red = _dequant_rows(qx, sx, world, block).sum(axis=0).reshape(sub)
+        if op == "mean":
+            red = red / world
+        # phase 2 (allgather): requantize the reduced chunk this device
+        # owns — with a finer result block, the only quantization the
+        # receivers see (see compression.result_block_size)
+        rblock = result_block_size(block)
+        key2 = jax.random.fold_in(key, world) if stochastic else None
+        q2, s2 = quantize_blockwise(red, rblock, stochastic=stochastic,
+                                    key=key2, seed=seed_ * world + idx + 1)
+        qg = jax.lax.all_gather(q2, axis, tiled=True)
+        sg = jax.lax.all_gather(s2, axis, tiled=True)
+        # per-device chunks may carry rblock padding; dequantize row-wise
+        # and strip it before restitching the flat stream
+        out = _dequant_rows(qg.reshape(world, -1), sg.reshape(world, -1),
+                            world, rblock)
+        out = out.reshape(world, -1)[:, :sub]
+        return out.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+    return shard_map(f, check_vma=False, mesh=mesh, in_specs=(spec, P()),
+                     out_specs=spec)(x, seed)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "block",
+                                             "stochastic"))
+def _q_reducescatter_impl(x, seed, mesh: Mesh, axis: str, block: int,
+                          stochastic: bool):
+    world = mesh.shape[axis]
+
+    def f(shard, seed_):
+        row = shard[0].astype(jnp.float32)      # this device's [N] contribution
+        sub = row.shape[0] // world
+        sub_pad = padded_len(sub, block)
+        chunks = row.reshape(world, sub)
+        if sub_pad != sub:
+            chunks = jnp.pad(chunks, ((0, 0), (0, sub_pad - sub)))
+        idx = jax.lax.axis_index(axis)
+        key = _fold_key(seed_, axis, stochastic)
+        q, s = quantize_blockwise(chunks, block, stochastic=stochastic,
+                                  key=key, seed=seed_ * world + idx)
+        qx = jax.lax.all_to_all(q.reshape(world, sub_pad), axis, split_axis=0,
+                                concat_axis=0, tiled=True)
+        sx = jax.lax.all_to_all(s.reshape(world, sub_pad // block), axis,
+                                split_axis=0, concat_axis=0, tiled=True)
+        red = _dequant_rows(qx, sx, world, block).sum(axis=0).reshape(sub_pad)
+        return red[:sub][None].astype(shard.dtype)
+
+    return shard_map(f, check_vma=False, mesh=mesh, in_specs=(P(axis), P()),
+                     out_specs=P(axis))(x, seed)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "block",
+                                             "stochastic"))
+def _q_allgather_impl(x, seed, mesh: Mesh, axis: str, block: int,
+                      stochastic: bool):
+    world = mesh.shape[axis]
+
+    def f(shard, seed_):
+        flat = shard.reshape(-1).astype(jnp.float32)
+        n = flat.shape[0]
+        npad = padded_len(n, block)
+        idx = jax.lax.axis_index(axis)
+        key = _fold_key(seed_, axis, stochastic)
+        q, s = quantize_blockwise(flat, block, stochastic=stochastic,
+                                  key=key, seed=seed_ * world + idx)
+        qg = jax.lax.all_gather(q, axis, tiled=True).reshape(world, npad)
+        sg = jax.lax.all_gather(s, axis, tiled=True).reshape(world, -1)
+        out = _dequant_rows(qg, sg, world, block).reshape(world, npad)[:, :n]
+        return out.reshape((world * shard.shape[0],)
+                           + shard.shape[1:]).astype(shard.dtype)
+
+    return shard_map(f, check_vma=False, mesh=mesh, in_specs=(P(axis), P()),
+                     out_specs=P())(x, seed)
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis", "tiled"))
@@ -71,11 +214,19 @@ def _allgather_impl(x, mesh: Mesh, axis: str, tiled: bool):
     return shard_map(f, check_vma=False, mesh=mesh, in_specs=P(axis), out_specs=P())(x)
 
 
-def mesh_allgather(x, mesh: Mesh, axis_name: Optional[str] = None):
+def mesh_allgather(x, mesh: Mesh, axis_name: Optional[str] = None,
+                   compression: Union[None, str, CompressionConfig] = None,
+                   seed: int = 0):
     """Each device contributes its shard; all get the concatenation
-    (reference API: collective.py:423 allgather)."""
+    (reference API: collective.py:423 allgather).  With `compression`,
+    shards travel as int8 blocks + scales and are dequantized on arrival
+    (lossy; see compression.py)."""
     axis = _axis(mesh, axis_name)
-    return _allgather_impl(x, mesh, axis, True)
+    cc = parse_compression(compression)
+    if cc is None:
+        return _allgather_impl(x, mesh, axis, True)
+    return _q_allgather_impl(x, jnp.int32(seed), mesh, axis, cc.block_size,
+                             cc.stochastic)
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis"))
@@ -91,13 +242,25 @@ def _reducescatter_impl(x, mesh: Mesh, axis: str):
                      out_specs=P(axis))(x)
 
 
-def mesh_reducescatter(x, mesh: Mesh, axis_name: Optional[str] = None):
+def mesh_reducescatter(x, mesh: Mesh, axis_name: Optional[str] = None,
+                       compression: Union[None, str, CompressionConfig] = None,
+                       seed: int = 0):
     """Reduce across the axis, leave each device its scattered chunk
     (reference API: collective.py:472 reducescatter).  Input is the stacked
     per-device contributions [world, N]; output [world, N/world] where row r
-    is the reduced chunk owned by device r."""
+    is the reduced chunk owned by device r.  With `compression`,
+    contributions travel as int8 blocks + scales (sum semantics, lossy)."""
     axis = _axis(mesh, axis_name)
-    return _reducescatter_impl(x, mesh, axis)
+    cc = parse_compression(compression)
+    if cc is None:
+        return _reducescatter_impl(x, mesh, axis)
+    world = mesh.shape[axis]
+    if x.shape[-1] % world:
+        raise ValueError(f"compressed reducescatter needs the payload dim "
+                         f"({x.shape[-1]}) divisible by the axis size "
+                         f"({world})")
+    return _q_reducescatter_impl(x, jnp.int32(seed), mesh, axis,
+                                 cc.block_size, cc.stochastic)
 
 
 def mesh_broadcast(x, mesh: Mesh, axis_name: Optional[str] = None,

@@ -3,10 +3,13 @@ from .attention import (attention, blockwise_attention, flash_attention,
 from .layers import (apply_rope, fused_softmax_cross_entropy, gelu_mlp,
                      layer_norm, rms_norm, rope_table,
                      softmax_cross_entropy, swiglu)
+from .quantize import (dequantize_blockwise, quantization_error,
+                       quantize_blockwise)
 from .ring_attention import ring_attention, ring_attention_sharded
 from .ulysses import ulysses_attention, ulysses_attention_sharded
 
 __all__ = [
+    "quantize_blockwise", "dequantize_blockwise", "quantization_error",
     "attention", "flash_attention", "flash_attention_with_lse",
     "blockwise_attention", "mha_reference",
     "ring_attention", "ring_attention_sharded",

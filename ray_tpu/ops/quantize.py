@@ -1,0 +1,242 @@
+"""Block-wise int8 quantization: the compressed-collective building block.
+
+EQuARX ("Efficient Quantized AllReduce in XLA", PAPERS.md) recovers ~2x
+allreduce speedups by moving gradients as int8 blocks with per-block
+scales instead of f32.  This module provides the quantize/dequantize
+primitives that `collective/compression.py` and the quantized
+`collective/xla_group.py` collectives compose:
+
+  * Pallas TPU kernels — per-block absmax reduction, scale, round (round
+    half-to-even, or stochastic via the on-core PRNG) fused in VMEM, so
+    the quantize never round-trips HBM per block.
+  * An XLA-lowered fallback with IDENTICAL numerics (same rounding mode,
+    same scale formula), so CPU meshes and tier-1 tests exercise the
+    real arithmetic, not a mock.
+
+Layout contract (shared with the collectives): an array is flattened,
+zero-padded to a multiple of `block_size`, and viewed as
+[nblocks, block_size]; block b covers flat elements
+[b*block_size, (b+1)*block_size).  scales[b] = absmax(block b)/127 (1.0
+for an all-zero block), values are the clipped rounded ratios in int8.
+Zero padding quantizes to exact zeros, so the trailing remainder of a
+non-multiple array survives a round trip untouched.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+INT8_MAX = 127.0
+
+
+def padded_len(n: int, block_size: int) -> int:
+    """Smallest multiple of block_size >= n."""
+    return n + (-n) % block_size
+
+
+def num_blocks(n: int, block_size: int) -> int:
+    return padded_len(n, block_size) // block_size
+
+
+def _as_blocks(x, block_size: int):
+    """Flatten + zero-pad to [nblocks, block_size] f32."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    pad = padded_len(n, block_size) - n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(-1, block_size)
+
+
+def _block_scales(blocks):
+    absmax = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
+    return jnp.where(absmax > 0, absmax / INT8_MAX, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# XLA fallback (CPU/TPU, in-jit traceable — the tier-1 numerics path)
+# ---------------------------------------------------------------------------
+
+
+def _quantize_xla(blocks, stochastic: bool, key):
+    scales = _block_scales(blocks)
+    y = blocks / scales
+    if stochastic:
+        # unbiased: floor(y + u), u ~ U[0,1) — E[q] = y exactly
+        u = jax.random.uniform(key, y.shape, jnp.float32)
+        q = jnp.floor(y + u)
+    else:
+        q = jnp.round(y)  # round half-to-even, same as the kernel
+    q = jnp.clip(q, -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return q, scales[:, 0]
+
+
+def _dequantize_xla(q_blocks, scales):
+    return q_blocks.astype(jnp.float32) * scales[:, None]
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernels
+# ---------------------------------------------------------------------------
+
+# Rows of [block_size] blocks handled per grid step; int8 tiles are
+# (32, 128) so stay a multiple of 32 sublanes.
+_KERNEL_ROWS = 32
+
+
+def _quantize_kernel(seed_ref, x_ref, q_ref, s_ref, *, stochastic: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    x = x_ref[:]                                        # [rows, block] f32
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / INT8_MAX, 1.0)
+    y = x / scale
+    if stochastic:
+        pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+        bits = pltpu.bitcast(pltpu.prng_random_bits(y.shape), jnp.uint32)
+        # top 24 bits -> u in [0, 1); floor(y + u) is unbiased
+        u = (bits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+        y = jnp.floor(y + u)
+    else:
+        y = jnp.round(y)
+    q_ref[:] = jnp.clip(y, -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    # scales ride as a lane-replicated [rows, 128] plane (sublane->lane
+    # transposes don't lower; same layout trick as attention's LSE)
+    s_ref[:] = jnp.broadcast_to(scale, s_ref.shape)
+
+
+def _dequantize_kernel(q_ref, s_ref, o_ref):
+    o_ref[:] = q_ref[:].astype(jnp.float32) * s_ref[:, :1]
+
+
+def _pad_rows(blocks, rows_mult: int):
+    nblocks = blocks.shape[0]
+    pad = (-nblocks) % rows_mult
+    if pad:
+        blocks = jnp.pad(blocks, ((0, pad), (0, 0)))
+    return blocks, nblocks
+
+
+def _quantize_pallas(blocks, stochastic: bool, seed, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    block_size = blocks.shape[1]
+    blocks, nblocks = _pad_rows(blocks, _KERNEL_ROWS)
+    rows = blocks.shape[0]
+    kernel = functools.partial(_quantize_kernel, stochastic=stochastic)
+    seed_arr = jnp.asarray([seed], jnp.int32)
+    q, s = pl.pallas_call(
+        kernel,
+        grid=(rows // _KERNEL_ROWS,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((_KERNEL_ROWS, block_size), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_KERNEL_ROWS, block_size), lambda i: (i, 0)),
+            pl.BlockSpec((_KERNEL_ROWS, 128), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, block_size), jnp.int8),
+            jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(seed_arr, blocks)
+    return q[:nblocks], s[:nblocks, 0]
+
+
+def _dequantize_pallas(q_blocks, scales, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    block_size = q_blocks.shape[1]
+    q_blocks, nblocks = _pad_rows(q_blocks, _KERNEL_ROWS)
+    rows = q_blocks.shape[0]
+    s128 = jnp.broadcast_to(scales[:, None], (nblocks, 128))
+    if rows != nblocks:
+        s128 = jnp.pad(s128, ((0, rows - nblocks), (0, 0)))
+    out = pl.pallas_call(
+        _dequantize_kernel,
+        grid=(rows // _KERNEL_ROWS,),
+        in_specs=[
+            pl.BlockSpec((_KERNEL_ROWS, block_size), lambda i: (i, 0)),
+            pl.BlockSpec((_KERNEL_ROWS, 128), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((_KERNEL_ROWS, block_size), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, block_size), jnp.float32),
+        interpret=interpret,
+    )(q_blocks, s128)
+    return out[:nblocks]
+
+
+def _pick_impl(impl: str, block_size: int) -> str:
+    if impl != "auto":
+        return impl
+    # pallas wants a lane-aligned block; anything else takes the XLA path
+    if jax.default_backend() == "tpu" and block_size % 128 == 0:
+        return "pallas"
+    return "xla"
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def quantize_blockwise(x, block_size: int = 256, *, stochastic: bool = False,
+                       key=None, seed: int = 0,
+                       impl: str = "auto") -> Tuple[jax.Array, jax.Array]:
+    """Quantize any-shape float array to (values int8 [npad], scales f32
+    [nblocks]) under the module's block layout.  Traceable (fixed shapes
+    given static block_size), so it composes into shard_map collectives.
+
+    stochastic: unbiased stochastic rounding — `key` (jax PRNG key) on
+    the XLA path, `seed` (int32) on the pallas path.
+    """
+    if block_size <= 0:
+        raise ValueError(f"block_size must be positive, got {block_size}")
+    blocks = _as_blocks(x, block_size)
+    impl = _pick_impl(impl, block_size)
+    if impl in ("pallas", "pallas_interpret"):
+        q, s = _quantize_pallas(blocks, stochastic, seed,
+                                interpret=(impl == "pallas_interpret"))
+    elif impl == "xla":
+        if stochastic and key is None:
+            key = jax.random.PRNGKey(seed)
+        q, s = _quantize_xla(blocks, stochastic, key)
+    else:
+        raise ValueError(f"unknown quantize impl {impl!r}")
+    return q.reshape(-1), s
+
+
+def dequantize_blockwise(q, scales, shape, dtype, block_size: int = 256,
+                         impl: str = "auto") -> jax.Array:
+    """Inverse of quantize_blockwise: back to `shape`/`dtype`, dropping
+    the zero padding."""
+    q_blocks = q.reshape(-1, block_size)
+    impl = _pick_impl(impl, block_size)
+    if impl in ("pallas", "pallas_interpret"):
+        out = _dequantize_pallas(q_blocks, scales,
+                                 interpret=(impl == "pallas_interpret"))
+    elif impl == "xla":
+        out = _dequantize_xla(q_blocks, scales)
+    else:
+        raise ValueError(f"unknown quantize impl {impl!r}")
+    n = 1
+    for d in shape:
+        n *= d
+    return out.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+def quantization_error(x, block_size: int = 256, impl: str = "xla"):
+    """x - deq(quant(x)): the per-call compression error (what error
+    feedback accumulates).  Deterministic rounding only — the stochastic
+    path's error depends on the drawn bits."""
+    q, s = quantize_blockwise(x, block_size, impl=impl)
+    return x - dequantize_blockwise(q, s, x.shape, x.dtype, block_size,
+                                    impl=impl)

@@ -42,6 +42,17 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
     """
     from .attention import attention as default_attn
 
+    if axis_name not in mesh.shape:
+        raise ValueError(f"ulysses_attention: axis {axis_name!r} is not in "
+                         f"the mesh (axes: {tuple(mesh.axis_names)})")
+    heads, sp = q.shape[1], mesh.shape[axis_name]
+    if heads % sp:
+        raise ValueError(
+            f"ulysses_attention: the all_to_all reshard splits the head dim "
+            f"across the {axis_name!r} axis, so heads ({heads}) must be "
+            f"divisible by the axis size ({sp}); pad/regroup heads or "
+            f"shrink {axis_name!r}")
+
     inner = attn_fn or (lambda a, b, c: default_attn(a, b, c, causal=causal,
                                                      scale=scale))
     spec = P(None, None, axis_name, None)

@@ -2,14 +2,15 @@ from .mesh import (AXIS_ORDER, MeshSpec, batch_sharding, data_axes,
                    default_mesh, get_default_mesh, local_mesh, make_mesh,
                    make_multislice_mesh, replicated, set_default_mesh,
                    slice_groups)
-from .sharding import (DEFAULT_RULES, Logical, shard_tree, spec_from_logical,
-                       tree_shardings, with_constraint)
+from .sharding import (DEFAULT_RULES, GradientSynchronizer, Logical,
+                       shard_tree, spec_from_logical, tree_shardings,
+                       with_constraint)
 
 __all__ = [
     "AXIS_ORDER", "MeshSpec", "make_mesh", "make_multislice_mesh",
     "local_mesh", "slice_groups", "batch_sharding",
     "data_axes", "replicated",
     "set_default_mesh", "get_default_mesh", "default_mesh",
-    "DEFAULT_RULES", "Logical", "spec_from_logical", "tree_shardings",
-    "shard_tree", "with_constraint",
+    "DEFAULT_RULES", "GradientSynchronizer", "Logical", "spec_from_logical",
+    "tree_shardings", "shard_tree", "with_constraint",
 ]

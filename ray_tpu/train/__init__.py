@@ -12,8 +12,8 @@ from .backend_executor import (BackendExecutor, TrainingFailedError,
                                TrainingWorkerError)
 from .checkpoint import Checkpoint
 from .checkpoint_manager import CheckpointManager
-from .config import (CheckpointConfig, FailureConfig, RunConfig,
-                     ScalingConfig)
+from .config import (CheckpointConfig, CompressionConfig, FailureConfig,
+                     RunConfig, ScalingConfig)
 from .result import Result
 from .session import (TrainContext, get_checkpoint, get_context,
                       get_dataset_shard, report)
@@ -24,7 +24,8 @@ from .worker_group import WorkerGroup
 
 __all__ = [
     "Backend", "BackendConfig", "BackendExecutor", "Checkpoint",
-    "CheckpointConfig", "CheckpointManager", "DataParallelTrainer",
+    "CheckpointConfig", "CheckpointManager", "CompressionConfig",
+    "DataParallelTrainer",
     "FailureConfig", "GBDTTrainer", "JaxConfig", "JaxTrainer",
     "LightGBMTrainer", "Result", "RunConfig",
     "ScalingConfig", "SklearnGBDTTrainer", "TensorflowConfig",

@@ -23,7 +23,9 @@ from __future__ import annotations
 import logging
 import os
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Union
+
+from ray_tpu.collective.compression import CompressionConfig, parse_compression
 
 logger = logging.getLogger(__name__)
 
@@ -193,6 +195,12 @@ class JaxConfig(BackendConfig):
     # batches and inbound jax.Arrays restore their shardings with no
     # per-callsite plumbing
     mesh_shape: Optional[Dict[str, int]] = None
+    # gradient-sync compression for the gang: a CompressionConfig or spec
+    # string ("int8", "int8:block=512,ef=1").  Installed as every
+    # worker's group default, so collective.allreduce /
+    # GradientSynchronizer compress without per-call plumbing; None
+    # defers to the RAY_TPU_COLLECTIVE_COMPRESSION flag
+    compression: Union[None, str, CompressionConfig] = None
 
     def backend_cls(self):
         return _JaxBackend
@@ -217,11 +225,15 @@ def _install_default_mesh(shape: Dict[str, int]):
     return {"mesh": {a: int(s) for a, s in mesh.shape.items()}}
 
 
-def _setup_jax_local(group_name: str, world_size: int, rank: int):
+def _setup_jax_local(group_name: str, world_size: int, rank: int,
+                     compression: str = ""):
     from ray_tpu import collective
+    from ray_tpu.collective.compression import set_group_compression
 
     collective.init_collective_group(world_size, rank, backend="kv",
                                      group_name=group_name)
+    if compression:
+        set_group_compression(compression)
     return {"process_index": rank, "device_count": None,
             "local_device_count": None}
 
@@ -239,6 +251,12 @@ class _JaxBackend(Backend):
         # publish the gang layout to every worker's env (the analog of
         # _share_cuda_visible_devices, reference: backend_executor.py:271)
         env = {"RAY_TPU_TRAIN_WORLD_SIZE": str(n)}
+        cc = parse_compression(backend_config.compression)
+        comp_spec = cc.to_spec() if cc is not None else ""
+        if comp_spec:
+            # the flag form reaches subprocesses a worker may itself
+            # spawn; the group default below covers the workers directly
+            env["RAY_TPU_COLLECTIVE_COMPRESSION"] = comp_spec
         import ray_tpu
 
         ray_tpu.get([
@@ -257,7 +275,8 @@ class _JaxBackend(Backend):
         elif n > 1:
             group = f"{backend_config.collective_group}-{id(worker_group)}"
             self._group = group
-            refs = [w.actor.execute.remote(_setup_jax_local, group, n, i)
+            refs = [w.actor.execute.remote(_setup_jax_local, group, n, i,
+                                           comp_spec)
                     for i, w in enumerate(worker_group.workers)]
             ray_tpu.get(refs)
         if backend_config.mesh_shape:

@@ -12,6 +12,11 @@ import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+# Gradient-sync compression config lives with the collective subsystem;
+# re-exported here so training code configures it next to the other run
+# configs (JaxConfig(compression=CompressionConfig(...))).
+from ray_tpu.collective.compression import CompressionConfig
+
 
 @dataclass
 class ScalingConfig:
