@@ -37,6 +37,12 @@ CONFIG_DEFS: List[Tuple[str, type, Any, str]] = [
      "missed-heartbeat window before a node is declared dead"),
     ("control_reconnect_s", float, 20.0,
      "how long clients retry re-attaching to a restarted control plane"),
+    ("preemption_poll_s", float, 1.0,
+     "raylet poll period of the preemption/maintenance-event source "
+     "(RAY_TPU_PREEMPTION_FILE sentinel or the GCE metadata endpoint)"),
+    ("drain_grace_s", float, 30.0,
+     "advisory deadline attached to a node drain notice that carries "
+     "no explicit grace window"),
     ("rpc_backoff_base_s", float, 0.05,
      "initial delay of the jittered-exponential backoff used by RPC "
      "reconnect/retry loops (raylet re-home, driver control rebuild, "

@@ -47,6 +47,7 @@ from .config import cfg as _cfg
 
 HEARTBEAT_INTERVAL_S = _cfg().heartbeat_interval_s
 NODE_DEATH_TIMEOUT_S = _cfg().node_death_timeout_s
+DRAIN_GRACE_S = _cfg().drain_grace_s
 
 ALIVE, RESTARTING, DEAD, PENDING = "ALIVE", "RESTARTING", "DEAD", "PENDING"
 
@@ -73,6 +74,12 @@ class NodeRecord:
         #: raylet's truth; ask the raylet to resend it (delta sync
         #: would otherwise never correct a control-side guess)
         self.needs_resync = False
+        #: advisory drain deadline (monotonic): a preemption/maintenance
+        #: notice says this host is going away around then.  Draining is
+        #: NOT death — the node keeps serving until it actually dies —
+        #: but the scheduler avoids it and Train shrinks off it.
+        self.draining_until: Optional[float] = None
+        self.draining_reason: str = ""
 
     def view(self):
         return {
@@ -87,6 +94,11 @@ class NodeRecord:
             # currently down (disconnected but NOT dead)
             "reg_epoch": self.reg_epoch,
             "disconnected": self.disconnected_at is not None,
+            "draining": self.draining_until is not None,
+            "draining_reason": self.draining_reason,
+            "draining_remaining_s": (
+                max(0.0, self.draining_until - time.monotonic())
+                if self.draining_until is not None else None),
         }
 
 
@@ -252,6 +264,7 @@ class ControlServer:
         s.handle("register_node", self.h_register_node)
         s.handle("unregister_node", self.h_unregister_node)
         s.handle("heartbeat", self.h_heartbeat)
+        s.handle("report_draining", self.h_report_draining)
         s.handle("get_nodes", self.h_get_nodes)
         s.handle("pick_node", self.h_pick_node)
         s.handle("register_function", self.h_register_function)
@@ -615,6 +628,43 @@ class ControlServer:
             # views, so explicitly request the ground truth back
             return {"ok": True, "resync": rec.needs_resync}
 
+    def h_report_draining(self, conn, p):
+        """A preemption/maintenance notice for a node: mark the record
+        draining and broadcast a ``node_draining`` advisory with its
+        deadline over pubsub, so consumers (Train's elastic supervisor,
+        schedulers) act BEFORE the heartbeat timeout declares death.
+        ``cancel=True`` clears a notice that didn't materialize."""
+        nid = p["node_id"]
+        cancel = bool(p.get("cancel"))
+        with self.lock:
+            rec = self.nodes.get(nid)
+            if rec is None or rec.state == DEAD:
+                return {"ok": False, "error": f"unknown or dead node {nid}"}
+            if cancel:
+                rec.draining_until = None
+                rec.draining_reason = ""
+                grace = None
+            else:
+                grace = float(p.get("grace_s") or DRAIN_GRACE_S)
+                rec.draining_until = time.monotonic() + grace
+                rec.draining_reason = str(p.get("reason") or "preemption")
+            view = rec.view()
+            reason = rec.draining_reason
+        event = "drain_canceled" if cancel else "draining"
+        if cancel:
+            logger.info("node %s drain canceled", nid[:12])
+        else:
+            logger.warning("node %s draining in %.1fs (%s)", nid[:12],
+                           grace, reason)
+        self.record_event(
+            severity="INFO" if cancel else "WARNING", source="node",
+            event_type=event, entity_id=nid,
+            message=(f"node {nid[:12]} drain canceled" if cancel else
+                     f"node {nid[:12]} draining in {grace:.1f}s ({reason})"))
+        self.publish("node", {"event": event, "node": view,
+                              "grace_s": grace, "reason": reason})
+        return {"ok": True}
+
     def h_get_nodes(self, conn, p):
         with self.lock:
             return [n.view() for n in self.nodes.values()]
@@ -697,6 +747,7 @@ class ControlServer:
                 if n is not None:
                     return n
                 cands = [n for n in nodes if fits(n.available, demand)]
+                cands = self._prefer_not_draining(cands)
                 if not cands:
                     return None
                 # least-loaded first
@@ -707,6 +758,7 @@ class ControlServer:
         if n is not None:
             return n
         cands = [n for n in nodes if fits(n.available, demand)]
+        cands = self._prefer_not_draining(cands)
         if not cands:
             return None
         # pack: most-utilized node that still fits
@@ -714,6 +766,14 @@ class ControlServer:
             tot = sum(n.total.values()) or 1
             return 1.0 - sum(n.available.values()) / tot
         return max(cands, key=util)
+
+    @staticmethod
+    def _prefer_not_draining(cands: List[NodeRecord]) -> List[NodeRecord]:
+        """New work avoids draining nodes while any non-draining node
+        fits — but a draining node remains a last resort (its work is
+        still better placed than not placed)."""
+        fresh = [n for n in cands if n.draining_until is None]
+        return fresh or cands
 
     def _native_pick(self, demand: Dict[str, int],
                      spread: bool) -> Optional[NodeRecord]:
@@ -729,6 +789,10 @@ class ControlServer:
         if nid is None:
             return None
         n = self.nodes.get(nid)
+        if n is not None and n.draining_until is not None:
+            # the native mirror doesn't track drains; fall back to the
+            # Python path, which prefers non-draining nodes
+            return None
         if n is not None and n.state == ALIVE and fits(n.available, demand):
             return n
         return None
@@ -1438,11 +1502,26 @@ class ControlServer:
             time.sleep(HEARTBEAT_INTERVAL_S)
             now = time.monotonic()
             dead_nodes: List[NodeRecord] = []
+            drain_expired: List[NodeRecord] = []
             with self.lock:
                 for rec in self.nodes.values():
                     if rec.state == ALIVE and now - rec.last_heartbeat > NODE_DEATH_TIMEOUT_S:
                         rec.state = DEAD
                         dead_nodes.append(rec)
+                    elif (rec.state == ALIVE and rec.draining_until is not None
+                            and now > rec.draining_until + NODE_DEATH_TIMEOUT_S):
+                        # the predicted preemption never happened: the node
+                        # outlived its deadline by a full death interval —
+                        # clear the advisory so it takes work again
+                        rec.draining_until = None
+                        rec.draining_reason = ""
+                        drain_expired.append(rec)
+            for rec in drain_expired:
+                logger.info("node %s drain notice expired without death; "
+                            "cleared", rec.node_id[:12])
+                self.publish("node", {"event": "drain_canceled",
+                                      "node": rec.view(), "grace_s": None,
+                                      "reason": "expired"})
             for rec in dead_nodes:
                 logger.warning("node %s declared dead (heartbeat timeout)", rec.node_id[:12])
                 self.publish("node", {"event": "removed", "node": rec.view()})

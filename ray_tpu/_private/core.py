@@ -2719,6 +2719,14 @@ class CoreWorker:
         with self.lock:
             self._push_handlers.setdefault(topic, []).append(fn)
 
+    def remove_push_handler(self, topic: str, fn) -> None:
+        """Detach a callback registered with add_push_handler (no-op if
+        it was never registered — teardown paths call this defensively)."""
+        with self.lock:
+            handlers = self._push_handlers.get(topic)
+            if handlers and fn in handlers:
+                handlers.remove(fn)
+
     def _sub_topics(self) -> List[str]:
         topics = ["actor", "node"]
         if self.log_to_driver:

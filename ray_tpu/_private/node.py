@@ -151,6 +151,10 @@ class Raylet:
         self._reap_thread = threading.Thread(target=self._reap_loop,
                                              name="raylet-reap", daemon=True)
         self._pull_pool: Dict[str, threading.Event] = {}
+        #: a preemption notice was observed for THIS host: stop warming
+        #: new workers; the control plane broadcasts the drain advisory
+        self._draining = False
+        self.preemption_watcher = None
 
         # object spilling + memory watchdog (reference:
         # local_object_manager.h:110, memory_monitor.h:52)
@@ -229,6 +233,22 @@ class Raylet:
             os.path.join(self.session_dir, "logs"), self.node_id,
             _publish_logs)
         self.log_monitor.start()
+        # preemption watcher: poll the maintenance-event source (env-
+        # selected; None on hosts without one) and report a drain notice
+        # to the control plane before the heartbeat timeout would fire
+        from ray_tpu.elastic.preemption import (PreemptionWatcher,
+                                                source_from_env)
+
+        src = source_from_env()
+        if src is not None:
+            from .config import cfg as _wcfg
+
+            self.preemption_watcher = PreemptionWatcher(
+                src, self._on_preemption_notice,
+                poll_interval_s=_wcfg().preemption_poll_s)
+            self.preemption_watcher.start()
+            logger.info("preemption watcher active (%s)",
+                        type(src).__name__)
         logger.info("raylet %s up at %s resources=%s", self.node_id[:12],
                     self.server.addr, common.denormalize_resources(self.total))
         if block:
@@ -238,6 +258,30 @@ class Raylet:
             except KeyboardInterrupt:
                 pass
             self.shutdown()
+
+    def _on_preemption_notice(self, notice):
+        """The preemption source says this host is going away: report a
+        drain notice to the control (which broadcasts the advisory) and
+        stop warming new workers locally.  Best-effort — a raylet that
+        can't reach the control still dies on schedule; the heartbeat
+        timeout remains the backstop."""
+        from .config import cfg as _wcfg
+
+        grace = notice.grace_s if notice.grace_s is not None \
+            else _wcfg().drain_grace_s
+        logger.warning("preemption notice (%s): draining, grace %.1fs",
+                       notice.reason, grace)
+        self._draining = True
+        cli = self.control
+        if cli is None or cli.closed:
+            return
+        try:
+            cli.call("report_draining", {
+                "node_id": self.node_id, "grace_s": grace,
+                "reason": notice.reason}, timeout=5.0)
+        except Exception:
+            logger.warning("could not report drain notice to control",
+                           exc_info=True)
 
     def _on_control_lost(self):
         """Control connection dropped.  With a persistent control plane the
@@ -460,6 +504,8 @@ class Raylet:
                 pass
         if getattr(self, "log_monitor", None) is not None:
             self.log_monitor.stop()
+        if self.preemption_watcher is not None:
+            self.preemption_watcher.stop()
         with self.lock:
             workers = list(self.workers.values())
         for w in workers:
@@ -833,8 +879,9 @@ class Raylet:
                     deficit = self.prestart_target - warm
                     room = self.max_workers - len(self.workers)
                 # spawn at most one per tick: on small hosts concurrent
-                # interpreter+jax imports thrash the CPU
-                if deficit > 0 and room > 0:
+                # interpreter+jax imports thrash the CPU.  A draining
+                # host stops warming — its pool only shrinks from here.
+                if deficit > 0 and room > 0 and not self._draining:
                     self._spawn_worker()
             except Exception:
                 logger.exception("prestart failed")

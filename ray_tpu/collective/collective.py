@@ -118,9 +118,23 @@ def get_group_handle(group_name: str = "default") -> GroupHandle:
 def destroy_collective_group(group_name: str = "default"):
     """Deregister and sweep the group's KV namespace.  Members that died
     mid-op leave `{name}/{op_idx}/{op}/{rank}` mailbox entries behind;
-    without the sweep those leak in the control plane forever."""
+    without the sweep those leak in the control plane forever.
+
+    Only the LAST member to arrive here sweeps: ranks leave a collective
+    at different times (rank 0 posts the reduced result and returns
+    before slower ranks have read it), so an early leaver deleting the
+    shared `/-1` result key would strand a reader mid-poll for the full
+    rendezvous timeout.  Members that died before destroy never post
+    their fin marker, so their debris is swept when a later same-named
+    group completes its own destroy over the shared prefix."""
     g = _groups.pop(group_name, None)
     if g is None:
+        return
+    _kv_put(f"{g.name}/fin/{g.rank}", b"1")
+    arrived = sum(
+        1 for r in range(g.world_size)
+        if _kv().call("kv_exists", {"ns": _NS, "key": f"{g.name}/fin/{r}"}))
+    if arrived < g.world_size:
         return
     prefix = f"{g.name}/"
     try:

@@ -1,0 +1,58 @@
+"""ray_tpu.elastic: preemption-aware elastic training.
+
+Three cooperating pieces (see COMPONENTS.md):
+
+  * preemption  — PreemptionWatcher + sources: raylets learn a host is
+    going away and report a drain notice to the control plane, which
+    broadcasts a ``node_draining`` advisory over pubsub.
+  * emergency   — EmergencyCheckpointer: async device->host snapshots of
+    each worker's train-state shard, peer-replicated to K ring
+    successors through the control-plane KV mailbox; recovery needs no
+    persistent-storage round-trip.
+  * resume      — shrink-to-fit width selection + exact global-batch
+    resplitting, driven by BackendExecutor.elastic_recover().
+
+User surface: ``JaxConfig(elastic=ElasticConfig(...))`` plus
+``elastic.snapshot(state, step)`` inside the train loop.
+
+Exports resolve lazily (PEP 562): raylets import only the preemption
+submodule, and must not drag the train stack (which ``emergency`` needs
+for its Checkpoint base class) into every node daemon.
+"""
+
+_EXPORTS = {
+    "ElasticConfig": "config",
+    "EmergencyCheckpoint": "emergency",
+    "EmergencyCheckpointer": "emergency",
+    "fold_shards": "emergency",
+    "get_checkpointer": "emergency",
+    "select_quorum": "emergency",
+    "snapshot": "emergency",
+    "wait_replicated": "emergency",
+    "FakePreemptionSource": "preemption",
+    "FilePreemptionSource": "preemption",
+    "PreemptionNotice": "preemption",
+    "PreemptionSource": "preemption",
+    "PreemptionWatcher": "preemption",
+    "TpuMetadataSource": "preemption",
+    "source_from_env": "preemption",
+    "InsufficientWorkersError": "resume",
+    "batch_offsets": "resume",
+    "per_replica_batches": "resume",
+    "shrink_to_fit": "resume",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

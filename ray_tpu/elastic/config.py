@@ -1,0 +1,98 @@
+"""ElasticConfig: user-facing knobs for preemption-aware elastic training.
+
+Attached to ``JaxConfig(elastic=ElasticConfig(...))``; consumed by the
+BackendExecutor's supervised restart loop (train/backend_executor.py) and
+by the per-worker EmergencyCheckpointer (elastic/emergency.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class ElasticConfig:
+    """How a training run shrinks and recovers when hosts are lost.
+
+    min_workers: smallest data-parallel width the run may shrink to;
+        below it elastic recovery gives up and the normal
+        restart-from-storage path (FailureConfig) takes over.
+    max_workers: cap on width (None = the ScalingConfig's num_workers).
+        Shrink-to-fit never grows past the current width; the cap exists
+        so configs round-trip when re-used after capacity returns.
+    replication_factor: K — each worker's emergency shard is replicated
+        to its K ring successors, so recovery survives losing any K
+        hosts without a persistent-storage round-trip.
+    workers_per_replica: workers per model replica (the product of the
+        non-data-parallel mesh axes, tp*sp, in hosts).  Shrink-to-fit
+        only drops whole model replicas: the new width is always a
+        multiple of this unit, preserving tp/sp axes.
+    snapshot_every: emergency-snapshot cadence in steps (1 = every
+        ``elastic.snapshot()`` call replicates).
+    keep_steps: how many distinct snapshot steps each worker's in-memory
+        vault retains.
+    drain_grace_s: advisory deadline attached to a drain notice that
+        carries no explicit grace.
+    global_batch_size: when set, the executor publishes an exact
+        per-replica batch split (``ctx.extra["per_replica_batch"]`` /
+        ``"batch_offset"``) that keeps the global batch constant across
+        width changes.
+    replicate_timeout_s: per-snapshot bound on the background peer
+        exchange (a dead peer must not wedge the replication thread).
+    recover_timeout_s: per-RPC bound during recovery (ping / abort /
+        inventory / fetch) — recovery must finish well inside one
+        heartbeat-death interval, so no call may block on a dead host.
+    """
+
+    min_workers: int = 1
+    max_workers: Optional[int] = None
+    replication_factor: int = 1
+    workers_per_replica: int = 1
+    snapshot_every: int = 1
+    keep_steps: int = 2
+    drain_grace_s: float = 30.0
+    global_batch_size: Optional[int] = None
+    replicate_timeout_s: float = 15.0
+    recover_timeout_s: float = 5.0
+
+    def __post_init__(self):
+        if self.min_workers < 1:
+            raise ValueError("min_workers must be >= 1")
+        if self.max_workers is not None and self.max_workers < self.min_workers:
+            raise ValueError(
+                f"max_workers ({self.max_workers}) < min_workers "
+                f"({self.min_workers})")
+        if self.replication_factor < 0:
+            raise ValueError("replication_factor must be >= 0")
+        if self.workers_per_replica < 1:
+            raise ValueError("workers_per_replica must be >= 1")
+        if self.min_workers % self.workers_per_replica:
+            raise ValueError(
+                f"min_workers ({self.min_workers}) must be a multiple of "
+                f"workers_per_replica ({self.workers_per_replica}): shrink "
+                f"drops whole model replicas")
+        if self.snapshot_every < 1:
+            raise ValueError("snapshot_every must be >= 1")
+        if self.keep_steps < 1:
+            raise ValueError("keep_steps must be >= 1")
+
+    def validate_for(self, num_workers: int) -> None:
+        """Check this config against a worker-group width at start."""
+        if num_workers < self.min_workers:
+            raise ValueError(
+                f"ScalingConfig.num_workers ({num_workers}) < "
+                f"ElasticConfig.min_workers ({self.min_workers})")
+        if self.max_workers is not None and num_workers > self.max_workers:
+            raise ValueError(
+                f"ScalingConfig.num_workers ({num_workers}) > "
+                f"ElasticConfig.max_workers ({self.max_workers})")
+        if num_workers % self.workers_per_replica:
+            raise ValueError(
+                f"num_workers ({num_workers}) must be a multiple of "
+                f"workers_per_replica ({self.workers_per_replica})")
+        if self.replication_factor > num_workers - 1:
+            raise ValueError(
+                f"replication_factor ({self.replication_factor}) must be "
+                f"< num_workers ({num_workers}): a shard cannot replicate "
+                f"to more peers than exist")

@@ -1,0 +1,52 @@
+"""Shrink-to-fit math for elastic resume.
+
+Pure functions (unit-testable without a cluster) used by the
+BackendExecutor's supervised restart loop: pick the largest feasible
+width over data-parallel replicas while preserving tp/sp axes, and split
+a constant global batch exactly across the new width.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class InsufficientWorkersError(RuntimeError):
+    """Fewer survivors than ElasticConfig.min_workers allows."""
+
+
+def shrink_to_fit(alive: int, min_workers: int,
+                  max_workers: Optional[int] = None,
+                  workers_per_replica: int = 1) -> int:
+    """Largest feasible width <= alive: a multiple of the model-replica
+    unit (tp*sp hosts), capped by max_workers, floored by min_workers."""
+    unit = max(1, workers_per_replica)
+    cap = alive if max_workers is None else min(alive, max_workers)
+    n = (cap // unit) * unit
+    floor = max(min_workers, unit)
+    if n < floor:
+        raise InsufficientWorkersError(
+            f"only {alive} workers survive; the largest width that keeps "
+            f"whole model replicas (unit={unit}, cap={cap}) is {n}, below "
+            f"min_workers={min_workers}")
+    return n
+
+
+def per_replica_batches(global_batch: int, world: int) -> List[int]:
+    """Split a global batch over ``world`` replicas so the sizes sum to
+    exactly global_batch (remainder spread over the first ranks): the
+    global batch — and thus the gradient — is invariant under width
+    changes."""
+    if world < 1:
+        raise ValueError("world must be >= 1")
+    base, rem = divmod(global_batch, world)
+    return [base + (1 if i < rem else 0) for i in range(world)]
+
+
+def batch_offsets(batches: List[int]) -> List[int]:
+    """Start offset of each rank's slice within the global batch."""
+    offsets, acc = [], 0
+    for b in batches:
+        offsets.append(acc)
+        acc += b
+    return offsets

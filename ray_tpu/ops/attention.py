@@ -138,7 +138,7 @@ def _fit_block(block, seq):
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
                   causal: bool, block_q: int, block_k: int,
-                  with_lse: bool):
+                  q_offset: int, with_lse: bool):
     from jax.experimental import pallas as pl
 
     if with_lse:
@@ -158,8 +158,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
 
     run = True
     if causal:
-        # whole block above the diagonal contributes nothing
-        run = (j * block_k) <= (i * block_q + block_q - 1)
+        # whole block above the diagonal contributes nothing; q_offset
+        # shifts local q rows to their global positions (decode-style
+        # rectangular causal: q_offset = sk - sq anchors bottom-right)
+        run = (j * block_k) <= (q_offset + i * block_q + block_q - 1)
 
     @pl.when(run if causal else True)
     def _compute():
@@ -173,7 +175,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
         if causal:
-            rows = i * block_q + jax.lax.broadcasted_iota(
+            rows = q_offset + i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -204,30 +206,33 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
 
 
 def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
-                   block_k: int, interpret: bool, with_lse: bool = False):
+                   block_k: int, interpret: bool, with_lse: bool = False,
+                   q_offset: int = 0):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, sq, d = q.shape
     sk = k.shape[-2]
-    if causal and sq != sk:
-        # the pallas kernels anchor the causal mask at row 0 (rows >=
-        # cols) while mha_reference anchors rectangular inputs bottom-
-        # right (tril with k=sk-sq, decode semantics: the last query row
-        # is position sk-1) — letting this through would silently
-        # diverge from the other impls
+    if q_offset < 0:
+        raise ValueError(f"q_offset must be >= 0, got {q_offset}")
+    if causal and sq != sk and q_offset == 0:
+        # with no offset the kernels anchor the causal mask at row 0
+        # (rows >= cols) while mha_reference anchors rectangular inputs
+        # bottom-right (tril with k=sk-sq, decode semantics: the last
+        # query row is position sk-1) — letting this through would
+        # silently diverge from the other impls
         raise ValueError(
-            f"pallas flash attention does not support causal masking "
-            f"with sq ({sq}) != sk ({sk}): its mask is anchored at row "
-            f"0, while mha_reference/blockwise anchor rectangular "
-            f"inputs at sk-sq.  Use impl='xla' (blockwise_attention "
-            f"handles the query offset) or pad q to sk.")
+            f"causal pallas flash attention with sq ({sq}) != sk ({sk}) "
+            f"needs an explicit query anchor: pass q_offset=sk-sq "
+            f"({sk - sq}) for bottom-right (decode) alignment, use "
+            f"impl='xla' (blockwise_attention handles the offset), or "
+            f"pad q to sk.")
     block_q = _fit_block(block_q, sq)
     block_k = _fit_block(block_k, sk)
     grid = (b, h, sq // block_q, sk // block_k)
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k,
-                               with_lse=with_lse)
+                               q_offset=q_offset, with_lse=with_lse)
     out_specs = [pl.BlockSpec((1, 1, block_q, d),
                               lambda b_, h_, i, j: (b_, h_, i, 0))]
     out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
@@ -273,7 +278,8 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
-                          causal: bool, block_q: int, block_k: int):
+                          causal: bool, block_q: int, block_k: int,
+                          q_offset: int):
     from jax.experimental import pallas as pl
 
     j = pl.program_id(2)   # k block (outer)
@@ -287,7 +293,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 
     run = True
     if causal:
-        run = (j * block_k) <= (i * block_q + block_q - 1)
+        run = (j * block_k) <= (q_offset + i * block_q + block_q - 1)
 
     @pl.when(run if causal else True)
     def _compute():
@@ -302,7 +308,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
         p = jnp.exp(s - lse)
         if causal:
-            rows = i * block_q + jax.lax.broadcasted_iota(
+            rows = q_offset + i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -328,7 +334,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                          dq_ref, dq_acc, *, scale: float, causal: bool,
-                         block_q: int, block_k: int):
+                         block_q: int, block_k: int, q_offset: int):
     from jax.experimental import pallas as pl
 
     i = pl.program_id(2)   # q block (outer)
@@ -341,7 +347,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 
     run = True
     if causal:
-        run = (j * block_k) <= (i * block_q + block_q - 1)
+        run = (j * block_k) <= (q_offset + i * block_q + block_q - 1)
 
     @pl.when(run if causal else True)
     def _compute():
@@ -356,7 +362,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
             preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)
         if causal:
-            rows = i * block_q + jax.lax.broadcasted_iota(
+            rows = q_offset + i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -376,7 +382,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 
 def _flash_backward(q, k, v, o, lse128, do, dlse, causal: bool,
                     scale: float, block_q: int, block_k: int,
-                    interpret: bool):
+                    interpret: bool, q_offset: int = 0):
     """dq, dk, dv from residuals.  lse128: [B,H,Sq,128] lane-replicated
     logsumexp; dlse: [B,H,Sq] cotangent of the lse output or None."""
     from jax.experimental import pallas as pl
@@ -418,7 +424,7 @@ def _flash_backward(q, k, v, o, lse128, do, dlse, causal: bool,
 
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k)
+        block_q=block_q, block_k=block_k, q_offset=q_offset)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(b, h, sk // block_k, sq // block_q),
@@ -434,7 +440,7 @@ def _flash_backward(q, k, v, o, lse128, do, dlse, causal: bool,
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k)
+        block_q=block_q, block_k=block_k, q_offset=q_offset)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b, h, sq // block_q, sk // block_k),
@@ -448,63 +454,75 @@ def _flash_backward(q, k, v, o, lse128, do, dlse, causal: bool,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None, block_q: int = 512,
-                    block_k: int = 1024, interpret: bool = False):
+                    block_k: int = 1024, interpret: bool = False,
+                    q_offset: int = 0):
     """Pallas TPU flash attention, forward AND backward kernels (the
     backward is the FlashAttention-2 dq/dk/dv pair above — no XLA
-    recompute fallback)."""
+    recompute fallback).
+
+    q_offset (static): global position of q's row 0 in the causal mask.
+    Pass sk - sq for bottom-right (decode) alignment of causal
+    rectangular inputs, matching mha_reference's tril(k=sk-sq)."""
     scale = (q.shape[-1] ** -0.5) if scale is None else scale
     return _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                          interpret)
+                          interpret, q_offset=q_offset)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               q_offset):
     scale_ = (q.shape[-1] ** -0.5) if scale is None else scale
     out, lse128 = _flash_forward(q, k, v, causal, scale_, block_q, block_k,
-                                 interpret, with_lse=True)
+                                 interpret, with_lse=True,
+                                 q_offset=q_offset)
     return out, (q, k, v, out, lse128)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, q_offset,
+               res, g):
     q, k, v, o, lse128 = res
     scale_ = (q.shape[-1] ** -0.5) if scale is None else scale
     return _flash_backward(q, k, v, o, lse128, g, None, causal, scale_,
-                           block_q, block_k, interpret)
+                           block_q, block_k, interpret, q_offset=q_offset)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention_with_lse(q, k, v, causal: bool = False,
                              scale: Optional[float] = None,
                              block_q: int = 512, block_k: int = 1024,
-                             interpret: bool = False):
+                             interpret: bool = False, q_offset: int = 0):
     """(out, lse) variant for partial-softmax composition (ring
     attention): lse is [B, H, Sq] f32 logsumexp of the scaled scores.
     Differentiable in both outputs — the lse cotangent folds into the
     same backward kernels (di -= dlse)."""
     scale_ = (q.shape[-1] ** -0.5) if scale is None else scale
     out, lse128 = _flash_forward(q, k, v, causal, scale_, block_q, block_k,
-                                 interpret, with_lse=True)
+                                 interpret, with_lse=True,
+                                 q_offset=q_offset)
     return out, lse128[..., 0]
 
 
-def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                   q_offset):
     scale_ = (q.shape[-1] ** -0.5) if scale is None else scale
     out, lse128 = _flash_forward(q, k, v, causal, scale_, block_q, block_k,
-                                 interpret, with_lse=True)
+                                 interpret, with_lse=True,
+                                 q_offset=q_offset)
     return (out, lse128[..., 0]), (q, k, v, out, lse128)
 
 
-def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, q_offset,
+                   res, g):
     q, k, v, o, lse128 = res
     do, dlse = g
     scale_ = (q.shape[-1] ** -0.5) if scale is None else scale
     return _flash_backward(q, k, v, o, lse128, do, dlse, causal, scale_,
-                           block_q, block_k, interpret)
+                           block_q, block_k, interpret, q_offset=q_offset)
 
 
 flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -532,23 +550,25 @@ def attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
         # everywhere — that's why this dispatch was XLA-only through
         # round 4.)  XLA remains the portable path: CPU meshes, seqs not
         # a multiple of 128, and anything interpret-mode.
-        # causal rectangular (sq != sk) routes to XLA: the pallas mask
-        # is row-0 anchored and would diverge from the reference
+        # causal rectangular with sq > sk still routes to XLA (a
+        # negative q_offset has no causal interpretation here); sk >= sq
+        # runs in pallas with the bottom-right anchor via q_offset
         if (jax.default_backend() == "tpu"
                 and sq % 128 == 0 and sk % 128 == 0
-                and not (causal and sq != sk)):
+                and not (causal and sq > sk)):
             impl = "pallas"
         else:
             impl = "xla"
+    # bottom-right-aligned causal mask for rectangular inputs, matching
+    # mha_reference's tril(k=sk-sq) decode semantics
+    qoff = (sk - sq) if (causal and sk > sq) else 0
     if impl == "pallas":
         return flash_attention(q, k, v, causal, scale, block_q or 512,
-                               block_k or 1024, False)
+                               block_k or 1024, False, qoff)
     if impl == "pallas_interpret":
         return flash_attention(q, k, v, causal, scale, block_q or 512,
-                               block_k or 1024, True)
+                               block_k or 1024, True, qoff)
     if impl == "xla":
-        # bottom-right-aligned causal mask for rectangular inputs,
-        # matching mha_reference's tril(k=sk-sq) decode semantics
         return blockwise_attention(q, k, v, causal=causal, scale=scale,
                                    block_k=block_k or 256,
                                    q_offset=(sk - sq) if causal else 0)

@@ -9,7 +9,7 @@ module-level session API (train/_internal/session.py:667-790).
 from .backend import (Backend, BackendConfig, JaxConfig, TensorflowConfig,
                       TorchConfig, TPUConfig)
 from .backend_executor import (BackendExecutor, TrainingFailedError,
-                               TrainingWorkerError)
+                               TrainingWorkerError, WorkerDrainedError)
 from .checkpoint import Checkpoint
 from .checkpoint_manager import CheckpointManager
 from .config import (CheckpointConfig, CompressionConfig, FailureConfig,
@@ -21,17 +21,19 @@ from .gbdt import (GBDTTrainer, LightGBMTrainer, SklearnGBDTTrainer,
                    XGBoostTrainer)
 from .trainer import DataParallelTrainer, JaxTrainer
 from .worker_group import WorkerGroup
+from ray_tpu.elastic.config import ElasticConfig
 
 __all__ = [
     "Backend", "BackendConfig", "BackendExecutor", "Checkpoint",
     "CheckpointConfig", "CheckpointManager", "CompressionConfig",
-    "DataParallelTrainer",
+    "DataParallelTrainer", "ElasticConfig",
     "FailureConfig", "GBDTTrainer", "JaxConfig", "JaxTrainer",
     "LightGBMTrainer", "Result", "RunConfig",
     "ScalingConfig", "SklearnGBDTTrainer", "TensorflowConfig",
     "TorchConfig", "TPUConfig", "XGBoostTrainer",
     "TrainContext",
     "TrainingFailedError",
-    "TrainingWorkerError", "WorkerGroup", "get_checkpoint", "get_context",
+    "TrainingWorkerError", "WorkerDrainedError", "WorkerGroup",
+    "get_checkpoint", "get_context",
     "get_dataset_shard", "report",
 ]

@@ -23,9 +23,12 @@ from __future__ import annotations
 import logging
 import os
 from dataclasses import dataclass
-from typing import Any, Dict, Optional, Union
+from typing import TYPE_CHECKING, Any, Dict, Optional, Union
 
 from ray_tpu.collective.compression import CompressionConfig, parse_compression
+
+if TYPE_CHECKING:
+    from ray_tpu.elastic.config import ElasticConfig
 
 logger = logging.getLogger(__name__)
 
@@ -201,6 +204,10 @@ class JaxConfig(BackendConfig):
     # GradientSynchronizer compress without per-call plumbing; None
     # defers to the RAY_TPU_COLLECTIVE_COMPRESSION flag
     compression: Union[None, str, CompressionConfig] = None
+    # opt into preemption-aware elastic training: peer-replicated
+    # emergency checkpoints + shrink-to-fit restarts (see
+    # ray_tpu.elastic.ElasticConfig / COMPONENTS.md)
+    elastic: Optional["ElasticConfig"] = None
 
     def backend_cls(self):
         return _JaxBackend
@@ -273,12 +280,23 @@ class _JaxBackend(Backend):
             infos = ray_tpu.get(refs)
             logger.info("jax.distributed initialized: %s", infos[0])
         elif n > 1:
-            group = f"{backend_config.collective_group}-{id(worker_group)}"
+            # incarnation in the name: an elastically rebuilt gang must
+            # never rendezvous with stale members of the old group
+            inc = getattr(worker_group, "incarnation", 0)
+            group = (f"{backend_config.collective_group}"
+                     f"-{id(worker_group)}-i{inc}")
             self._group = group
+            ray_tpu.get([
+                w.actor.set_env_vars.remote(
+                    {"RAY_TPU_TRAIN_COLLECTIVE_GROUP": group})
+                for w in worker_group.workers])
             refs = [w.actor.execute.remote(_setup_jax_local, group, n, i,
                                            comp_spec)
                     for i, w in enumerate(worker_group.workers)]
             ray_tpu.get(refs)
+        if backend_config.elastic is not None:
+            self._init_emergency_checkpointers(worker_group,
+                                               backend_config.elastic)
         if backend_config.mesh_shape:
             # after jax init so spmd workers see the global device set
             meshes = ray_tpu.get([
@@ -287,6 +305,25 @@ class _JaxBackend(Backend):
                 for w in worker_group.workers])
             logger.info("default mesh installed on %d workers: %s",
                         n, meshes[0])
+
+    def _init_emergency_checkpointers(self, worker_group, ec):
+        """Arm per-worker EmergencyCheckpointers.  Tag carries the gang
+        incarnation: snapshots from before a shrink stay readable in the
+        vault (recovery source) but new writes land under the new tag."""
+        import ray_tpu
+        from ray_tpu.elastic.emergency import _init_worker_checkpointer
+
+        n = worker_group.num_workers
+        inc = getattr(worker_group, "incarnation", 0)
+        tag = f"em-{id(worker_group)}-i{inc}"
+        ray_tpu.get([
+            w.actor.execute.remote(
+                _init_worker_checkpointer, tag, i, n,
+                ec.replication_factor, ec.keep_steps, ec.snapshot_every,
+                ec.replicate_timeout_s)
+            for i, w in enumerate(worker_group.workers)])
+        logger.info("emergency checkpointers armed: tag=%s world=%d k=%d",
+                    tag, n, ec.replication_factor)
 
     def on_shutdown(self, worker_group, backend_config: JaxConfig):
         if getattr(self, "mode", None) == "local" and worker_group.workers:

@@ -32,6 +32,10 @@ class CheckpointManager:
         self.config = config or CheckpointConfig()
         self._checkpoints: List[_TrackedCheckpoint] = []
         self._next_index = 0
+        # emergency (in-memory, peer-replicated) tier: recovery events are
+        # recorded, not retained — the payloads live in worker vaults, not
+        # in run storage, so retention/scoring never applies to them
+        self._emergency_events: List[Dict[str, Any]] = []
 
     def register_checkpoint(self, checkpoint: Checkpoint,
                             metrics: Dict[str, Any]) -> None:
@@ -65,6 +69,20 @@ class CheckpointManager:
             t = ranked.pop()
             self._checkpoints.remove(t)
             storage.rmtree(t.checkpoint.path)
+
+    def note_emergency(self, step: int,
+                       metadata: Optional[Dict[str, Any]] = None) -> None:
+        """Record an emergency-tier recovery (elastic restart restored
+        from peer-replicated shards at `step`)."""
+        import time
+
+        self._emergency_events.append({
+            "step": int(step), "tier": "emergency", "ts": time.time(),
+            **(metadata or {})})
+
+    @property
+    def emergency_events(self) -> List[Dict[str, Any]]:
+        return list(self._emergency_events)
 
     @property
     def latest_checkpoint(self) -> Optional[Checkpoint]:

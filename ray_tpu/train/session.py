@@ -125,6 +125,12 @@ class TrainSession:
         from ray_tpu._private.core import adopt_task_context
 
         adopt_task_context()
+        # bind THIS loop thread to THIS session: after an elastic abort +
+        # restart, a zombie loop thread (still unwinding from a blocked
+        # collective) must see its own aborted session — not the fresh
+        # one installed in the module global — so its next report()
+        # raises SessionAborted instead of corrupting the new lockstep
+        _tls.session = self
         try:
             out = self._train_fn()
             # the last checkpoint upload may still be in flight: the
@@ -168,6 +174,16 @@ class TrainSession:
         try:
             self._results.get_nowait()
         except queue.Empty:
+            pass
+        # ... and unblock a next_result() call already parked on the
+        # queue: the worker actor has bounded concurrency, so a forever-
+        # blocked result lane would wedge the actor after an elastic
+        # restart (the driver abandons the old ref, but the lane must
+        # free itself)
+        try:
+            self._results.put_nowait(
+                _FinishedMarker(error=RuntimeError("session aborted")))
+        except queue.Full:
             pass
         if self._started:
             self._thread.join(timeout=timeout)
@@ -246,6 +262,7 @@ class TrainSession:
 
 _session_lock = threading.Lock()
 _session: Optional[TrainSession] = None
+_tls = threading.local()
 
 
 def _set_session(s: Optional[TrainSession]):
@@ -255,7 +272,11 @@ def _set_session(s: Optional[TrainSession]):
 
 
 def _get_session() -> Optional[TrainSession]:
-    return _session
+    # loop threads resolve their own session (see TrainSession._run);
+    # anything else (actor control lane, user helper threads) gets the
+    # process-current one
+    tls = getattr(_tls, "session", None)
+    return tls if tls is not None else _session
 
 
 def report(metrics: Dict[str, Any],

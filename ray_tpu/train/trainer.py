@@ -144,6 +144,13 @@ class JaxTrainer:
         error: Optional[BaseException] = None
         n = self.scaling_config.num_workers
         rounds = 0  # report rounds consumed, survives restarts
+        elastic = getattr(self.backend_config, "elastic", None)
+        # safety net: every genuine loss shrinks the width toward
+        # min_workers, so recoveries are naturally bounded — this cap only
+        # guards against a pathological notice/restart loop
+        elastic_recoveries = 0
+        max_elastic_recoveries = 2 * n + 2
+        per_worker_cks: Optional[List[Optional[Checkpoint]]] = None
         self._publish_state(trial_name, "RUNNING", None, 0)
         try:
             while True:
@@ -152,7 +159,9 @@ class JaxTrainer:
                         self._train_fn, self._config, experiment_name,
                         trial_name, trial_dir, checkpoint=restore,
                         dataset_shards_per_worker=self._shard_datasets(n),
-                        start_iteration=rounds)
+                        start_iteration=rounds,
+                        per_worker_checkpoints=per_worker_cks)
+                    per_worker_cks = None
                     while True:
                         results = executor.get_next_results()
                         if results is None:
@@ -176,6 +185,30 @@ class JaxTrainer:
                     executor.finish_training()
                     break
                 except TrainingWorkerError as e:
+                    if (elastic is not None
+                            and elastic_recoveries < max_elastic_recoveries):
+                        try:
+                            cks, step, new_n = executor.elastic_recover()
+                        except Exception as rec_err:
+                            logger.warning(
+                                "elastic recovery unavailable (%s); falling "
+                                "back to storage-checkpoint restart",
+                                rec_err)
+                        else:
+                            # in-memory recovery: does NOT count against
+                            # max_failures (bounded by width shrinking to
+                            # min_workers + the recoveries cap above)
+                            elastic_recoveries += 1
+                            per_worker_cks = cks
+                            n = new_n
+                            ckpt_mgr.note_emergency(step)
+                            logger.warning(
+                                "elastic recovery %d: resuming %d-wide from "
+                                "replicated snapshot step=%d (trigger: %s)",
+                                elastic_recoveries, new_n, step, e)
+                            self._publish_state(trial_name, "RESTARTING",
+                                                last_metrics, rounds)
+                            continue
                     failures += 1
                     if max_failures != -1 and failures > max(max_failures, 0):
                         error = e
@@ -191,6 +224,10 @@ class JaxTrainer:
                     restore = (_find_latest_checkpoint(trial_dir, n)
                                or self._resume_checkpoint)
                     executor.restart()
+                    # a full restart rebuilds at the configured width even
+                    # after elastic shrinks
+                    n = executor.worker_group.num_workers
+                    per_worker_cks = None
                 except TrainingFailedError as e:
                     error = e
                     break

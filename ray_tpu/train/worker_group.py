@@ -44,11 +44,20 @@ class RayTrainWorker:
             "hostname": socket.gethostname(),
             "pid": os.getpid(),
             "node_ip": os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1"),
+            # which raylet hosts this worker — the elastic supervisor
+            # matches drain notices (keyed by node_id) to workers
+            "node_id": os.environ.get("RAY_TPU_NODE_ID"),
             "has_tpu": has_tpu,
         }
 
     def set_env_vars(self, env: Dict[str, str]):
         os.environ.update(env)
+
+    def ping(self) -> bool:
+        """Cheap liveness probe (elastic recovery separates slow from
+        dead with a short-timeout ping rather than waiting for the
+        heartbeat-timeout death declaration)."""
+        return True
 
     # -- session lifecycle -------------------------------------------------
 
@@ -80,6 +89,22 @@ class RayTrainWorker:
             _set_session(None)
         return True
 
+    def abort_session(self) -> bool:
+        """Unwind the user loop without killing the worker process — the
+        elastic restart path keeps surviving actors alive (their
+        emergency-checkpoint vaults are the recovery source).
+
+        Short join: a loop blocked inside a collective (waiting on a
+        peer that just died) unwinds on its own once the kv poll times
+        out; recovery must not wait for it — this call doubles as the
+        driver's reachability probe and has to answer fast."""
+        if self._session is None:
+            return False
+        self._session.abort(timeout=0.2)
+        self._session = None
+        _set_session(None)
+        return True
+
 
 class Worker:
     def __init__(self, actor, metadata: Dict[str, Any]):
@@ -92,6 +117,9 @@ class WorkerGroup:
                  placement_strategy: str = "PACK",
                  actor_cls=RayTrainWorker):
         self.num_workers = num_workers
+        # bumped by shrink_to(); backends fold it into collective group
+        # names so a rebuilt gang never collides with the old rendezvous
+        self.incarnation = 0
         self._pg = placement_group(bundles, strategy=placement_strategy)
         if not self._pg.ready(timeout=60.0):
             remove_placement_group(self._pg)
@@ -150,6 +178,43 @@ class WorkerGroup:
     def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
         return ray_tpu.get(
             self.workers[rank].actor.execute.remote(fn, *args, **kwargs))
+
+    # -- elastic support ---------------------------------------------------
+
+    def ping_workers(self, timeout: float = 5.0) -> List[bool]:
+        """Probe every worker with a shared deadline; True per index that
+        answered.  Does not wait for the control plane's death declaration
+        — a worker that can't answer within `timeout` is treated as lost
+        by the elastic recovery path regardless of its official state."""
+        import time
+
+        refs = [w.actor.ping.remote() for w in self.workers]
+        deadline = time.monotonic() + timeout
+        alive = []
+        for ref in refs:
+            budget = max(0.05, deadline - time.monotonic())
+            try:
+                alive.append(bool(ray_tpu.get(ref, timeout=budget)))
+            except Exception:
+                alive.append(False)
+        return alive
+
+    def shrink_to(self, keep_indices: List[int]):
+        """Rebuild the gang from the surviving subset, in the given order.
+
+        Dropped actors are killed best-effort; the placement group is
+        kept (its bundles on dead nodes are simply unused — recreating a
+        PG mid-recovery would race the drain deadline)."""
+        keep = set(keep_indices)
+        for i, w in enumerate(self.workers):
+            if i not in keep:
+                try:
+                    ray_tpu.kill(w.actor)
+                except Exception:
+                    pass
+        self.workers = [self.workers[i] for i in keep_indices]
+        self.num_workers = len(self.workers)
+        self.incarnation += 1
 
     def shutdown(self):
         for w in self.workers:

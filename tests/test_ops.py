@@ -51,12 +51,13 @@ def test_flash_block_fitting():
 
 
 def test_flash_causal_rectangular_raises():
-    """Pallas kernels anchor the causal mask at row 0; mha_reference
-    anchors rectangular inputs at sk-sq.  Causal sq != sk must raise in
-    the pallas path instead of silently diverging from the other impls."""
+    """Without an explicit q_offset the pallas kernels would anchor the
+    causal mask at row 0 while mha_reference anchors rectangular inputs
+    at sk-sq: causal sq != sk with q_offset=0 must raise instead of
+    silently diverging (callers pass q_offset=sk-sq to opt in)."""
     q, _, _ = _qkv(b=1, h=1, s=128, d=32)
     k, v = _qkv(b=1, h=1, s=256, d=32, seed=1)[1:]
-    with pytest.raises(ValueError, match="sq"):
+    with pytest.raises(ValueError, match="q_offset"):
         flash_attention(q, k, v, True, None, 64, 64, True)
     # non-causal rectangular stays supported
     out = flash_attention(q, k, v, False, None, 64, 64, True)
@@ -64,17 +65,46 @@ def test_flash_causal_rectangular_raises():
     assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
 
 
-def test_attention_causal_rectangular_routes_to_xla():
-    """The dispatcher must not hand causal rectangular inputs to pallas;
-    the xla path applies the bottom-right (decode-aligned) mask and
-    matches the reference."""
+def test_flash_q_offset_decode_alignment():
+    """q_offset=sk-sq gives the bottom-right (decode) causal alignment:
+    fwd, dq/dk/dv and the lse variant all match the dense oracle on a
+    rectangular multi-block grid."""
+    q, _, _ = _qkv(b=1, h=2, s=128, d=32)
+    k, v = _qkv(b=1, h=2, s=256, d=32, seed=1)[1:]
+    ref = mha_reference(q, k, v, causal=True)  # bottom-right for sq<sk
+    out = flash_attention(q, k, v, True, None, 64, 64, True, 128)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+    out_lse, _ = flash_attention_with_lse(q, k, v, True, None, 64, 64,
+                                          True, 128)
+    assert np.allclose(np.asarray(out_lse), np.asarray(ref), atol=2e-4)
+
+    def loss_f(q_, k_, v_):
+        return jnp.sum(flash_attention(q_, k_, v_, True, None,
+                                       64, 64, True, 128) ** 2)
+
+    def loss_ref(q_, k_, v_):
+        return jnp.sum(mha_reference(q_, k_, v_, causal=True) ** 2)
+
+    g_f = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_ref):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_attention_causal_rectangular_matches_reference():
+    """Causal rectangular through the dispatcher: sq < sk (decode /
+    sliding-window shapes) auto-sets q_offset=sk-sq on the pallas paths
+    and the xla path applies the same bottom-right mask — every impl
+    agrees with the reference."""
     q, _, _ = _qkv(b=1, h=2, s=128, d=32)
     k, v = _qkv(b=1, h=2, s=256, d=32, seed=1)[1:]
     ref = mha_reference(q, k, v, causal=True)
-    out = attention(q, k, v, causal=True)  # auto -> xla on any backend
+    out = attention(q, k, v, causal=True)  # auto
     assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
     out_xla = attention(q, k, v, causal=True, impl="xla")
     assert np.allclose(np.asarray(out_xla), np.asarray(ref), atol=2e-5)
+    out_pl = attention(q, k, v, causal=True, impl="pallas_interpret")
+    assert np.allclose(np.asarray(out_pl), np.asarray(ref), atol=2e-4)
 
 
 @pytest.mark.parametrize("causal", [False, True])
