@@ -248,6 +248,30 @@ def bench_gpt_step():
                        + " | ".join(e[:400] for e in errs)) from last
 
 
+# --emit-telemetry: the step loops below record a per-step phase
+# breakdown (StepTimer) whose aggregate lands in the BENCH_*.json row as
+# "telemetry", so a perf regression is attributable to a phase.  Fencing
+# every step costs a sync, so it is opt-in.
+_LAST_TELEMETRY = None
+
+
+def _maybe_step_timer(steps: int):
+    if not os.environ.get("BENCH_EMIT_TELEMETRY"):
+        return None
+    try:
+        from ray_tpu.telemetry import StepTimer
+
+        return StepTimer(ring_size=max(int(steps), 1))
+    except Exception:
+        return None
+
+
+def _finish_timer(timer) -> None:
+    global _LAST_TELEMETRY
+    if timer is not None:
+        _LAST_TELEMETRY = timer.aggregate()
+
+
 def _gpt_step_run(remat: bool, policy: str = "full"):
     import jax
     import numpy as np
@@ -280,11 +304,20 @@ def _gpt_step_run(remat: bool, policy: str = "full"):
     b = shard_batch({"tokens": tokens}, mesh)
     state, m = step_fn(state, b)  # compile
     float(m["loss"])  # host transfer = true synchronization
+    timer = _maybe_step_timer(steps)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state, m = step_fn(state, b)
+    for i in range(steps):
+        if timer is not None:
+            timer.step_start(i)
+            with timer.phase("compute") as ph:
+                state, m = step_fn(state, b)
+                ph.fence(m["loss"])
+            timer.step_end(i)
+        else:
+            state, m = step_fn(state, b)
     loss = float(m["loss"])  # depends on the whole chain; forces completion
     dt = time.perf_counter() - t0
+    _finish_timer(timer)
     tokens_per_s = steps * batch_size * seq / dt
     # training FLOPs/token ~= 6N (fwd+bwd matmuls) + attention term
     n_params = gpt.num_params(cfg)
@@ -643,11 +676,20 @@ def bench_resnet_step():
     flops_per_step = _compiled_flops(compiled)
     params, state, opt, loss = step(params, state, opt, b)  # warm
     float(loss)
+    timer = _maybe_step_timer(steps)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        params, state, opt, loss = step(params, state, opt, b)
+    for i in range(steps):
+        if timer is not None:
+            timer.step_start(i)
+            with timer.phase("compute") as ph:
+                params, state, opt, loss = step(params, state, opt, b)
+                ph.fence(loss)
+            timer.step_end(i)
+        else:
+            params, state, opt, loss = step(params, state, opt, b)
     loss = float(loss)
     dt = time.perf_counter() - t0
+    _finish_timer(timer)
     images_per_s = steps * batch / dt
     peak = _peak_flops(jax.devices()[0])
     mfu = None
@@ -680,6 +722,8 @@ def _resnet_only_main():
         row["resnet_flops_per_step"] = flops
     if mfu is not None:
         row["resnet_mfu"] = round(mfu, 4)
+    if _LAST_TELEMETRY:
+        row["telemetry"] = _LAST_TELEMETRY
     if jax.default_backend() != "cpu":
         _cache_store(row, model="resnet")
     print(json.dumps(row), flush=True)
@@ -709,6 +753,8 @@ def _gpt_only_main():
     }
     if mfu is not None:
         row[f"{arch}_mfu"] = round(mfu, 4)
+    if _LAST_TELEMETRY:
+        row["telemetry"] = _LAST_TELEMETRY
     # the child owns the cache write: every consumer of a real-chip
     # number (extras stage, scripts/tpu_watch.sh) goes through here.
     # ONLY the untouched headline config may overwrite the headline
@@ -1233,6 +1279,9 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--emit-telemetry" in sys.argv:
+        # env (not a flag) so child bench subprocesses inherit it
+        os.environ["BENCH_EMIT_TELEMETRY"] = "1"
     if "--client-child" in sys.argv:
         i = sys.argv.index("--client-child")
         _client_child_main(sys.argv[i + 1], sys.argv[i + 2],

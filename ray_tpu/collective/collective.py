@@ -34,9 +34,28 @@ from ray_tpu._private.protocol import Backoff
 from ray_tpu.collective.compression import (CompressionConfig, compress_array,
                                             decompress_array,
                                             resolve_compression,
-                                            result_block_size)
+                                            result_block_size, wire_ratio)
+from ray_tpu.util import tracing
 
 _NS = "collective"
+
+
+def _record_op(op: str, t0: float, x: Optional[np.ndarray] = None,
+               cc: Optional[CompressionConfig] = None):
+    """Feed the flight recorder (telemetry.recorder): op latency into the
+    current step's "collective" phase + Prometheus series, logical vs
+    wire bytes so compression savings are visible in production."""
+    try:
+        from ray_tpu.telemetry import recorder as _rec
+
+        payload = float(x.nbytes) if x is not None else 0.0
+        wire = None
+        if x is not None and cc is not None:
+            wire = payload * wire_ratio(x.size, cc,
+                                        baseline_itemsize=x.itemsize)
+        _rec.record_collective(op, time.perf_counter() - t0, payload, wire)
+    except Exception:
+        pass
 
 
 def _kv():
@@ -90,22 +109,26 @@ def init_collective_group(world_size: int, rank: int, backend: str = "kv",
     members arrive (reference: collective.py:120)."""
     if backend not in ("kv", "xla"):
         raise ValueError(f"unknown backend {backend!r}")
-    g = GroupHandle(group_name, world_size, rank, backend)
-    _groups[group_name] = g
-    _kv_put(f"{group_name}/init/{rank}", b"1")
-    deadline = time.monotonic() + 120.0
-    bo = Backoff(base=0.005, cap=0.1)
-    while True:
-        n = sum(1 for r in range(world_size)
-                if _kv().call("kv_exists",
-                              {"ns": _NS, "key": f"{group_name}/init/{r}"}))
-        if n == world_size:
-            return g
-        remaining = deadline - time.monotonic()
-        if remaining <= 0:
-            raise TimeoutError(f"collective group {group_name} init: only "
-                               f"{n}/{world_size} arrived")
-        bo.sleep(max_s=remaining)
+    with tracing.span("collective.init", group=group_name,
+                      world_size=world_size, rank=rank, backend=backend):
+        g = GroupHandle(group_name, world_size, rank, backend)
+        _groups[group_name] = g
+        _kv_put(f"{group_name}/init/{rank}", b"1")
+        deadline = time.monotonic() + 120.0
+        bo = Backoff(base=0.005, cap=0.1)
+        while True:
+            n = sum(1 for r in range(world_size)
+                    if _kv().call(
+                        "kv_exists",
+                        {"ns": _NS, "key": f"{group_name}/init/{r}"}))
+            if n == world_size:
+                return g
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"collective group {group_name} init: only "
+                    f"{n}/{world_size} arrived")
+            bo.sleep(max_s=remaining)
 
 
 def get_group_handle(group_name: str = "default") -> GroupHandle:
@@ -130,19 +153,23 @@ def destroy_collective_group(group_name: str = "default"):
     g = _groups.pop(group_name, None)
     if g is None:
         return
-    _kv_put(f"{g.name}/fin/{g.rank}", b"1")
-    arrived = sum(
-        1 for r in range(g.world_size)
-        if _kv().call("kv_exists", {"ns": _NS, "key": f"{g.name}/fin/{r}"}))
-    if arrived < g.world_size:
-        return
-    prefix = f"{g.name}/"
-    try:
-        residual = _kv().call("kv_keys", {"ns": _NS, "prefix": prefix}) or []
-    except Exception:
-        residual = []
-    for k in set(residual) | {f"{g.name}/init/{g.rank}"}:
-        _kv_del(k)
+    with tracing.span("collective.destroy", group=group_name,
+                      world_size=g.world_size, rank=g.rank):
+        _kv_put(f"{g.name}/fin/{g.rank}", b"1")
+        arrived = sum(
+            1 for r in range(g.world_size)
+            if _kv().call("kv_exists",
+                          {"ns": _NS, "key": f"{g.name}/fin/{r}"}))
+        if arrived < g.world_size:
+            return
+        prefix = f"{g.name}/"
+        try:
+            residual = _kv().call("kv_keys",
+                                  {"ns": _NS, "prefix": prefix}) or []
+        except Exception:
+            residual = []
+        for k in set(residual) | {f"{g.name}/init/{g.rank}"}:
+            _kv_del(k)
 
 
 def _as_numpy(t) -> np.ndarray:
@@ -153,9 +180,13 @@ def barrier(group_name: str = "default"):
     """All members rendezvous (reference: collective.py:298)."""
     g = get_group_handle(group_name)
     g.op_idx += 1
-    _kv_put(g._key("bar", g.rank), b"1")
-    for r in range(g.world_size):
-        _kv_get(g._key("bar", r))
+    t0 = time.perf_counter()
+    try:
+        _kv_put(g._key("bar", g.rank), b"1")
+        for r in range(g.world_size):
+            _kv_get(g._key("bar", r))
+    finally:
+        _record_op("barrier", t0)
 
 
 def _xla_stacked(g: GroupHandle, x: np.ndarray):
@@ -352,32 +383,36 @@ def allreduce(tensor, group_name: str = "default", op: str = "sum",
     g.op_idx += 1
     x = _as_numpy(tensor)
     cc = _resolve_op_compression(x, op, compression)
-    if g.backend == "xla":
-        if op not in _XLA_REDUCE:
-            raise ValueError(f"unknown op {op}")
-        if cc is not None:
-            return _xla_compressed_allreduce(g, x, op, cc)
-        return _xla_run(g, x, f"allreduce-{op}", _XLA_REDUCE[op])
-    if cc is not None:
-        return _kv_compressed_allreduce(g, x, op, cc)
-    _kv_put(g._key("ar", g.rank), pickle.dumps(x, protocol=5))
-    if g.rank == 0:
-        acc = x.copy()
-        for r in range(1, g.world_size):
-            other = pickle.loads(_kv_get(g._key("ar", r)))
-            if op == "sum" or op == "mean":
-                acc = acc + other
-            elif op == "max":
-                acc = np.maximum(acc, other)
-            elif op == "min":
-                acc = np.minimum(acc, other)
-            else:
+    t0 = time.perf_counter()
+    try:
+        if g.backend == "xla":
+            if op not in _XLA_REDUCE:
                 raise ValueError(f"unknown op {op}")
-        if op == "mean":
-            acc = acc / g.world_size
-        _kv_put(g._key("ar", -1), pickle.dumps(acc, protocol=5))
-        return acc
-    return pickle.loads(_kv_get(g._key("ar", -1)))
+            if cc is not None:
+                return _xla_compressed_allreduce(g, x, op, cc)
+            return _xla_run(g, x, f"allreduce-{op}", _XLA_REDUCE[op])
+        if cc is not None:
+            return _kv_compressed_allreduce(g, x, op, cc)
+        _kv_put(g._key("ar", g.rank), pickle.dumps(x, protocol=5))
+        if g.rank == 0:
+            acc = x.copy()
+            for r in range(1, g.world_size):
+                other = pickle.loads(_kv_get(g._key("ar", r)))
+                if op == "sum" or op == "mean":
+                    acc = acc + other
+                elif op == "max":
+                    acc = np.maximum(acc, other)
+                elif op == "min":
+                    acc = np.minimum(acc, other)
+                else:
+                    raise ValueError(f"unknown op {op}")
+            if op == "mean":
+                acc = acc / g.world_size
+            _kv_put(g._key("ar", -1), pickle.dumps(acc, protocol=5))
+            return acc
+        return pickle.loads(_kv_get(g._key("ar", -1)))
+    finally:
+        _record_op("allreduce", t0, x, cc)
 
 
 def allgather(tensor, group_name: str = "default",
@@ -391,18 +426,24 @@ def allgather(tensor, group_name: str = "default",
     g = get_group_handle(group_name)
     g.op_idx += 1
     x = _as_numpy(tensor)
-    if g.backend == "xla":
-        stacked = _xla_run(g, x, "allgather", _xla_identity)
-        return [stacked[r] for r in range(g.world_size)]
     cc = _resolve_op_compression(x, "sum", compression) \
         if compression is not None else None
-    if cc is not None:
-        payload = compress_array(x, cc, _rng_for(g, cc, g.rank))
-        _kv_put(g._key("qag", g.rank), pickle.dumps(payload, protocol=5))
-        return [decompress_array(pickle.loads(_kv_get(g._key("qag", r))))
-                .astype(x.dtype) for r in range(g.world_size)]
-    _kv_put(g._key("ag", g.rank), pickle.dumps(x, protocol=5))
-    return [pickle.loads(_kv_get(g._key("ag", r))) for r in range(g.world_size)]
+    t0 = time.perf_counter()
+    try:
+        if g.backend == "xla":
+            stacked = _xla_run(g, x, "allgather", _xla_identity)
+            return [stacked[r] for r in range(g.world_size)]
+        if cc is not None:
+            payload = compress_array(x, cc, _rng_for(g, cc, g.rank))
+            _kv_put(g._key("qag", g.rank), pickle.dumps(payload, protocol=5))
+            return [decompress_array(pickle.loads(_kv_get(g._key("qag", r))))
+                    .astype(x.dtype) for r in range(g.world_size)]
+        _kv_put(g._key("ag", g.rank), pickle.dumps(x, protocol=5))
+        return [pickle.loads(_kv_get(g._key("ag", r)))
+                for r in range(g.world_size)]
+    finally:
+        _record_op("allgather", t0, x,
+                   cc if g.backend != "xla" else None)
 
 
 def reducescatter(tensor, group_name: str = "default", op: str = "sum",
@@ -424,19 +465,27 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     kv backend only reads the root's tensor."""
     g = get_group_handle(group_name)
     g.op_idx += 1
-    if g.backend == "xla":
-        if tensor is None:
-            raise TypeError(
-                "broadcast on the xla backend is an SPMD op: every rank "
-                "must pass a same-shape/dtype tensor (non-root values "
-                "are ignored); got None — pass e.g. np.zeros_like(root)")
-        return _xla_run(g, _as_numpy(tensor), f"broadcast-{src_rank}",
-                        functools.partial(_xla_take_row, src=src_rank))
-    if g.rank == src_rank:
-        _kv_put(g._key("bc", src_rank), pickle.dumps(_as_numpy(tensor),
-                                                     protocol=5))
-        return _as_numpy(tensor)
-    return pickle.loads(_kv_get(g._key("bc", src_rank)))
+    t0 = time.perf_counter()
+    x = None
+    try:
+        if g.backend == "xla":
+            if tensor is None:
+                raise TypeError(
+                    "broadcast on the xla backend is an SPMD op: every rank "
+                    "must pass a same-shape/dtype tensor (non-root values "
+                    "are ignored); got None — pass e.g. np.zeros_like(root)")
+            x = _as_numpy(tensor)
+            return _xla_run(g, x, f"broadcast-{src_rank}",
+                            functools.partial(_xla_take_row, src=src_rank))
+        if g.rank == src_rank:
+            x = _as_numpy(tensor)
+            _kv_put(g._key("bc", src_rank), pickle.dumps(x, protocol=5))
+            return x
+        out = pickle.loads(_kv_get(g._key("bc", src_rank)))
+        x = out
+        return out
+    finally:
+        _record_op("broadcast", t0, x)
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):

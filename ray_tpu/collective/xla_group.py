@@ -26,9 +26,32 @@ from ray_tpu._private.jax_compat import shard_map
 
 from ray_tpu.collective.compression import (CompressionConfig,
                                             parse_compression,
-                                            result_block_size)
+                                            result_block_size, wire_ratio)
 from ray_tpu.ops.quantize import (dequantize_blockwise, padded_len,
                                   quantize_blockwise)
+from ray_tpu.util import tracing
+
+import time
+
+
+def _record_mesh_op(op: str, t0: float, x,
+                    cc: Optional[CompressionConfig]) -> None:
+    """Report dispatch time + byte counters to the flight recorder.
+    Dispatch-side only — no forced fence here: blocking the hot path to
+    measure it would serialize the very overlap XLA buys us.  Device
+    time lands in the step's fenced total instead."""
+    try:
+        from ray_tpu.telemetry import recorder as _rec
+
+        nbytes = float(getattr(x, "nbytes", 0) or 0)
+        wire = None
+        if nbytes and cc is not None:
+            itemsize = getattr(getattr(x, "dtype", None), "itemsize", 4)
+            wire = nbytes * wire_ratio(x.size, cc,
+                                       baseline_itemsize=itemsize)
+        _rec.record_collective(op, time.perf_counter() - t0, nbytes, wire)
+    except Exception:
+        pass
 
 
 def _axis(mesh: Mesh, axis_name: Optional[str]) -> str:
@@ -76,13 +99,19 @@ def mesh_allreduce(x, mesh: Mesh, axis_name: Optional[str] = None,
     feeds stochastic rounding when the config asks for it."""
     axis = _axis(mesh, axis_name)
     cc = parse_compression(compression)
-    if cc is None:
-        return _allreduce_impl(x, mesh, axis, op)
-    if op not in ("sum", "mean"):
-        raise ValueError(f"compressed allreduce supports op in "
-                         f"('sum', 'mean'), got {op!r}")
-    return _q_allreduce_impl(x, jnp.int32(seed), mesh, axis, op,
-                             cc.block_size, cc.stochastic)
+    t0 = time.perf_counter()
+    with tracing.span("collective.mesh_allreduce", axis=axis, op=op,
+                      compressed=cc is not None):
+        if cc is None:
+            out = _allreduce_impl(x, mesh, axis, op)
+        else:
+            if op not in ("sum", "mean"):
+                raise ValueError(f"compressed allreduce supports op in "
+                                 f"('sum', 'mean'), got {op!r}")
+            out = _q_allreduce_impl(x, jnp.int32(seed), mesh, axis, op,
+                                    cc.block_size, cc.stochastic)
+    _record_mesh_op("mesh_allreduce", t0, x, cc)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -223,10 +252,16 @@ def mesh_allgather(x, mesh: Mesh, axis_name: Optional[str] = None,
     (lossy; see compression.py)."""
     axis = _axis(mesh, axis_name)
     cc = parse_compression(compression)
-    if cc is None:
-        return _allgather_impl(x, mesh, axis, True)
-    return _q_allgather_impl(x, jnp.int32(seed), mesh, axis, cc.block_size,
-                             cc.stochastic)
+    t0 = time.perf_counter()
+    with tracing.span("collective.mesh_allgather", axis=axis,
+                      compressed=cc is not None):
+        if cc is None:
+            out = _allgather_impl(x, mesh, axis, True)
+        else:
+            out = _q_allgather_impl(x, jnp.int32(seed), mesh, axis,
+                                    cc.block_size, cc.stochastic)
+    _record_mesh_op("mesh_allgather", t0, x, cc)
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis"))
@@ -252,15 +287,22 @@ def mesh_reducescatter(x, mesh: Mesh, axis_name: Optional[str] = None,
     contributions travel as int8 blocks + scales (sum semantics, lossy)."""
     axis = _axis(mesh, axis_name)
     cc = parse_compression(compression)
-    if cc is None:
-        return _reducescatter_impl(x, mesh, axis)
-    world = mesh.shape[axis]
-    if x.shape[-1] % world:
-        raise ValueError(f"compressed reducescatter needs the payload dim "
-                         f"({x.shape[-1]}) divisible by the axis size "
-                         f"({world})")
-    return _q_reducescatter_impl(x, jnp.int32(seed), mesh, axis,
-                                 cc.block_size, cc.stochastic)
+    t0 = time.perf_counter()
+    with tracing.span("collective.mesh_reducescatter", axis=axis,
+                      compressed=cc is not None):
+        if cc is None:
+            out = _reducescatter_impl(x, mesh, axis)
+        else:
+            world = mesh.shape[axis]
+            if x.shape[-1] % world:
+                raise ValueError(
+                    f"compressed reducescatter needs the payload dim "
+                    f"({x.shape[-1]}) divisible by the axis size "
+                    f"({world})")
+            out = _q_reducescatter_impl(x, jnp.int32(seed), mesh, axis,
+                                        cc.block_size, cc.stochastic)
+    _record_mesh_op("mesh_reducescatter", t0, x, cc)
+    return out
 
 
 def mesh_broadcast(x, mesh: Mesh, axis_name: Optional[str] = None,

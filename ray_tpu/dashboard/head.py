@@ -190,6 +190,15 @@ class DashboardHead:
                         runs.append(json.loads(raw))
                 runs.sort(key=lambda r: -(r.get("ts") or 0))
                 return self._json(runs[:100])
+            if path == "/api/train/timeline":
+                # flight-recorder rings -> Chrome trace-event JSON (loads
+                # straight into Perfetto); ?trial= filters to one run
+                from ray_tpu.telemetry.timeline import (chrome_trace,
+                                                        collect_snapshots)
+
+                trial = (query.get("trial") or [None])[0]
+                snaps = collect_snapshots(self.control, trial=trial)
+                return self._json(chrome_trace(snaps))
             if path == "/api/serve":
                 # snapshot the serve controller publishes each reconcile
                 # pass (serve/_controller.py _publish_status)

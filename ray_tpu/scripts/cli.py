@@ -383,6 +383,27 @@ def cmd_summary(args):
 
 
 def cmd_timeline(args):
+    if args.job:
+        # training flight-recorder dump: per-step phase breakdowns from
+        # every worker of one trial, as Chrome trace-event JSON
+        from ray_tpu._private.protocol import Client
+        from ray_tpu.telemetry.timeline import (chrome_trace,
+                                                collect_snapshots)
+
+        address = _resolve_address(args)
+        host, port = address.rsplit(":", 1)
+        control = Client((host, int(port)), name="cli-timeline")
+        try:
+            snaps = collect_snapshots(control, trial=args.job)
+            trace = chrome_trace(snaps)
+        finally:
+            control.close()
+        with open(args.output, "w") as f:
+            json.dump(trace, f)
+        steps = sum(len(s.get("steps", [])) for s in snaps)
+        print(f"wrote {args.output} ({len(snaps)} workers, {steps} step "
+              f"records for trial {args.job!r})")
+        return
     from ray_tpu.util.state import api as state
 
     state.timeline(args.output, address=_resolve_address(args))
@@ -489,7 +510,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_summary)
 
-    sp = sub.add_parser("timeline", help="export Chrome trace")
+    sp = sub.add_parser("timeline", help="export Chrome trace (pass a "
+                        "trial name for the training flight recorder)")
+    sp.add_argument("job", nargs="?", default=None,
+                    help="trial name: dump that run's per-step telemetry "
+                         "instead of the cluster task timeline")
     sp.add_argument("-o", "--output", default="timeline.json")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_timeline)

@@ -1,0 +1,62 @@
+"""ray_tpu.telemetry: the training flight recorder.
+
+Four cooperating pieces (see COMPONENTS.md):
+
+  * recorder   — per-worker StepTimer: phase-resolved step timing
+    (data / compute / collective / checkpoint) with
+    ``jax.block_until_ready`` fences, a bounded ring buffer, and
+    rate-limited KV snapshot flushes; ``record_collective`` is the hook
+    the collective layer reports per-op timing + wire bytes through.
+  * goodput    — GoodputAccountant: wall-clock state machine
+    (productive / draining / recovering / idle) stamped by the elastic
+    subsystem across incarnations.
+  * aggregator — driver-side StepAggregator: merges per-round
+    cross-worker step records, flags stragglers (busy time >
+    multiple × gang median, sustained-N hysteresis) and publishes
+    ``straggler_detected`` advisories on the "train" topic.
+  * timeline   — Chrome trace-event export for Perfetto, serving
+    ``GET /api/train/timeline`` and ``ray-tpu timeline <job>``.
+
+Exports resolve lazily (PEP 562) so importing ``ray_tpu`` does not drag
+the train stack in.
+"""
+
+_EXPORTS = {
+    "TelemetryConfig": "config",
+    "resolve_telemetry": "config",
+    "StepTimer": "recorder",
+    "phase": "recorder",
+    "set_current_timer": "recorder",
+    "current_timer": "recorder",
+    "record_collective": "recorder",
+    "flush_snapshot": "recorder",
+    "TELEMETRY_KEY_PREFIX": "recorder",
+    "GoodputAccountant": "goodput",
+    "set_current_accountant": "goodput",
+    "current_accountant": "goodput",
+    "stamp": "goodput",
+    "StepAggregator": "aggregator",
+    "collect_snapshots": "timeline",
+    "chrome_trace": "timeline",
+    "validate_chrome_trace": "timeline",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        modname = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}") from None
+    import importlib
+
+    mod = importlib.import_module(f".{modname}", __name__)
+    val = getattr(mod, name)
+    globals()[name] = val
+    return val
+
+
+def __dir__():
+    return __all__

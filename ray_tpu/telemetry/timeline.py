@@ -1,0 +1,106 @@
+"""Timeline export: ring-buffer snapshots -> Chrome trace-event JSON.
+
+The dashboard's ``GET /api/train/timeline`` and the
+``ray-tpu timeline <job>`` CLI both route through here. Output follows
+the Trace Event Format ("X" complete events, µs timestamps) so the
+payload drops straight into Perfetto / chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List, Optional
+
+from .recorder import PHASE_ORDER, TELEMETRY_KEY_PREFIX
+from ..util.metrics import METRICS_NS
+
+
+def collect_snapshots(control_client,
+                      trial: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Pull every worker's last flushed ring snapshot from control KV."""
+    snaps: List[Dict[str, Any]] = []
+    try:
+        keys = control_client.call(
+            "kv_keys", {"ns": METRICS_NS, "prefix": TELEMETRY_KEY_PREFIX},
+            timeout=5.0)
+        for k in keys:
+            raw = control_client.call(
+                "kv_get", {"ns": METRICS_NS, "key": k}, timeout=5.0)
+            if not raw:
+                continue
+            try:
+                snap = pickle.loads(raw)
+            except Exception:
+                continue
+            tel = snap.get("telemetry")
+            if not isinstance(tel, dict):
+                continue
+            if trial and tel.get("trial") != trial:
+                continue
+            tel["worker_id"] = k[len(TELEMETRY_KEY_PREFIX):]
+            snaps.append(tel)
+    except Exception:
+        pass
+    return snaps
+
+
+def _phase_sorted(phases: Dict[str, float]) -> List[str]:
+    known = [p for p in PHASE_ORDER if p in phases]
+    extra = sorted(p for p in phases if p not in PHASE_ORDER)
+    return known + extra
+
+
+def chrome_trace(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Render snapshots as a Chrome trace: one process per worker rank,
+    an "X" span per step plus sequential per-phase child spans."""
+    events: List[Dict[str, Any]] = []
+    for snap in sorted(snapshots, key=lambda s: s.get("rank", 0)):
+        rank = snap.get("rank", 0)
+        pid = rank
+        label = f"worker {rank}"
+        if snap.get("trial"):
+            label = f"{snap['trial']} / {label}"
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": label}})
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": "train step"}})
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": 1, "args": {"name": "phases"}})
+        for rec in snap.get("steps", []):
+            ts_us = rec["ts"] * 1e6
+            dur_us = max(rec["dur"] * 1e6, 0.001)
+            step = rec.get("step")
+            events.append({
+                "name": f"step {step}" if step is not None else "step",
+                "ph": "X", "ts": ts_us, "dur": dur_us,
+                "pid": pid, "tid": 0,
+                "args": {"step": step, "dur_s": rec["dur"],
+                         "incarnation": rec.get("incarnation"),
+                         "phases": rec.get("phases", {})},
+            })
+            # phases have durations, not start offsets — lay them out
+            # sequentially in canonical order on a sibling track
+            cursor = ts_us
+            phases = rec.get("phases") or {}
+            for name in _phase_sorted(phases):
+                p_us = max(phases[name] * 1e6, 0.001)
+                events.append({
+                    "name": name, "ph": "X", "ts": cursor, "dur": p_us,
+                    "pid": pid, "tid": 1,
+                    "args": {"step": step, "seconds": phases[name]},
+                })
+                cursor += p_us
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def validate_chrome_trace(trace: Dict[str, Any]) -> bool:
+    """Structural check used by tests/CLI: is this loadable trace JSON?"""
+    if not isinstance(trace, dict) or \
+            not isinstance(trace.get("traceEvents"), list):
+        return False
+    for ev in trace["traceEvents"]:
+        if not isinstance(ev, dict) or "ph" not in ev or "name" not in ev:
+            return False
+        if ev["ph"] == "X" and not ("ts" in ev and "dur" in ev):
+            return False
+    return True

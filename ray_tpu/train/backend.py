@@ -29,6 +29,7 @@ from ray_tpu.collective.compression import CompressionConfig, parse_compression
 
 if TYPE_CHECKING:
     from ray_tpu.elastic.config import ElasticConfig
+    from ray_tpu.telemetry.config import TelemetryConfig
 
 logger = logging.getLogger(__name__)
 
@@ -208,6 +209,11 @@ class JaxConfig(BackendConfig):
     # emergency checkpoints + shrink-to-fit restarts (see
     # ray_tpu.elastic.ElasticConfig / COMPONENTS.md)
     elastic: Optional["ElasticConfig"] = None
+    # training flight recorder (ray_tpu.telemetry): None/True = on with
+    # defaults; TelemetryConfig(...) to tune ring size / straggler
+    # thresholds; False to disable step timing + goodput accounting
+    telemetry: Union[None, bool, Dict[str, Any],
+                     "TelemetryConfig"] = None
 
     def backend_cls(self):
         return _JaxBackend

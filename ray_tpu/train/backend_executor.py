@@ -62,6 +62,9 @@ class BackendExecutor:
         # rounds consumed since the last (re)start — the elastic restart
         # resumes session iteration numbering from here
         self.rounds_consumed = 0
+        # GoodputAccountant installed by the trainer; drain/recover paths
+        # stamp state transitions through it when present
+        self.goodput = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -113,6 +116,12 @@ class BackendExecutor:
             return
         if event == "draining":
             self._draining_nodes.add(nid)
+            if self.goodput is not None:
+                try:
+                    if self.drain_pending():
+                        self.goodput.transition("draining", node=nid)
+                except Exception:
+                    pass
         elif event in ("drain_canceled", "removed"):
             self._draining_nodes.discard(nid)
 
@@ -171,6 +180,15 @@ class BackendExecutor:
                     ctx.extra["global_batch_size"] = ec.global_batch_size
                     ctx.extra["per_replica_batch"] = batches[ctx.world_rank]
                     ctx.extra["batch_offset"] = offsets[ctx.world_rank]
+        try:
+            from ray_tpu.telemetry import resolve_telemetry
+
+            tc = resolve_telemetry(
+                getattr(self._backend_config, "telemetry", None))
+            for ctx in ctxs:
+                ctx.extra["telemetry"] = tc.to_dict()
+        except Exception:
+            pass
         return ctxs
 
     def start_training(self, train_fn: Callable, config: Dict[str, Any],
@@ -278,6 +296,11 @@ class BackendExecutor:
         wg = self.worker_group
         if wg is None:
             raise EmergencyRecoveryError("worker group not started")
+        if self.goodput is not None:
+            try:
+                self.goodput.transition("recovering")
+            except Exception:
+                pass
         t0 = time.monotonic()
 
         # 1. abort + reachability probe in one pass: a worker that can't
@@ -357,6 +380,12 @@ class BackendExecutor:
                                            shards=shards))
         logger.info("elastic recovery completed in %.2fs",
                     time.monotonic() - t0)
+        if self.goodput is not None:
+            try:
+                self.goodput.note_incarnation(
+                    getattr(wg, "incarnation", 0))
+            except Exception:
+                pass
         return cks, step, new_n
 
     def finish_training(self):

@@ -29,7 +29,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from .backend import BackendConfig, JaxConfig
 from .backend_executor import (BackendExecutor, TrainingFailedError,
-                               TrainingWorkerError)
+                               TrainingWorkerError, WorkerDrainedError)
 from .checkpoint import Checkpoint
 from .checkpoint_manager import CheckpointManager
 from .config import RunConfig, ScalingConfig
@@ -109,7 +109,8 @@ class JaxTrainer:
     # -- the run loop (shared by fit() and the Tune trainable) -------------
 
     def _publish_state(self, trial_name: str, status: str,
-                       metrics: Optional[Dict[str, Any]], rounds: int):
+                       metrics: Optional[Dict[str, Any]], rounds: int,
+                       telemetry: Optional[Dict[str, Any]] = None):
         """Run-state snapshot into the control KV (ns 'train') for the
         dashboard (reference: TrainStateActor feeding
         dashboard/modules/train/train_head.py) — advisory, never fails
@@ -119,15 +120,18 @@ class JaxTrainer:
 
             from ray_tpu._private.api import current_core
 
+            state = {
+                "name": self.run_config.name, "trial": trial_name,
+                "status": status,
+                "workers": self.scaling_config.num_workers,
+                "rounds": rounds,
+                "last_metrics": metrics, "ts": time.time(),
+            }
+            if telemetry is not None:
+                state["telemetry"] = telemetry
             current_core().control.call("kv_put", {
                 "ns": "train", "key": trial_name,
-                "val": _json.dumps({
-                    "name": self.run_config.name, "trial": trial_name,
-                    "status": status,
-                    "workers": self.scaling_config.num_workers,
-                    "rounds": rounds,
-                    "last_metrics": metrics, "ts": time.time(),
-                }).encode()})
+                "val": _json.dumps(state).encode()})
         except Exception:
             pass
 
@@ -139,6 +143,34 @@ class JaxTrainer:
         failures = 0
         restore = self._resume_checkpoint
         executor = BackendExecutor(self.backend_config, self.scaling_config)
+        # flight recorder: goodput state machine + cross-worker straggler
+        # detection, armed before start() so early drain notices stamp
+        goodput = aggregator = None
+        try:
+            from ray_tpu.telemetry import (GoodputAccountant, StepAggregator,
+                                           resolve_telemetry,
+                                           set_current_accountant)
+
+            _tc = resolve_telemetry(
+                getattr(self.backend_config, "telemetry", None))
+            if _tc.enabled:
+                goodput = GoodputAccountant()
+                aggregator = StepAggregator(_tc, trial=trial_name)
+                executor.goodput = goodput
+                set_current_accountant(goodput)
+        except Exception:
+            pass
+
+        def _telemetry_state():
+            if goodput is None and aggregator is None:
+                return None
+            out: Dict[str, Any] = {}
+            if goodput is not None:
+                out["goodput"] = goodput.report()
+            if aggregator is not None:
+                out["stragglers"] = aggregator.summary()
+            return out
+
         executor.start()
         last_metrics: Optional[Dict[str, Any]] = None
         error: Optional[BaseException] = None
@@ -162,11 +194,21 @@ class JaxTrainer:
                         start_iteration=rounds,
                         per_worker_checkpoints=per_worker_cks)
                     per_worker_cks = None
+                    if goodput is not None:
+                        goodput.transition(
+                            "productive",
+                            incarnation=getattr(executor.worker_group,
+                                                "incarnation", 0))
                     while True:
                         results = executor.get_next_results()
                         if results is None:
                             break
                         rounds += 1
+                        if aggregator is not None:
+                            aggregator.ingest_round([
+                                m.get("telemetry")
+                                if isinstance(m, dict) else None
+                                for _, m, _ in results])
                         # rank-0 metrics are authoritative (reference keeps
                         # per-rank results; rank 0 drives callbacks)
                         _, metrics, ckpt_path = results[0]
@@ -181,10 +223,15 @@ class JaxTrainer:
                         if on_report is not None and metrics is not None:
                             on_report(metrics)
                         self._publish_state(trial_name, "RUNNING",
-                                            metrics, rounds)
+                                            metrics, rounds,
+                                            telemetry=_telemetry_state())
                     executor.finish_training()
                     break
                 except TrainingWorkerError as e:
+                    if goodput is not None:
+                        goodput.transition(
+                            "draining" if isinstance(e, WorkerDrainedError)
+                            else "recovering")
                     if (elastic is not None
                             and elastic_recoveries < max_elastic_recoveries):
                         try:
@@ -207,7 +254,8 @@ class JaxTrainer:
                                 "replicated snapshot step=%d (trigger: %s)",
                                 elastic_recoveries, new_n, step, e)
                             self._publish_state(trial_name, "RESTARTING",
-                                                last_metrics, rounds)
+                                                last_metrics, rounds,
+                                                telemetry=_telemetry_state())
                             continue
                     failures += 1
                     if max_failures != -1 and failures > max(max_failures, 0):
@@ -233,9 +281,18 @@ class JaxTrainer:
                     break
         finally:
             executor.shutdown()
+            if goodput is not None:
+                try:
+                    goodput.transition("idle")
+                    from ray_tpu.telemetry import set_current_accountant
+
+                    set_current_accountant(None)
+                except Exception:
+                    pass
             self._publish_state(trial_name,
                                 "ERRORED" if error else "FINISHED",
-                                last_metrics, rounds)
+                                last_metrics, rounds,
+                                telemetry=_telemetry_state())
         return Result(metrics=last_metrics,
                       checkpoint=ckpt_mgr.latest_checkpoint,
                       path=trial_dir, error=error,

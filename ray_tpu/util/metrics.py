@@ -15,6 +15,7 @@ import json
 import pickle
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 METRICS_NS = "_metrics"
@@ -26,16 +27,40 @@ _DEFAULT_HIST_BOUNDARIES = [
 
 
 class _Registry:
+    """Process-local metric registry.
+
+    Holds metrics by *weak* reference: user code that drops its last
+    strong ref (e.g. metrics created in a prior init/shutdown epoch)
+    gets swept instead of flushing stale series forever.
+    """
+
     def __init__(self):
         self.lock = threading.Lock()
-        self.metrics: List["Metric"] = []
+        self.metrics: List["weakref.ref[Metric]"] = []
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
     def register(self, metric: "Metric"):
         with self.lock:
-            self.metrics.append(metric)
+            self.metrics.append(weakref.ref(metric))
         self._ensure_flusher()
+
+    def deregister(self, metric: "Metric"):
+        """Explicitly drop a metric from future snapshots."""
+        with self.lock:
+            self.metrics = [r for r in self.metrics
+                            if r() is not None and r() is not metric]
+
+    def _live(self) -> List["Metric"]:
+        """Prune dead refs; caller must hold self.lock."""
+        live, refs = [], []
+        for r in self.metrics:
+            m = r()
+            if m is not None:
+                live.append(m)
+                refs.append(r)
+        self.metrics = refs
+        return live
 
     def restart_if_needed(self):
         """Re-arm the flusher after a shutdown()/init() cycle so metrics
@@ -44,13 +69,13 @@ class _Registry:
 
     def snapshot(self) -> List[Dict]:
         with self.lock:
-            return [m._snapshot() for m in self.metrics]
+            return [m._snapshot() for m in self._live()]
 
     def _ensure_flusher(self):
         with self.lock:
             if self._thread is not None:
                 return
-            if not self.metrics:
+            if not self._live():
                 return
             stop = self._stop = threading.Event()  # fresh after a stop()
             self._thread = threading.Thread(
@@ -70,7 +95,12 @@ class _Registry:
         metric registration restarts it."""
         with self.lock:
             self._stop.set()
-            self._thread = None
+            thread, self._thread = self._thread, None
+            self._live()  # sweep dead epoch refs while we hold the lock
+        if thread is not None:
+            # the set event makes stop.wait return immediately, so this
+            # join is bounded by one in-flight flush at most
+            thread.join(timeout=1.0)
 
     def flush(self):
         # non-raising core lookup: the flusher may fire after shutdown
@@ -162,6 +192,10 @@ class Metric:
         self._lock = threading.Lock()
         self._series: Dict[str, object] = {}  # json(tags) -> value
         _registry.register(self)
+
+    def deregister(self):
+        """Remove this metric from the registry (stops future flushes)."""
+        _registry.deregister(self)
 
     def set_default_tags(self, default_tags: Dict[str, str]):
         self._default_tags = dict(default_tags)

@@ -120,6 +120,15 @@ def _span(name: str, kind: str,
                 logger.exception("span sink failed")
 
 
+def span(name: str, kind: str = "INTERNAL", **attrs):
+    """Public INTERNAL span, auto-parented to the current context — a
+    span opened inside task execution links to the submitting task's
+    trace through the propagated traceparent (the collective layer uses
+    this so a stalled allreduce shows up under the task that issued it).
+    No-op contextmanager when tracing is disabled."""
+    return _span(name, kind, None, **attrs)
+
+
 def submit_span(kind: str, name: str):
     """PRODUCER span around task/actor submission (driver side)."""
     return _span(f"{kind} {name}", "PRODUCER", None)

@@ -128,7 +128,10 @@ def test_dashboard_endpoints(cluster):
         # metrics scrape endpoint
         from ray_tpu.util.metrics import _registry
 
-        Counter("test_dash_total", tag_keys=()).inc(1)
+        # hold the ref: the registry is weak (dropped metrics are swept,
+        # not flushed forever)
+        dash_total = Counter("test_dash_total", tag_keys=())
+        dash_total.inc(1)
         _registry.flush()
         status, body = _get(head.url + "/metrics")
         assert status == 200

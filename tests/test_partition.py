@@ -328,6 +328,76 @@ def test_raylet_reconnect_preserves_actors(multi_node_cluster):
         proxy.close()
 
 
+def test_telemetry_flush_survives_partition_flap(private_cluster_slot,
+                                                 multi_node_cluster):
+    """Flight-recorder chaos coverage (ISSUE 5 satellite): a severed
+    control link must degrade telemetry to a no-op — flush_snapshot
+    returns False (bounded, never raises into the train loop), the ring
+    stays bounded while cut off, and flushes resume after the heal."""
+    import ray_tpu
+    from ray_tpu.telemetry import StepTimer
+    from ray_tpu.telemetry import recorder as telemetry_recorder
+
+    c = multi_node_cluster()
+    c.add_node(resources={"CPU": 1})
+    proxy = SocketProxy(c.control_addr)
+    phost, pport = proxy.addr
+    ray_tpu.init(address=f"{phost}:{pport}")
+    try:
+        timer = StepTimer(ring_size=32, rank=0, trial="flap")
+        for i in range(100):
+            timer.step_start(i)
+            timer.step_end(i)
+        assert len(timer.snapshot()["steps"]) == 32  # ring bounded
+        assert telemetry_recorder.flush_snapshot(timer, interval_s=0.0)
+
+        proxy.sever()
+        t0 = time.monotonic()
+        assert not telemetry_recorder.flush_snapshot(timer,
+                                                     interval_s=0.0)
+        assert time.monotonic() - t0 < 30.0  # bounded, not a hang
+        # recording continues unharmed mid-partition, still bounded
+        for i in range(100, 200):
+            timer.step_start(i)
+            timer.step_end(i)
+        assert len(timer.snapshot()["steps"]) == 32
+        proxy.resume()
+
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if telemetry_recorder.flush_snapshot(timer, interval_s=0.0):
+                break
+            time.sleep(0.25)
+        else:
+            pytest.fail("flush never recovered after the heal")
+    finally:
+        ray_tpu.shutdown()
+        proxy.close()
+
+
+def test_metrics_flusher_no_thread_leak_across_cycles(private_cluster_slot):
+    """Three init/shutdown cycles: exactly one metrics-flush daemon
+    while up, zero after each shutdown — the flusher must neither leak
+    (one per epoch) nor wedge (weakref registry sweeping its metrics)."""
+    import ray_tpu
+    from ray_tpu.util.metrics import Gauge
+
+    def census():
+        return [t for t in threading.enumerate()
+                if t.name == "metrics-flush" and t.is_alive()]
+
+    for cycle in range(3):
+        ray_tpu.init(num_cpus=1)
+        g = Gauge(f"test_cycle_gauge_{cycle}")
+        g.set(float(cycle))
+        assert len(census()) == 1, census()
+        ray_tpu.shutdown()
+        deadline = time.monotonic() + 5
+        while census() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not census(), census()
+
+
 def test_graceful_unregister_is_immediate(multi_node_cluster):
     """The flip side of disconnect tolerance: a *deliberate* raylet
     shutdown must not linger ALIVE for the heartbeat-timeout window —
