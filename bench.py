@@ -250,17 +250,29 @@ def bench_gpt_step():
 
 # --emit-telemetry: the step loops below record a per-step phase
 # breakdown (StepTimer) whose aggregate lands in the BENCH_*.json row as
-# "telemetry", so a perf regression is attributable to a phase.  Fencing
-# every step costs a sync, so it is opt-in.
+# "telemetry", so a perf regression is attributable to a phase.  A
+# GoodputAccountant runs alongside so the row also carries the goodput
+# fraction and remediation count — locally those are ~1.0 and 0, but the
+# keys match what a cluster run's flight recorder reports, so the same
+# tooling reads both.  Fencing every step costs a sync, so it is opt-in.
 _LAST_TELEMETRY = None
+_BENCH_GOODPUT = None
 
 
 def _maybe_step_timer(steps: int):
+    global _BENCH_GOODPUT
     if not os.environ.get("BENCH_EMIT_TELEMETRY"):
         return None
     try:
         from ray_tpu.telemetry import StepTimer
 
+        try:
+            from ray_tpu.telemetry import GoodputAccountant
+
+            _BENCH_GOODPUT = GoodputAccountant()
+            _BENCH_GOODPUT.transition("productive")
+        except Exception:
+            _BENCH_GOODPUT = None
         return StepTimer(ring_size=max(int(steps), 1))
     except Exception:
         return None
@@ -270,6 +282,14 @@ def _finish_timer(timer) -> None:
     global _LAST_TELEMETRY
     if timer is not None:
         _LAST_TELEMETRY = timer.aggregate()
+        if _BENCH_GOODPUT is not None:
+            try:
+                rep = _BENCH_GOODPUT.report()
+                _LAST_TELEMETRY["goodput"] = round(rep["goodput"], 4)
+                _LAST_TELEMETRY["goodput_seconds"] = rep["seconds"]
+            except Exception:
+                pass
+        _LAST_TELEMETRY["remediations"] = 0  # no cluster, no engine
 
 
 def _gpt_step_run(remat: bool, policy: str = "full"):

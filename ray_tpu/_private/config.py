@@ -43,6 +43,11 @@ CONFIG_DEFS: List[Tuple[str, type, Any, str]] = [
     ("drain_grace_s", float, 30.0,
      "advisory deadline attached to a node drain notice that carries "
      "no explicit grace window"),
+    ("preemption_debounce_s", float, 5.0,
+     "flap suppression window: a preemption notice edge within this "
+     "many seconds of the last fired notice is swallowed (drain -> "
+     "cancel -> drain inside one window costs one drain report, not "
+     "two); 0 disables"),
     ("rpc_backoff_base_s", float, 0.05,
      "initial delay of the jittered-exponential backoff used by RPC "
      "reconnect/retry loops (raylet re-home, driver control rebuild, "

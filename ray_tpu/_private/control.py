@@ -80,6 +80,13 @@ class NodeRecord:
         #: but the scheduler avoids it and Train shrinks off it.
         self.draining_until: Optional[float] = None
         self.draining_reason: str = ""
+        #: remediation quarantine deadline (monotonic): a sustained-
+        #: straggler advisory got this node benched.  Quarantine is NOT
+        #: death either — the node stays alive and its vaults readable —
+        #: but the scheduler avoids it and Train rebalances off it until
+        #: the deadline passes.
+        self.quarantined_until: Optional[float] = None
+        self.quarantine_reason: str = ""
 
     def view(self):
         return {
@@ -99,6 +106,11 @@ class NodeRecord:
             "draining_remaining_s": (
                 max(0.0, self.draining_until - time.monotonic())
                 if self.draining_until is not None else None),
+            "quarantined": self.quarantined_until is not None,
+            "quarantine_reason": self.quarantine_reason,
+            "quarantine_remaining_s": (
+                max(0.0, self.quarantined_until - time.monotonic())
+                if self.quarantined_until is not None else None),
         }
 
 
@@ -265,6 +277,7 @@ class ControlServer:
         s.handle("unregister_node", self.h_unregister_node)
         s.handle("heartbeat", self.h_heartbeat)
         s.handle("report_draining", self.h_report_draining)
+        s.handle("report_quarantine", self.h_report_quarantine)
         s.handle("get_nodes", self.h_get_nodes)
         s.handle("pick_node", self.h_pick_node)
         s.handle("register_function", self.h_register_function)
@@ -665,6 +678,45 @@ class ControlServer:
                               "grace_s": grace, "reason": reason})
         return {"ok": True}
 
+    def h_report_quarantine(self, conn, p):
+        """Remediation benched a node (sustained-straggler quarantine):
+        mark the record so the scheduler avoids it, and broadcast a
+        ``node_quarantined`` advisory over pubsub so Train executors
+        rebalance off it.  ``cancel=True`` clears the bench early; the
+        health loop clears it automatically once the grace passes."""
+        nid = p["node_id"]
+        cancel = bool(p.get("cancel"))
+        with self.lock:
+            rec = self.nodes.get(nid)
+            if rec is None or rec.state == DEAD:
+                return {"ok": False, "error": f"unknown or dead node {nid}"}
+            if cancel:
+                rec.quarantined_until = None
+                rec.quarantine_reason = ""
+                grace = None
+            else:
+                grace = float(p.get("grace_s") or 600.0)
+                rec.quarantined_until = time.monotonic() + grace
+                rec.quarantine_reason = str(
+                    p.get("reason") or "sustained straggler")
+            view = rec.view()
+            reason = rec.quarantine_reason
+        event = "quarantine_cleared" if cancel else "quarantined"
+        if cancel:
+            logger.info("node %s quarantine cleared", nid[:12])
+        else:
+            logger.warning("node %s quarantined for %.1fs (%s)", nid[:12],
+                           grace, reason)
+        self.record_event(
+            severity="INFO" if cancel else "WARNING", source="remediation",
+            event_type=event, entity_id=nid,
+            message=(f"node {nid[:12]} quarantine cleared" if cancel else
+                     f"node {nid[:12]} quarantined for {grace:.1f}s "
+                     f"({reason})"))
+        self.publish("node", {"event": event, "node": view,
+                              "grace_s": grace, "reason": reason})
+        return {"ok": True}
+
     def h_get_nodes(self, conn, p):
         with self.lock:
             return [n.view() for n in self.nodes.values()]
@@ -769,11 +821,18 @@ class ControlServer:
 
     @staticmethod
     def _prefer_not_draining(cands: List[NodeRecord]) -> List[NodeRecord]:
-        """New work avoids draining nodes while any non-draining node
-        fits — but a draining node remains a last resort (its work is
-        still better placed than not placed)."""
-        fresh = [n for n in cands if n.draining_until is None]
-        return fresh or cands
+        """New work avoids draining AND quarantined nodes while any
+        untainted node fits — but a tainted node remains a last resort
+        (its work is still better placed than not placed; a quarantined
+        host is slow, not dead)."""
+        fresh = [n for n in cands if n.draining_until is None
+                 and n.quarantined_until is None]
+        if fresh:
+            return fresh
+        # among tainted, a merely-quarantined node beats one that is
+        # about to disappear
+        not_draining = [n for n in cands if n.draining_until is None]
+        return not_draining or cands
 
     def _native_pick(self, demand: Dict[str, int],
                      spread: bool) -> Optional[NodeRecord]:
@@ -789,9 +848,10 @@ class ControlServer:
         if nid is None:
             return None
         n = self.nodes.get(nid)
-        if n is not None and n.draining_until is not None:
-            # the native mirror doesn't track drains; fall back to the
-            # Python path, which prefers non-draining nodes
+        if n is not None and (n.draining_until is not None
+                              or n.quarantined_until is not None):
+            # the native mirror doesn't track drains/quarantines; fall
+            # back to the Python path, which prefers untainted nodes
             return None
         if n is not None and n.state == ALIVE and fits(n.available, demand):
             return n
@@ -1503,6 +1563,7 @@ class ControlServer:
             now = time.monotonic()
             dead_nodes: List[NodeRecord] = []
             drain_expired: List[NodeRecord] = []
+            quarantine_expired: List[NodeRecord] = []
             with self.lock:
                 for rec in self.nodes.values():
                     if rec.state == ALIVE and now - rec.last_heartbeat > NODE_DEATH_TIMEOUT_S:
@@ -1516,6 +1577,20 @@ class ControlServer:
                         rec.draining_until = None
                         rec.draining_reason = ""
                         drain_expired.append(rec)
+                    if (rec.state == ALIVE
+                            and rec.quarantined_until is not None
+                            and now > rec.quarantined_until):
+                        # quarantine served: the bench duration IS the
+                        # penalty — the node rejoins the schedulable pool
+                        rec.quarantined_until = None
+                        rec.quarantine_reason = ""
+                        quarantine_expired.append(rec)
+            for rec in quarantine_expired:
+                logger.info("node %s quarantine expired; schedulable again",
+                            rec.node_id[:12])
+                self.publish("node", {"event": "quarantine_cleared",
+                                      "node": rec.view(), "grace_s": None,
+                                      "reason": "expired"})
             for rec in drain_expired:
                 logger.info("node %s drain notice expired without death; "
                             "cleared", rec.node_id[:12])

@@ -245,7 +245,8 @@ class Raylet:
 
             self.preemption_watcher = PreemptionWatcher(
                 src, self._on_preemption_notice,
-                poll_interval_s=_wcfg().preemption_poll_s)
+                poll_interval_s=_wcfg().preemption_poll_s,
+                debounce_s=_wcfg().preemption_debounce_s)
             self.preemption_watcher.start()
             logger.info("preemption watcher active (%s)",
                         type(src).__name__)

@@ -28,12 +28,43 @@ logger = logging.getLogger(__name__)
 
 
 class LoadMetrics:
-    """Cluster demand/usage snapshot (reference: load_metrics.py)."""
+    """Cluster demand/usage snapshot (reference: load_metrics.py).
+
+    Besides resource demand, the snapshot carries the train-side health
+    signals the v2 goodput policy scales on: per-RUNNING-trial goodput
+    fractions (from the run states the Trainer publishes into KV ns
+    'train') under ``train_goodput``."""
 
     def __init__(self, control_client):
         self.control = control_client
         #: node_id -> monotonic ts when last seen busy
         self.last_busy: Dict[str, float] = {}
+
+    def _train_goodput(self) -> Dict[str, float]:
+        """trial -> goodput fraction for every RUNNING/RESTARTING run
+        that publishes telemetry.  Advisory: any failure yields {}."""
+        import json
+
+        out: Dict[str, float] = {}
+        try:
+            keys = self.control.call(
+                "kv_keys", {"ns": "train", "prefix": ""}, timeout=5.0) or []
+            for key in keys:
+                raw = self.control.call(
+                    "kv_get", {"ns": "train", "key": key}, timeout=5.0)
+                if not raw:
+                    continue
+                state = json.loads(
+                    raw.decode() if isinstance(raw, bytes) else raw)
+                if state.get("status") not in ("RUNNING", "RESTARTING"):
+                    continue
+                gp = ((state.get("telemetry") or {}).get("goodput")
+                      or {}).get("goodput")
+                if gp is not None:
+                    out[key] = float(gp)
+        except Exception:
+            return {}
+        return out
 
     def snapshot(self) -> Dict[str, Any]:
         from ray_tpu._private.protocol import Client
@@ -69,7 +100,8 @@ class LoadMetrics:
                 demands.extend(dict(b) for b in pg["bundles"])
         return {"nodes": alive, "demands": demands,
                 "idle_s": {nid: now - ts
-                           for nid, ts in self.last_busy.items()}}
+                           for nid, ts in self.last_busy.items()},
+                "train_goodput": self._train_goodput()}
 
 
 class ResourceDemandScheduler:

@@ -45,6 +45,43 @@ TERMINATED = "TERMINATED"
 
 
 @dataclass
+class GoodputPolicy:
+    """Goodput-driven scaling knobs (the self-healing loop's capacity
+    arm): when a training run's goodput sags while demand queues, spare
+    capacity is launched ahead of strict bin-packing need; while it
+    sags, idle termination pauses so recovery headroom isn't shaved.
+
+    scale_up_below: launch spares when any RUNNING trial's goodput drops
+        below this fraction AND queue pressure warrants it.
+    scale_down_above: idle termination only proceeds while every
+        RUNNING trial's goodput is at/above this fraction.
+    min_queue: queued demands required before goodput alone triggers a
+        spare launch (goodput sag with an empty queue means the gang is
+        recovering, not starved).
+    max_extra: cap on goodput-motivated spare instances on the way up at
+        any moment (counted against QUEUED/REQUESTED/ALLOCATED).
+    """
+
+    scale_up_below: float = 0.7
+    scale_down_above: float = 0.95
+    min_queue: int = 1
+    max_extra: int = 2
+
+
+def _min_goodput(snapshot: Dict[str, Any]) -> Optional[float]:
+    vals = list((snapshot.get("train_goodput") or {}).values())
+    return min(vals) if vals else None
+
+
+def _untainted(nodes: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Drop draining/quarantined nodes from a capacity view: demand that
+    only 'fits' on a node the control plane is steering work away from
+    is unmet demand, and must drive a launch."""
+    return [n for n in nodes
+            if not n.get("draining") and not n.get("quarantined")]
+
+
+@dataclass
 class Instance:
     instance_id: str
     instance_type: str
@@ -145,15 +182,19 @@ class Reconciler:
                  scheduler: ResourceDemandScheduler,
                  load_metrics: LoadMetrics,
                  idle_timeout_s: float = 60.0,
-                 request_timeout_s: float = 300.0):
+                 request_timeout_s: float = 300.0,
+                 goodput_policy: Optional[GoodputPolicy] = None):
         self.im = manager
         self.provider = provider
         self.scheduler = scheduler
         self.load = load_metrics
         self.idle_timeout_s = idle_timeout_s
         self.request_timeout_s = request_timeout_s
+        self.goodput_policy = goodput_policy
         self.num_launched = 0
         self.num_terminated = 0
+        self.num_goodput_launches = 0
+        self.num_goodput_holds = 0
 
     # -- observation --------------------------------------------------------
 
@@ -197,7 +238,14 @@ class Reconciler:
 
     def _declare_target(self, snapshot: Dict[str, Any]):
         """Compute instances to add from unmet demand (the declarative
-        step: we only *enqueue* here; launching happens in stepping)."""
+        step: we only *enqueue* here; launching happens in stepping).
+
+        Draining/quarantined nodes are dropped from the capacity view:
+        the control plane is steering work away from them, so demand
+        that only "fits" there must still drive a launch.  When a
+        goodput policy is set and a RUNNING trial's goodput sags below
+        its threshold while demand queues, spare instances are enqueued
+        beyond strict bin-packing need (capped by max_extra)."""
         pending_like = self.im.storage.get_instances(
             [QUEUED, REQUESTED, ALLOCATED])
         # feed the scheduler a view that includes instances on the way up
@@ -210,12 +258,46 @@ class Reconciler:
             extra_nodes.append({"node_id": inst.instance_id,
                                 "available": dict(res),
                                 "total": dict(res)})
-        snap["nodes"] = list(snapshot.get("nodes", [])) + extra_nodes
+        snap["nodes"] = _untainted(
+            list(snapshot.get("nodes", []))) + extra_nodes
         to_launch = self.scheduler.get_nodes_to_launch(
             snap, self._counts_by_type())
         for type_name, count in to_launch.items():
             if count > 0:
                 self.im.add_instances(type_name, count)
+        self._declare_goodput_spares(snapshot, to_launch)
+
+    def _declare_goodput_spares(self, snapshot: Dict[str, Any],
+                                demand_launch: Dict[str, int]):
+        pol = self.goodput_policy
+        if pol is None:
+            return
+        gp = _min_goodput(snapshot)
+        if gp is None or gp >= pol.scale_up_below:
+            return
+        if len(snapshot.get("demands", [])) < pol.min_queue:
+            return
+        on_the_way = len(self.im.storage.get_instances(
+            [QUEUED, REQUESTED, ALLOCATED])) + sum(demand_launch.values())
+        budget = pol.max_extra - on_the_way
+        if budget <= 0:
+            return
+        # spares take the first type with headroom under its max_workers
+        counts = self._counts_by_type()
+        total = sum(counts.values())
+        for tname, tcfg in self.scheduler.node_types.items():
+            cap = tcfg.get("max_workers", self.scheduler.max_workers)
+            room = min(cap - counts.get(tname, 0),
+                       self.scheduler.max_workers - total, budget)
+            if room <= 0:
+                continue
+            logger.info(
+                "goodput %.2f < %.2f with %d queued demands: launching "
+                "%d spare %s", gp, pol.scale_up_below,
+                len(snapshot.get("demands", [])), room, tname)
+            self.im.add_instances(tname, room)
+            self.num_goodput_launches += room
+            return
 
     def _counts_by_type(self) -> Dict[str, int]:
         counts: Dict[str, int] = {}
@@ -246,6 +328,17 @@ class Reconciler:
                 cloud_instance_id=cloud_ids[0] if cloud_ids else None)
 
     def _step_idle_termination(self, snapshot: Dict[str, Any]):
+        pol = self.goodput_policy
+        if pol is not None:
+            gp = _min_goodput(snapshot)
+            if gp is not None and gp < pol.scale_down_above:
+                # a run is below healthy goodput: keep every node — the
+                # recovery may need exactly the capacity we'd shave
+                self.num_goodput_holds += 1
+                logger.debug(
+                    "idle termination held: goodput %.2f < %.2f",
+                    gp, pol.scale_down_above)
+                return
         idle_s = snapshot.get("idle_s", {})
         min_workers = {
             t: cfg.get("min_workers", 0)
@@ -311,10 +404,16 @@ class AutoscalerV2:
         self.scheduler = ResourceDemandScheduler(
             node_types, max_workers=config.get("max_workers", 8))
         self.manager = InstanceManager()
+        gp_cfg = config.get("goodput")
+        policy = None
+        if gp_cfg is not None:
+            policy = GoodputPolicy(**gp_cfg) if isinstance(gp_cfg, dict) \
+                else GoodputPolicy()
         self.reconciler = Reconciler(
             self.manager, provider, self.scheduler,
             LoadMetrics(control_client),
-            idle_timeout_s=config.get("idle_timeout_minutes", 1.0) * 60.0)
+            idle_timeout_s=config.get("idle_timeout_minutes", 1.0) * 60.0,
+            goodput_policy=policy)
 
     def update(self):
         self.reconciler.reconcile()

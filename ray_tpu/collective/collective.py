@@ -138,7 +138,13 @@ def get_group_handle(group_name: str = "default") -> GroupHandle:
     return _groups[group_name]
 
 
-def destroy_collective_group(group_name: str = "default"):
+class CollectiveTeardownTimeout(RuntimeError):
+    """destroy_collective_group(timeout=...) expired before every member
+    posted its fin marker; the message names the absent ranks."""
+
+
+def destroy_collective_group(group_name: str = "default",
+                             timeout: Optional[float] = None):
     """Deregister and sweep the group's KV namespace.  Members that died
     mid-op leave `{name}/{op_idx}/{op}/{rank}` mailbox entries behind;
     without the sweep those leak in the control plane forever.
@@ -149,18 +155,43 @@ def destroy_collective_group(group_name: str = "default"):
     shared `/-1` result key would strand a reader mid-poll for the full
     rendezvous timeout.  Members that died before destroy never post
     their fin marker, so their debris is swept when a later same-named
-    group completes its own destroy over the shared prefix."""
+    group completes its own destroy over the shared prefix.
+
+    With ``timeout=None`` (the default) an early leaver returns
+    immediately without sweeping.  With a timeout, this member waits up
+    to that many seconds for every fin marker and raises
+    :class:`CollectiveTeardownTimeout` naming the ranks that never
+    posted one — turning a silent KV leak into an actionable error."""
     g = _groups.pop(group_name, None)
     if g is None:
         return
     with tracing.span("collective.destroy", group=group_name,
                       world_size=g.world_size, rank=g.rank):
         _kv_put(f"{g.name}/fin/{g.rank}", b"1")
-        arrived = sum(
-            1 for r in range(g.world_size)
-            if _kv().call("kv_exists",
-                          {"ns": _NS, "key": f"{g.name}/fin/{r}"}))
-        if arrived < g.world_size:
+
+        def _missing() -> List[int]:
+            return [r for r in range(g.world_size)
+                    if not _kv().call(
+                        "kv_exists",
+                        {"ns": _NS, "key": f"{g.name}/fin/{r}"})]
+
+        missing = _missing()
+        if missing and timeout is not None:
+            deadline = time.monotonic() + timeout
+            bo = Backoff(base=0.005, cap=0.1)
+            while missing:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise CollectiveTeardownTimeout(
+                        f"destroy_collective_group({group_name!r}): timed "
+                        f"out after {timeout}s waiting for fin markers "
+                        f"from ranks {missing} of world {g.world_size} — "
+                        f"those members likely died mid-run or never "
+                        f"called destroy; their KV debris will be swept "
+                        f"by the next same-named group's destroy")
+                bo.sleep(max_s=remaining)
+                missing = _missing()
+        if missing:
             return
         prefix = f"{g.name}/"
         try:

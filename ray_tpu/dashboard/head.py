@@ -192,13 +192,24 @@ class DashboardHead:
                 return self._json(runs[:100])
             if path == "/api/train/timeline":
                 # flight-recorder rings -> Chrome trace-event JSON (loads
-                # straight into Perfetto); ?trial= filters to one run
+                # straight into Perfetto); ?trial= filters to one run and
+                # overlays that run's remediation markers
                 from ray_tpu.telemetry.timeline import (chrome_trace,
+                                                        collect_remediations,
                                                         collect_snapshots)
 
                 trial = (query.get("trial") or [None])[0]
                 snaps = collect_snapshots(self.control, trial=trial)
-                return self._json(chrome_trace(snaps))
+                rems = collect_remediations(self.control, trial=trial) \
+                    if trial else []
+                return self._json(chrome_trace(snaps, remediations=rems))
+            if path == "/api/train/remediations":
+                # a run's cause→action→effect self-healing log (see
+                # elastic/remediation.py); ?trial= selects the run
+                from ray_tpu.elastic.remediation import fetch_records
+
+                trial = (query.get("trial") or [""])[0]
+                return self._json(fetch_records(self.control, trial))
             if path == "/api/serve":
                 # snapshot the serve controller publishes each reconcile
                 # pass (serve/_controller.py _publish_status)

@@ -9,8 +9,13 @@ Three cooperating pieces (see COMPONENTS.md):
     each worker's train-state shard, peer-replicated to K ring
     successors through the control-plane KV mailbox; recovery needs no
     persistent-storage round-trip.
-  * resume      — shrink-to-fit width selection + exact global-batch
-    resplitting, driven by BackendExecutor.elastic_recover().
+  * resume      — shrink-to-fit width selection (goodput-predicted via
+    IncarnationHistory) + exact global-batch resplitting, driven by
+    BackendExecutor.elastic_recover().
+  * remediation — RemediationEngine: turns sustained straggler
+    advisories into quarantine+rebalance actions (advisory by default)
+    with hysteresis, rate limits, and measured cause→action→effect
+    records.
 
 User surface: ``JaxConfig(elastic=ElasticConfig(...))`` plus
 ``elastic.snapshot(state, step)`` inside the train loop.
@@ -36,10 +41,16 @@ _EXPORTS = {
     "PreemptionWatcher": "preemption",
     "TpuMetadataSource": "preemption",
     "source_from_env": "preemption",
+    "IncarnationHistory": "resume",
     "InsufficientWorkersError": "resume",
     "batch_offsets": "resume",
+    "choose_width": "resume",
     "per_replica_batches": "resume",
+    "predict_rate": "resume",
     "shrink_to_fit": "resume",
+    "REMEDIATION_NS": "remediation",
+    "RemediationEngine": "remediation",
+    "fetch_records": "remediation",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -43,6 +43,33 @@ class ElasticConfig:
     recover_timeout_s: per-RPC bound during recovery (ping / abort /
         inventory / fetch) — recovery must finish well inside one
         heartbeat-death interval, so no call may block on a dead host.
+
+    Remediation (self-healing — see elastic/remediation.py):
+
+    remediation_mode: what the RemediationEngine does with sustained
+        ``straggler_detected`` advisories.
+          "off"      — no engine; advisories stay advisories.
+          "advisory" — the default.  The engine runs the full policy
+                       (hysteresis, rate limits) and records/publishes
+                       exactly what it WOULD do (cause→action records
+                       with ``dry_run=True``) but changes nothing.
+                       Inspect with ``ray-tpu remediations <trial>``.
+          "enforce"  — act: quarantine the straggler's node (scheduler
+                       avoidance on the control plane) and rebalance the
+                       gang off it through elastic recovery.
+    remediation_confirm_rounds: hysteresis — rounds an episode must stay
+        open BEYOND the aggregator's ``straggler_sustain`` before the
+        policy acts; a transient pause never triggers a rebalance.
+    remediation_cooldown_s: minimum seconds between two remediation
+        episodes (rate limit against thrash).
+    remediation_max_episodes: cap on remediation episodes per run.
+    remediation_effect_window: post-rebalance rounds measured before the
+        cause→action→effect record is stamped recovered-or-not.
+    remediation_recover_tolerance: the effect verdict — recovered when
+        the post-rebalance gang median busy time is within this fraction
+        of the pre-episode baseline (0.15 = within 15%).
+    quarantine_grace_s: how long the control plane keeps the quarantined
+        node out of scheduling before it may take work again.
     """
 
     min_workers: int = 1
@@ -55,6 +82,13 @@ class ElasticConfig:
     global_batch_size: Optional[int] = None
     replicate_timeout_s: float = 15.0
     recover_timeout_s: float = 5.0
+    remediation_mode: str = "advisory"
+    remediation_confirm_rounds: int = 2
+    remediation_cooldown_s: float = 30.0
+    remediation_max_episodes: int = 2
+    remediation_effect_window: int = 3
+    remediation_recover_tolerance: float = 0.15
+    quarantine_grace_s: float = 600.0
 
     def __post_init__(self):
         if self.min_workers < 1:
@@ -76,6 +110,19 @@ class ElasticConfig:
             raise ValueError("snapshot_every must be >= 1")
         if self.keep_steps < 1:
             raise ValueError("keep_steps must be >= 1")
+        if self.remediation_mode not in ("off", "advisory", "enforce"):
+            raise ValueError(
+                f"remediation_mode must be 'off', 'advisory' or 'enforce', "
+                f"got {self.remediation_mode!r}")
+        if self.remediation_confirm_rounds < 0:
+            raise ValueError("remediation_confirm_rounds must be >= 0")
+        if self.remediation_max_episodes < 0:
+            raise ValueError("remediation_max_episodes must be >= 0")
+        if self.remediation_effect_window < 1:
+            raise ValueError("remediation_effect_window must be >= 1")
+        if not 0.0 <= self.remediation_recover_tolerance < 1.0:
+            raise ValueError(
+                "remediation_recover_tolerance must be in [0, 1)")
 
     def validate_for(self, num_workers: int) -> None:
         """Check this config against a worker-group width at start."""

@@ -135,19 +135,34 @@ def source_from_env() -> Optional[PreemptionSource]:
 class PreemptionWatcher:
     """Polls a source on its own thread; fires ``on_notice`` once per
     event edge (armed after being clear), so a level-held maintenance
-    event produces exactly one drain report until it clears."""
+    event produces exactly one drain report until it clears.
+
+    ``debounce_s`` suppresses flapping: a notice edge arriving within
+    the window after the last fired notice is swallowed instead of
+    fired.  A drain→cancel→drain flap inside one window therefore costs
+    ONE drain report, not two (and one elastic recovery, not two).  If
+    the re-trigger is still pending when the window closes, the watcher
+    fires it then — a real second event is delayed, never lost."""
 
     def __init__(self, source: PreemptionSource,
                  on_notice: Callable[[PreemptionNotice], None],
-                 poll_interval_s: float = 1.0):
+                 poll_interval_s: float = 1.0,
+                 debounce_s: float = 0.0,
+                 clock: Callable[[], float] = None):
+        import time
         self.source = source
         self.on_notice = on_notice
         self.poll_interval_s = poll_interval_s
+        self.debounce_s = debounce_s
+        self._clock = clock or time.monotonic
         self._stop = threading.Event()
         self._armed = True  # fire on the first positive poll
+        self._last_fired_at: Optional[float] = None
+        self._pending_flap = False  # edge swallowed inside the window
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="preemption-watcher")
         self.notices_fired = 0
+        self.notices_suppressed = 0
 
     def start(self):
         self._thread.start()
@@ -166,10 +181,29 @@ class PreemptionWatcher:
             return False
         if notice is None:
             self._armed = True
+            self._pending_flap = False  # the flap cleared: nothing owed
             return False
-        if not self._armed:
+        in_window = (self.debounce_s > 0.0
+                     and self._last_fired_at is not None
+                     and (self._clock() - self._last_fired_at)
+                     < self.debounce_s)
+        if not self._armed and not self._pending_flap:
+            return False
+        if in_window:
+            # a fresh edge inside the debounce window: swallow it, but
+            # remember it so a still-pending notice fires when the
+            # window closes
+            if self._armed:
+                self._armed = False
+                self._pending_flap = True
+                self.notices_suppressed += 1
+                logger.info(
+                    "preemption notice (%s) debounced: within %.1fs of "
+                    "the previous notice", notice.reason, self.debounce_s)
             return False
         self._armed = False
+        self._pending_flap = False
+        self._last_fired_at = self._clock()
         self.notices_fired += 1
         try:
             self.on_notice(notice)

@@ -388,6 +388,7 @@ def cmd_timeline(args):
         # every worker of one trial, as Chrome trace-event JSON
         from ray_tpu._private.protocol import Client
         from ray_tpu.telemetry.timeline import (chrome_trace,
+                                                collect_remediations,
                                                 collect_snapshots)
 
         address = _resolve_address(args)
@@ -395,19 +396,66 @@ def cmd_timeline(args):
         control = Client((host, int(port)), name="cli-timeline")
         try:
             snaps = collect_snapshots(control, trial=args.job)
-            trace = chrome_trace(snaps)
+            rems = collect_remediations(control, trial=args.job)
+            trace = chrome_trace(snaps, remediations=rems)
         finally:
             control.close()
         with open(args.output, "w") as f:
             json.dump(trace, f)
         steps = sum(len(s.get("steps", [])) for s in snaps)
         print(f"wrote {args.output} ({len(snaps)} workers, {steps} step "
-              f"records for trial {args.job!r})")
+              f"records, {len(rems)} remediation markers for trial "
+              f"{args.job!r})")
         return
     from ray_tpu.util.state import api as state
 
     state.timeline(args.output, address=_resolve_address(args))
     print(f"wrote {args.output}")
+
+
+def cmd_remediations(args):
+    """List a training run's cause→action→effect self-healing log."""
+    from ray_tpu._private.protocol import Client
+    from ray_tpu.elastic.remediation import fetch_records
+
+    address = _resolve_address(args)
+    host, port = address.rsplit(":", 1)
+    control = Client((host, int(port)), name="cli-remediations")
+    try:
+        records = fetch_records(control, args.job)
+    finally:
+        control.close()
+    if args.format == "json":
+        print(json.dumps(records, indent=2, default=str))
+        return
+    if not records:
+        print(f"no remediation records for trial {args.job!r}")
+        return
+    for rec in records:
+        cause = rec.get("cause") or {}
+        action = rec.get("action") or {}
+        effect = rec.get("effect")
+        dry = " (dry-run)" if action.get("dry_run") else ""
+        print(f"{rec.get('id')}  [{rec.get('mode')}]{dry}")
+        print(f"  cause:  rank {cause.get('rank')} straggling — step "
+              f"{cause.get('step_s')}s vs gang median "
+              f"{cause.get('median_s')}s (x{cause.get('ratio')}), "
+              f"sustained {action.get('confirmed_rounds')} rounds")
+        tgt = f" node {str(action.get('node_id'))[:12]}" \
+            if action.get("node_id") else ""
+        world = f" -> world {action.get('new_world')}" \
+            if action.get("new_world") is not None else ""
+        print(f"  action: {action.get('kind')} rank {action.get('rank')}"
+              f"{tgt} (grace {action.get('grace_s')}s){world}")
+        if effect is None:
+            print("  effect: (not yet measured)")
+        else:
+            verdict = "recovered" if effect.get("recovered") \
+                else "NOT recovered"
+            print(f"  effect: gang median busy {effect.get('post_busy_s')}s "
+                  f"vs baseline {effect.get('baseline_busy_s')}s over "
+                  f"{effect.get('measured_rounds')} rounds — {verdict} "
+                  f"(tolerance {effect.get('tolerance'):.0%})")
 
 
 def cmd_memory(args):
@@ -518,6 +566,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-o", "--output", default="timeline.json")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("remediations",
+                        help="list a run's cause→action→effect "
+                             "self-healing log")
+    sp.add_argument("job", help="trial name")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--format", choices=("text", "json"), default="text")
+    sp.set_defaults(fn=cmd_remediations)
 
     sp = sub.add_parser("memory", help="object store summary")
     sp.add_argument("--address", default=None)

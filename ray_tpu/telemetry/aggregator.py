@@ -113,6 +113,21 @@ class StepAggregator:
             except Exception:
                 pass
 
+    def last_view(self) -> Optional[Dict[str, Any]]:
+        """The most recent merged per-step view (step/workers/busy), or
+        None before the first round — the RemediationEngine's per-round
+        input."""
+        with self._lock:
+            return self._recent[-1] if self._recent else None
+
+    def open_episodes(self) -> Dict[int, int]:
+        """Ranks currently inside an advised straggler episode, mapped to
+        their consecutive over-threshold round count.  The count keeps
+        growing past ``straggler_sustain`` while the episode stays open —
+        remediation hysteresis is built on that."""
+        with self._lock:
+            return {r: self._over.get(r, 0) for r in self._advised}
+
     def summary(self) -> Dict[str, Any]:
         with self._lock:
             views = list(self._recent)

@@ -44,15 +44,32 @@ def collect_snapshots(control_client,
     return snaps
 
 
+def collect_remediations(control_client,
+                         trial: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Pull the trial's cause→action→effect remediation records (see
+    elastic/remediation.py) for overlay onto the trace timeline."""
+    try:
+        from ray_tpu.elastic.remediation import fetch_records
+
+        return fetch_records(control_client, trial or "")
+    except Exception:
+        return []
+
+
 def _phase_sorted(phases: Dict[str, float]) -> List[str]:
     known = [p for p in PHASE_ORDER if p in phases]
     extra = sorted(p for p in phases if p not in PHASE_ORDER)
     return known + extra
 
 
-def chrome_trace(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
+def chrome_trace(snapshots: List[Dict[str, Any]],
+                 remediations: Optional[List[Dict[str, Any]]] = None
+                 ) -> Dict[str, Any]:
     """Render snapshots as a Chrome trace: one process per worker rank,
-    an "X" span per step plus sequential per-phase child spans."""
+    an "X" span per step plus sequential per-phase child spans.
+    Remediation records land as global instant events ("i") at their
+    cause/action/effect wall timestamps, so the timeline answers "why
+    did the cluster change shape right here"."""
     events: List[Dict[str, Any]] = []
     for snap in sorted(snapshots, key=lambda s: s.get("rank", 0)):
         rank = snap.get("rank", 0)
@@ -90,6 +107,21 @@ def chrome_trace(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
                     "args": {"step": step, "seconds": phases[name]},
                 })
                 cursor += p_us
+    for rec in remediations or []:
+        rid = rec.get("id", "rem")
+        kind = (rec.get("action") or {}).get("kind", "remediation")
+        marks = [("cause", rec.get("ts"))]
+        marks.append(("action", (rec.get("action") or {}).get("ts")))
+        marks.append(("effect", (rec.get("effect") or {}).get("ts")))
+        for phase, ts in marks:
+            if ts is None:
+                continue
+            events.append({
+                "name": f"{rid}:{kind}:{phase}",
+                "ph": "i", "ts": float(ts) * 1e6,
+                "pid": 0, "tid": 0, "s": "g",  # global-scope instant
+                "args": {"remediation": rec, "phase": phase},
+            })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

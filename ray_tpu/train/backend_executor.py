@@ -39,6 +39,12 @@ class WorkerDrainedError(TrainingWorkerError):
     proactively (before the host disappears) rather than reactively."""
 
 
+class WorkerQuarantinedError(TrainingWorkerError):
+    """Remediation quarantined a sustained straggler's node: rebalance
+    the gang off it (the host is alive — merely benched — so its vault
+    remains a recovery source)."""
+
+
 class EmergencyRecoveryError(Exception):
     """Elastic in-memory recovery is not possible (no quorum of
     replicated shards / too few survivors); fall back to the
@@ -58,6 +64,7 @@ class BackendExecutor:
         self.worker_group: Optional[WorkerGroup] = None
         self._elastic = getattr(self._backend_config, "elastic", None)
         self._draining_nodes: set = set()
+        self._quarantined_nodes: set = set()
         self._drain_listener_installed = False
         # rounds consumed since the last (re)start — the elastic restart
         # resumes session iteration numbering from here
@@ -65,6 +72,11 @@ class BackendExecutor:
         # GoodputAccountant installed by the trainer; drain/recover paths
         # stamp state transitions through it when present
         self.goodput = None
+        # per-incarnation effective-rate records feeding goodput-predicted
+        # width selection in elastic_recover
+        from ray_tpu.elastic.resume import IncarnationHistory
+
+        self.history = IncarnationHistory()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -124,6 +136,12 @@ class BackendExecutor:
                     pass
         elif event in ("drain_canceled", "removed"):
             self._draining_nodes.discard(nid)
+            if event == "removed":
+                self._quarantined_nodes.discard(nid)
+        elif event == "quarantined":
+            self._quarantined_nodes.add(nid)
+        elif event == "quarantine_cleared":
+            self._quarantined_nodes.discard(nid)
 
     def drain_pending(self) -> bool:
         """True when any current training worker sits on a draining node."""
@@ -131,6 +149,33 @@ class BackendExecutor:
             return False
         return any(w.metadata.get("node_id") in self._draining_nodes
                    for w in self.worker_group.workers)
+
+    def quarantine_worker(self, rank: int, reason: str,
+                          grace_s: float = 600.0) -> Optional[str]:
+        """Remediation enforcement: bench the node hosting ``rank`` on
+        the control plane (scheduler avoidance + ``node_quarantined``
+        pubsub) and exclude it locally so the next ``elastic_recover``
+        sheds it.  Returns the node id (None when unknown).  The local
+        exclusion is authoritative — a pubsub lag or an unreachable
+        control must not leave the straggler in the rebuilt gang."""
+        wg = self.worker_group
+        if wg is None or not (0 <= rank < len(wg.workers)):
+            return None
+        nid = wg.workers[rank].metadata.get("node_id")
+        if not nid:
+            return None
+        self._quarantined_nodes.add(nid)
+        try:
+            from ray_tpu._private.core import current_core
+
+            current_core().control.call("report_quarantine", {
+                "node_id": nid, "grace_s": grace_s, "reason": reason,
+            }, timeout=5.0)
+        except Exception:
+            logger.warning("could not report quarantine of node %s to the "
+                           "control plane (local exclusion still applies)",
+                           nid[:12], exc_info=True)
+        return nid
 
     def _contexts(self, experiment_name: str, trial_name: str,
                   trial_dir: str) -> List[TrainContext]:
@@ -215,6 +260,11 @@ class BackendExecutor:
             for i, w in enumerate(self.worker_group.workers)
         ]
         self._get_with_failure_handling(refs)
+        import time as _time
+
+        self.history.begin(getattr(self.worker_group, "incarnation", 0),
+                           self.worker_group.num_workers,
+                           self.rounds_consumed, _time.monotonic())
 
     def get_next_results(self) -> Optional[List[tuple]]:
         """One lockstep round of next_result() from every worker.
@@ -288,7 +338,7 @@ class BackendExecutor:
         from ray_tpu.elastic.emergency import (EmergencyCheckpoint,
                                                _fetch, _inventory,
                                                fold_shards, select_quorum)
-        from ray_tpu.elastic.resume import shrink_to_fit
+        from ray_tpu.elastic.resume import choose_width
 
         ec = self._elastic
         if ec is None:
@@ -296,6 +346,9 @@ class BackendExecutor:
         wg = self.worker_group
         if wg is None:
             raise EmergencyRecoveryError("worker group not started")
+        # close the dying incarnation's history record — its effective
+        # rate (recovery churn included) informs the width choice below
+        self.history.end(self.rounds_consumed, time.monotonic())
         if self.goodput is not None:
             try:
                 self.goodput.transition("recovering")
@@ -317,11 +370,14 @@ class BackendExecutor:
             except Exception:
                 pass
 
-        # 2. survivors exclude draining hosts (they're reachable now but
-        # won't be for long).
+        # 2. survivors exclude draining hosts (reachable now but won't be
+        # for long) and quarantined ones (alive but benched by
+        # remediation — keeping a sustained straggler in the new gang
+        # would defeat the rebalance).
+        tainted = self._draining_nodes | self._quarantined_nodes
         survivors = [i for i in reachable
                      if wg.workers[i].metadata.get("node_id")
-                     not in self._draining_nodes]
+                     not in tainted]
 
         # 3. freshest quorum across every vault we can still read.
         inv_refs = [(i, wg.workers[i].actor.execute.remote(_inventory))
@@ -359,14 +415,17 @@ class BackendExecutor:
                     f"shard {sid} of step {step} vanished from its vault")
             payloads[sid] = b
 
-        # 5. shrink and re-run backend setup on the new gang.
-        new_n = shrink_to_fit(len(survivors), ec.min_workers,
-                              ec.max_workers, ec.workers_per_replica)
+        # 5. shrink to the goodput-predicted width and re-run backend
+        # setup on the new gang.
+        new_n = choose_width(len(survivors), ec.min_workers,
+                             ec.max_workers, ec.workers_per_replica,
+                             history=self.history)
         keep = survivors[:new_n]
         logger.warning(
             "elastic recovery: step=%d old_world=%d survivors=%s -> "
-            "new_world=%d (draining=%s)", step, old_world, survivors,
-            new_n, sorted(self._draining_nodes))
+            "new_world=%d (draining=%s quarantined=%s)", step, old_world,
+            survivors, new_n, sorted(self._draining_nodes),
+            sorted(self._quarantined_nodes))
         wg.shrink_to(keep)
         self._backend.on_start(wg, self._backend_config)
 

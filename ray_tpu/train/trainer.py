@@ -29,7 +29,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from .backend import BackendConfig, JaxConfig
 from .backend_executor import (BackendExecutor, TrainingFailedError,
-                               TrainingWorkerError, WorkerDrainedError)
+                               TrainingWorkerError, WorkerDrainedError,
+                               WorkerQuarantinedError)
 from .checkpoint import Checkpoint
 from .checkpoint_manager import CheckpointManager
 from .config import RunConfig, ScalingConfig
@@ -145,7 +146,7 @@ class JaxTrainer:
         executor = BackendExecutor(self.backend_config, self.scaling_config)
         # flight recorder: goodput state machine + cross-worker straggler
         # detection, armed before start() so early drain notices stamp
-        goodput = aggregator = None
+        goodput = aggregator = remediation = None
         try:
             from ray_tpu.telemetry import (GoodputAccountant, StepAggregator,
                                            resolve_telemetry,
@@ -158,6 +159,15 @@ class JaxTrainer:
                 aggregator = StepAggregator(_tc, trial=trial_name)
                 executor.goodput = goodput
                 set_current_accountant(goodput)
+                # close the detect->act loop: the engine watches the
+                # aggregator's straggler episodes and (in enforce mode)
+                # quarantines + rebalances; advisory mode records only
+                _ec = getattr(self.backend_config, "elastic", None)
+                if _ec is not None and \
+                        getattr(_ec, "remediation_mode", "off") != "off":
+                    from ray_tpu.elastic.remediation import RemediationEngine
+
+                    remediation = RemediationEngine(_ec, trial=trial_name)
         except Exception:
             pass
 
@@ -169,6 +179,8 @@ class JaxTrainer:
                 out["goodput"] = goodput.report()
             if aggregator is not None:
                 out["stragglers"] = aggregator.summary()
+            if remediation is not None:
+                out["remediations"] = remediation.summary()
             return out
 
         executor.start()
@@ -209,6 +221,19 @@ class JaxTrainer:
                                 m.get("telemetry")
                                 if isinstance(m, dict) else None
                                 for _, m, _ in results])
+                            if remediation is not None:
+                                decision = remediation.observe_round(
+                                    aggregator)
+                                if decision is not None:
+                                    nid = executor.quarantine_worker(
+                                        decision["rank"],
+                                        reason=decision["reason"],
+                                        grace_s=decision["grace_s"])
+                                    remediation.note_enforced(decision, nid)
+                                    raise WorkerQuarantinedError(
+                                        f"rank {decision['rank']} (node "
+                                        f"{str(nid)[:12]}) quarantined: "
+                                        f"{decision['reason']}")
                         # rank-0 metrics are authoritative (reference keeps
                         # per-rank results; rank 0 drives callbacks)
                         _, metrics, ckpt_path = results[0]
@@ -249,6 +274,8 @@ class JaxTrainer:
                             per_worker_cks = cks
                             n = new_n
                             ckpt_mgr.note_emergency(step)
+                            if remediation is not None:
+                                remediation.note_recovered(new_n, step)
                             logger.warning(
                                 "elastic recovery %d: resuming %d-wide from "
                                 "replicated snapshot step=%d (trigger: %s)",

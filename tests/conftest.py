@@ -72,8 +72,8 @@ _QUICK_FILES = {
     "test_core_objects.py", "test_core_tasks.py", "test_data.py",
     "test_data_remote_io.py", "test_elastic.py", "test_label_scheduling.py",
     "test_native_sched.py", "test_native_store.py", "test_ops.py",
-    "test_parallel.py", "test_partition.py", "test_resource_sync.py",
-    "test_runtime_env.py",
+    "test_parallel.py", "test_partition.py", "test_remediation.py",
+    "test_resource_sync.py", "test_runtime_env.py",
     "test_serve.py", "test_serve_grpc.py", "test_state.py",
     "test_telemetry.py", "test_tune.py",
 }

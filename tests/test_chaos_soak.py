@@ -26,6 +26,45 @@ def _train_loop(config):
         train.report({"step": step})
 
 
+def _straggle_drain_loop(config):
+    """Elastic loop whose rank 1 straggles only at full width: once the
+    remediation engine quarantines that host the injected slowness is
+    gone, and a later drain notice shrinks the gang a second time."""
+    import numpy as np
+
+    from ray_tpu import collective, elastic, telemetry
+    from ray_tpu import train as _train
+    from ray_tpu.elastic.emergency import EmergencyCheckpoint as _EC
+
+    ctx = _train.get_context()
+    G = ctx.extra["global_batch_size"]
+    pb = ctx.extra["per_replica_batch"]
+    off = ctx.extra["batch_offset"]
+    group = os.environ["RAY_TPU_TRAIN_COLLECTIVE_GROUP"]
+
+    state = {"w": 1.0, "step": 0}
+    ck = _train.get_checkpoint()
+    if isinstance(ck, _EC):
+        state = dict(max(ck.load(), key=lambda s: s["step"]))
+
+    while state["step"] < config["steps"]:
+        t = state["step"]
+        with telemetry.phase("data"):
+            idx = np.arange(off, off + pb, dtype=np.float64)
+            time.sleep(0.05)
+            if ctx.get_world_rank() == 1 and ctx.get_world_size() == 4:
+                time.sleep(0.15)
+        gsum = float(np.sum(np.sin(idx + t) * state["w"] + idx * 0.01))
+        total = collective.allreduce(np.array([gsum]), group_name=group)
+        state = {"w": state["w"] - 0.1 * float(total[0]) / G,
+                 "step": t + 1}
+        elastic.snapshot(state, state["step"])
+        assert elastic.wait_replicated(20.0)
+        _train.report({"step": state["step"], "w": state["w"],
+                       "world_size": ctx.get_world_size(),
+                       "node_id": os.environ.get("RAY_TPU_NODE_ID")})
+
+
 def test_chaos_soak(multi_node_cluster, tmp_path):
     from ray_tpu._private.test_utils import (RayletKiller, WorkerKiller,
                                              get_and_run_killer)
@@ -127,3 +166,95 @@ def test_chaos_soak(multi_node_cluster, tmp_path):
     finally:
         core.shutdown()
     assert time.monotonic() - t_start < 300, "soak exceeded 5 minutes"
+
+
+class _LateDrainInjector:
+    """Once the straggler quarantine has already shrunk the gang, post a
+    drain notice against a surviving node — the run must absorb BOTH
+    failure modes back to back."""
+
+    def __init__(self, full_width):
+        self.full = full_width
+        self.drained_node = None
+        self.widths = []
+
+    def on_trial_result(self, trial, metrics):
+        self.widths.append(metrics["world_size"])
+        if (self.drained_node is None
+                and metrics["world_size"] == self.full - 1):
+            from ray_tpu._private.api import current_core
+
+            self.drained_node = metrics["node_id"]
+            current_core().control.call("report_draining", {
+                "node_id": self.drained_node, "grace_s": 30.0,
+                "reason": "chaos-preemption"}, timeout=10.0)
+
+    def on_trial_complete(self, trial):
+        pass
+
+    def on_trial_error(self, trial):
+        pass
+
+
+def test_chaos_straggler_then_drain(private_cluster_slot,
+                                    multi_node_cluster, tmp_path):
+    """Combined-failure soak (ISSUE 6 satellite): a sustained rank-1
+    straggler under ``remediation_mode="enforce"`` costs one quarantine
+    episode (4 -> 3), then a preemption drain against a surviving host
+    costs one elastic shrink (3 -> 2).  The run finishes with exactly one
+    remediation record — the drain is handled by the ordinary elastic
+    path, never double-counted as a second remediation."""
+    from ray_tpu._private.api import current_core
+    from ray_tpu.elastic import ElasticConfig
+    from ray_tpu.elastic.remediation import fetch_records
+    from ray_tpu.telemetry import TelemetryConfig
+    from ray_tpu.train import JaxConfig, JaxTrainer, RunConfig, ScalingConfig
+
+    STEPS, G = 20, 12
+    c = multi_node_cluster()
+    for _ in range(4):
+        c.add_node(resources={"CPU": 1})
+    host, port = c.control_addr
+    ray_tpu.init(address=f"{host}:{port}")
+    core = current_core()
+
+    injector = _LateDrainInjector(full_width=4)
+    trainer = JaxTrainer(
+        _straggle_drain_loop, train_loop_config={"steps": STEPS},
+        backend_config=JaxConfig(
+            mode="local",
+            elastic=ElasticConfig(
+                min_workers=2, replication_factor=1, global_batch_size=G,
+                recover_timeout_s=5.0,
+                remediation_mode="enforce",
+                remediation_confirm_rounds=1,
+                remediation_cooldown_s=5.0,
+                remediation_max_episodes=2,
+                remediation_effect_window=2),
+            telemetry=TelemetryConfig(flush_interval_s=0.0,
+                                      straggler_multiple=2.0,
+                                      straggler_sustain=2)),
+        scaling_config=ScalingConfig(num_workers=4),
+        run_config=RunConfig(name="chaos2", storage_path=str(tmp_path),
+                             callbacks=[injector]))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["step"] == STEPS
+
+    # both shrinks happened, in order: 4 (straggler) -> 3 (drain) -> 2
+    assert injector.widths[0] == 4
+    assert result.metrics["world_size"] == 2
+    assert injector.drained_node is not None
+
+    nodes = core.control.call("get_nodes", {}, timeout=10.0)
+    quarantined = [n["node_id"] for n in nodes if n.get("quarantined")]
+    assert len(quarantined) == 1
+    # the drain victim and the quarantine victim are different hosts
+    assert injector.drained_node not in quarantined
+
+    # exactly ONE remediation episode: the drain shrink is elastic
+    # recovery, not a second remediation
+    records = fetch_records(core.control, "chaos2_00000")
+    assert len(records) == 1, records
+    assert records[0]["action"]["kind"] == "quarantine_rebalance"
+    assert records[0]["action"]["node_id"] == quarantined[0]
