@@ -1,4 +1,5 @@
-from .collective import (allgather, allreduce, barrier, broadcast,
+from .collective import (AllreduceHandle, allgather, allreduce,
+                         allreduce_async, barrier, broadcast,
                          destroy_collective_group, get_group_handle,
                          init_collective_group, recv, reducescatter, send)
 from .compression import (CompressionConfig, compress_array, decompress_array,
@@ -9,7 +10,8 @@ from .xla_group import (mesh_allgather, mesh_allreduce, mesh_all_to_all,
 
 __all__ = [
     "init_collective_group", "destroy_collective_group", "get_group_handle",
-    "allreduce", "allgather", "reducescatter", "broadcast", "barrier",
+    "allreduce", "allreduce_async", "AllreduceHandle",
+    "allgather", "reducescatter", "broadcast", "barrier",
     "send", "recv",
     "CompressionConfig", "parse_compression", "resolve_compression",
     "set_group_compression", "compress_array", "decompress_array",

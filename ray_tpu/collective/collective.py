@@ -41,10 +41,16 @@ _NS = "collective"
 
 
 def _record_op(op: str, t0: float, x: Optional[np.ndarray] = None,
-               cc: Optional[CompressionConfig] = None):
+               cc: Optional[CompressionConfig] = None,
+               breakdown: Optional[Dict[str, float]] = None,
+               elapsed: Optional[float] = None):
     """Feed the flight recorder (telemetry.recorder): op latency into the
     current step's "collective" phase + Prometheus series, logical vs
-    wire bytes so compression savings are visible in production."""
+    wire bytes so compression savings are visible in production.
+    `breakdown` carries measured quantize/transfer/dequantize sub-phase
+    seconds when the caller timed its stages; `elapsed` overrides the
+    t0-derived duration (async ops report issue+finish time, not the
+    caller's overlap window)."""
     try:
         from ray_tpu.telemetry import recorder as _rec
 
@@ -53,7 +59,8 @@ def _record_op(op: str, t0: float, x: Optional[np.ndarray] = None,
         if x is not None and cc is not None:
             wire = payload * wire_ratio(x.size, cc,
                                         baseline_itemsize=x.itemsize)
-        _rec.record_collective(op, time.perf_counter() - t0, payload, wire)
+        dur = elapsed if elapsed is not None else time.perf_counter() - t0
+        _rec.record_collective(op, dur, payload, wire, breakdown=breakdown)
     except Exception:
         pass
 
@@ -346,38 +353,86 @@ def _rng_for(g: GroupHandle, cc: CompressionConfig, rank: int):
     return np.random.default_rng((g.op_idx * (g.world_size + 1)) + rank + 1)
 
 
-def _kv_compressed_allreduce(g: GroupHandle, x: np.ndarray, op: str,
-                             cc: CompressionConfig) -> np.ndarray:
-    """KV allreduce shipping int8 blocks + scales (~0.25x the wire bytes
-    at block=256).  Rank 0 dequantizes all contributions, reduces in f32,
-    and republishes a requantized result so every rank lands on the SAME
-    (quantized) value — same two-quantization structure as the compiled
-    EQuARX path in xla_group.py."""
+def _key_at(g: GroupHandle, idx: int, op: str, rank: int) -> str:
+    """Mailbox key pinned to a captured op epoch — async ops finish after
+    later ops have bumped g.op_idx, so they must not read it live."""
+    return f"{g.name}/{idx}/{op}/{rank}"
+
+
+def _new_breakdown() -> Dict[str, float]:
+    return {"quantize": 0.0, "transfer": 0.0, "dequantize": 0.0}
+
+
+def _kv_q_allreduce_issue(g: GroupHandle, idx: int, x: np.ndarray,
+                          cc: CompressionConfig,
+                          bd: Dict[str, float]) -> None:
+    """Publish this rank's quantized contribution (the non-blocking half)."""
+    t = time.perf_counter()
     payload = compress_array(x, cc, _rng_for(g, cc, g.rank))
-    _kv_put(g._key("qar", g.rank), pickle.dumps(payload, protocol=5))
+    bd["quantize"] += time.perf_counter() - t
+    t = time.perf_counter()
+    _kv_put(_key_at(g, idx, "qar", g.rank), pickle.dumps(payload, protocol=5))
+    bd["transfer"] += time.perf_counter() - t
+
+
+def _kv_q_allreduce_finish(g: GroupHandle, idx: int, x: np.ndarray, op: str,
+                           cc: CompressionConfig,
+                           bd: Dict[str, float]) -> np.ndarray:
+    """Reduce/fetch half: rank 0 dequantizes all contributions, reduces
+    in f32, and republishes a requantized result so every rank lands on
+    the SAME (quantized) value — same two-quantization structure as the
+    compiled EQuARX path in xla_group.py."""
     if g.rank == 0:
         acc = np.zeros(x.shape, np.float32)
         for r in range(g.world_size):
-            part = pickle.loads(_kv_get(g._key("qar", r)))
-            acc += decompress_array(part).astype(np.float32)
+            t = time.perf_counter()
+            raw = _kv_get(_key_at(g, idx, "qar", r))
+            bd["transfer"] += time.perf_counter() - t
+            t = time.perf_counter()
+            acc += decompress_array(pickle.loads(raw)).astype(np.float32)
+            bd["dequantize"] += time.perf_counter() - t
         if op == "mean":
             acc /= g.world_size
         # finer result block: the republished value is the only
         # quantization the group sees from here (compression.result_block_size)
         rcc = dataclasses.replace(cc, block_size=result_block_size(
             cc.block_size))
+        t = time.perf_counter()
         result = compress_array(acc, rcc, _rng_for(g, cc, g.world_size))
-        _kv_put(g._key("qar", -1), pickle.dumps(result, protocol=5))
+        bd["quantize"] += time.perf_counter() - t
+        t = time.perf_counter()
+        _kv_put(_key_at(g, idx, "qar", -1),
+                pickle.dumps(result, protocol=5))
+        bd["transfer"] += time.perf_counter() - t
     else:
-        result = pickle.loads(_kv_get(g._key("qar", -1)))
-    return decompress_array(result).astype(x.dtype)
+        t = time.perf_counter()
+        result = pickle.loads(_kv_get(_key_at(g, idx, "qar", -1)))
+        bd["transfer"] += time.perf_counter() - t
+    t = time.perf_counter()
+    out = decompress_array(result).astype(x.dtype)
+    bd["dequantize"] += time.perf_counter() - t
+    return out
 
 
-def _xla_compressed_allreduce(g: GroupHandle, x: np.ndarray, op: str,
-                              cc: CompressionConfig) -> np.ndarray:
-    """Compiled EQuARX path over the group's device mesh: the two-phase
-    quantized allreduce from xla_group.py, with a replicated output
-    fetched back to host (same caching contract as _xla_run)."""
+def _kv_compressed_allreduce(g: GroupHandle, x: np.ndarray, op: str,
+                             cc: CompressionConfig,
+                             bd: Optional[Dict[str, float]] = None
+                             ) -> np.ndarray:
+    """KV allreduce shipping int8 blocks + scales (~0.25x the wire bytes
+    at block=256); issue + finish back-to-back."""
+    if bd is None:
+        bd = _new_breakdown()
+    idx = g.op_idx
+    _kv_q_allreduce_issue(g, idx, x, cc, bd)
+    return _kv_q_allreduce_finish(g, idx, x, op, cc, bd)
+
+
+def _xla_compressed_allreduce_issue(g: GroupHandle, x: np.ndarray, op: str,
+                                    cc: CompressionConfig):
+    """Dispatch the compiled EQuARX path over the group's device mesh and
+    return the (asynchronously executing) device array: the two-phase
+    quantized allreduce from xla_group.py (same caching contract as
+    _xla_run)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -385,18 +440,25 @@ def _xla_compressed_allreduce(g: GroupHandle, x: np.ndarray, op: str,
     from ray_tpu.collective import xla_group
 
     arr, mesh = _xla_stacked(g, x)
-    cache_key = (f"q-allreduce-{op}-{cc.block_size}-{int(cc.stochastic)}",
-                 x.shape, str(x.dtype))
+    chunks = xla_group._resolve_chunks(cc, x.size, x.dtype.itemsize)
+    cache_key = (f"q-allreduce-{op}-{cc.block_size}-{int(cc.stochastic)}"
+                 f"-c{chunks}", x.shape, str(x.dtype))
     jitted = g._xla_jit_cache.get(cache_key)
     if jitted is None:
         def fn(a, seed):
             red = xla_group._q_allreduce_impl(a, seed, mesh, "cc", op,
-                                              cc.block_size, cc.stochastic)
+                                              cc.block_size, cc.stochastic,
+                                              chunks)
             return red[0]
 
         jitted = g._xla_jit_cache[cache_key] = jax.jit(
             fn, out_shardings=NamedSharding(mesh, P()))
-    return np.asarray(jitted(arr, jnp.int32(g.op_idx)))
+    return jitted(arr, jnp.int32(g.op_idx))
+
+
+def _xla_compressed_allreduce(g: GroupHandle, x: np.ndarray, op: str,
+                              cc: CompressionConfig) -> np.ndarray:
+    return np.asarray(_xla_compressed_allreduce_issue(g, x, op, cc))
 
 
 def allreduce(tensor, group_name: str = "default", op: str = "sum",
@@ -415,6 +477,7 @@ def allreduce(tensor, group_name: str = "default", op: str = "sum",
     x = _as_numpy(tensor)
     cc = _resolve_op_compression(x, op, compression)
     t0 = time.perf_counter()
+    bd = None
     try:
         if g.backend == "xla":
             if op not in _XLA_REDUCE:
@@ -423,7 +486,8 @@ def allreduce(tensor, group_name: str = "default", op: str = "sum",
                 return _xla_compressed_allreduce(g, x, op, cc)
             return _xla_run(g, x, f"allreduce-{op}", _XLA_REDUCE[op])
         if cc is not None:
-            return _kv_compressed_allreduce(g, x, op, cc)
+            bd = _new_breakdown()
+            return _kv_compressed_allreduce(g, x, op, cc, bd)
         _kv_put(g._key("ar", g.rank), pickle.dumps(x, protocol=5))
         if g.rank == 0:
             acc = x.copy()
@@ -443,7 +507,116 @@ def allreduce(tensor, group_name: str = "default", op: str = "sum",
             return acc
         return pickle.loads(_kv_get(g._key("ar", -1)))
     finally:
-        _record_op("allreduce", t0, x, cc)
+        _record_op("allreduce", t0, x, cc, breakdown=bd)
+
+
+class AllreduceHandle:
+    """In-flight allreduce from :func:`allreduce_async`; ``result()``
+    blocks for (and caches) the reduced array.  Issue order IS the op
+    order — every member must issue the same sequence of collectives,
+    matching the SPMD discipline of the synchronous API — but results
+    may be awaited late, so callers can keep producing bucket k+1 while
+    bucket k's reduce is in flight (the GradientSynchronizer pipeline)."""
+
+    def __init__(self, finish):
+        self._finish = finish
+        self._value = None
+
+    def result(self) -> np.ndarray:
+        if self._finish is not None:
+            self._value = self._finish()
+            self._finish = None
+        return self._value
+
+
+def allreduce_async(tensor, group_name: str = "default", op: str = "sum",
+                    compression: Union[None, str, "CompressionConfig"] = None
+                    ) -> AllreduceHandle:
+    """Issue an allreduce and return an :class:`AllreduceHandle` without
+    blocking for the result.
+
+    kv backend: this rank's (possibly quantized) contribution is
+    published immediately; the reduce/fetch half runs at ``result()``,
+    so quantize+publish of the next bucket overlaps peers' posting of
+    this one.  xla backend: the compiled program is dispatched
+    asynchronously (XLA's async execution IS the overlap) and
+    ``result()`` fetches the host copy.  Telemetry records issue+finish
+    time — not the caller's overlap window — under the same sub-phase
+    breakdown as the blocking path."""
+    g = get_group_handle(group_name)
+    g.op_idx += 1
+    x = _as_numpy(tensor)
+    cc = _resolve_op_compression(x, op, compression)
+    t0 = time.perf_counter()
+    if g.backend == "xla":
+        if op not in _XLA_REDUCE:
+            raise ValueError(f"unknown op {op}")
+        if cc is not None:
+            fut = _xla_compressed_allreduce_issue(g, x, op, cc)
+        else:
+            arr, mesh = _xla_stacked(g, x)
+            cache_key = (f"allreduce-{op}", x.shape, str(x.dtype))
+            jitted = g._xla_jit_cache.get(cache_key)
+            if jitted is None:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                jitted = g._xla_jit_cache[cache_key] = jax.jit(
+                    _XLA_REDUCE[op],
+                    out_shardings=NamedSharding(mesh, P()))
+            fut = jitted(arr)
+        issued = time.perf_counter() - t0
+
+        def finish_xla():
+            t1 = time.perf_counter()
+            out = np.asarray(fut)
+            _record_op("allreduce", t0, x, cc,
+                       elapsed=issued + time.perf_counter() - t1)
+            return out
+
+        return AllreduceHandle(finish_xla)
+    idx = g.op_idx
+    if cc is not None:
+        bd = _new_breakdown()
+        _kv_q_allreduce_issue(g, idx, x, cc, bd)
+        issued = time.perf_counter() - t0
+
+        def finish_q():
+            t1 = time.perf_counter()
+            out = _kv_q_allreduce_finish(g, idx, x, op, cc, bd)
+            _record_op("allreduce", t0, x, cc, breakdown=bd,
+                       elapsed=issued + time.perf_counter() - t1)
+            return out
+
+        return AllreduceHandle(finish_q)
+    if op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unknown op {op}")
+    _kv_put(_key_at(g, idx, "ar", g.rank), pickle.dumps(x, protocol=5))
+    issued = time.perf_counter() - t0
+
+    def finish_kv():
+        t1 = time.perf_counter()
+        if g.rank == 0:
+            acc = x.copy()
+            for r in range(1, g.world_size):
+                other = pickle.loads(_kv_get(_key_at(g, idx, "ar", r)))
+                if op in ("sum", "mean"):
+                    acc = acc + other
+                elif op == "max":
+                    acc = np.maximum(acc, other)
+                else:
+                    acc = np.minimum(acc, other)
+            if op == "mean":
+                acc = acc / g.world_size
+            _kv_put(_key_at(g, idx, "ar", -1), pickle.dumps(acc, protocol=5))
+            out = acc
+        else:
+            out = pickle.loads(_kv_get(_key_at(g, idx, "ar", -1)))
+        _record_op("allreduce", t0, x, cc,
+                   elapsed=issued + time.perf_counter() - t1)
+        return out
+
+    return AllreduceHandle(finish_kv)
 
 
 def allgather(tensor, group_name: str = "default",

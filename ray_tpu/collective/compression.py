@@ -45,6 +45,17 @@ class CompressionConfig:
         one-shot collective calls.
     min_size: arrays with fewer elements ship uncompressed (scale
         overhead would beat the savings).
+    pipeline_chunks: split large tensors into this many block-aligned
+        chunks inside the quantized allreduce and double-buffer them —
+        quantize of chunk k+1 overlaps transfer of chunk k.  0 = auto
+        (tuned from tensor size and backend; see auto_pipeline_chunks).
+        1 = monolithic.  Chunked and monolithic results are bit-identical
+        for deterministic rounding (chunk boundaries are block-aligned so
+        every per-block scale is unchanged).
+    bucket_bytes: GradientSynchronizer coalesces per-parameter gradients
+        into flat buckets of about this many (f32) bytes, so many small
+        leaves ride one pipelined collective instead of one blocking
+        call each.
     """
 
     dtype: str = "int8"
@@ -52,6 +63,8 @@ class CompressionConfig:
     stochastic: bool = False
     error_feedback: bool = True
     min_size: int = 1024
+    pipeline_chunks: int = 0
+    bucket_bytes: int = 4 << 20
 
     def __post_init__(self):
         if self.dtype != "int8":
@@ -60,13 +73,22 @@ class CompressionConfig:
         if self.block_size <= 0:
             raise ValueError(
                 f"block_size must be positive, got {self.block_size}")
+        if self.pipeline_chunks < 0:
+            raise ValueError(
+                f"pipeline_chunks must be >= 0 (0 = auto), got "
+                f"{self.pipeline_chunks}")
+        if self.bucket_bytes <= 0:
+            raise ValueError(
+                f"bucket_bytes must be positive, got {self.bucket_bytes}")
 
     def to_spec(self) -> str:
         """Inverse of parse_compression — env-var/CLI-safe string."""
         return (f"{self.dtype}:block={self.block_size}"
                 f",stochastic={int(self.stochastic)}"
                 f",ef={int(self.error_feedback)}"
-                f",min={self.min_size}")
+                f",min={self.min_size}"
+                f",chunks={self.pipeline_chunks}"
+                f",bucket={self.bucket_bytes}")
 
 
 def result_block_size(block_size: int) -> int:
@@ -80,10 +102,75 @@ def result_block_size(block_size: int) -> int:
     return max(16, block_size // 8)
 
 
+# Per-chunk payload the auto-tuner aims for.  Small enough that a few
+# chunks fit in flight (quantize of k+1 behind transfer of k), large
+# enough that per-chunk collective launch overhead stays negligible.
+CHUNK_TARGET_BYTES = 4 << 20
+MAX_PIPELINE_CHUNKS = 8
+
+
+def auto_pipeline_chunks(n_elements: int, itemsize: int = 4,
+                         backend: str = "") -> int:
+    """Pick a pipeline chunk count for an n-element tensor.
+
+    Pure math (no jax import); callers pass the device backend string.
+    On hosts where the "interconnect" is shared memory (the cpu backend —
+    incl. XLA_FLAGS-forced multi-device CPU meshes) transfer is a memcpy
+    that cannot be hidden behind compute, and every extra chunk adds a
+    collective rendezvous, so auto always picks 1 there.  On real
+    accelerator fabrics, chunk so each piece is ~CHUNK_TARGET_BYTES."""
+    if backend not in ("tpu", "gpu"):
+        return 1
+    total = int(n_elements) * int(itemsize)
+    if total < 2 * CHUNK_TARGET_BYTES:
+        return 1
+    return min(MAX_PIPELINE_CHUNKS, total // CHUNK_TARGET_BYTES)
+
+
+def chunk_layout(n_blocks: int, chunks: int) -> Tuple[int, ...]:
+    """Split n_blocks quantization blocks into `chunks` contiguous runs.
+
+    Chunk boundaries land ON block boundaries by construction — that is
+    what keeps the chunked allreduce bit-identical to the monolithic one
+    (every per-block absmax/scale sees exactly the same elements).  The
+    remainder is spread over the leading chunks, so uneven splits (say 7
+    blocks into 2 chunks -> (4, 3)) stay valid.  Requesting more chunks
+    than blocks clamps; empty chunks are never returned.
+    """
+    if chunks <= 0:
+        raise ValueError(
+            f"pipeline chunk count must be >= 1, got {chunks} — use "
+            f"pipeline_chunks=0 on CompressionConfig for auto-tuning or 1 "
+            f"to disable chunking")
+    if n_blocks <= 0:
+        raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
+    chunks = min(chunks, n_blocks)
+    base, extra = divmod(n_blocks, chunks)
+    return tuple(base + (1 if c < extra else 0) for c in range(chunks))
+
+
+def validate_chunk_elems(chunk_elems: int, block_size: int) -> None:
+    """Guard for callers that slice their own chunks (rather than going
+    through chunk_layout, which can only produce aligned chunks): a chunk
+    whose size is not a block multiple would shift every later block
+    boundary, silently changing per-block scales and breaking both the
+    chunked==monolithic guarantee and host-codec residual recomputation."""
+    if chunk_elems % block_size:
+        raise ValueError(
+            f"pipeline chunk of {chunk_elems} elements is not a multiple "
+            f"of block_size={block_size}: chunk boundaries must land on "
+            f"quantization-block boundaries or the per-block scales (and "
+            f"the bit-exact host-codec contract) change.  Pick a chunk "
+            f"count that divides the tensor into block-aligned pieces "
+            f"(compression.chunk_layout does this), or pad the tensor to "
+            f"a multiple of block_size first")
+
+
 def parse_compression(
     spec: Union[None, str, CompressionConfig]) -> Optional[CompressionConfig]:
-    """Parse "int8" / "int8:block=512,stochastic=1,ef=0,min=0" (or pass
-    through a config / None).  Empty string means off."""
+    """Parse "int8" / "int8:block=512,stochastic=1,ef=0,min=0,chunks=4,
+    bucket=4194304" (or pass through a config / None).  Empty string
+    means off."""
     if spec is None or isinstance(spec, CompressionConfig):
         return spec
     spec = spec.strip()
@@ -106,10 +193,14 @@ def parse_compression(
                 kw["error_feedback"] = v.lower() in _TRUE
             elif k == "min":
                 kw["min_size"] = int(v)
+            elif k == "chunks":
+                kw["pipeline_chunks"] = int(v)
+            elif k == "bucket":
+                kw["bucket_bytes"] = int(v)
             else:
                 raise ValueError(f"unknown compression spec key {k!r} in "
                                  f"{spec!r} (known: block, stochastic, ef, "
-                                 f"min)")
+                                 f"min, chunks, bucket)")
     return CompressionConfig(**kw)  # type: ignore[arg-type]
 
 
@@ -164,7 +255,11 @@ def compress_array(x: np.ndarray, config: CompressionConfig,
     blocks = _host_blocks(x, config.block_size)
     absmax = np.max(np.abs(blocks), axis=-1, keepdims=True)
     scales = np.where(absmax > 0, absmax / INT8_MAX, 1.0).astype(np.float32)
-    y = blocks / scales
+    # multiply by the rounded reciprocal instead of dividing: divides are
+    # the slowest VPU/host op in the codec, and 1/scale is IEEE-identical
+    # between numpy and XLA, so the jit path (ops/quantize.py) makes the
+    # same substitution and the bit-exactness contract holds
+    y = blocks * (np.float32(1.0) / scales)
     if config.stochastic:
         rng = rng or np.random.default_rng(0)
         y = np.floor(y + rng.random(y.shape, dtype=np.float32))

@@ -25,21 +25,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ray_tpu._private.jax_compat import shard_map
 
 from ray_tpu.collective.compression import (CompressionConfig,
-                                            parse_compression,
+                                            auto_pipeline_chunks,
+                                            chunk_layout, parse_compression,
                                             result_block_size, wire_ratio)
-from ray_tpu.ops.quantize import (dequantize_blockwise, padded_len,
-                                  quantize_blockwise)
+from ray_tpu.ops.quantize import (dequantize_accumulate, dequantize_blockwise,
+                                  fused_reduce_scatter, fused_rs_vmem_bytes,
+                                  padded_len, quantize_blockwise)
 from ray_tpu.util import tracing
 
+import os
 import time
 
 
-def _record_mesh_op(op: str, t0: float, x,
-                    cc: Optional[CompressionConfig]) -> None:
+def _record_mesh_op(op: str, t0: float, x, cc: Optional[CompressionConfig],
+                    breakdown: Optional[dict] = None) -> None:
     """Report dispatch time + byte counters to the flight recorder.
     Dispatch-side only — no forced fence here: blocking the hot path to
     measure it would serialize the very overlap XLA buys us.  Device
-    time lands in the step's fenced total instead."""
+    time lands in the step's fenced total instead.  `breakdown` carries
+    measured quantize/transfer/dequantize sub-phase seconds when the
+    caller ran the staged (fenced) profiling path."""
     try:
         from ray_tpu.telemetry import recorder as _rec
 
@@ -49,7 +54,8 @@ def _record_mesh_op(op: str, t0: float, x,
             itemsize = getattr(getattr(x, "dtype", None), "itemsize", 4)
             wire = nbytes * wire_ratio(x.size, cc,
                                        baseline_itemsize=itemsize)
-        _rec.record_collective(op, time.perf_counter() - t0, nbytes, wire)
+        _rec.record_collective(op, time.perf_counter() - t0, nbytes, wire,
+                               breakdown=breakdown)
     except Exception:
         pass
 
@@ -81,10 +87,44 @@ def _allreduce_impl(x, mesh: Mesh, axis: str, op: str):
     return shard_map(f, check_vma=False, mesh=mesh, in_specs=spec, out_specs=spec)(x)
 
 
+def _resolve_chunks(cc: CompressionConfig, n_elements: int,
+                    itemsize: int) -> int:
+    if cc.pipeline_chunks:
+        return cc.pipeline_chunks
+    return auto_pipeline_chunks(n_elements, itemsize, jax.default_backend())
+
+
+# Largest per-chunk VMEM footprint the fused single-kernel reduce-scatter
+# will accept before falling back to the staged kernels (quantize kernel
+# -> all_to_all -> dequant-accumulate kernel).
+_FUSED_RS_VMEM_CAP = 8 << 20
+
+
+def _resolve_rs_impl(impl: str, world: int, block: int, stochastic: bool,
+                     max_chunk_elems: int) -> str:
+    """Pick how the reduce-scatter phase runs.  "fused" = the one-kernel
+    quantize->remote-DMA-exchange->accumulate path (TPU only,
+    deterministic rounding only, chunk must fit VMEM);
+    "fused_interpret" forces the same kernel through the pallas
+    interpreter (CPU tests); anything else takes the XLA-lowered
+    fallback with identical numerics."""
+    if impl != "auto":
+        return impl
+    if os.environ.get("RAY_TPU_FUSED_RS", "1") in ("0", "false", "off"):
+        return "xla"
+    if (jax.default_backend() == "tpu" and not stochastic
+            and block % 128 == 0 and world > 1
+            and fused_rs_vmem_bytes(world, max_chunk_elems)
+            <= _FUSED_RS_VMEM_CAP):
+        return "fused"
+    return "xla"
+
+
 def mesh_allreduce(x, mesh: Mesh, axis_name: Optional[str] = None,
                    op: str = "sum",
                    compression: Union[None, str, CompressionConfig] = None,
-                   seed: int = 0):
+                   seed: int = 0, impl: str = "auto",
+                   profile: bool = False):
     """Allreduce a leading-axis-sharded array across a mesh axis.
 
     x has a per-device leading chunk layout [n_dev * k, ...]; each device's
@@ -93,13 +133,30 @@ def mesh_allreduce(x, mesh: Mesh, axis_name: Optional[str] = None,
 
     compression: a CompressionConfig / spec string ("int8", "int8:block=512")
     switches to the EQuARX-style two-phase quantized path: blockwise int8
-    quantize → all_to_all (the reduce-scatter phase) → dequantize+reduce →
-    requantize → all_gather → dequantize once per block.  Wire traffic
-    drops ~4x; result carries quantization error (sum/mean only).  `seed`
-    feeds stochastic rounding when the config asks for it."""
+    quantize → all_to_all (the reduce-scatter phase) → fused
+    dequantize+accumulate → requantize → all_gather → dequantize.  Wire
+    traffic drops ~4x; result carries quantization error (sum/mean only).
+    `seed` feeds stochastic rounding when the config asks for it.
+
+    The quantized path is chunked and pipelined per
+    `CompressionConfig.pipeline_chunks` (0 = auto): the tensor is split
+    into block-aligned chunks emitted so quantization of chunk k+1
+    overlaps the exchange of chunk k and the accumulate of chunk k-1
+    (XLA's latency-hiding scheduler does the overlap; chunk results are
+    bit-identical to the monolithic path for deterministic rounding).
+    On TPU, each chunk's reduce-scatter hop runs as ONE pallas kernel
+    (quantize -> remote DMA exchange -> accumulate, never leaving VMEM);
+    `impl` overrides the choice ("fused", "fused_interpret", "xla").
+
+    profile=True runs the same numerics as separate fenced stage
+    programs and reports measured quantize/transfer/dequantize sub-phase
+    seconds to the flight recorder — attribution mode for bench/debug;
+    the fused path stays the production default because the fences
+    serialize the very overlap the pipeline buys."""
     axis = _axis(mesh, axis_name)
     cc = parse_compression(compression)
     t0 = time.perf_counter()
+    breakdown = None
     with tracing.span("collective.mesh_allreduce", axis=axis, op=op,
                       compressed=cc is not None):
         if cc is None:
@@ -108,9 +165,15 @@ def mesh_allreduce(x, mesh: Mesh, axis_name: Optional[str] = None,
             if op not in ("sum", "mean"):
                 raise ValueError(f"compressed allreduce supports op in "
                                  f"('sum', 'mean'), got {op!r}")
-            out = _q_allreduce_impl(x, jnp.int32(seed), mesh, axis, op,
-                                    cc.block_size, cc.stochastic)
-    _record_mesh_op("mesh_allreduce", t0, x, cc)
+            if profile:
+                out, breakdown = _q_allreduce_profiled(
+                    x, jnp.int32(seed), mesh, axis, op, cc, impl)
+            else:
+                chunks = _resolve_chunks(cc, x.size, x.dtype.itemsize)
+                out = _q_allreduce_impl(x, jnp.int32(seed), mesh, axis, op,
+                                        cc.block_size, cc.stochastic,
+                                        chunks, impl)
+    _record_mesh_op("mesh_allreduce", t0, x, cc, breakdown)
     return out
 
 
@@ -134,9 +197,33 @@ def _dequant_rows(q, s, world: int, block: int):
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis", "op", "block",
-                                             "stochastic"))
+                                             "stochastic", "chunks", "impl"))
 def _q_allreduce_impl(x, seed, mesh: Mesh, axis: str, op: str, block: int,
-                      stochastic: bool):
+                      stochastic: bool, chunks: int = 1, impl: str = "auto"):
+    """Chunked, software-pipelined two-phase quantized allreduce.
+
+    The flat payload is padded to a world*block multiple, viewed as
+    [world, sub], and split column-wise into `chunks` block-aligned
+    pieces (compression.chunk_layout).  Per chunk the EQuARX structure
+    runs: quantize -> all_to_all (the reduce-scatter hop, still int8) ->
+    fused dequantize-accumulate -> requantize at the finer result block
+    -> all_gather -> dequantize.  Emission order is software-pipelined —
+    chunk k+1's quantize is emitted before chunk k's exchange is
+    consumed — so XLA's latency-hiding scheduler overlaps codec compute
+    with transfer; there is no barrier between chunks.
+
+    Because chunk boundaries land on (result-)block boundaries, every
+    per-block scale sees exactly the elements it would monolithically,
+    and the f32 accumulation order over the world axis is unchanged:
+    chunked and monolithic results are BIT-IDENTICAL for deterministic
+    rounding (stochastic draws differ per chunk layout and are exempt).
+
+    On TPU (impl="fused"/auto) each chunk's whole reduce-scatter hop is
+    ONE pallas kernel doing quantize -> remote-DMA exchange ->
+    accumulate in VMEM (ops/quantize.fused_reduce_scatter);
+    "fused_interpret" drives the same kernel through the pallas
+    interpreter on CPU meshes, and the default CPU path is the
+    XLA-lowered stage sequence with identical numerics."""
     world = mesh.shape[axis]
     spec = P(axis)
 
@@ -149,38 +236,244 @@ def _q_allreduce_impl(x, seed, mesh: Mesh, axis: str, op: str, block: int,
             flat = jnp.pad(flat, (0, total - n))
         sub = total // world
         nblk = sub // block
+        layout = chunk_layout(nblk, chunks)
+        csizes = [nb * block for nb in layout]
+        offs = [0]
+        for csz in csizes[:-1]:
+            offs.append(offs[-1] + csz)
+        C = len(csizes)
+        x2d = flat.reshape(world, sub)
+        idx = jax.lax.axis_index(axis)
+        key = _fold_key(seed_, axis, stochastic)
+        rs_impl = _resolve_rs_impl(impl, world, block, stochastic,
+                                   max(csizes))
+        rblock = result_block_size(block)
+        # phase 2 pipelines per chunk only when chunk boundaries are also
+        # result-block boundaries (true whenever rblock divides block);
+        # otherwise the reduced chunks are restitched and phase 2 runs
+        # monolithically — either way bit-identical to chunks=1
+        p2_chunked = C > 1 and block % rblock == 0
+
+        def quantize_chunk(c):
+            xc = x2d[:, offs[c]:offs[c] + csizes[c]]
+            # C == 1 keeps the exact pre-chunking key/seed derivation so
+            # stochastic draws reproduce across versions
+            kc = None
+            if stochastic:
+                kc = key if C == 1 else jax.random.fold_in(key, c)
+            return quantize_blockwise(xc, block, stochastic=stochastic,
+                                      key=kc, seed=seed_ * world + idx + c)
+
+        def requant_chunk(c, red_c):
+            kc = (jax.random.fold_in(key, world + c)
+                  if stochastic else None)
+            return quantize_blockwise(red_c, rblock, stochastic=stochastic,
+                                      key=kc,
+                                      seed=seed_ * world + idx + c + 1)
+
+        reds = [None] * C
+        if rs_impl in ("fused", "fused_interpret"):
+            for c in range(C):
+                xc = x2d[:, offs[c]:offs[c] + csizes[c]]
+                reds[c] = fused_reduce_scatter(
+                    xc, axis, block,
+                    interpret=(rs_impl == "fused_interpret"))
+        else:
+            qs = [None] * C
+            ss = [None] * C
+            qs[0], ss[0] = quantize_chunk(0)
+            for c in range(C):
+                # exchange chunk c ...
+                qx = jax.lax.all_to_all(qs[c].reshape(world, csizes[c]),
+                                        axis, split_axis=0, concat_axis=0,
+                                        tiled=True)
+                sx = jax.lax.all_to_all(ss[c].reshape(world, layout[c]),
+                                        axis, split_axis=0, concat_axis=0,
+                                        tiled=True)
+                # ... while quantizing chunk c+1 (emitted before the
+                # exchange is consumed: the scheduler may overlap them)
+                if c + 1 < C:
+                    qs[c + 1], ss[c + 1] = quantize_chunk(c + 1)
+                reds[c] = dequantize_accumulate(qx.reshape(-1),
+                                                sx.reshape(-1), world,
+                                                block)
+        if op == "mean":
+            reds = [r / world for r in reds]
+
+        def gather_chunk(q2, s2, csz):
+            qg = jax.lax.all_gather(q2, axis, tiled=True)
+            sg = jax.lax.all_gather(s2, axis, tiled=True)
+            # per-device pieces may carry rblock padding; dequantize
+            # row-wise and strip before restitching
+            out = _dequant_rows(qg.reshape(world, -1),
+                                sg.reshape(world, -1), world, rblock)
+            return out.reshape(world, -1)[:, :csz]
+
+        if p2_chunked:
+            q2s = [None] * C
+            s2s = [None] * C
+            q2s[0], s2s[0] = requant_chunk(0, reds[0])
+            pieces = [None] * C
+            for c in range(C):
+                if c + 1 < C:
+                    q2s[c + 1], s2s[c + 1] = requant_chunk(c + 1,
+                                                           reds[c + 1])
+                pieces[c] = gather_chunk(q2s[c], s2s[c], csizes[c])
+            out2d = jnp.concatenate(pieces, axis=1)
+        else:
+            red = reds[0] if C == 1 else jnp.concatenate(reds)
+            q2, s2 = requant_chunk(0, red)
+            out2d = gather_chunk(q2, s2, sub)
+        return out2d.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+    return shard_map(f, check_vma=False, mesh=mesh, in_specs=(spec, P()),
+                     out_specs=spec)(x, seed)
+
+
+# --- staged profiling path -------------------------------------------------
+# Same numerics as _q_allreduce_impl with chunks=1, but split into six
+# separately-jitted, fenced stage programs so wall time is attributable to
+# quantize / transfer / dequantize sub-phases.  The fences serialize the
+# overlap the pipelined path exists to create, so this is a measurement
+# mode (bench --emit-telemetry, debugging), never the production default.
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "block",
+                                             "stochastic"))
+def _qprof_quantize(x, seed, mesh: Mesh, axis: str, block: int,
+                    stochastic: bool):
+    world = mesh.shape[axis]
+
+    def f(shard, seed_):
+        flat = shard.reshape(-1).astype(jnp.float32)
+        n = flat.shape[0]
+        total = padded_len(n, world * block)
+        if total != n:
+            flat = jnp.pad(flat, (0, total - n))
+        sub = total // world
         idx = jax.lax.axis_index(axis)
         key = _fold_key(seed_, axis, stochastic)
         q, s = quantize_blockwise(flat.reshape(world, sub), block,
                                   stochastic=stochastic, key=key,
                                   seed=seed_ * world + idx)
-        # phase 1 (reduce-scatter): all_to_all hands device i every peer's
-        # sub-chunk i, still in int8
-        qx = jax.lax.all_to_all(q.reshape(world, sub), axis, split_axis=0,
-                                concat_axis=0, tiled=True)
-        sx = jax.lax.all_to_all(s.reshape(world, nblk), axis, split_axis=0,
-                                concat_axis=0, tiled=True)
-        red = _dequant_rows(qx, sx, world, block).sum(axis=0).reshape(sub)
+        return q.reshape(world, sub), s.reshape(world, sub // block)
+
+    return shard_map(f, check_vma=False, mesh=mesh, in_specs=(P(axis), P()),
+                     out_specs=(P(axis), P(axis)))(x, seed)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def _qprof_exchange(q, s, mesh: Mesh, axis: str):
+    def f(qs, ss):
+        qx = jax.lax.all_to_all(qs, axis, split_axis=0, concat_axis=0,
+                                tiled=True)
+        sx = jax.lax.all_to_all(ss, axis, split_axis=0, concat_axis=0,
+                                tiled=True)
+        return qx, sx
+
+    return shard_map(f, check_vma=False, mesh=mesh,
+                     in_specs=(P(axis), P(axis)),
+                     out_specs=(P(axis), P(axis)))(q, s)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "op", "block"))
+def _qprof_accumulate(qx, sx, mesh: Mesh, axis: str, op: str, block: int):
+    world = mesh.shape[axis]
+
+    def f(q, s):
+        red = dequantize_accumulate(q.reshape(-1), s.reshape(-1), world,
+                                    block)
         if op == "mean":
             red = red / world
-        # phase 2 (allgather): requantize the reduced chunk this device
-        # owns — with a finer result block, the only quantization the
-        # receivers see (see compression.result_block_size)
-        rblock = result_block_size(block)
+        return red
+
+    return shard_map(f, check_vma=False, mesh=mesh,
+                     in_specs=(P(axis), P(axis)), out_specs=P(axis))(qx, sx)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "block",
+                                             "stochastic"))
+def _qprof_requant(red, seed, mesh: Mesh, axis: str, block: int,
+                   stochastic: bool):
+    world = mesh.shape[axis]
+    rblock = result_block_size(block)
+
+    def f(r, seed_):
+        idx = jax.lax.axis_index(axis)
+        key = _fold_key(seed_, axis, stochastic)
         key2 = jax.random.fold_in(key, world) if stochastic else None
-        q2, s2 = quantize_blockwise(red, rblock, stochastic=stochastic,
-                                    key=key2, seed=seed_ * world + idx + 1)
-        qg = jax.lax.all_gather(q2, axis, tiled=True)
-        sg = jax.lax.all_gather(s2, axis, tiled=True)
-        # per-device chunks may carry rblock padding; dequantize row-wise
-        # and strip it before restitching the flat stream
-        out = _dequant_rows(qg.reshape(world, -1), sg.reshape(world, -1),
+        return quantize_blockwise(r.reshape(-1), rblock,
+                                  stochastic=stochastic, key=key2,
+                                  seed=seed_ * world + idx + 1)
+
+    return shard_map(f, check_vma=False, mesh=mesh, in_specs=(P(axis), P()),
+                     out_specs=(P(axis), P(axis)))(red, seed)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def _qprof_gather(q2, s2, mesh: Mesh, axis: str):
+    def f(qv, sv):
+        qg = jax.lax.all_gather(qv, axis, tiled=True)
+        sg = jax.lax.all_gather(sv, axis, tiled=True)
+        return qg, sg
+
+    return shard_map(f, check_vma=False, mesh=mesh,
+                     in_specs=(P(axis), P(axis)),
+                     out_specs=(P(), P()))(q2, s2)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "rblock", "sub",
+                                             "n", "shape", "dtype"))
+def _qprof_stitch(qg, sg, mesh: Mesh, axis: str, rblock: int, sub: int,
+                  n: int, shape: tuple, dtype: str):
+    world = mesh.shape[axis]
+
+    def f(qg_, sg_):
+        out = _dequant_rows(qg_.reshape(world, -1), sg_.reshape(world, -1),
                             world, rblock)
         out = out.reshape(world, -1)[:, :sub]
-        return out.reshape(-1)[:n].reshape(shape).astype(dtype)
+        return out.reshape(-1)[:n].reshape(shape).astype(jnp.dtype(dtype))
 
-    return shard_map(f, check_vma=False, mesh=mesh, in_specs=(spec, P()),
-                     out_specs=spec)(x, seed)
+    return shard_map(f, check_vma=False, mesh=mesh, in_specs=(P(), P()),
+                     out_specs=P(axis))(qg, sg)
+
+
+def _q_allreduce_profiled(x, seed, mesh: Mesh, axis: str, op: str,
+                          cc: CompressionConfig, impl: str):
+    """Run the quantized allreduce as six fenced stage programs and
+    return (result, {"quantize","transfer","dequantize"} seconds).
+    Bit-identical to _q_allreduce_impl(chunks=1) for deterministic
+    rounding; `impl` is ignored — attribution always uses the XLA stage
+    sequence (a fused kernel cannot be split for timing)."""
+    del impl
+    block, stochastic = cc.block_size, cc.stochastic
+    world = mesh.shape[axis]
+    rblock = result_block_size(block)
+    pershard = (x.shape[0] // world,) + tuple(x.shape[1:])
+    n = 1
+    for d in pershard:
+        n *= d
+    sub = padded_len(n, world * block) // world
+    times = {"quantize": 0.0, "transfer": 0.0, "dequantize": 0.0}
+
+    def run(bucket, fn, *a):
+        t = time.perf_counter()
+        out = fn(*a)
+        jax.block_until_ready(out)
+        times[bucket] += time.perf_counter() - t
+        return out
+
+    x = jax.block_until_ready(x)
+    q, s = run("quantize", _qprof_quantize, x, seed, mesh, axis, block,
+               stochastic)
+    qx, sx = run("transfer", _qprof_exchange, q, s, mesh, axis)
+    red = run("dequantize", _qprof_accumulate, qx, sx, mesh, axis, op, block)
+    q2, s2 = run("quantize", _qprof_requant, red, seed, mesh, axis, block,
+                 stochastic)
+    qg, sg = run("transfer", _qprof_gather, q2, s2, mesh, axis)
+    out = run("dequantize", _qprof_stitch, qg, sg, mesh, axis, rblock, sub,
+              n, pershard, jnp.dtype(x.dtype).name)
+    return out, times
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis", "block",
@@ -204,7 +497,8 @@ def _q_reducescatter_impl(x, seed, mesh: Mesh, axis: str, block: int,
                                 concat_axis=0, tiled=True)
         sx = jax.lax.all_to_all(s.reshape(world, sub_pad // block), axis,
                                 split_axis=0, concat_axis=0, tiled=True)
-        red = _dequant_rows(qx, sx, world, block).sum(axis=0).reshape(sub_pad)
+        red = dequantize_accumulate(qx.reshape(-1), sx.reshape(-1), world,
+                                    block)
         return red[:sub][None].astype(shard.dtype)
 
     return shard_map(f, check_vma=False, mesh=mesh, in_specs=(P(axis), P()),

@@ -109,6 +109,23 @@ def shard_tree(tree, logical_tree, mesh: Mesh,
     return jax.tree.map(lambda x, s: jax.device_put(x, s), tree, sh)
 
 
+def _is_float_dtype(dtype) -> bool:
+    """True for any real floating dtype INCLUDING the ml_dtypes
+    extension floats (bfloat16 etc.), which numpy's issubdtype does not
+    recognize as np.floating — without this, bf16 gradients would skip
+    the bucketed/error-feedback path entirely."""
+    import numpy as np
+
+    if np.issubdtype(dtype, np.floating):
+        return True
+    try:
+        from jax import dtypes as _jd
+
+        return bool(_jd.issubdtype(dtype, np.floating))
+    except Exception:
+        return False
+
+
 class GradientSynchronizer:
     """Cross-process gradient sync with optional compressed collectives.
 
@@ -119,56 +136,164 @@ class GradientSynchronizer:
     the group's backend, compressed per `compression` / the group
     default / the RAY_TPU_COLLECTIVE_COMPRESSION flag.
 
-    With `error_feedback` on (the CompressionConfig default), the per-
-    parameter compression residual e_t = g_t - deq(quant(g_t)) is held
-    host-side and re-injected into the next step's gradient — the
-    standard EF-SGD construction that keeps compressed training
-    convergent instead of accumulating quantization bias.  Residuals are
-    recomputed locally from the deterministic codec (an extra local
-    quantize per leaf, no extra wire traffic)."""
+    Float gradients are COALESCED into buckets of ~`bucket_bytes`
+    (CompressionConfig.bucket_bytes unless overridden here) and each
+    bucket is issued through `collective.allreduce_async` the moment it
+    fills — so with the incremental `begin()/push()/finish()` API the
+    first buckets are in flight while the backward pass is still
+    producing the rest, and `__call__` still pipelines bucket k's
+    reduce under bucket k+1's quantize.  Bucketing also amortizes the
+    per-op rendezvous and lets small leaves ride a compressed bucket
+    instead of going uncompressed below `min_size`.
+
+    With `error_feedback` on (the CompressionConfig default), the
+    compression residual e_t = g_t - deq(quant(g_t)) is held host-side
+    — in the PARAMETER dtype, so bf16 training doesn't double residual
+    memory by upcasting to f32 — and re-injected into the next step's
+    gradient: the standard EF-SGD construction that keeps compressed
+    training convergent instead of accumulating quantization bias.
+    Residuals are recomputed locally from the deterministic codec over
+    the exact bucket stream that went on the wire (an extra local
+    quantize per bucket, no extra wire traffic)."""
 
     def __init__(self, group_name: str = "default", op: str = "mean",
-                 compression=None):
+                 compression=None, bucket_bytes: Optional[int] = None):
         self.group_name = group_name
         self.op = op
         self.compression = compression
-        self._residuals: Optional[list] = None
+        self.bucket_bytes = bucket_bytes
+        self._residuals: Optional[dict] = None
+        self._stream: Optional[dict] = None
 
     def reset(self):
         """Drop accumulated error-feedback residuals (e.g. after a
         checkpoint restore on different parameters)."""
         self._residuals = None
 
-    def __call__(self, grads):
+    # -- incremental streaming API ---------------------------------------
+
+    def begin(self):
+        """Start a sync stream; feed leaves with push(), collect with
+        finish().  Push order must match across ranks (it is the
+        collective issue order)."""
+        import numpy as np
+
+        from ray_tpu.collective.compression import resolve_compression
+
+        cc = resolve_compression(self.compression)
+        cap = self.bucket_bytes
+        if cap is None:
+            cap = cc.bucket_bytes if cc is not None else 4 << 20
+        if self._residuals is None:
+            self._residuals = {}
+        self._stream = {
+            "cc": cc,
+            "use_ef": cc is not None and cc.error_feedback,
+            "cap": max(1, int(cap)),
+            "pending": [],        # (slot, x_np) awaiting bucket flush
+            "pending_bytes": 0,
+            "buckets": [],        # flushed: (handle, corrected, segments)
+            "singles": {},        # slot -> handle (non-bucketed leaves)
+            "meta": {},           # slot -> (shape, dtype)
+            "nslots": 0,
+        }
+        return self
+
+    def push(self, g) -> int:
+        """Enqueue one gradient leaf; returns its slot id.  Issues the
+        current bucket's allreduce as soon as it crosses bucket_bytes."""
+        import numpy as np
+
+        st = self._stream
+        if st is None:
+            raise RuntimeError("push() outside begin()/finish() — call "
+                               "begin() first (or use __call__)")
+        from ray_tpu.collective import collective
+
+        slot = st["nslots"]
+        st["nslots"] += 1
+        x = np.asarray(g)
+        st["meta"][slot] = (x.shape, x.dtype)
+        if st["cc"] is not None and _is_float_dtype(x.dtype):
+            st["pending"].append((slot, x))
+            st["pending_bytes"] += x.size * 4     # bucket carries f32
+            if st["pending_bytes"] >= st["cap"]:
+                self._flush_bucket()
+        else:
+            st["singles"][slot] = collective.allreduce_async(
+                x, self.group_name, op=self.op, compression=st["cc"])
+        return slot
+
+    def _flush_bucket(self):
         import numpy as np
 
         from ray_tpu.collective import collective
-        from ray_tpu.collective.compression import (compression_residual,
-                                                    resolve_compression)
 
-        cc = resolve_compression(self.compression)
+        st = self._stream
+        if not st["pending"]:
+            return
+        parts, segments, off = [], [], 0
+        for slot, x in st["pending"]:
+            flat = x.reshape(-1).astype(np.float32)
+            res = self._residuals.get(slot) if st["use_ef"] else None
+            if res is not None:
+                flat = flat + res.reshape(-1).astype(np.float32)
+            parts.append(flat)
+            segments.append((slot, off, off + flat.size))
+            off += flat.size
+        st["pending"] = []
+        st["pending_bytes"] = 0
+        corrected = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        handle = collective.allreduce_async(corrected, self.group_name,
+                                            op=self.op, compression=st["cc"])
+        st["buckets"].append((handle, corrected, segments))
+
+    def finish(self) -> list:
+        """Flush the tail bucket, await every in-flight reduce, update
+        residuals, and return the synced leaves in push order."""
+        import numpy as np
+
+        from ray_tpu.collective.compression import compression_residual
+
+        st = self._stream
+        if st is None:
+            raise RuntimeError("finish() without begin()")
+        self._flush_bucket()
+        cc = st["cc"]
+        out = [None] * st["nslots"]
+        for handle, corrected, segments in st["buckets"]:
+            reduced = handle.result()
+            # did the wire actually compress this bucket?  (mirrors
+            # _resolve_op_compression: small buckets go exact)
+            compressed = cc is not None and corrected.size >= cc.min_size
+            resid = (compression_residual(corrected, cc)
+                     if compressed and st["use_ef"] else None)
+            for slot, a, b in segments:
+                shape, dtype = st["meta"][slot]
+                out[slot] = np.asarray(
+                    reduced[a:b]).reshape(shape).astype(dtype)
+                if resid is not None:
+                    # parameter dtype on purpose: bf16 params keep bf16
+                    # residuals (half the memory; the re-injection above
+                    # upcasts to f32 for the arithmetic)
+                    self._residuals[slot] = resid[a:b].reshape(
+                        shape).astype(dtype)
+                elif st["use_ef"]:
+                    # exact (uncompressed) sync consumed whatever
+                    # residual was injected
+                    self._residuals[slot] = np.zeros(shape, dtype)
+        for slot, handle in st["singles"].items():
+            shape, dtype = st["meta"][slot]
+            out[slot] = np.asarray(handle.result())
+        self._stream = None
+        return out
+
+    def __call__(self, grads):
         leaves, treedef = jax.tree.flatten(grads)
-        use_ef = cc is not None and cc.error_feedback
-        if use_ef and self._residuals is None:
-            self._residuals = [np.zeros(np.shape(g), np.float32)
-                               for g in leaves]
-        synced = []
-        for i, g in enumerate(leaves):
-            x = np.asarray(g)
-            if use_ef and np.issubdtype(x.dtype, np.floating):
-                corrected = x.astype(np.float32) + self._residuals[i]
-                out = collective.allreduce(corrected, self.group_name,
-                                           op=self.op, compression=cc)
-                if corrected.size >= cc.min_size:
-                    # what this rank's contribution lost to quantization;
-                    # deterministic codec => exact local recomputation
-                    self._residuals[i] = compression_residual(corrected, cc)
-                synced.append(out.astype(x.dtype))
-            else:
-                synced.append(collective.allreduce(x, self.group_name,
-                                                   op=self.op,
-                                                   compression=cc))
-        return jax.tree.unflatten(treedef, synced)
+        self.begin()
+        for g in leaves:
+            self.push(g)
+        return jax.tree.unflatten(treedef, self.finish())
 
 
 def with_constraint(x, logical: Tuple[Optional[str], ...],

@@ -59,6 +59,9 @@ class StepAggregator:
         busy = {}
         for rec in recs:
             phases = rec.get("phases") or {}
+            # only the parent "collective" phase is subtracted — dotted
+            # sub-phases (collective.quantize/transfer/dequantize) are
+            # nested inside it, not additional wait time
             busy[rec.get("rank", 0)] = max(
                 0.0, rec["dur"] - phases.get("collective", 0.0))
         view = {
@@ -141,4 +144,13 @@ class StepAggregator:
             out["last_step"] = last["step"]
             out["last_step_max_s"] = round(max(durs), 6)
             out["last_step_median_s"] = round(statistics.median(durs), 6)
+            # mean per-phase seconds across the gang, sub-phases included
+            # — the dashboard's "where does a step go" line
+            totals: Dict[str, float] = {}
+            for rec in last["workers"].values():
+                for name, secs in (rec.get("phases") or {}).items():
+                    totals[name] = totals.get(name, 0.0) + secs
+            n = max(1, len(last["workers"]))
+            out["last_step_phase_means_s"] = {
+                k: round(v / n, 6) for k, v in sorted(totals.items())}
         return out

@@ -30,7 +30,12 @@ from ..util import metrics as metrics_mod
 TELEMETRY_KEY_PREFIX = "telemetry:"
 
 # Canonical phase order for timeline rendering; unknown phases append.
-PHASE_ORDER = ("data", "compute", "collective", "checkpoint")
+# Dotted names are SUB-phases nested under their parent ("collective" is
+# also reported as quantize/transfer/dequantize when the collective layer
+# measured its stages) — children overlap the parent's time, so summaries
+# and the step residual must not double-count them (see step_end).
+PHASE_ORDER = ("data", "compute", "collective", "collective.quantize",
+               "collective.transfer", "collective.dequantize", "checkpoint")
 
 _STEP_BOUNDARIES = [0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5,
                     10, 30, 60, 300]
@@ -177,8 +182,11 @@ class StepTimer:
                 return None
             dur = self._clock() - self._t0
             phases = dict(self._phases)
-            # residual host+device time not claimed by an explicit phase
-            residual = dur - sum(phases.values())
+            # residual host+device time not claimed by an explicit phase;
+            # dotted sub-phases ("collective.quantize") overlap their
+            # parent's time and must not be counted twice
+            residual = dur - sum(v for k, v in phases.items()
+                                 if "." not in k)
             if residual > 0:
                 phases["compute"] = phases.get("compute", 0.0) + residual
             rec = {
@@ -274,12 +282,23 @@ def current_timer() -> Optional[StepTimer]:
 
 
 def record_collective(op: str, seconds: float, payload_bytes: float = 0,
-                      wire_bytes: Optional[float] = None) -> None:
+                      wire_bytes: Optional[float] = None,
+                      breakdown: Optional[Dict[str, float]] = None) -> None:
     """Called by collective/xla_group per op; feeds the current step's
-    "collective" phase plus cluster-wide Prometheus series."""
+    "collective" phase plus cluster-wide Prometheus series.
+
+    ``breakdown`` carries measured quantize/transfer/dequantize sub-phase
+    seconds (the kv backend times its codec/wire stages; the compiled
+    backend reports them from mesh_allreduce(profile=True)'s fenced
+    stage programs).  Sub-phases land as "collective.<stage>" children —
+    NESTED inside the parent "collective" time, not additional to it."""
     timer = current_timer()
     if timer is not None:
         timer.add_phase_time("collective", seconds)
+        if breakdown:
+            for stage, secs in breakdown.items():
+                if secs > 0:
+                    timer.add_phase_time(f"collective.{stage}", secs)
     try:
         _collective_histogram().observe(seconds, tags={"op": op})
         if payload_bytes > 0:

@@ -62,6 +62,17 @@ def _phase_sorted(phases: Dict[str, float]) -> List[str]:
     return known + extra
 
 
+def _top_level(phases: Dict[str, float]) -> List[str]:
+    """Canonical-order top-level phase names (dotted sub-phases are laid
+    out nested inside their parent, not on the sequential cursor)."""
+    return [p for p in _phase_sorted(phases) if "." not in p]
+
+
+def _children_of(phases: Dict[str, float], parent: str) -> List[str]:
+    pre = parent + "."
+    return [p for p in _phase_sorted(phases) if p.startswith(pre)]
+
+
 def chrome_trace(snapshots: List[Dict[str, Any]],
                  remediations: Optional[List[Dict[str, Any]]] = None
                  ) -> Dict[str, Any]:
@@ -96,16 +107,35 @@ def chrome_trace(snapshots: List[Dict[str, Any]],
                          "phases": rec.get("phases", {})},
             })
             # phases have durations, not start offsets — lay them out
-            # sequentially in canonical order on a sibling track
+            # sequentially in canonical order on a sibling track; dotted
+            # sub-phases (collective.quantize/transfer/dequantize) nest
+            # INSIDE their parent's span (same tid, contained ts range ->
+            # Perfetto renders them as child slices), so the track's
+            # sequential cursor never double-counts them
             cursor = ts_us
             phases = rec.get("phases") or {}
-            for name in _phase_sorted(phases):
+            for name in _top_level(phases):
                 p_us = max(phases[name] * 1e6, 0.001)
                 events.append({
                     "name": name, "ph": "X", "ts": cursor, "dur": p_us,
                     "pid": pid, "tid": 1,
                     "args": {"step": step, "seconds": phases[name]},
                 })
+                sub_cursor = cursor
+                for child in _children_of(phases, name):
+                    c_us = max(phases[child] * 1e6, 0.001)
+                    # clip to the parent span: measured sub-stages can
+                    # overshoot the async parent's dispatch time by a
+                    # rounding hair, and an escaping child breaks nesting
+                    c_us = min(c_us, cursor + p_us - sub_cursor)
+                    if c_us <= 0:
+                        break
+                    events.append({
+                        "name": child, "ph": "X", "ts": sub_cursor,
+                        "dur": c_us, "pid": pid, "tid": 1,
+                        "args": {"step": step, "seconds": phases[child]},
+                    })
+                    sub_cursor += c_us
                 cursor += p_us
     for rec in remediations or []:
         rid = rec.get("id", "rem")

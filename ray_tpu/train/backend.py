@@ -200,10 +200,12 @@ class JaxConfig(BackendConfig):
     # per-callsite plumbing
     mesh_shape: Optional[Dict[str, int]] = None
     # gradient-sync compression for the gang: a CompressionConfig or spec
-    # string ("int8", "int8:block=512,ef=1").  Installed as every
-    # worker's group default, so collective.allreduce /
-    # GradientSynchronizer compress without per-call plumbing; None
-    # defers to the RAY_TPU_COLLECTIVE_COMPRESSION flag
+    # string ("int8", "int8:block=512,ef=1",
+    # "int8:chunks=4,bucket=8388608" for the pipelined-chunk and
+    # gradient-bucket knobs).  Installed as every worker's group
+    # default, so collective.allreduce / GradientSynchronizer compress
+    # (and bucket/pipeline) without per-call plumbing; None defers to
+    # the RAY_TPU_COLLECTIVE_COMPRESSION flag
     compression: Union[None, str, CompressionConfig] = None
     # opt into preemption-aware elastic training: peer-replicated
     # emergency checkpoints + shrink-to-fit restarts (see

@@ -1,0 +1,355 @@
+"""Fused/pipelined quantized collectives (tier-1).
+
+Covers the chunked-pipeline contract (chunked == monolithic BITWISE for
+deterministic rounding, across world sizes and uneven boundaries), the
+fused reduce-scatter kernel through the pallas interpreter, the fenced
+stage-profiled attribution path and its telemetry sub-phases, async
+allreduce handles, and the bucketed error-feedback GradientSynchronizer
+(including bf16 residual dtype).  CPU exercises the real numerics via
+the XLA-fallback kernels; the fused kernel runs in interpret mode.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.collective.compression import (CHUNK_TARGET_BYTES,
+                                            MAX_PIPELINE_CHUNKS,
+                                            CompressionConfig,
+                                            auto_pipeline_chunks,
+                                            chunk_layout, parse_compression,
+                                            validate_chunk_elems)
+
+
+def _rel(a, b):
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    return np.linalg.norm(a - b) / (np.linalg.norm(b) + 1e-30)
+
+
+def _mesh(world):
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < world:
+        pytest.skip(f"needs {world} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:world]), ("dp",))
+
+
+def _put(g, mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.device_put(jnp.asarray(g), NamedSharding(mesh, P("dp")))
+
+
+# ---------------------------------------------------------------------------
+# chunk layout / knob plumbing (pure host math)
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_layout_block_aligned_and_uneven():
+    assert chunk_layout(7, 2) == (4, 3)
+    assert chunk_layout(8, 4) == (2, 2, 2, 2)
+    # more chunks than blocks clamps, never returns empties
+    assert chunk_layout(3, 8) == (1, 1, 1)
+    with pytest.raises(ValueError, match="pipeline chunk count"):
+        chunk_layout(4, 0)
+    with pytest.raises(ValueError, match="n_blocks"):
+        chunk_layout(0, 2)
+
+
+def test_validate_chunk_elems_actionable_error():
+    validate_chunk_elems(1024, 256)  # aligned: fine
+    with pytest.raises(ValueError, match="block-aligned|chunk_layout"):
+        validate_chunk_elems(1000, 256)
+
+
+def test_auto_pipeline_chunks_backend_aware():
+    # shared-memory hosts never chunk (transfer is a memcpy)
+    assert auto_pipeline_chunks(1 << 24, 4, "cpu") == 1
+    # small tensors never chunk, big ones cap at MAX_PIPELINE_CHUNKS
+    assert auto_pipeline_chunks(1024, 4, "tpu") == 1
+    big = MAX_PIPELINE_CHUNKS * 4 * CHUNK_TARGET_BYTES
+    assert auto_pipeline_chunks(big // 4, 4, "tpu") == MAX_PIPELINE_CHUNKS
+
+
+def test_spec_parses_chunks_and_bucket_knobs():
+    cc = parse_compression("int8:chunks=4,bucket=1048576")
+    assert cc.pipeline_chunks == 4 and cc.bucket_bytes == 1 << 20
+    rt = parse_compression(cc.to_spec())
+    assert rt == cc
+
+
+# ---------------------------------------------------------------------------
+# chunked == monolithic, bitwise, across world sizes / ops / boundaries
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+def test_chunked_bit_identical_to_monolithic(world):
+    from ray_tpu.collective import xla_group
+
+    mesh = _mesh(world)
+    rng = np.random.default_rng(world)
+    # 1000 is NOT a multiple of world*block: padding + uneven chunk
+    # boundaries (chunk_layout spreads the remainder) both in play
+    g = rng.standard_normal((world, 1000)).astype(np.float32)
+    arr = _put(g, mesh)
+    ops = ("sum", "mean") if world == 8 else ("mean",)
+    for op in ops:
+        mono = np.asarray(xla_group.mesh_allreduce(
+            arr, mesh, "dp", op=op,
+            compression=CompressionConfig(min_size=0, pipeline_chunks=1)))
+        for chunks in (2, 5):
+            chk = np.asarray(xla_group.mesh_allreduce(
+                arr, mesh, "dp", op=op,
+                compression=CompressionConfig(min_size=0,
+                                              pipeline_chunks=chunks)))
+            assert np.array_equal(mono, chk), (op, chunks)
+        # and the quantized result stays near the exact reduction
+        full = np.asarray(xla_group.mesh_allreduce(arr, mesh, "dp", op=op))
+        assert _rel(mono, full) < 1e-2
+
+
+def test_chunked_block_multiple_boundary():
+    """Block-multiple tensor: phase 2 runs chunked too (block % rblock
+    == 0), still bitwise-equal to monolithic."""
+    from ray_tpu.collective import xla_group
+
+    mesh = _mesh(4)
+    g = np.random.default_rng(9).standard_normal(
+        (4, 4 * 256 * 3)).astype(np.float32)
+    arr = _put(g, mesh)
+    mono = np.asarray(xla_group.mesh_allreduce(
+        arr, mesh, "dp", op="mean",
+        compression=CompressionConfig(min_size=0, pipeline_chunks=1)))
+    chk = np.asarray(xla_group.mesh_allreduce(
+        arr, mesh, "dp", op="mean",
+        compression=CompressionConfig(min_size=0, pipeline_chunks=3)))
+    assert np.array_equal(mono, chk)
+
+
+# ---------------------------------------------------------------------------
+# fused reduce-scatter kernel (pallas; interpret mode on CPU)
+# ---------------------------------------------------------------------------
+
+
+def test_fused_reduce_scatter_interpret_matches_xla():
+    from ray_tpu.collective import xla_group
+
+    mesh = _mesh(4)
+    g = np.random.default_rng(11).standard_normal(
+        (4, 4 * 512)).astype(np.float32)
+    arr = _put(g, mesh)
+    cc = CompressionConfig(min_size=0, pipeline_chunks=1)
+    ref = np.asarray(xla_group.mesh_allreduce(
+        arr, mesh, "dp", op="mean", compression=cc, impl="xla"))
+    fused = np.asarray(xla_group.mesh_allreduce(
+        arr, mesh, "dp", op="mean", compression=cc,
+        impl="fused_interpret"))
+    assert np.array_equal(ref, fused)
+
+
+# ---------------------------------------------------------------------------
+# stage-profiled attribution + telemetry sub-phases
+# ---------------------------------------------------------------------------
+
+
+def test_profiled_matches_pipelined_and_records_subphases():
+    from ray_tpu.collective import xla_group
+    from ray_tpu.telemetry import StepTimer, set_current_timer
+
+    mesh = _mesh(8)
+    g = np.random.default_rng(13).standard_normal(
+        (8, 1000)).astype(np.float32)
+    arr = _put(g, mesh)
+    cc = CompressionConfig(min_size=0, pipeline_chunks=1)
+    mono = np.asarray(xla_group.mesh_allreduce(
+        arr, mesh, "dp", op="mean", compression=cc))
+    timer = StepTimer(ring_size=4)
+    set_current_timer(timer)
+    try:
+        timer.step_start(0)
+        prof = np.asarray(xla_group.mesh_allreduce(
+            arr, mesh, "dp", op="mean", compression=cc, profile=True))
+        rec = timer.step_end(0)
+    finally:
+        set_current_timer(None)
+    assert np.array_equal(mono, prof)
+    phases = rec["phases"]
+    subs = [k for k in phases if k.startswith("collective.")]
+    assert sorted(subs) == ["collective.dequantize", "collective.quantize",
+                            "collective.transfer"]
+    # sub-phases NEST inside the parent: never double-counted in the
+    # step's residual, and their sum stays within the parent's span
+    assert sum(phases[k] for k in subs) <= phases["collective"] + 1e-3
+    assert rec["dur"] + 1e-6 >= phases["collective"]
+
+
+def test_timeline_nests_subphases_inside_collective():
+    from ray_tpu.telemetry import chrome_trace, validate_chrome_trace
+
+    snap = {"rank": 0, "incarnation": 0, "trial": "t", "steps": [{
+        "step": 0, "ts": 100.0, "dur": 1.0,
+        "phases": {"compute": 0.5, "collective": 0.4,
+                   "collective.quantize": 0.15,
+                   "collective.transfer": 0.1,
+                   "collective.dequantize": 0.1},
+        "rank": 0, "incarnation": 0}]}
+    trace = chrome_trace([snap])
+    assert validate_chrome_trace(trace)
+    spans = {e["name"]: e for e in trace["traceEvents"] if e["ph"] == "X"}
+    parent = spans["collective"]
+    for name in ("collective.quantize", "collective.transfer",
+                 "collective.dequantize"):
+        child = spans[name]
+        assert child["ts"] >= parent["ts"] - 1e-6
+        assert child["ts"] + child["dur"] <= \
+            parent["ts"] + parent["dur"] + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# kv backend: async handles + bucketed EF synchronizer (needs a cluster)
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+class PipelineWorker:
+    def __init__(self, rank, world):
+        self.rank = rank
+        self.world = world
+
+    def setup(self, group):
+        from ray_tpu import collective as col
+
+        col.init_collective_group(self.world, self.rank, backend="kv",
+                                  group_name=group)
+        return True
+
+    def async_out_of_order(self, group, seed):
+        """Issue two async allreduces, then resolve them in REVERSE
+        order — op indices must be captured at issue time."""
+        from ray_tpu import collective as col
+
+        rng = np.random.default_rng(seed + self.rank)
+        x1 = rng.standard_normal(1024).astype(np.float32)
+        x2 = rng.standard_normal(512).astype(np.float32)
+        h1 = col.allreduce_async(x1, group, op="mean",
+                                 compression="int8:min=0")
+        h2 = col.allreduce_async(x2, group, op="sum")
+        r2 = h2.result()
+        r1 = h1.result()
+        return r1, r2
+
+    def bf16_residual_probe(self, group):
+        import ml_dtypes
+
+        from ray_tpu.parallel import GradientSynchronizer
+
+        sync = GradientSynchronizer(group_name=group,
+                                    compression="int8:min=0")
+        outs = []
+        for t in range(2):
+            g = {
+                "wb": (np.random.default_rng(10 * t + self.rank)
+                       .standard_normal(2048).astype(np.float32)
+                       .astype(ml_dtypes.bfloat16)),
+                "wf": (np.random.default_rng(77 * t + self.rank)
+                       .standard_normal(512).astype(np.float32)),
+            }
+            out = sync(g)
+            outs.append({k: np.asarray(v, np.float32)
+                         for k, v in out.items()})
+        res_dtypes = sorted(str(v.dtype) for v in sync._residuals.values())
+        out_dtypes = {k: str(v.dtype) for k, v in out.items()}
+        return outs, out_dtypes, res_dtypes
+
+    def ef_train_bucketed(self, group, steps, dims, bucket_bytes, lr,
+                          seed):
+        """Quadratic dp training through the BUCKETED synchronizer:
+        worker i pulls toward target t_i = center + noise_i; the synced
+        mean gradient should drive w to the mean target."""
+        from ray_tpu.parallel import GradientSynchronizer
+
+        rng = np.random.default_rng(seed)
+        center = {k: rng.standard_normal(d).astype(np.float32)
+                  for k, d in dims.items()}
+        # every rank derives ALL targets from the shared seed, uses its own
+        noises = [{k: np.random.default_rng(seed + 1 + r)
+                   .standard_normal(d).astype(np.float32)
+                   for k, d in dims.items()} for r in range(self.world)]
+        target = {k: center[k] + noises[self.rank][k] for k in dims}
+        w = {k: np.zeros(d, np.float32) for k, d in dims.items()}
+        sync = GradientSynchronizer(group_name=group,
+                                    compression="int8:min=0",
+                                    bucket_bytes=bucket_bytes)
+        for _ in range(steps):
+            grads = {k: w[k] - target[k] for k in dims}
+            g = sync(grads)
+            w = {k: w[k] - lr * g[k] for k in dims}
+        mean_t = {k: center[k] + np.mean([nz[k] for nz in noises], axis=0)
+                  for k in dims}
+        excess = float(sum(
+            0.5 * np.mean((w[k] - mean_t[k]) ** 2) for k in dims))
+        return w, excess
+
+
+def _gang(ray_cluster, group, world=2):
+    workers = [PipelineWorker.remote(r, world) for r in range(world)]
+    assert all(ray_tpu.get([w.setup.remote(group) for w in workers],
+                           timeout=120))
+    return workers
+
+
+def test_allreduce_async_out_of_order(ray_cluster):
+    world = 2
+    workers = _gang(ray_cluster, "apipe", world)
+    outs = ray_tpu.get(
+        [w.async_out_of_order.remote("apipe", 3) for w in workers],
+        timeout=120)
+    (a1, a2), (b1, b2) = outs
+    assert np.array_equal(a1, b1) and np.array_equal(a2, b2)
+    draws = []
+    for r in range(world):
+        rng = np.random.default_rng(3 + r)   # same stream as the actor
+        draws.append((rng.standard_normal(1024), rng.standard_normal(512)))
+    exp1 = np.mean([d[0] for d in draws], axis=0)
+    exp2 = np.sum([d[1] for d in draws], axis=0)
+    assert _rel(a1, exp1) < 1e-2          # compressed mean
+    assert _rel(a2, exp2) < 1e-6          # exact sum
+
+
+def test_bf16_residuals_stay_bf16(ray_cluster):
+    world = 2
+    workers = _gang(ray_cluster, "bfpipe", world)
+    results = ray_tpu.get(
+        [w.bf16_residual_probe.remote("bfpipe") for w in workers],
+        timeout=120)
+    (outs_a, out_dt, res_dt), (outs_b, _, res_dt_b) = results
+    # error-feedback residuals live in the PARAMETER dtype — bf16 params
+    # must not silently double residual memory by upcasting to f32
+    assert res_dt == ["bfloat16", "float32"] == res_dt_b
+    assert out_dt == {"wb": "bfloat16", "wf": "float32"}
+    for t in range(2):
+        for k in ("wb", "wf"):
+            assert np.array_equal(outs_a[t][k], outs_b[t][k]), (t, k)
+
+
+def test_bucketed_ef_training_50_steps(ray_cluster):
+    world = 2
+    workers = _gang(ray_cluster, "efpipe", world)
+    dims = {"a": 768, "b": 512, "c": 512}
+    # bucket_bytes=3000 coalesces (a) into one bucket and (b,c) into a
+    # second — multiple leaves per bucket AND multiple buckets per step
+    outs = ray_tpu.get(
+        [w.ef_train_bucketed.remote("efpipe", 50, dims, 3000, 0.5, 42)
+         for w in workers], timeout=300)
+    (w_a, excess_a), (w_b, excess_b) = outs
+    for k in dims:
+        assert np.array_equal(w_a[k], w_b[k]), k
+    # EF keeps compressed bucketed training convergent: distance to the
+    # true optimum stays tiny after 50 steps (gradients are O(1) there)
+    assert excess_a < 1e-3, excess_a
