@@ -1255,6 +1255,7 @@ def bench_table() -> dict:
     rows["single_client_tasks_async"] = _timed(
         2000, lambda: ray_tpu.get([tiny.remote() for _ in range(2000)],
                                   timeout=300))
+    submit_tel = {"single_client": _submit_telemetry()}
 
     # actor/PG rows need logical CPU slots for every concurrently-live
     # actor (each leases 1 CPU for its lifetime; the n:n fleets bring the
@@ -1326,6 +1327,7 @@ def bench_table() -> dict:
         4, 500, lambda t, n: ray_tpu.get(
             [nn_actors[(t + i) % 4].m.remote() for i in range(n)],
             timeout=300))
+    submit_tel["actor_rows"] = _submit_telemetry()
 
     @ray_tpu.remote
     class ArgActor:
@@ -1474,6 +1476,7 @@ def bench_table() -> dict:
             "across different queue depths, not an identical workload."),
         "rows": {},
         "tasks_async_vs_num_workers": curve,
+        "submit_telemetry": submit_tel,
     }
     for name, value in rows.items():
         base = BASELINES.get(name)
@@ -1483,6 +1486,131 @@ def bench_table() -> dict:
             "vs_baseline": round(value / base, 4) if base else None,
         }
     return out
+
+
+# ---------------------------------------------------------------------------
+# Task-submission quick mode (`python bench.py --tasks-only`): only the
+# rows the batched submit hot path owns, in a few minutes, plus the
+# owner-side batch-size histogram — emits BENCH_TASKS.json and exits
+# non-zero when single_client_tasks_async regresses vs the recorded
+# BENCH_TABLE.json value (0.9x grace for shared-host jitter).
+# ---------------------------------------------------------------------------
+
+_TASK_ROWS = ("single_client_tasks_sync", "single_client_tasks_async",
+              "multi_client_tasks_async", "n_n_actor_calls_async")
+
+
+def _submit_telemetry() -> dict:
+    """Owner-side submit-path counters (batch-size histogram + flusher
+    stats) from the live driver core; {} when no core is up."""
+    try:
+        from ray_tpu._private import core as _core_mod
+
+        c = _core_mod._current_core
+        return c.submit_telemetry() if c is not None else {}
+    except Exception:
+        return {}
+
+
+def bench_tasks_table() -> dict:
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=max(1, (os.cpu_count() or 1)),
+                 ignore_reinit_error=True)
+    rows = {}
+
+    @ray_tpu.remote
+    def tiny():
+        return None
+
+    ray_tpu.get([tiny.remote() for _ in range(200)], timeout=120)  # warm
+
+    def sync_tasks():
+        for _ in range(300):
+            ray_tpu.get(tiny.remote(), timeout=60)
+    rows["single_client_tasks_sync"] = _timed(300, sync_tasks)
+    rows["single_client_tasks_async"] = _timed(
+        2000, lambda: ray_tpu.get([tiny.remote() for _ in range(2000)],
+                                  timeout=300))
+    submit_tel = {"single_client": _submit_telemetry()}
+
+    rows["multi_client_tasks_async"] = _multi_client_row("tasks", 4, 500)
+
+    # the n:n actor row needs CPU slots for the whole fleet
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=max(8, (os.cpu_count() or 2)),
+                 ignore_reinit_error=True)
+    import threading as _th
+
+    @ray_tpu.remote
+    class Actor:
+        def m(self):
+            return None
+
+    nn_actors = [Actor.remote() for _ in range(4)]
+    ray_tpu.get([x.m.remote() for x in nn_actors], timeout=60)
+
+    def nn_run():
+        errs = []
+
+        def body(t):
+            try:
+                ray_tpu.get([nn_actors[(t + i) % 4].m.remote()
+                             for i in range(500)], timeout=300)
+            except Exception as e:  # pragma: no cover - surfaced below
+                errs.append(e)
+        ts = [_th.Thread(target=body, args=(t,)) for t in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise errs[0]
+    rows["n_n_actor_calls_async"] = _timed(2000, nn_run)
+    submit_tel["actor_rows"] = _submit_telemetry()
+    ray_tpu.shutdown()
+
+    out = {"host_cpus": os.cpu_count(),
+           "rows": {}, "submit_telemetry": submit_tel}
+    for name, value in rows.items():
+        base = BASELINES.get(name)
+        out["rows"][name] = {
+            "value": round(value, 2),
+            "baseline_64cpu": base,
+            "vs_baseline": round(value / base, 4) if base else None,
+        }
+    return out
+
+
+def _write_bench_tasks(table: dict) -> int:
+    """Write BENCH_TASKS.json from a full- or quick-table dict and gate
+    on the recorded headline: returns a non-zero exit code when
+    single_client_tasks_async fell below 0.9x the last BENCH_TABLE.json
+    value (shared-host jitter grace; the recorded value only moves when
+    --table reruns)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    data = {
+        "host_cpus": table.get("host_cpus"),
+        "rows": {k: v for k, v in table.get("rows", {}).items()
+                 if k in _TASK_ROWS},
+        "submit_telemetry": table.get("submit_telemetry", {}),
+    }
+    with open(os.path.join(here, "BENCH_TASKS.json"), "w") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+    print(json.dumps(data, indent=2))
+    try:
+        with open(os.path.join(here, "BENCH_TABLE.json")) as f:
+            recorded = json.load(f)["rows"]["single_client_tasks_async"][
+                "value"]
+    except (OSError, KeyError, ValueError):
+        return 0
+    got = data["rows"].get("single_client_tasks_async", {}).get("value")
+    if got is not None and recorded and got < 0.9 * recorded:
+        print(f"FAIL: single_client_tasks_async {got} < 0.9x recorded "
+              f"{recorded}", file=sys.stderr)
+        return 1
+    return 0
 
 
 def main():
@@ -1553,6 +1681,8 @@ if __name__ == "__main__":
         _gpt_sync_main()
     elif "--extras-only" in sys.argv:
         _extras_main()
+    elif "--tasks-only" in sys.argv:
+        sys.exit(_write_bench_tasks(bench_tasks_table()))
     elif "--table" in sys.argv:
         table = bench_table()
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -1576,5 +1706,8 @@ if __name__ == "__main__":
             json.dump(table, f, indent=2)
             f.write("\n")
         print(json.dumps(table, indent=2))
+        # the tasks view regenerates with every table refresh so the two
+        # files never disagree about the submission rows
+        _write_bench_tasks(table)
     else:
         main()

@@ -65,6 +65,23 @@ CONFIG_DEFS: List[Tuple[str, type, Any, str]] = [
     # own process — set via env or _system_config before daemons spawn)
     ("pipeline_depth", int, 8,
      "tasks pushed per leased worker before waiting on replies"),
+    ("submit_batch", int, 64,
+     "max TaskSpecs coalesced into one framed push_tasks RPC per leased "
+     "worker; 1 = escape hatch, bypasses the combining flusher and ships "
+     "one spec per frame (bit-identical semantics, no coalescing)"),
+    ("lease_grant_batch", int, 16,
+     "max leases requested from the raylet in one request_leases RPC "
+     "(the vectorized ramp-up; 1 degrades to the old one-lease-per-"
+     "round-trip behavior)"),
+    ("pending_lease_cap", int, 64,
+     "max outstanding lease requests per scheduling pool (bounds the "
+     "one-request-per-queued-task aim during 100k-task bursts)"),
+    ("small_arg_limit", int, 4096,
+     "max serialized bytes for the small-arg inline fast path (plain "
+     "scalars/bytes/ObjectRefs skip full pickle framing); 0 disables"),
+    ("small_arg_memo", int, 512,
+     "entries kept in the small-arg serialization memo (repeated "
+     "identical ref-free arg tuples reuse their bytes); 0 disables"),
     ("idle_lease_ttl_s", float, 1.0,
      "idle time before a lease is returned to the raylet"),
     ("delete_grace_s", float, 0.5,

@@ -280,6 +280,7 @@ class ControlServer:
         s.handle("report_quarantine", self.h_report_quarantine)
         s.handle("get_nodes", self.h_get_nodes)
         s.handle("pick_node", self.h_pick_node)
+        s.handle("pick_nodes", self.h_pick_nodes)
         s.handle("register_function", self.h_register_function)
         s.handle("get_function", self.h_get_function)
         s.handle("register_job", self.h_register_job)
@@ -871,6 +872,63 @@ class ControlServer:
             if self.nsched is not None:
                 self.nsched.set_available(n.node_id, n.available)
             return {"node_id": n.node_id, "addr": n.addr}
+
+    def _native_pick_n_locked(self, demand: Dict[str, int],
+                              count: int) -> List[Dict[str, str]]:
+        """Vectorized native selection: one ctypes call picks AND reserves
+        up to `count` placements.  Each returned name is validated against
+        the Python books (mirror drift can never hand out a bad node);
+        accepted picks copy the native reservation into the Python books
+        directly (the native side already subtracted, so set_available
+        would double-count); rejected picks are released back and the
+        remainder falls through to the Python loop."""
+        try:
+            from ray_tpu.native.sched import PACK
+            out = self.nsched.pick_n(demand, count, PACK)
+        except Exception:
+            return []
+        picks: List[Dict[str, str]] = []
+        stop = False
+        for nid in out:
+            n = self.nodes.get(nid)
+            ok = (not stop and n is not None and n.state == ALIVE
+                  and n.draining_until is None
+                  and n.quarantined_until is None
+                  and fits(n.available, demand))
+            if ok:
+                subtract(n.available, demand)
+                n.needs_resync = True
+                picks.append({"node_id": n.node_id, "addr": n.addr})
+            else:
+                try:
+                    self.nsched.release(nid, demand)
+                except Exception:
+                    pass
+                stop = True
+        return picks
+
+    def h_pick_nodes(self, conn, p):
+        """Batched pick_node: reserve up to `count` placements of one
+        demand in a single RPC (the owner's vectorized lease ramp-up).
+        Returns a possibly-short (or empty) list of {node_id, addr};
+        names may repeat when one node fits several leases."""
+        demand = normalize_resources(p.get("resources"))
+        count = max(1, int(p.get("count", 1)))
+        strategy = p.get("strategy")
+        picks: List[Dict[str, str]] = []
+        with self.lock:
+            if strategy is None and self.nsched is not None:
+                picks.extend(self._native_pick_n_locked(demand, count))
+            while len(picks) < count:
+                n = self._pick_node_locked(demand, strategy)
+                if n is None:
+                    break
+                subtract(n.available, demand)
+                n.needs_resync = True
+                if self.nsched is not None:
+                    self.nsched.set_available(n.node_id, n.available)
+                picks.append({"node_id": n.node_id, "addr": n.addr})
+        return picks
 
     def h_cluster_resources(self, conn, p):
         with self.lock:

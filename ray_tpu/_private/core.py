@@ -323,6 +323,12 @@ class SchedPool:
         self.key = key
         self.queue: deque = deque()
         self.leases: Dict[str, LeasedWorker] = {}
+        # rotation order for O(1) amortized lease picking: _pick_lease
+        # inspects the front and rotates, so a 100k-task burst never
+        # rebuilds/rescans the whole lease list per task.  Entries are
+        # healed lazily — a lease removed from `leases` is dropped the
+        # next time the rotation reaches it (identity check).
+        self.rr: deque = deque()
         self.pending_requests = 0
         # EWMA of task execution time drives pipeline depth: tiny tasks are
         # pipelined deep (throughput), long tasks one-at-a-time so queued
@@ -476,6 +482,34 @@ class CoreWorker:
         self._blocked_depth = 0
         self._executing = threading.local()
 
+        # submission batching (resolved once: these knobs sit on the
+        # .remote() hot path, and cfg() rebuilds from the env per call)
+        c = _cfg()
+        self._submit_batch = max(1, int(c.submit_batch))
+        self._lease_grant_batch = max(1, int(c.lease_grant_batch))
+        self._pending_lease_cap = max(1, int(c.pending_lease_cap))
+        self._small_arg_limit = int(c.small_arg_limit)
+        self._small_arg_memo = int(c.small_arg_memo)
+        # register_function identity fast path (cheaper than the weak-dict
+        # hash when one fn is submitted in a tight loop — the common case)
+        self._last_fn: Any = None
+        self._last_fn_out: Optional[Tuple[str, str]] = None
+        # combining submit flusher: .remote() appends to the pool queue
+        # and marks the pool dirty; this thread ships whatever accumulated
+        # since its last pass as framed push_tasks batches.  Batch size
+        # adapts to the submission rate (busy flusher -> bigger batches).
+        self._flush_cv = threading.Condition()
+        self._flush_dirty: Set[SchedPool] = set()
+        # telemetry: push_tasks batch-size histogram + flush-latency sums
+        self._stats_lock = threading.Lock()
+        self._submit_hist: Dict[int, int] = {}
+        self._flush_stats = {"flushes": 0, "tasks": 0,
+                             "latency_ms_total": 0.0, "latency_ms_max": 0.0}
+        self._flush_thread = threading.Thread(
+            target=self._submit_flush_loop, name="core-submit-flush",
+            daemon=True)
+        self._flush_thread.start()
+
         # task-event export (reference: task_event_buffer.h:220)
         from .task_events import NULL_BUFFER, TaskEventBuffer
 
@@ -603,6 +637,40 @@ class CoreWorker:
             except Exception:
                 pass
 
+    def _submit_flush_loop(self):
+        """Ship staged submissions.  One pass pumps every pool that went
+        dirty since the previous pass — while this thread is busy doing
+        socket sends, .remote() keeps staging, so the next pass naturally
+        carries more tasks per frame (a combining flusher: batch size is
+        adaptive, bounded by submit_batch, with no added latency when the
+        submission rate is low)."""
+        while not self._shutdown:
+            with self._flush_cv:
+                while not self._flush_dirty and not self._shutdown:
+                    self._flush_cv.wait(0.5)
+                dirty, self._flush_dirty = self._flush_dirty, set()
+            if self._shutdown:
+                return
+            t0 = time.monotonic()
+            for pool in dirty:
+                try:
+                    self._pump(pool)
+                except Exception:
+                    logger.exception("submit flush failed")
+            ms = (time.monotonic() - t0) * 1000.0
+            with self._stats_lock:
+                st = self._flush_stats
+                st["flushes"] += 1
+                st["latency_ms_total"] += ms
+                if ms > st["latency_ms_max"]:
+                    st["latency_ms_max"] = ms
+
+    def submit_telemetry(self) -> Dict[str, Any]:
+        """Snapshot of the submission-batching counters (bench/debug)."""
+        with self._stats_lock:
+            return {"batch_hist": dict(self._submit_hist),
+                    "flush": dict(self._flush_stats)}
+
     def _lease_reaper_loop(self):
         """Return leases that have sat idle past the TTL so their resources
         free up for other clients (reference: worker lease idle timeout)."""
@@ -645,6 +713,8 @@ class CoreWorker:
             except Exception:
                 pass
         self._shutdown = True
+        with self._flush_cv:
+            self._flush_cv.notify_all()  # wake the submit flusher to exit
         # fail pending awaited futures instead of hanging their loops
         with self._future_lock:
             waiters, self._future_waiters = self._future_waiters, []
@@ -1275,12 +1345,18 @@ class CoreWorker:
     def register_function(self, fn) -> Tuple[str, str]:
         # hot path: hashing cloudpickles the function, so memoize per
         # function object (the reference's function table is likewise
-        # populated once per unique function, not per .remote() call)
+        # populated once per unique function, not per .remote() call).
+        # Identity guard first: a tight .remote() loop over one function
+        # skips even the weak-dict hash.
+        if fn is self._last_fn:
+            return self._last_fn_out
         try:
             cached = self._fn_registration_cache.get(fn)
         except TypeError:  # unhashable callables fall through
             cached = None
         if cached is not None:
+            self._last_fn = fn
+            self._last_fn_out = cached
             return cached
         fid, blob = common.hash_function(fn)
         with self.lock:
@@ -1295,6 +1371,8 @@ class CoreWorker:
             self._fn_registration_cache[fn] = out
         except TypeError:
             pass
+        self._last_fn = fn
+        self._last_fn_out = out
         return out
 
     def get_function(self, fid: str):
@@ -1315,11 +1393,22 @@ class CoreWorker:
     # ------------------------------------------------------------------
 
     _EMPTY_ARGS_BLOB = serialization.dumps_inline(((), {}))
+    _DEFAULT_RESOURCES = normalize_resources({common.CPU: 1})
 
     def serialize_args(self, args, kwargs,
                        task_id: Optional[str] = None) -> bytes:
         if not args and not kwargs:
             return self._EMPTY_ARGS_BLOB  # no-arg calls skip pickling
+        if not kwargs and type(args) is tuple:
+            # small-arg shortcut: plain scalars/bytes/ObjectRefs skip the
+            # CloudPickler framing entirely (ref pin bookkeeping still
+            # runs; actor handles are ineligible so no transit holds are
+            # skipped).  None = ineligible, fall through to the full path.
+            blob = serialization.dumps_args_small(
+                args, limit=self._small_arg_limit,
+                memo_cap=self._small_arg_memo)
+            if blob is not None:
+                return blob
         if task_id is None:
             return serialization.dumps_inline((args, kwargs))
         # actor handles pickled inside these args take transit holds
@@ -1347,8 +1436,11 @@ class CoreWorker:
             function_name=name or fname,
             args_blob=self.serialize_args(args, kwargs, task_id=tid),
             num_returns=num_returns,
-            resources=normalize_resources(
-                {common.CPU: 1} if resources is None else resources),
+            # the default-resources dict is shared across specs (never
+            # mutated downstream: _pool_key and the lease path only read
+            # it, and the wire copy is a pickle)
+            resources=(self._DEFAULT_RESOURCES if resources is None
+                       else normalize_resources(resources)),
             max_retries=max_retries,
             scheduling_strategy=strategy,
             placement_group_id=pg,
@@ -1401,10 +1493,19 @@ class CoreWorker:
                 pool = self.pools[key] = SchedPool(key)
             pool.queue.append(rec)
             self.task_records[spec.task_id] = rec  # cancel() lookup
-        self.task_events.record_status(
-            spec.task_id, "PENDING_ARGS_AVAIL", name=spec.function_name,
-            extra={"type": "NORMAL_TASK"})
-        self._pump(pool)
+        self.task_events.record_submit(
+            spec.task_id, spec.function_name, "NORMAL_TASK")
+        if self._submit_batch <= 1:
+            # escape hatch: bypass the combining flusher, ship inline
+            # exactly like the pre-batching path
+            self._pump(pool)
+        else:
+            # hand the pump to the combining flusher; by the time it runs,
+            # a tight .remote() loop has queued more work and the whole
+            # backlog ships as framed push_tasks batches
+            with self._flush_cv:
+                self._flush_dirty.add(pool)
+                self._flush_cv.notify()
         if spec.num_returns == STREAMING_RETURNS and not recovery:
             return [ObjectRefGenerator(self, spec.task_id)]
         return refs
@@ -1420,7 +1521,7 @@ class CoreWorker:
 
     def _pump(self, pool: SchedPool):
         to_push: List[Tuple[LeasedWorker, TaskRecord]] = []
-        request_new = False
+        request_new = 0
         with self.lock:
             while pool.queue:
                 lw = self._pick_lease(pool)
@@ -1428,26 +1529,36 @@ class CoreWorker:
                     # every lease is saturated (or stalled on a slow task):
                     # aim for one outstanding lease request per queued task
                     # so queued work can run in parallel instead of
-                    # stacking behind busy workers
-                    needed = len(pool.queue)
-                    if pool.pending_requests < min(needed, 64):
-                        pool.pending_requests += 1
-                        request_new = True
+                    # stacking behind busy workers.  The whole shortfall is
+                    # charged at once and served by ONE vectorized
+                    # request_leases round-trip (capped per request).
+                    cap = min(len(pool.queue), self._pending_lease_cap)
+                    if pool.pending_requests < cap:
+                        request_new = min(cap - pool.pending_requests,
+                                          self._lease_grant_batch)
+                        pool.pending_requests += request_new
                     break
                 rec = pool.queue.popleft()
                 rec.pushed_to = lw.worker_id
                 lw.inflight.add(rec.spec.task_id)
                 lw.inflight_since[rec.spec.task_id] = time.monotonic()
                 to_push.append((lw, rec))
-        for lw, rec in to_push:
-            self._push_task(lw, rec, pool)
+        if to_push:
+            self._push_batched(pool, to_push)
         if request_new:
-            self.pool_executor.submit(self._request_lease, pool)
+            self.pool_executor.submit(self._request_lease, pool, request_new)
 
     PIPELINE_STALL_S = 0.1
 
     def _pick_lease(self, pool: SchedPool) -> Optional[LeasedWorker]:
-        best, best_n = None, None
+        """O(1) amortized pick over the rotation deque: inspect the front
+        lease, rotate, return the first one with pipeline room.  The old
+        per-task rebuild of list(pool.leases.values()) re-scanned every
+        lease per submitted task — O(leases) per pick.  Rotation spreads
+        work round-robin, which converges to the same balance the
+        least-loaded scan produced (depth caps per-lease load either
+        way).  Worst case (all saturated/stalled) is one full rotation,
+        identical to the old scan."""
         depth = pool.depth()
         now = time.monotonic()
         # The EWMA depth is a *prediction*; a worker whose oldest
@@ -1457,17 +1568,27 @@ class CoreWorker:
         # behind it; the caller leases another worker instead.
         stall_s = max(self.PIPELINE_STALL_S,
                       (pool.avg_ms or 0.0) * depth * 2 / 1000.0)
-        for lw in list(pool.leases.values()):
+        rr = pool.rr
+        for _ in range(len(rr)):
+            lw = rr[0]
+            rr.rotate(-1)  # the inspected lease is now at the back
+            if pool.leases.get(lw.worker_id) is not lw:
+                rr.pop()   # removed elsewhere: heal the rotation lazily
+                continue
             if lw.client is not None and lw.client.closed:
                 pool.leases.pop(lw.worker_id, None)
+                rr.pop()
                 continue
             n = len(lw.inflight)
-            if n and lw.inflight_since and \
-                    now - min(lw.inflight_since.values()) > stall_s:
+            if n >= depth:
                 continue
-            if n < depth and (best_n is None or n < best_n):
-                best, best_n = lw, n
-        return best
+            # dict preserves insertion order and push timestamps are
+            # monotonic, so the first inflight_since value IS the oldest
+            if n and lw.inflight_since and \
+                    now - next(iter(lw.inflight_since.values())) > stall_s:
+                continue
+            return lw
+        return None
 
     @staticmethod
     def _strategy_is_hard(strategy) -> bool:
@@ -1498,48 +1619,84 @@ class CoreWorker:
             self._remote_raylets[addr] = cli
         return cli
 
-    def _request_lease(self, pool: SchedPool):
+    def _request_lease(self, pool: SchedPool, count: int = 1):
+        """Acquire up to `count` leases for this pool in one vectorized
+        round-trip: pick_nodes reserves the placements at the control
+        plane, then each chosen raylet serves its whole share via a
+        single request_leases RPC.  _pump pre-charged pending_requests by
+        `count`; it is decremented by exactly `count` here on every path
+        (partial grants simply leave the shortfall for the next _pump)."""
+        outcome, err = "error", None
         try:
-            resources = dict(pool.key[0])
-            pg_id, bundle_index = pool.key[1], pool.key[2]
-            strategy = None
-            spec0 = None
+            outcome = self._request_lease_inner(pool, count)
+        except Exception as e:
+            err = e
+        finally:
             with self.lock:
-                if pool.queue:
-                    spec0 = pool.queue[0].spec
-            if spec0 is not None:
-                strategy = spec0.scheduling_strategy
-            if pg_id:
-                strategy = {"kind": "placement_group", "pg_id": pg_id,
-                            "bundle_index": bundle_index}
-            picked = self._control_call("pick_node", {
-                "resources": common.denormalize_resources(dict(resources)),
-                "strategy": strategy,
-            }, timeout=30.0)
-            if picked is None and self._strategy_is_hard(strategy):
-                # no node satisfies the hard constraint right now: stay
-                # pending and re-probe (falling back to the local raylet
-                # would violate the strategy — reference keeps such tasks
-                # queued as demand)
-                with self.lock:
-                    pool.pending_requests -= 1
-                    still_queued = bool(pool.queue)
-                if still_queued and not self._shutdown:
-                    def reprobe():
-                        time.sleep(0.5)
-                        self._pump(pool)
+                pool.pending_requests -= count
+                had_queue = bool(pool.queue)
+        if self._shutdown or not had_queue or outcome == "canceled":
+            return
+        if outcome == "ok":
+            self._pump(pool)
+        elif outcome == "reprobe":
+            # no node satisfies the hard constraint right now: stay
+            # pending and re-probe (falling back to the local raylet
+            # would violate the strategy — reference keeps such tasks
+            # queued as demand)
+            def reprobe():
+                time.sleep(0.5)
+                self._pump(pool)
 
-                    self.pool_executor.submit(reprobe)
-                return
+            self.pool_executor.submit(reprobe)
+        else:
+            logger.warning("lease request failed (%s); retrying", err)
+            time.sleep(0.2)
+            self._pump(pool)
+
+    def _request_lease_inner(self, pool: SchedPool, count: int) -> str:
+        resources = dict(pool.key[0])
+        pg_id, bundle_index = pool.key[1], pool.key[2]
+        strategy = None
+        spec0 = None
+        with self.lock:
+            if pool.queue:
+                spec0 = pool.queue[0].spec
+        if spec0 is not None:
+            strategy = spec0.scheduling_strategy
+        if pg_id:
+            strategy = {"kind": "placement_group", "pg_id": pg_id,
+                        "bundle_index": bundle_index}
+        demand = common.denormalize_resources(dict(resources))
+        picked = self._control_call("pick_nodes", {
+            "resources": demand,
+            "strategy": strategy,
+            "count": count,
+        }, timeout=30.0)
+        if not picked:
+            if self._strategy_is_hard(strategy):
+                return "reprobe"
+            # soft/no strategy with nothing reserved: aim the whole batch
+            # at the local raylet (mirrors the old single-lease fallback)
+            picked = [None] * count
+        # one request_leases RPC per granting raylet, carrying its share
+        shares: Dict[Optional[Tuple], int] = {}
+        for pk in picked:
+            addr = tuple(pk["addr"]) if pk is not None else None
+            shares[addr] = shares.get(addr, 0) + 1
+        got_any = False
+        canceled = False
+        for addr, share in shares.items():
             raylet_addr = self.raylet_addr
             raylet_cli = self.raylet
-            if picked is not None and tuple(picked["addr"]) != self.raylet_addr:
-                raylet_addr = tuple(picked["addr"])
-                raylet_cli = self._remote_raylet_client(raylet_addr)
+            if addr is not None and addr != self.raylet_addr:
+                raylet_addr = addr
+                raylet_cli = self._remote_raylet_client(addr)
             if raylet_cli is None:
                 raise RuntimeError("no raylet available for lease request")
-            payload = {"resources": common.denormalize_resources(dict(resources)),
+            payload = {"resources": demand,
                        "client_id": self.worker_id,
+                       "count": share,
                        # OOM-victim hint (reference retriable-FIFO policy):
                        # whether the work heading for this lease can be
                        # retried if the raylet kills the worker
@@ -1548,7 +1705,7 @@ class CoreWorker:
             if pg_id:
                 payload["bundle"] = (pg_id, bundle_index)
             # Idempotency token: if the connection drops after the raylet
-            # granted the lease but before the reply lands, the blind
+            # granted the leases but before the reply lands, the blind
             # retry below replays the SAME request and the raylet's replay
             # cache answers with the original grant — a retry can never
             # double-place a lease.
@@ -1559,13 +1716,13 @@ class CoreWorker:
             while True:
                 try:
                     r = raylet_cli.call(
-                        "request_lease", payload,
+                        "request_leases", payload,
                         timeout=max(1.0, lease_deadline - time.monotonic()))
                     break
                 except (ConnectionLost, OSError) as lease_err:
                     if self._shutdown or time.monotonic() >= lease_deadline:
                         raise
-                    logger.warning("request_lease connection lost (%s); "
+                    logger.warning("request_leases connection lost (%s); "
                                    "replaying with idempotency token",
                                    lease_err)
                     bo.sleep(max_s=max(
@@ -1577,39 +1734,41 @@ class CoreWorker:
                         raylet_cli = self.raylet
             if not (r and r.get("ok")):
                 if r and r.get("canceled"):
-                    with self.lock:
-                        pool.pending_requests -= 1
-                    return
+                    canceled = True
+                    continue
                 raise RuntimeError(f"lease request failed: {r}")
-            with self.lock:
-                unneeded = not pool.queue
+            node_id = r["node_id"]
+            for g in r.get("grants", []):
+                with self.lock:
+                    unneeded = not pool.queue
                 if unneeded:
-                    pool.pending_requests -= 1
-            if unneeded:
-                # queue drained while the lease was pending: hand it back
-                try:
-                    raylet_cli.notify("return_lease", {"worker_id": r["worker_id"]})
-                except Exception:
-                    pass
-                return
-            lw = LeasedWorker(r["worker_id"], r["worker_addr"], r["lease_id"],
-                              r["node_id"], raylet_addr, None)
-            lw.client = Client(lw.addr, name="core->leased",
-                               on_disconnect=lambda: self._on_worker_lost(pool, lw))
-            with self.lock:
-                pool.pending_requests -= 1
-                pool.leases[lw.worker_id] = lw
-            self._pump(pool)
-        except Exception as e:
-            with self.lock:
-                pool.pending_requests -= 1
-                had_queue = bool(pool.queue)
-            if had_queue and not self._shutdown:
-                logger.warning("lease request failed (%s); retrying", e)
-                time.sleep(0.2)
-                self._pump(pool)
+                    # queue drained while the grant was pending: hand the
+                    # rest of the vector back
+                    try:
+                        raylet_cli.notify("return_lease",
+                                          {"worker_id": g["worker_id"]})
+                    except Exception:
+                        pass
+                    continue
+                lw = LeasedWorker(g["worker_id"], g["worker_addr"],
+                                  g["lease_id"], node_id, raylet_addr, None)
+                lw.client = Client(
+                    lw.addr, name="core->leased",
+                    on_disconnect=lambda pool=pool, lw=lw:
+                        self._on_worker_lost(pool, lw),
+                    on_push=lambda topic, payload, pool=pool, lw=lw:
+                        self._on_lease_push(pool, lw, topic, payload))
+                with self.lock:
+                    pool.leases[lw.worker_id] = lw
+                    pool.rr.append(lw)
+                got_any = True
+        if got_any:
+            return "ok"
+        return "canceled" if canceled else "ok"
 
     def _push_task(self, lw: LeasedWorker, rec: TaskRecord, pool: SchedPool):
+        """Legacy single-task push (submit_batch <= 1 escape hatch):
+        one call_cb round-trip per task, reply handled per task."""
         def on_reply(reply, exc):
             if exc is not None:
                 self._on_task_failure(pool, lw, rec, exc)
@@ -1618,31 +1777,81 @@ class CoreWorker:
 
         lw.client.call_cb("push_task", rec.spec, on_reply)
 
+    def _push_batched(self, pool: SchedPool,
+                      to_push: List[Tuple[LeasedWorker, TaskRecord]]):
+        """Ship the picked (lease, task) pairs.  Batched mode groups by
+        lease and frames up to submit_batch specs per one-way push_tasks
+        notify — O(bytes) on the wire, no per-task reply slot; the worker
+        acks via coalesced tasks_done pushes instead."""
+        if self._submit_batch <= 1:
+            for lw, rec in to_push:
+                self._push_task(lw, rec, pool)
+            return
+        groups: Dict[str, Tuple[LeasedWorker, List[TaskRecord]]] = {}
+        for lw, rec in to_push:   # dict keeps insertion order = FIFO
+            groups.setdefault(lw.worker_id, (lw, []))[1].append(rec)
+        for lw, recs in groups.values():
+            for i in range(0, len(recs), self._submit_batch):
+                chunk = recs[i:i + self._submit_batch]
+                with self._stats_lock:
+                    h = self._submit_hist
+                    h[len(chunk)] = h.get(len(chunk), 0) + 1
+                    self._flush_stats["tasks"] += len(chunk)
+                try:
+                    lw.client.notify("push_tasks",
+                                     [rec.spec for rec in chunk])
+                except (ConnectionLost, OSError) as e:
+                    # synchronous failure only (conn already closed at
+                    # enqueue); async write failures surface through the
+                    # client's on_disconnect -> _on_worker_lost
+                    for rec in chunk:
+                        self._on_task_failure(pool, lw, rec, e)
+
+    def _on_lease_push(self, pool: SchedPool, lw: LeasedWorker,
+                       topic: str, payload):
+        """Server-push from a leased worker (reader thread)."""
+        if topic == "tasks_done":
+            self._on_tasks_done(pool, lw, payload)
+
     def _on_task_reply(self, pool, lw: LeasedWorker, rec: TaskRecord, reply):
-        # ONE lock acquisition for the bookkeeping: this path runs once
-        # per completed task on the reply thread and ping-pongs the core
-        # lock with the submitting thread — every extra acquire/release
-        # pair is contention at 100k-task submission bursts
+        self._on_tasks_done(pool, lw, [(rec.spec.task_id, reply)])
+
+    def _on_tasks_done(self, pool: SchedPool, lw: LeasedWorker, items):
+        """Handle a coalesced batch of task completions from one lease.
+        ONE lock acquisition for the whole batch's bookkeeping: this path
+        ping-pongs the core lock with the submitting thread — every extra
+        acquire/release pair is contention at 100k-task submission
+        bursts — and one _pump refills the freed pipeline slots for all
+        completions at once."""
+        finished: List[Tuple[TaskRecord, Dict[str, Any]]] = []
         with self.lock:
-            lw.inflight.discard(rec.spec.task_id)
-            lw.inflight_since.pop(rec.spec.task_id, None)
+            for task_id, reply in items:
+                lw.inflight.discard(task_id)
+                lw.inflight_since.pop(task_id, None)
+                ms = reply.get("exec_ms")
+                if ms is not None:
+                    pool.avg_ms = ms if pool.avg_ms is None else \
+                        0.8 * pool.avg_ms + 0.2 * ms
+                rec = self.task_records.get(task_id)
+                if rec is None or rec.done:
+                    continue   # late duplicate (e.g. post-retry ack)
+                rec.done = True
+                self.task_records.pop(task_id, None)
+                finished.append((rec, reply))
             lw.idle_since = time.monotonic()
-            ms = reply.get("exec_ms")
-            if ms is not None:
-                pool.avg_ms = ms if pool.avg_ms is None else \
-                    0.8 * pool.avg_ms + 0.2 * ms
-            rec.done = True
-            self.task_records.pop(rec.spec.task_id, None)
-        self._released_streams.discard(rec.spec.task_id)
-        if rec.canceled and reply.get("status") != "ok":
-            # the worker raised out of the injected cancellation: surface
-            # TaskCancelledError rather than the interrupt artifact
-            reply = {"status": "error", "error": serialization.dumps_inline(
-                TaskCancelledError(
-                    f"task {rec.spec.function_name} was cancelled"))}
-        self._store_results(rec.spec, reply)
-        if rec.spec.num_returns == STREAMING_RETURNS:
-            self._finish_stream(rec.spec.task_id, reply)
+        for rec, reply in finished:
+            self._released_streams.discard(rec.spec.task_id)
+            if rec.canceled and reply.get("status") != "ok":
+                # the worker raised out of the injected cancellation:
+                # surface TaskCancelledError, not the interrupt artifact
+                reply = {"status": "error",
+                         "error": serialization.dumps_inline(
+                             TaskCancelledError(
+                                 f"task {rec.spec.function_name} "
+                                 f"was cancelled"))}
+            self._store_results(rec.spec, reply)
+            if rec.spec.num_returns == STREAMING_RETURNS:
+                self._finish_stream(rec.spec.task_id, reply)
         self._pump(pool)
         self._maybe_return_idle_leases(pool)
 
@@ -1833,6 +2042,13 @@ class CoreWorker:
         """Worker died or connection lost mid-task: retry or error out
         (reference: TaskManager retry bookkeeping, task_manager.h:208)."""
         with self.lock:
+            # idempotency guard: a lost worker can report the same task
+            # through two paths (pending-call ConnectionLost callback in
+            # legacy mode AND _on_worker_lost's sweep) — only the first
+            # claim for this (task, worker) assignment acts
+            if rec.done or rec.pushed_to != lw.worker_id:
+                return
+            rec.pushed_to = None
             lw.inflight.discard(rec.spec.task_id)
             lw.inflight_since.pop(rec.spec.task_id, None)
             if lw.client is not None and lw.client.closed:
@@ -1871,10 +2087,17 @@ class CoreWorker:
         with self.lock:
             pool.leases.pop(lw.worker_id, None)
             lost = list(lw.inflight)
-            lw.inflight.clear()
-            lw.inflight_since.clear()
-        # tasks whose replies will never come are retried by their pending
-        # futures erroring out (ConnectionLost) via _on_task_failure
+            recs = [self.task_records.get(t) for t in lost]
+        # batched pushes are one-way notifies with no per-task reply slot,
+        # so a dead connection surfaces ONLY here: sweep every in-flight
+        # task into the retry/error path.  In legacy (submit_batch<=1)
+        # mode the pending call_cb futures also fire ConnectionLost —
+        # _on_task_failure's pushed_to guard keeps the two claims from
+        # double-handling a task.
+        err = ConnectionLost(f"worker {lw.worker_id} connection lost")
+        for rec in recs:
+            if rec is not None and not rec.done:
+                self._on_task_failure(pool, lw, rec, err)
 
     def _on_raylet_push(self, topic, payload):
         """Raylet -> core notifications (worker_proc forwards unhandled
@@ -2101,9 +2324,8 @@ class CoreWorker:
                 refs.append(ObjectRef(oid, self.addr, self.worker_id))
         if streaming:
             refs = [ObjectRefGenerator(self, spec.task_id)]
-        self.task_events.record_status(
-            spec.task_id, "PENDING_ARGS_AVAIL", name=method_name,
-            actor_id=actor_id, extra={"type": "ACTOR_TASK"})
+        self.task_events.record_submit(
+            spec.task_id, method_name, "ACTOR_TASK", actor_id=actor_id)
         # A locally-DEAD conn may be stale: during control-plane failover
         # the conn can be marked dead (lost worker + transient control
         # unavailability) while the restored control has since restarted

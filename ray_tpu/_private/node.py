@@ -62,12 +62,15 @@ class WorkerRecord:
 class PendingLease:
     def __init__(self, demand: Dict[str, int], deferred: Deferred, client_id: str,
                  bundle: Optional[Tuple[str, int]] = None,
-                 retriable: bool = True):
+                 retriable: bool = True, count: int = 1,
+                 vector: bool = False):
         self.demand = demand
         self.deferred = deferred
         self.client_id = client_id
         self.bundle = bundle
         self.retriable = retriable
+        self.count = count    # copies of `demand` wanted in one grant
+        self.vector = vector  # reply shape: {"grants": [...]} vs single
         self.ts = time.monotonic()
 
 
@@ -112,6 +115,7 @@ class Raylet:
         s.handle("ping", lambda c, p: "pong")
         s.handle("register_worker", self.h_register_worker)
         s.handle("request_lease", self.h_request_lease, deferred=True)
+        s.handle("request_leases", self.h_request_leases, deferred=True)
         s.handle("return_lease", self.h_return_lease)
         s.handle("cancel_lease_requests", self.h_cancel_lease_requests)
         s.handle("task_blocked", self.h_task_blocked)
@@ -797,6 +801,18 @@ class Raylet:
     # -- leases ------------------------------------------------------------
 
     def h_request_lease(self, conn, p, d: Deferred):
+        self._enqueue_lease(conn, p, d, count=1, vector=False)
+
+    def h_request_leases(self, conn, p, d: Deferred):
+        """Vectorized lease request: up to p['count'] copies of one demand
+        granted in a single reply ({"ok": True, "grants": [...]}).  Grants
+        may be fewer than requested — whatever one grant pass can serve —
+        and never zero with ok=True (zero keeps the request pending)."""
+        self._enqueue_lease(conn, p, d,
+                            count=max(1, int(p.get("count", 1))),
+                            vector=True)
+
+    def _enqueue_lease(self, conn, p, d: Deferred, count: int, vector: bool):
         res = p.get("resources")
         demand = normalize_resources({common.CPU: 1} if res is None else res)
         bundle = p.get("bundle")  # (pg_id, index) -> draw from bundle reservation
@@ -822,7 +838,8 @@ class Raylet:
                 self.client_conns[cid] = conn
             self.pending_leases.append(
                 PendingLease(demand, d, cid, bundle,
-                             retriable=p.get("retriable", True)))
+                             retriable=p.get("retriable", True),
+                             count=count, vector=vector))
         self._try_grant()
 
     def _pg_bundles_locked(self, pg_id: str):
@@ -889,68 +906,103 @@ class Raylet:
             time.sleep(0.25)
 
     def _try_grant(self):
-        grants: List[Tuple[PendingLease, WorkerRecord]] = []
+        grants: List[Tuple[PendingLease, List[WorkerRecord]]] = []
+        rejects: List[Tuple[PendingLease, str]] = []
         spawn = 0
         spawn_tpu = False
         starved = False
         with self.lock:
             while self.pending_leases:
                 pl = self.pending_leases[0]
+                wants_tpu = any(k.startswith(common.TPU)
+                                for k in pl.demand)
+                # grant up to pl.count copies in this one pass; the fits
+                # check re-runs per copy because each charge shrinks the
+                # pool (vector requests stop at whatever actually fits)
+                granted: List[WorkerRecord] = []
+                reject_msg = None
+                while len(granted) < pl.count:
+                    if not self._lease_fits(pl):
+                        break
+                    w = None
+                    skipped: List[WorkerRecord] = []
+                    while self.idle:
+                        cand = self.idle.popleft()
+                        if cand.state != "idle":
+                            continue
+                        if wants_tpu and not cand.tpu:
+                            skipped.append(cand)  # CPU-only worker: no device
+                            continue
+                        w = cand
+                        break
+                    self.idle.extend(skipped)
+                    if w is None:
+                        break
+                    if pl.bundle is not None:
+                        key = self._resolve_bundle_locked(pl.bundle, pl.demand)
+                        b = self.bundles.get(key) if key else None
+                        if b is None:
+                            reject_msg = f"bundle {pl.bundle} no longer committed"
+                            self.idle.append(w)
+                            break
+                        add(b.setdefault("used", {}), pl.demand)
+                        w.bundle_key = key
+                    else:
+                        subtract(self.available, pl.demand)
+                    w.state = "leased"
+                    w.leased_at = time.monotonic()
+                    w.lease_id = common.new_id("lease-")
+                    w.lease_resources = pl.demand
+                    w.lease_retriable = pl.retriable
+                    w.lease_client_id = pl.client_id
+                    granted.append(w)
+                if granted:
+                    # partial vector grants resolve immediately with what
+                    # this pass could serve — never park granted workers
+                    # behind the remainder (the owner re-requests)
+                    self.pending_leases.popleft()
+                    grants.append((pl, granted))
+                    continue
+                if reject_msg is not None:
+                    self.pending_leases.popleft()
+                    rejects.append((pl, reject_msg))
+                    continue
                 if not self._lease_fits(pl):
                     starved = True
                     break
-                wants_tpu = any(k.startswith(common.TPU)
-                                for k in pl.demand)
-                w = None
-                skipped: List[WorkerRecord] = []
-                while self.idle:
-                    cand = self.idle.popleft()
-                    if cand.state != "idle":
-                        continue
-                    if wants_tpu and not cand.tpu:
-                        skipped.append(cand)  # CPU-only worker: no device
-                        continue
-                    w = cand
-                    break
-                self.idle.extend(skipped)
-                if w is None:
-                    n_starting = sum(
-                        1 for r in self.workers.values()
-                        if r.state == "starting" and r.actor_id is None
-                        and r.tpu == wants_tpu)
-                    if n_starting == 0 and len(self.workers) < self.max_workers:
-                        spawn += 1
-                        spawn_tpu = wants_tpu
-                    break
-                self.pending_leases.popleft()
-                if pl.bundle is not None:
-                    key = self._resolve_bundle_locked(pl.bundle, pl.demand)
-                    b = self.bundles.get(key) if key else None
-                    if b is None:
-                        pl.deferred.reject(f"bundle {pl.bundle} no longer committed")
-                        self.idle.append(w)
-                        continue
-                    add(b.setdefault("used", {}), pl.demand)
-                    w.bundle_key = key
-                else:
-                    subtract(self.available, pl.demand)
-                w.state = "leased"
-                w.leased_at = time.monotonic()
-                w.lease_id = common.new_id("lease-")
-                w.lease_resources = pl.demand
-                w.lease_retriable = pl.retriable
-                w.lease_client_id = pl.client_id
-                grants.append((pl, w))
+                # fits but no idle worker: spawn toward the remaining
+                # demand (a vector request warms several at once instead
+                # of the old one-per-grant-tick trickle)
+                n_starting = sum(
+                    1 for r in self.workers.values()
+                    if r.state == "starting" and r.actor_id is None
+                    and r.tpu == wants_tpu)
+                room = self.max_workers - len(self.workers)
+                spawn = max(0, min(pl.count - n_starting, room))
+                spawn_tpu = wants_tpu
+                break
         for _ in range(spawn):
             self._spawn_worker(tpu=spawn_tpu)
-        for pl, w in grants:
+        for pl, msg in rejects:
+            pl.deferred.reject(msg)
+        for pl, ws in grants:
             logger.debug("grant %s lease=%s client=%s avail=%s",
-                         w.worker_id, w.lease_resources,
+                         [w.worker_id for w in ws], pl.demand,
                          pl.client_id, self.available)
-            pl.deferred.resolve({
-                "ok": True, "lease_id": w.lease_id, "worker_id": w.worker_id,
-                "worker_addr": w.addr, "node_id": self.node_id,
-            })
+            if pl.vector:
+                pl.deferred.resolve({
+                    "ok": True, "node_id": self.node_id,
+                    "grants": [{"lease_id": w.lease_id,
+                                "worker_id": w.worker_id,
+                                "worker_addr": w.addr} for w in ws],
+                })
+            else:
+                w = ws[0]
+                pl.deferred.resolve({
+                    "ok": True, "lease_id": w.lease_id,
+                    "worker_id": w.worker_id,
+                    "worker_addr": w.addr, "node_id": self.node_id,
+                })
         if starved:
             self._request_idle_reclaim()
 

@@ -173,7 +173,101 @@ def dumps_inline(value: Any) -> bytes:
     return f.getvalue()
 
 
+# -- small-arg fast path ------------------------------------------------------
+#
+# The task hot path serializes (args, kwargs) once per .remote().  When the
+# args are a short tuple of plain scalars/bytes/ObjectRefs with no kwargs
+# (the benchmark and RL actor-step shape), full pickle framing through
+# _Pickler is pure overhead: a plain protocol-5 pickle of the converted
+# tuple suffices, and repeated identical ref-free tuples can reuse their
+# bytes outright.  Blobs carry a one-byte prefix that no pickle stream
+# starts with (protocol-5 pickles begin with b'\x80'), so loads_inline
+# stays a single entry point for both framings.
+
+_SMALL_PREFIX = b"\xf5"
+_SMALL_MAX_ARGS = 8
+
+# type-aware memo: hash(1) == hash(True) == hash(1.0) and they compare
+# equal, but their pickles differ — the key must carry the value types.
+# Only ref-free tuples are memoizable (ref->marker conversion pins the
+# object per serialization; reusing a blob must not skip that bookkeeping).
+_small_memo: dict = {}
+
+
+def _small_memo_key(args: tuple):
+    try:
+        return tuple((type(a), a) for a in args)
+    except TypeError:  # pragma: no cover - all eligible types are hashable
+        return None
+
+
+def dumps_args_small(args: tuple, *, limit: int,
+                     memo_cap: int = 0) -> Optional[bytes]:
+    """Fast inline framing for a no-kwargs call whose args are all plain
+    scalars/bytes or ObjectRefs.  Returns None when ineligible (caller
+    falls back to the full path); round-trips through loads_inline to the
+    same (args, {}) the full path produces."""
+    if limit <= 0 or len(args) > _SMALL_MAX_ARGS:
+        return None
+    has_ref = False
+    for a in args:
+        t = type(a)
+        if t in _PRIMITIVE_TYPES:
+            # big strings/bytes would pickle past the limit anyway;
+            # bail before paying for the dump on every call
+            if (t is bytes or t is str) and len(a) > limit:
+                return None
+            continue
+        if _ref_type is not None and t is _ref_type:
+            has_ref = True
+            continue
+        return None
+    if not has_ref and memo_cap > 0:
+        key = _small_memo_key(args)
+        cached = _small_memo.get(key) if key is not None else None
+        if cached is not None:
+            return cached
+    else:
+        key = None
+    if has_ref:
+        # swap refs for markers by hand — plain pickle can't carry
+        # ObjectRefs (their __reduce__ raises), and the conversion's pin
+        # bookkeeping must run exactly like the full path's
+        ref_pos = []
+        conv = []
+        for i, a in enumerate(args):
+            if type(a) is _ref_type:
+                ref_pos.append(i)
+                conv.append(_ref_to_marker(a))
+            else:
+                conv.append(a)
+        blob = _SMALL_PREFIX + pickle.dumps(
+            (tuple(conv), tuple(ref_pos)), protocol=5)
+    else:
+        blob = _SMALL_PREFIX + pickle.dumps((args, ()), protocol=5)
+    if len(blob) > limit:
+        return None
+    if key is not None:
+        if len(_small_memo) >= memo_cap:
+            _small_memo.clear()  # cheap bound; the hot set refills fast
+        _small_memo[key] = blob
+    return blob
+
+
+def _loads_args_small(blob: bytes):
+    conv, ref_pos = pickle.loads(blob[1:])
+    if ref_pos:
+        out = list(conv)
+        for i in ref_pos:
+            out[i] = _marker_to_ref(out[i]) if _marker_to_ref is not None \
+                else out[i]
+        return tuple(out), {}
+    return conv, {}
+
+
 def loads_inline(blob: bytes) -> Any:
+    if blob[:1] == _SMALL_PREFIX:
+        return _loads_args_small(blob)
     return pickle.loads(blob)
 
 

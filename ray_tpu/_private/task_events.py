@@ -22,6 +22,9 @@ from typing import Any, Deque, Dict, Optional
 
 FLUSH_INTERVAL_S = 1.0
 MAX_BUFFERED = 10_000  # drop-oldest beyond this (reference: task_events_max_buffer_size)
+# submit-path ring -> event conversion per flush window: bounds the dict
+# building a 100k-task burst would otherwise pay inside one flush tick
+SUBMIT_DRAIN_MAX = 5_000
 
 
 class TaskEventBuffer:
@@ -41,6 +44,13 @@ class TaskEventBuffer:
         self._events: Deque[Dict[str, Any]] = collections.deque(
             maxlen=MAX_BUFFERED)
         self._dropped = 0
+        # submit-path ring: the owner's .remote() hot loop appends bare
+        # tuples here (no dict build, no per-call time formatting beyond
+        # one clock read); the flush thread converts them to full status
+        # events off the hot path, rate-limited per window
+        self._submit_ring: Deque[tuple] = collections.deque(
+            maxlen=MAX_BUFFERED)
+        self._submit_dropped = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._flush_loop,
                                         name="task-events-flush", daemon=True)
@@ -67,6 +77,39 @@ class TaskEventBuffer:
         if extra:
             ev.update(extra)
         self._append(ev)
+
+    def record_submit(self, task_id: str, name: str, type_: str,
+                      actor_id: Optional[str] = None):
+        """Hot-path submission record (state PENDING_ARGS_AVAIL).  A bare
+        tuple append into a bounded ring; the flush loop builds the event
+        dict.  The deque append is atomic under the GIL, so no lock is
+        taken here — the full/drop check races benignly (the counter is a
+        metric, the maxlen deque enforces the bound regardless)."""
+        ring = self._submit_ring
+        if len(ring) == ring.maxlen:
+            self._submit_dropped += 1  # maxlen evicts the oldest
+        ring.append((task_id, name, type_, actor_id, time.time()))
+
+    def _drain_submit_ring(self):
+        """Convert up to SUBMIT_DRAIN_MAX staged submissions into status
+        events (called from the flush thread).  Anything beyond the rate
+        limit stays ringed for the next window; sustained overflow falls
+        off the ring's tail into the dropped counter."""
+        ring = self._submit_ring
+        for _ in range(SUBMIT_DRAIN_MAX):
+            try:
+                task_id, name, type_, actor_id, ts = ring.popleft()
+            except IndexError:
+                break
+            self._append({
+                "kind": "status",
+                "task_id": task_id,
+                "state": "PENDING_ARGS_AVAIL",
+                "name": name,
+                "actor_id": actor_id,
+                "ts": ts,
+                "type": type_,
+            })
 
     def record_profile(self, task_id: str, event_name: str,
                        start_ts: float, end_ts: float,
@@ -95,12 +138,17 @@ class TaskEventBuffer:
             self.flush()
 
     def flush(self):
+        self._drain_submit_ring()
         with self._lock:
-            if not self._events:
+            if not self._events and not self._submit_dropped:
                 return
             batch = list(self._events)
             self._events.clear()
-            dropped, self._dropped = self._dropped, 0
+            dropped = self._dropped + self._submit_dropped
+            self._dropped = 0
+            self._submit_dropped = 0
+            if not batch and not dropped:
+                return
         try:
             self._client.call("report_task_events",
                               {"events": batch, "dropped": dropped,
@@ -129,6 +177,9 @@ class _NullBuffer:
     """No-op stand-in before init / after shutdown."""
 
     def record_status(self, *a, **k):
+        pass
+
+    def record_submit(self, *a, **k):
         pass
 
     def record_profile(self, *a, **k):

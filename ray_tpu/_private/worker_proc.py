@@ -35,6 +35,58 @@ logger = logging.getLogger(__name__)
 _ASYNC_INFLIGHT = object()  # sentinel: reply will come from the aio loop
 
 
+class _ReplyBatcher:
+    """Combining sender for coalesced task acks: completions are framed
+    into `tasks_done` pushes on the owner connection.  The first
+    completion ships immediately; completions that land while a push is
+    on the wire coalesce into the next frame — the ack batch size adapts
+    to the completion rate exactly like the owner's submit flusher.  A
+    completed reply is NEVER held back waiting for more (a task whose
+    downstream depends on it would deadlock the batch)."""
+
+    def __init__(self, conn: ServerConn):
+        self._conn = conn
+        self._lock = threading.Lock()
+        self._pending: list = []
+        self._sending = False
+
+    def add(self, task_id: str, reply):
+        with self._lock:
+            self._pending.append((task_id, reply))
+            if self._sending:
+                return   # the in-flight sender will pick this up
+            self._sending = True
+        while True:
+            with self._lock:
+                batch, self._pending = self._pending, []
+                if not batch:
+                    self._sending = False
+                    return
+            # push failure = owner gone; its on_disconnect reschedules
+            self._conn.push("tasks_done", batch)
+
+
+class _BatchSlot:
+    """Pseudo-Deferred for batch-pushed tasks: the execution pipeline
+    resolves replies through the same interface either way, but here the
+    reply routes into the per-connection ack batcher instead of a
+    per-call reply frame."""
+
+    __slots__ = ("_batcher", "_task_id")
+
+    def __init__(self, batcher: _ReplyBatcher, task_id: str):
+        self._batcher = batcher
+        self._task_id = task_id
+
+    def resolve(self, reply):
+        self._batcher.add(self._task_id, reply)
+
+    def reject(self, exc):
+        self._batcher.add(self._task_id, {
+            "status": "error",
+            "error": serialization.dumps_inline(exc)})
+
+
 class WorkerMain:
     def __init__(self, control_addr, raylet_addr):
         self.token = int(os.environ["RAY_TPU_STARTUP_TOKEN"])
@@ -47,11 +99,15 @@ class WorkerMain:
         self.core = CoreWorker(control_addr, raylet_addr, mode="worker",
                                worker_id=wid, node_id=nid, store_root=store_root)
         self.core.server.handle("push_task", self.h_push_task, deferred=True)
+        self.core.server.handle("push_tasks", self.h_push_tasks)
         self.core.server.handle("actor_task", self.h_actor_task, deferred=True)
         self.core.server.handle("exit", lambda c, p: self._exit_soon())
         self.core.server.handle("cancel_task", self.h_cancel_task)
 
         self.task_queue: "queue.Queue" = queue.Queue()
+        # one reply batcher per owner connection (batched submissions)
+        self._reply_batchers: dict = {}
+        self._batcher_lock = threading.Lock()
         # cancellation state (reference: core_worker HandleCancelTask):
         # queued task ids to drop + the id/thread of the running task
         self._cancelled: set = set()
@@ -161,6 +217,25 @@ class WorkerMain:
 
     def h_push_task(self, conn: ServerConn, spec: TaskSpec, d: Deferred):
         self.task_queue.put(("normal", spec, d))
+
+    def h_push_tasks(self, conn: ServerConn, specs):
+        """Batched submission (one-way notify, no per-task reply slot):
+        enqueue every framed spec FIFO; completions ack through the
+        per-connection tasks_done batcher."""
+        batcher = self._reply_batchers.get(conn)
+        if batcher is None:
+            with self._batcher_lock:
+                batcher = self._reply_batchers.get(conn)
+                if batcher is None:
+                    # prune batchers of disconnected owners while here
+                    for c in [c for c in self._reply_batchers
+                              if not c.alive]:
+                        del self._reply_batchers[c]
+                    batcher = self._reply_batchers[conn] = \
+                        _ReplyBatcher(conn)
+        for spec in specs:
+            self.task_queue.put(
+                ("normal", spec, _BatchSlot(batcher, spec.task_id)))
 
     def h_actor_task(self, conn: ServerConn, spec: TaskSpec, d: Deferred):
         self.task_queue.put(("actor", spec, d))

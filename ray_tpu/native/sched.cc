@@ -250,6 +250,47 @@ int rsched_pick(void* h, const int* ids, const int64_t* demand, int cnt,
   return 1;
 }
 
+// Pick AND reserve up to `want` placements of one demand in a single
+// locked pass (batched lease ramp-up: one crossing of the ctypes
+// boundary instead of `want` pick+acquire round-trips).  Each pick
+// subtracts the demand from the real books so successive picks spread
+// correctly and the availability matches the reservation the caller is
+// about to mirror into its own accounting.  Writes node indices (resolve
+// via rsched_node_name) into out_indices; returns how many were placed
+// (0..want).  Picks the caller rejects must be handed back with
+// rsched_release.
+int rsched_pick_n(void* h, const int* ids, const int64_t* demand, int cnt,
+                  int strategy, int want, int* out_indices) {
+  auto* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  int got = 0;
+  for (; got < want; ++got) {
+    int idx = pick_index(s, s->nodes, ids, demand, cnt, strategy);
+    if (idx < 0) break;
+    Node& n = s->nodes[idx];
+    for (int i = 0; i < cnt; ++i) n.avail[ids[i]] -= demand[i];
+    out_indices[got] = idx;
+  }
+  return got;
+}
+
+// Acquire up to `want` copies of one demand on one node atomically.
+// Returns how many copies fit (each subtracted); 0 when the node is
+// missing, dead, or full.
+int rsched_acquire_n(void* h, const char* node_id, const int* ids,
+                     const int64_t* demand, int cnt, int want) {
+  auto* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Node* n = find_node(s, node_id);
+  if (!n || !n->alive) return 0;
+  int got = 0;
+  for (; got < want; ++got) {
+    if (!fits(*n, ids, demand, cnt)) break;
+    for (int i = 0; i < cnt; ++i) n->avail[ids[i]] -= demand[i];
+  }
+  return got;
+}
+
 // Plan placement for a placement group's bundles against a simulated
 // snapshot (2-phase commit happens elsewhere; this is the policy step).
 // bundles are flattened: offsets[b]..offsets[b+1] index into ids/demands.

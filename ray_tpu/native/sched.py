@@ -56,6 +56,14 @@ def _load():
         lib.rsched_pick.argtypes = [
             ctypes.c_void_p, I, Q, ctypes.c_int, ctypes.c_int,
             ctypes.c_char_p, ctypes.c_int]
+        lib.rsched_pick_n.restype = ctypes.c_int
+        lib.rsched_pick_n.argtypes = [
+            ctypes.c_void_p, I, Q, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, I]
+        lib.rsched_acquire_n.restype = ctypes.c_int
+        lib.rsched_acquire_n.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, I, Q, ctypes.c_int,
+            ctypes.c_int]
         lib.rsched_plan_bundles.restype = ctypes.c_int
         lib.rsched_plan_bundles.argtypes = [
             ctypes.c_void_p, I, Q, I, ctypes.c_int, ctypes.c_int, I]
@@ -132,6 +140,36 @@ class ClusterScheduler:
         out = ctypes.create_string_buffer(256)
         ok = self._lib.rsched_pick(self._h, ids, vals, n, strategy, out, 256)
         return out.value.decode() if ok else None
+
+    def pick_n(self, demand: Dict[str, int], count: int,
+               strategy: int = PACK) -> List[str]:
+        """Pick AND reserve up to `count` placements of `demand` in one
+        native call.  Unlike pick(), every returned node has the demand
+        already subtracted from the native books — a pick the caller
+        rejects must be handed back via release().  Returned names may
+        repeat (one node can host several leases)."""
+        if count <= 0:
+            return []
+        ids, vals, n = self._pack(demand)
+        out = (ctypes.c_int * count)()
+        got = self._lib.rsched_pick_n(self._h, ids, vals, n, strategy,
+                                      count, out)
+        names: List[str] = []
+        buf = ctypes.create_string_buffer(256)
+        for i in range(got):
+            if self._lib.rsched_node_name(self._h, out[i], buf, 256):
+                names.append(buf.value.decode())
+        return names
+
+    def acquire_n(self, node_id: str, demand: Dict[str, int],
+                  count: int) -> int:
+        """Atomically acquire up to `count` copies of `demand` on one
+        node; returns how many fit (each already subtracted)."""
+        if count <= 0:
+            return 0
+        ids, vals, n = self._pack(demand)
+        return int(self._lib.rsched_acquire_n(
+            self._h, node_id.encode(), ids, vals, n, count))
 
     def plan_bundles(self, bundles: Sequence[Dict[str, int]],
                      strategy: int = PACK) -> Optional[List[str]]:

@@ -75,7 +75,7 @@ _QUICK_FILES = {
     "test_parallel.py", "test_partition.py", "test_remediation.py",
     "test_resource_sync.py", "test_runtime_env.py",
     "test_serve.py", "test_serve_grpc.py", "test_state.py",
-    "test_telemetry.py", "test_tune.py",
+    "test_submit_batching.py", "test_telemetry.py", "test_tune.py",
 }
 
 

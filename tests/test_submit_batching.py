@@ -1,0 +1,244 @@
+"""Batched task submission tests (reference model: the reference's
+normal_task_submitter lease-batching + HandlePushTask semantics).
+
+Covers the core hot path introduced for O(bytes) submission:
+  * framed push_tasks batches vs the RAY_TPU_SUBMIT_BATCH=1 escape hatch
+    must be observably identical (results, ordering, chained deps)
+  * per-task retry and cancel semantics survive batching
+  * request_leases grants a partial vector when the node can't serve the
+    full count
+  * small-arg serialization fast path round-trips bit-exact with full
+    type fidelity (bool vs int, bytes vs str)
+  * native pick_n/acquire_n reserve-as-they-pick
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import serialization
+
+G = 10000  # fixed-point granularity used by _private.common
+
+
+@ray_tpu.remote
+def _add(a, b):
+    return a + b
+
+
+def _core():
+    from ray_tpu._private import core as core_mod
+
+    return core_mod._current_core
+
+
+# -- batch vs batch=1 equivalence -------------------------------------------
+
+
+def _workload():
+    """Mixed workload: independent fan-out plus chained deps that cross
+    batch boundaries."""
+    refs = [_add.remote(i, 1) for i in range(120)]
+    r1 = _add.remote(1, 2)
+    r2 = _add.remote(r1, 10)
+    r3 = _add.remote(r2, r1)
+    out = ray_tpu.get(refs, timeout=120)
+    chained = ray_tpu.get(r3, timeout=120)
+    return out, chained
+
+
+def test_batched_submission_results(ray_cluster):
+    out, chained = _workload()
+    assert out == [i + 1 for i in range(120)]
+    assert chained == 16
+
+
+def test_batch1_escape_hatch_identical(private_cluster_slot, monkeypatch):
+    """RAY_TPU_SUBMIT_BATCH=1 pumps inline per task (the pre-batching
+    path); results must match the batched run bit-for-bit."""
+    monkeypatch.setenv("RAY_TPU_SUBMIT_BATCH", "1")
+    ray_tpu.init(num_cpus=4)
+    try:
+        assert _core()._submit_batch == 1
+        out, chained = _workload()
+        assert out == [i + 1 for i in range(120)]
+        assert chained == 16
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_submit_telemetry_shows_batches(ray_cluster):
+    """The combining flusher must actually coalesce under a burst."""
+    refs = [_add.remote(i, 0) for i in range(200)]
+    ray_tpu.get(refs, timeout=120)
+    tel = _core().submit_telemetry()
+    assert tel["flush"]["tasks"] >= 200
+    # at least one frame carried more than one task
+    assert any(size > 1 for size in tel["batch_hist"])
+
+
+# -- per-task semantics inside a batch --------------------------------------
+
+
+def test_retry_inside_batch(ray_cluster, tmp_path):
+    """A worker dying mid-batch retries ONLY its own tasks; batchmates
+    complete normally."""
+    marker = str(tmp_path / "die_once")
+
+    @ray_tpu.remote(max_retries=2)
+    def die_once(path):
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os._exit(1)
+        return "survived"
+
+    refs = [_add.remote(i, 1) for i in range(20)]
+    victim = die_once.remote(marker)
+    assert ray_tpu.get(victim, timeout=120) == "survived"
+    assert ray_tpu.get(refs, timeout=120) == [i + 1 for i in range(20)]
+
+
+def test_no_retries_fails_cleanly(ray_cluster):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os._exit(1)
+
+    with pytest.raises(ray_tpu.WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=120)
+
+
+def test_cancel_inside_batch(ray_cluster):
+    """Cancelling one task of a submitted burst affects only that task."""
+    from ray_tpu._private.common import TaskCancelledError
+
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(30)
+        return x
+
+    keep = [_add.remote(i, 1) for i in range(10)]
+    victim = slow.remote(99)
+    time.sleep(0.5)
+    ray_tpu.cancel(victim)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(victim, timeout=60)
+    assert ray_tpu.get(keep, timeout=120) == [i + 1 for i in range(10)]
+
+
+# -- vectorized lease grants ------------------------------------------------
+
+
+def test_request_leases_partial_vector(ray_cluster):
+    """Asking one raylet for more leases than the node can host returns
+    a partial grant vector rather than blocking on the remainder."""
+    core = _core()
+    # 8 single-CPU leases on a 4-CPU node: at most 4 can be granted
+    r = core.raylet.call("request_leases", {
+        "resources": {"CPU": 1},
+        "client_id": core.worker_id,
+        "count": 8,
+        "retriable": True,
+    }, timeout=90.0)
+    assert r["ok"]
+    grants = r["grants"]
+    assert 1 <= len(grants) <= 4
+    seen = set()
+    for g in grants:
+        assert g["lease_id"] and g["worker_id"] and g["worker_addr"]
+        seen.add(g["worker_id"])
+    assert len(seen) == len(grants)  # distinct workers
+    for g in grants:
+        core.raylet.notify("return_lease", {"worker_id": g["worker_id"]})
+
+
+# -- small-arg serialization fast path --------------------------------------
+
+
+def test_small_args_roundtrip_bit_exact():
+    cases = [
+        (),
+        (1, 2, 3),
+        ("x", b"raw", None, True, False, 2.5),
+        (0, -1, 10**18),
+    ]
+    for args in cases:
+        blob = serialization.dumps_args_small(args, limit=4096, memo_cap=0)
+        assert blob is not None, args
+        assert blob[:1] == serialization._SMALL_PREFIX
+        got_args, got_kwargs = serialization.loads_inline(blob)
+        ref_args, ref_kwargs = serialization.loads_inline(
+            serialization.dumps_inline((args, {})))
+        assert got_args == ref_args == args
+        assert got_kwargs == ref_kwargs == {}
+
+
+def test_small_args_type_fidelity():
+    """hash(1) == hash(True) == hash(1.0): the memo key must not conflate
+    them, and the wire format must preserve exact types."""
+    b_int = serialization.dumps_args_small((1,), limit=64, memo_cap=8)
+    b_bool = serialization.dumps_args_small((True,), limit=64, memo_cap=8)
+    b_float = serialization.dumps_args_small((1.0,), limit=64, memo_cap=8)
+    a_int, _ = serialization.loads_inline(b_int)
+    a_bool, _ = serialization.loads_inline(b_bool)
+    a_float, _ = serialization.loads_inline(b_float)
+    assert type(a_int[0]) is int
+    assert a_bool[0] is True
+    assert type(a_float[0]) is float
+
+
+def test_small_args_memo_caches_ref_free():
+    b1 = serialization.dumps_args_small((7, "m"), limit=4096, memo_cap=16)
+    b2 = serialization.dumps_args_small((7, "m"), limit=4096, memo_cap=16)
+    assert b1 == b2
+
+
+def test_small_args_ineligible_falls_back():
+    # over the byte limit
+    assert serialization.dumps_args_small(
+        (b"x" * 100,), limit=10, memo_cap=0) is None
+    # unsupported type
+    assert serialization.dumps_args_small(
+        ([1, 2],), limit=4096, memo_cap=0) is None
+    # too many positions
+    assert serialization.dumps_args_small(
+        tuple(range(9)), limit=4096, memo_cap=0) is None
+
+
+def test_small_args_with_object_ref(ray_cluster):
+    """ObjectRef args ride the fast path as markers and rehydrate."""
+    inner = _add.remote(5, 5)
+    assert ray_tpu.get(_add.remote(inner, 1), timeout=120) == 11
+    # many scalar-arg tasks through the cluster exercise the memo
+    assert ray_tpu.get([_add.remote(3, 4) for _ in range(10)],
+                       timeout=120) == [7] * 10
+
+
+# -- native vectorized pick/acquire -----------------------------------------
+
+
+def test_native_pick_n_reserves():
+    from ray_tpu.native.sched import PACK, ClusterScheduler
+
+    s = ClusterScheduler()
+    s.upsert_node("a", {"CPU": 2 * G})
+    s.upsert_node("b", {"CPU": 2 * G})
+    picks = s.pick_n({"CPU": 1 * G}, 4, PACK)
+    assert sorted(picks) == ["a", "a", "b", "b"]
+    # everything reserved: a 5th pick finds nothing
+    assert s.pick_n({"CPU": 1 * G}, 1, PACK) == []
+    assert s.available("a", "CPU") == 0
+    s.release("a", {"CPU": 1 * G})
+    assert s.pick_n({"CPU": 1 * G}, 3, PACK) == ["a"]  # partial
+
+
+def test_native_acquire_n():
+    from ray_tpu.native.sched import ClusterScheduler
+
+    s = ClusterScheduler()
+    s.upsert_node("a", {"CPU": 4 * G})
+    assert s.acquire_n("a", {"CPU": 1 * G}, 8) == 4
+    assert s.acquire_n("a", {"CPU": 1 * G}, 1) == 0
+    assert s.acquire_n("missing", {"CPU": 1 * G}, 1) == 0
+    assert s.acquire_n("a", {"CPU": 1 * G}, 0) == 0
