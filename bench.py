@@ -1489,6 +1489,181 @@ def bench_table() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Serving quick mode (`python bench.py --serve-only`): the continuous-
+# batching engine (serve/_engine.py) vs the legacy static micro-batching
+# path, same model, same Zipfian request trace — emits BENCH_SERVE.json
+# (tokens/s, TTFT p50/p99, p99 latency for both) and exits non-zero when
+# the continuous engine's tokens/s falls below 0.9x the recorded
+# headline (shared-host jitter grace; the headline only moves forward).
+# ---------------------------------------------------------------------------
+
+
+def _serve_trace(n_req: int, vocab: int):
+    """Deterministic Zipf-shaped trace: prompt and generation lengths
+    both heavy-tailed and UNQUANTIZED, like real traffic.  This is the
+    mix the static path is worst at — every distinct (batch, prompt_len,
+    max_new) combination is a fresh XLA program and groups fragment to
+    near-singletons — while the continuous engine runs one fixed-shape
+    step program regardless."""
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    gen_lens = [int(g) for g in 3 + np.clip(rng.zipf(1.5, n_req), 1, 37)]
+    plens = 4 + np.clip(rng.zipf(1.4, n_req), 0, 20)
+    prompts = [rng.randint(1, vocab, int(p)).tolist() for p in plens]
+    return prompts, gen_lens
+
+
+def bench_serve() -> dict:
+    import jax
+    import numpy as np
+
+    from ray_tpu.serve.llm import _LLMServerImpl
+
+    arch = os.environ.get("BENCH_SERVE_ARCH", "nano")
+    n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", "48"))
+    max_seq = int(os.environ.get("BENCH_SERVE_MAX_SEQ", "128"))
+    prompts, gen_lens = _serve_trace(n_req, 200)
+
+    def pct(xs, p):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(p * len(xs)))] if xs else 0.0
+
+    def summarize(wall, tokens, ttfts, lats):
+        return {
+            "tokens_per_s": round(tokens / wall, 1),
+            "wall_s": round(wall, 2),
+            "tokens": tokens,
+            "ttft_p50_s": round(pct(ttfts, 0.50), 4),
+            "ttft_p99_s": round(pct(ttfts, 0.99), 4),
+            "latency_p99_s": round(pct(lats, 0.99), 4),
+        }
+
+    # -- static micro-batching (the old default), driven exactly like a
+    # replica would be: one asyncio loop, serve.batch coalescing
+    from ray_tpu.serve.batching import batch as _sbatch
+
+    cls = type("StaticBench", (_LLMServerImpl,), {})
+    cls.generate_batch = _sbatch(_LLMServerImpl.generate_batch,
+                                 max_batch_size=8,
+                                 batch_wait_timeout_s=0.02)
+    srv = cls(preset=arch, max_seq=max_seq, engine="static")
+    # production defaults on both sides: the static path keeps its
+    # configured compile-cache cap and pays per-shape compiles just as a
+    # deployed replica would; the warmup replay below warms whatever the
+    # LRU can actually hold
+
+    async def drive_static():
+        async def one(i):
+            t0 = time.perf_counter()
+            r = await srv.generate_batch(
+                {"tokens": prompts[i], "max_new_tokens": gen_lens[i]})
+            dt = time.perf_counter() - t0
+            # no streaming on the batched path: the first token exists
+            # only when the whole generation returns
+            return dt, dt, len(r["completion"])
+        import asyncio as _aio
+
+        return await _aio.gather(*[one(i) for i in range(n_req)])
+
+    import asyncio as _aio
+
+    _aio.run(drive_static())               # warm every compile variant
+    t0 = time.perf_counter()
+    res = _aio.run(drive_static())
+    wall = time.perf_counter() - t0
+    static_row = summarize(wall, sum(r[2] for r in res),
+                           [r[0] for r in res], [r[1] for r in res])
+
+    # -- continuous batching over the paged KV arena (the new default)
+    srv2 = _LLMServerImpl(preset=arch, max_seq=max_seq, engine="paged",
+                          engine_kwargs={"queue_cap": 4 * n_req,
+                                         "shed_queue_depth": 4 * n_req})
+    eng = srv2._get_engine()
+    warm = eng.submit(prompts[0], max_new_tokens=4)
+    eng.collect(warm, timeout=600)         # compile prefill + step
+    done_at = {}
+    t0 = time.perf_counter()
+    seqs = []
+    for i in range(n_req):
+        s = eng.submit(prompts[i], max_new_tokens=gen_lens[i])
+        s.result.add_done_callback(
+            lambda f, i=i: done_at.__setitem__(i, time.perf_counter()))
+        seqs.append((i, time.perf_counter(), s))
+    results = [(i, t_sub, eng.collect(s, timeout=600))
+               for i, t_sub, s in seqs]
+    wall = max(done_at.values()) - t0
+    cont_row = summarize(
+        wall, sum(len(r["completion"]) for _, _, r in results),
+        [r["ttft_s"] for _, _, r in results if r["ttft_s"] is not None],
+        [done_at[i] - t_sub for i, t_sub, _ in results])
+    stats = eng.engine_stats()
+    eng.stop()
+
+    return {
+        "backend": jax.default_backend(),
+        "host_cpus": os.cpu_count(),
+        "arch": arch,
+        "n_requests": n_req,
+        "trace": "zipf(1.5) gen lengths 4..40, zipf(1.4) prompt "
+                 "lengths 4..24, unquantized",
+        "static": static_row,
+        "continuous": cont_row,
+        "speedup_tokens_per_s": round(
+            cont_row["tokens_per_s"] / max(static_row["tokens_per_s"],
+                                           1e-9), 2),
+        "ttft_p99_improved": cont_row["ttft_p99_s"]
+        < static_row["ttft_p99_s"],
+        "engine": {k: stats[k] for k in
+                   ("cache", "steps", "prefills", "shared_pages",
+                    "cow_copies", "num_pages") if k in stats},
+    }
+
+
+def _write_bench_serve(row: dict) -> int:
+    """Write BENCH_SERVE.json and gate on the recorded headline: the
+    continuous engine's tokens/s must stay within 0.9x of the best
+    recorded run on this backend (the headline ratchets forward, so a
+    regressed run can't lower the bar for the next one)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, "BENCH_SERVE.json")
+    prior = None
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        if rec.get("backend") == row["backend"]:
+            prior = rec.get("headline_tokens_per_s")
+    except (OSError, ValueError):
+        pass
+    got = row["continuous"]["tokens_per_s"]
+    regressed = prior is not None and got < 0.9 * prior
+    row["headline_tokens_per_s"] = max(got, prior or 0.0) \
+        if not regressed else prior
+    row["recorded_unix_time"] = int(time.time())
+    with open(path, "w") as f:
+        json.dump(row, f, indent=2)
+        f.write("\n")
+    print(json.dumps(row, indent=2))
+    if regressed:
+        print(f"FAIL: continuous tokens/s {got} < 0.9x recorded "
+              f"{prior}", file=sys.stderr)
+        return 1
+    if row["speedup_tokens_per_s"] < 1.5:
+        print(f"WARNING: continuous/static speedup "
+              f"{row['speedup_tokens_per_s']}x < 1.5x target",
+              file=sys.stderr)
+    return 0
+
+
+def _serve_only_main() -> int:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    return _write_bench_serve(bench_serve())
+
+
+# ---------------------------------------------------------------------------
 # Task-submission quick mode (`python bench.py --tasks-only`): only the
 # rows the batched submit hot path owns, in a few minutes, plus the
 # owner-side batch-size histogram — emits BENCH_TASKS.json and exits
@@ -1681,6 +1856,8 @@ if __name__ == "__main__":
         _gpt_sync_main()
     elif "--extras-only" in sys.argv:
         _extras_main()
+    elif "--serve-only" in sys.argv:
+        sys.exit(_serve_only_main())
     elif "--tasks-only" in sys.argv:
         sys.exit(_write_bench_tasks(bench_tasks_table()))
     elif "--table" in sys.argv:

@@ -126,6 +126,40 @@ CONFIG_DEFS: List[Tuple[str, type, Any, str]] = [
      "'int8' / 'int8:block=512,stochastic=1,ef=0' (block-wise quantized "
      "allreduce; see collective/compression.py).  Per-call compression= "
      "and the Train backend's CompressionConfig override this"),
+    # -- serving (the LLM engine knobs live here, not as hardcoded
+    # constants in serve/llm.py, so one RAY_TPU_SERVE_* env var reaches
+    # every replica the bootstrapper spawns)
+    ("serve_engine", str, "paged",
+     "LLM decode engine: 'paged' (continuous batching over the paged "
+     "KV arena), 'contiguous' (continuous batching over per-slot "
+     "contiguous caches; the parity baseline), or 'static' (legacy "
+     "serve.batch micro-batching)"),
+    ("serve_gen_cache_cap", int, 8,
+     "compiled-program LRU entries per LLM replica (generate/prefill/"
+     "stream-step variants; the engine's own step programs are bounded "
+     "by construction and not counted)"),
+    ("serve_max_slots", int, 8,
+     "decode slots per replica = the fixed batch width of the compiled "
+     "continuous-batching step program"),
+    ("serve_page_size", int, 16,
+     "KV-cache page size in token positions"),
+    ("serve_num_pages", int, 0,
+     "pages in the device KV arena (incl. the reserved null page); "
+     "0 = auto-size so every slot can hold a full-length sequence"),
+    ("serve_max_total", int, 0,
+     "max prompt+generation positions per sequence; 0 = the model's "
+     "max_seq"),
+    ("serve_queue_cap", int, 32,
+     "waiting-queue length at which the engine rejects new requests "
+     "(AdmissionRejected -> HTTP 503 + Retry-After)"),
+    ("serve_shed_queue_depth", int, 16,
+     "queue depth at which the replica advertises accepting=False so "
+     "the router sheds before the hard queue_cap bounces requests"),
+    ("serve_retry_after_s", float, 1.0,
+     "Retry-After hint attached to shed/rejected serve requests"),
+    ("serve_prefill_bucket", int, 32,
+     "prefill token chunks are padded to multiples of this (bounds "
+     "prefill compile variants to max_total/bucket)"),
     # -- misc
     ("usage_stats_enabled", bool, True, "local usage tagging"),
     ("log_to_driver_batch_lines", int, 200,

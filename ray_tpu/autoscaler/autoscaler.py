@@ -66,6 +66,24 @@ class LoadMetrics:
             return {}
         return out
 
+    def _serve_load(self) -> Dict[str, Any]:
+        """'app:deployment' -> decode-engine load aggregates (queue_depth,
+        ttft_p99_s, accepting, ...) from the Serve controller's status
+        snapshot in KV ns 'serve'.  Advisory: any failure yields {}."""
+        import json
+
+        try:
+            raw = self.control.call(
+                "kv_get", {"ns": "serve", "key": "status"}, timeout=5.0)
+            if not raw:
+                return {}
+            snap = json.loads(
+                raw.decode() if isinstance(raw, bytes) else raw)
+            load = snap.get("serve_load") or {}
+            return load if isinstance(load, dict) else {}
+        except Exception:
+            return {}
+
     def snapshot(self) -> Dict[str, Any]:
         from ray_tpu._private.protocol import Client
 
@@ -101,7 +119,8 @@ class LoadMetrics:
         return {"nodes": alive, "demands": demands,
                 "idle_s": {nid: now - ts
                            for nid, ts in self.last_busy.items()},
-                "train_goodput": self._train_goodput()}
+                "train_goodput": self._train_goodput(),
+                "serve_load": self._serve_load()}
 
 
 class ResourceDemandScheduler:

@@ -68,6 +68,47 @@ class GoodputPolicy:
     max_extra: int = 2
 
 
+@dataclass
+class ServeSLOPolicy:
+    """Serve-SLO scaling knobs (the inference-side analog of
+    GoodputPolicy): when a deployment's decode engines queue past the
+    per-replica watermark, breach the p99-TTFT SLO, or shed everything,
+    spare capacity is launched ahead of strict bin-packing need; while
+    any deployment is under pressure, idle termination pauses.
+
+    max_queue_per_replica: average engine waiting-queue depth per
+        RUNNING replica that counts as pressure (0 disables).
+    ttft_slo_s: p99 time-to-first-token above this counts as pressure
+        (0 disables).
+    max_extra: cap on SLO-motivated spare instances on the way up at
+        any moment (counted against QUEUED/REQUESTED/ALLOCATED).
+    """
+
+    max_queue_per_replica: float = 4.0
+    ttft_slo_s: float = 0.0
+    max_extra: int = 2
+
+
+def _serve_pressure(snapshot: Dict[str, Any],
+                    pol: "ServeSLOPolicy") -> Optional[str]:
+    """First deployment violating the serve SLO, as a human-readable
+    reason — None when every deployment is inside its envelope."""
+    for name, load in (snapshot.get("serve_load") or {}).items():
+        replicas = max(1, int(load.get("replicas", 1) or 1))
+        queued = float(load.get("queue_depth", 0) or 0)
+        if pol.max_queue_per_replica > 0 \
+                and queued / replicas > pol.max_queue_per_replica:
+            return (f"{name}: {queued:g} queued across {replicas} "
+                    f"replica(s) > {pol.max_queue_per_replica:g}/replica")
+        ttft = float(load.get("ttft_p99_s", 0.0) or 0.0)
+        if pol.ttft_slo_s > 0 and ttft > pol.ttft_slo_s:
+            return (f"{name}: p99 TTFT {ttft:.3f}s > "
+                    f"{pol.ttft_slo_s:g}s SLO")
+        if int(load.get("accepting", 1) or 0) == 0:
+            return f"{name}: every replica shedding"
+    return None
+
+
 def _min_goodput(snapshot: Dict[str, Any]) -> Optional[float]:
     vals = list((snapshot.get("train_goodput") or {}).values())
     return min(vals) if vals else None
@@ -183,7 +224,8 @@ class Reconciler:
                  load_metrics: LoadMetrics,
                  idle_timeout_s: float = 60.0,
                  request_timeout_s: float = 300.0,
-                 goodput_policy: Optional[GoodputPolicy] = None):
+                 goodput_policy: Optional[GoodputPolicy] = None,
+                 serve_policy: Optional[ServeSLOPolicy] = None):
         self.im = manager
         self.provider = provider
         self.scheduler = scheduler
@@ -191,10 +233,13 @@ class Reconciler:
         self.idle_timeout_s = idle_timeout_s
         self.request_timeout_s = request_timeout_s
         self.goodput_policy = goodput_policy
+        self.serve_policy = serve_policy
         self.num_launched = 0
         self.num_terminated = 0
         self.num_goodput_launches = 0
         self.num_goodput_holds = 0
+        self.num_serve_launches = 0
+        self.num_serve_holds = 0
 
     # -- observation --------------------------------------------------------
 
@@ -266,6 +311,34 @@ class Reconciler:
             if count > 0:
                 self.im.add_instances(type_name, count)
         self._declare_goodput_spares(snapshot, to_launch)
+        self._declare_serve_spares(snapshot, to_launch)
+
+    def _declare_serve_spares(self, snapshot: Dict[str, Any],
+                              demand_launch: Dict[str, int]):
+        pol = self.serve_policy
+        if pol is None:
+            return
+        reason = _serve_pressure(snapshot, pol)
+        if reason is None:
+            return
+        on_the_way = len(self.im.storage.get_instances(
+            [QUEUED, REQUESTED, ALLOCATED])) + sum(demand_launch.values())
+        budget = pol.max_extra - on_the_way
+        if budget <= 0:
+            return
+        counts = self._counts_by_type()
+        total = sum(counts.values())
+        for tname, tcfg in self.scheduler.node_types.items():
+            cap = tcfg.get("max_workers", self.scheduler.max_workers)
+            room = min(cap - counts.get(tname, 0),
+                       self.scheduler.max_workers - total, budget)
+            if room <= 0:
+                continue
+            logger.info("serve SLO pressure (%s): launching %d spare %s",
+                        reason, room, tname)
+            self.im.add_instances(tname, room)
+            self.num_serve_launches += room
+            return
 
     def _declare_goodput_spares(self, snapshot: Dict[str, Any],
                                 demand_launch: Dict[str, int]):
@@ -339,6 +412,14 @@ class Reconciler:
                     "idle termination held: goodput %.2f < %.2f",
                     gp, pol.scale_down_above)
                 return
+        if self.serve_policy is not None:
+            reason = _serve_pressure(snapshot, self.serve_policy)
+            if reason is not None:
+                # a deployment is under SLO pressure: shaving nodes now
+                # would fight the replicas the controller wants to add
+                self.num_serve_holds += 1
+                logger.debug("idle termination held: %s", reason)
+                return
         idle_s = snapshot.get("idle_s", {})
         min_workers = {
             t: cfg.get("min_workers", 0)
@@ -409,11 +490,16 @@ class AutoscalerV2:
         if gp_cfg is not None:
             policy = GoodputPolicy(**gp_cfg) if isinstance(gp_cfg, dict) \
                 else GoodputPolicy()
+        slo_cfg = config.get("serve_slo")
+        serve_policy = None
+        if slo_cfg is not None:
+            serve_policy = ServeSLOPolicy(**slo_cfg) \
+                if isinstance(slo_cfg, dict) else ServeSLOPolicy()
         self.reconciler = Reconciler(
             self.manager, provider, self.scheduler,
             LoadMetrics(control_client),
             idle_timeout_s=config.get("idle_timeout_minutes", 1.0) * 60.0,
-            goodput_policy=policy)
+            goodput_policy=policy, serve_policy=serve_policy)
 
     def update(self):
         self.reconciler.reconcile()

@@ -542,6 +542,246 @@ def _decode_hidden_fast(view, cfg: GPTConfig, kcache, vcache, pos, toks):
     return x.astype(cfg.dtype), kcache, vcache
 
 
+# ---------------------------------------------------------------------------
+# Slot-batch decoding (continuous batching).  The serving engine keeps a
+# fixed-shape batch of B "slots"; sequences join at prefill and leave at
+# EOS/max-tokens, so every slot sits at its OWN position.  Two cache
+# layouts share the identical attention math:
+#
+#   * contiguous slot cache [L, B, H, S, dh] — one row per slot (kept for
+#     bitwise parity tests against the paged path);
+#   * paged cache: a device arena of fixed-size pages [L, P, H, ps, dh]
+#     plus per-slot page tables gathered inside the decode step.  Page 0
+#     is reserved as the null page: inactive slots write there and their
+#     outputs are discarded host-side, so the compiled step program
+#     never changes shape as sequences come and go.
+
+
+def _slot_rope(x, cos, sin, positions):
+    """Per-slot rotary embedding: x [B, H, 1, dh], positions [B] (each
+    batch row at its own decode position, unlike ops.apply_rope whose
+    positions are shared across the batch)."""
+    c = cos[positions][:, None, None]           # [B, 1, 1, dh/2]
+    sn = sin[positions][:, None, None]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * c - x2 * sn, x2 * c + x1 * sn],
+                           axis=-1).astype(x.dtype)
+
+
+def _slot_embed(params, tokens, pos, cfg: GPTConfig):
+    x = params["embed"][tokens].astype(cfg.dtype)          # [B, D]
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"][pos].astype(cfg.dtype)  # per-slot row
+    return x[:, None]                                      # [B, 1, D]
+
+
+def _slot_qkv(x, layer, cfg: GPTConfig, rope, pos):
+    q, k, v = _qkv_proj(x, layer, cfg, rope=None)
+    if rope is not None:
+        q = _slot_rope(q, *rope, positions=pos)
+        k = _slot_rope(k, *rope, positions=pos)
+    return q, k, v
+
+
+def _slot_attention(q, kc, vc, pos, cfg: GPTConfig):
+    """q [B,H,1,dh] against a per-slot cache view kc/vc [B,H,S,dh] with
+    per-slot causal masks (<= pos[b]).  This is the ONE attention recipe
+    both cache layouts feed — the paged path gathers its pages into
+    exactly this [B,H,S,dh] view, which is what makes paged==contiguous
+    a structural identity rather than a numerical accident."""
+    S = kc.shape[2]
+    mask = (jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, S), 3)
+            <= pos[:, None, None, None])
+    s = jnp.einsum("bhqk,bhsk->bhqs", q.astype(jnp.float32),
+                   kc.astype(jnp.float32)) * (cfg.d_head ** -0.5)
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    vcd = vc if vc.dtype == cfg.dtype else vc.astype(cfg.dtype)
+    return jnp.einsum("bhqs,bhsk->bhqk", p.astype(cfg.dtype), vcd)
+
+
+def init_slot_cache(cfg: GPTConfig, slots: int, max_total: int
+                    ) -> Dict[str, Any]:
+    """Contiguous slot cache: [L, slots, H, max_total, d_head] per side.
+    Positions live with the engine (per-slot, host-driven), not in the
+    cache — unlike init_cache's scalar lockstep `pos`."""
+    shape = (cfg.n_layers, slots, cfg.n_heads, max_total, cfg.d_head)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _slot_decode_hidden(params, kcache, vcache, tokens, pos, cfg: GPTConfig,
+                        rope=None):
+    """One decode position for every slot: tokens [B] at per-slot
+    positions pos [B] -> (hidden [B, D], kcache, vcache).  kcache/vcache
+    [L, B, H, S, dh]."""
+    B = tokens.shape[0]
+    S = kcache.shape[3]
+    if cfg.pos == "learned":
+        rope = None
+    elif rope is None:
+        rope = rope_table(S, cfg.d_head, dtype=jnp.float32)
+    x = _slot_embed(params, tokens, pos, cfg)
+    bidx = jnp.arange(B)
+
+    def block(x, inp):
+        layer, kc, vc = inp                    # kc/vc [B, H, S, dh]
+        q, k, v = _slot_qkv(x, layer, cfg, rope, pos)
+        kc = kc.at[bidx, :, pos, :].set(k[:, :, 0, :].astype(kc.dtype))
+        vc = vc.at[bidx, :, pos, :].set(v[:, :, 0, :].astype(vc.dtype))
+        o = _slot_attention(q, kc, vc, pos, cfg)
+        return _attn_out_and_mlp(x, o, layer, cfg), (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        block, x, (params["layers"], kcache, vcache), unroll=cfg.n_layers)
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"), cfg.norm)
+    return x[:, 0], k_new, v_new
+
+
+def slot_decode_step(params, cache, tokens, pos, cfg: GPTConfig, rope=None):
+    """Slot-batch decode on the contiguous cache: tokens [B] at per-slot
+    positions pos [B] -> (logits [B, V], cache)."""
+    x, k_new, v_new = _slot_decode_hidden(params, cache["k"], cache["v"],
+                                          tokens, pos, cfg, rope)
+    logits = jnp.einsum("bd,dv->bv", x.astype(cfg.dtype),
+                        _unembed_table(params, cfg))
+    return logits, {"k": k_new, "v": v_new}
+
+
+def slot_prefill(params, cache, toks, start, last_idx, slot,
+                 cfg: GPTConfig, rope=None):
+    """Prefill ONE slot while the rest of the batch is frozen: toks [T]
+    (padded; positions are clamped so pad steps never overflow the
+    row — pad writes land at positions decode overwrites before it
+    attends them), starting at position `start`; logits are taken at
+    scanned index `last_idx` (the last REAL prompt token).  Returns
+    (logits [V], cache)."""
+    S = cache["k"].shape[3]
+    kc = jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, 1)
+    vc = jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, 1)
+    T = toks.shape[0]
+    positions = jnp.minimum(start + jnp.arange(T, dtype=jnp.int32), S - 1)
+    if cfg.pos != "learned" and rope is None:
+        rope = rope_table(S, cfg.d_head, dtype=jnp.float32)
+
+    def body(carry, inp):
+        kc, vc = carry
+        tok, p = inp
+        x, kc, vc = _slot_decode_hidden(params, kc, vc, tok[None],
+                                        p[None], cfg, rope)
+        return (kc, vc), x[0]
+
+    (kc, vc), xs = jax.lax.scan(body, (kc, vc), (toks, positions))
+    x = jax.lax.dynamic_index_in_dim(xs, last_idx, 0, keepdims=False)
+    logits = jnp.einsum("d,dv->v", x.astype(cfg.dtype),
+                        _unembed_table(params, cfg))
+    cache = {
+        "k": jax.lax.dynamic_update_slice_in_dim(cache["k"], kc, slot, 1),
+        "v": jax.lax.dynamic_update_slice_in_dim(cache["v"], vc, slot, 1),
+    }
+    return logits, cache
+
+
+# -- paged variant ----------------------------------------------------------
+
+
+def init_paged_cache(cfg: GPTConfig, num_pages: int, page_size: int
+                     ) -> Dict[str, Any]:
+    """Paged KV arena: [L, num_pages, H, page_size, d_head] per side.
+    Page 0 is the reserved null page (inactive-slot writes land there;
+    the allocator never hands it out)."""
+    shape = (cfg.n_layers, num_pages, cfg.n_heads, page_size, cfg.d_head)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _paged_decode_hidden(params, kpages, vpages, tokens, ptab, pos,
+                         cfg: GPTConfig, rope=None):
+    """One decode position for every slot against the page arena:
+    tokens [B], ptab [B, max_pages] (page ids in sequence order; unused
+    entries 0), pos [B] -> (hidden [B, D], kpages, vpages).  Writes
+    scatter into each slot's current page; attention gathers the slot's
+    pages into the contiguous [B, H, S, dh] view and runs the shared
+    _slot_attention recipe."""
+    B = tokens.shape[0]
+    H, dh = cfg.n_heads, cfg.d_head
+    ps = kpages.shape[3]
+    maxp = ptab.shape[1]
+    S = maxp * ps
+    pos = jnp.minimum(pos, S - 1)
+    if cfg.pos == "learned":
+        rope = None
+    elif rope is None:
+        rope = rope_table(S, cfg.d_head, dtype=jnp.float32)
+    x = _slot_embed(params, tokens, pos, cfg)
+    pidx = jnp.take_along_axis(ptab, (pos // ps)[:, None], axis=1)[:, 0]
+    poff = pos % ps
+
+    def gather(pages):
+        g = pages[ptab]                        # [B, maxp, H, ps, dh]
+        return jnp.transpose(g, (0, 2, 1, 3, 4)).reshape(B, H, S, dh)
+
+    def block(x, inp):
+        layer, kc, vc = inp                    # kc/vc [P, H, ps, dh]
+        q, k, v = _slot_qkv(x, layer, cfg, rope, pos)
+        kc = kc.at[pidx, :, poff, :].set(k[:, :, 0, :].astype(kc.dtype))
+        vc = vc.at[pidx, :, poff, :].set(v[:, :, 0, :].astype(vc.dtype))
+        o = _slot_attention(q, gather(kc), gather(vc), pos, cfg)
+        return _attn_out_and_mlp(x, o, layer, cfg), (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        block, x, (params["layers"], kpages, vpages), unroll=cfg.n_layers)
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"), cfg.norm)
+    return x[:, 0], k_new, v_new
+
+
+def paged_decode_step(params, cache, tokens, ptab, pos, cfg: GPTConfig,
+                      rope=None):
+    """Slot-batch decode on the paged cache: -> (logits [B, V], cache)."""
+    x, k_new, v_new = _paged_decode_hidden(params, cache["k"], cache["v"],
+                                           tokens, ptab, pos, cfg, rope)
+    logits = jnp.einsum("bd,dv->bv", x.astype(cfg.dtype),
+                        _unembed_table(params, cfg))
+    return logits, {"k": k_new, "v": v_new}
+
+
+def paged_prefill(params, cache, toks, ptab_row, start, last_idx,
+                  cfg: GPTConfig, rope=None):
+    """Prefill one slot's pages: toks [T] (padded) starting at position
+    `start` (positions before `start` are prefix-shared pages already
+    holding valid K/V); logits at scanned index `last_idx`.  Returns
+    (logits [V], cache)."""
+    kc, vc = cache["k"], cache["v"]
+    ps = kc.shape[3]
+    S = ptab_row.shape[0] * ps
+    T = toks.shape[0]
+    positions = jnp.minimum(start + jnp.arange(T, dtype=jnp.int32), S - 1)
+    if cfg.pos != "learned" and rope is None:
+        rope = rope_table(S, cfg.d_head, dtype=jnp.float32)
+
+    def body(carry, inp):
+        kc, vc = carry
+        tok, p = inp
+        x, kc, vc = _paged_decode_hidden(params, kc, vc, tok[None],
+                                         ptab_row[None], p[None], cfg,
+                                         rope)
+        return (kc, vc), x[0]
+
+    (kc, vc), xs = jax.lax.scan(body, (kc, vc), (toks, positions))
+    x = jax.lax.dynamic_index_in_dim(xs, last_idx, 0, keepdims=False)
+    logits = jnp.einsum("d,dv->v", x.astype(cfg.dtype),
+                        _unembed_table(params, cfg))
+    return logits, {"k": kc, "v": vc}
+
+
+def copy_page(cache, dst, src):
+    """Copy-on-write: duplicate page `src` into `dst` across all layers
+    (both K and V sides) — used when a new sequence diverges inside a
+    prefix-shared page."""
+    return {"k": cache["k"].at[:, dst].set(cache["k"][:, src]),
+            "v": cache["v"].at[:, dst].set(cache["v"][:, src])}
+
+
 def sample_logits(logits, key, temperature: float = 0.0,
                   top_k: Optional[int] = None, dtype=jnp.int32):
     """The ONE sampling recipe (greedy argmax at temperature 0, else

@@ -9,6 +9,16 @@ from typing import Any, Dict, List, Optional
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 DEFAULT_ROUTE_PREFIX = "/"
 
+
+class NoCapacityError(Exception):
+    """Every candidate replica is shedding (engine accepting=False):
+    the router refuses the request up front so the proxy can answer
+    503 + Retry-After instead of letting replica queues collapse."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
 # replica states (reference: serve/_private/common.py ReplicaState)
 STARTING = "STARTING"
 RUNNING = "RUNNING"
@@ -30,13 +40,20 @@ class AutoscalingConfig:
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 3.0
     downscale_delay_s: float = 30.0
+    # serve-SLO signals (0 = disabled): consumed by the controller's
+    # _autoscale from decode-engine stats — average engine waiting-queue
+    # depth per replica to hold, and the p99 time-to-first-token SLO
+    target_queue_depth: float = 0.0
+    ttft_slo_s: float = 0.0
 
     def to_dict(self) -> Dict[str, Any]:
         return {"min_replicas": self.min_replicas,
                 "max_replicas": self.max_replicas,
                 "target_ongoing_requests": self.target_ongoing_requests,
                 "upscale_delay_s": self.upscale_delay_s,
-                "downscale_delay_s": self.downscale_delay_s}
+                "downscale_delay_s": self.downscale_delay_s,
+                "target_queue_depth": self.target_queue_depth,
+                "ttft_slo_s": self.ttft_slo_s}
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "AutoscalingConfig":

@@ -41,6 +41,7 @@ class _ReplicaState:
         self.ready_ref = None
         self.ongoing = 0
         self.model_ids: List[str] = []
+        self.engine: Optional[Dict[str, Any]] = None  # decode-engine stats
         self.last_health_ts = time.time()
         self.health_ref = None
         self.metrics_ref = None
@@ -216,7 +217,8 @@ class ServeController:
                 "version": self._replica_version,
                 "replicas": [
                     {"replica_id": r.replica_id, "handle": r.handle,
-                     "model_ids": list(r.model_ids)}
+                     "model_ids": list(r.model_ids),
+                     "engine": dict(r.engine) if r.engine else None}
                     for r in ds.replicas.values() if r.state == RUNNING],
                 "max_ongoing_requests": ds.spec.get(
                     "max_ongoing_requests", 100),
@@ -343,7 +345,7 @@ class ServeController:
 
         from ray_tpu._private.api import current_core
 
-        snap = {"ts": time.time(), "apps": []}
+        snap = {"ts": time.time(), "apps": [], "serve_load": {}}
         with self._lock:
             for app_name, app in self._apps.items():
                 deps = []
@@ -360,6 +362,29 @@ class ServeController:
                                        for r in ds.replicas.values()),
                         "message": ds.message or "",
                     })
+                    engines = [r.engine for r in ds.replicas.values()
+                               if r.state == RUNNING and r.engine]
+                    if engines:
+                        # per-deployment decode-engine load: the
+                        # queue-depth / p99-TTFT signals autoscaler v2's
+                        # ServeSLOPolicy consumes from LoadMetrics
+                        snap["serve_load"][f"{app_name}:{dname}"] = {
+                            "replicas": running,
+                            "queue_depth": sum(e.get("queue_depth", 0)
+                                               for e in engines),
+                            "active": sum(e.get("active", 0)
+                                          for e in engines),
+                            "free_pages": sum(e.get("free_pages", 0)
+                                              for e in engines),
+                            "accepting": sum(
+                                1 for e in engines
+                                if e.get("accepting", True)),
+                            "ttft_p99_s": max(e.get("ttft_p99_s", 0.0)
+                                              for e in engines),
+                            "tokens_per_s": sum(
+                                e.get("tokens_per_s", 0.0)
+                                for e in engines),
+                        }
                 snap["apps"].append({
                     "app": app_name, "status": app["status"],
                     "route_prefix": app["route_prefix"],
@@ -451,6 +476,7 @@ class ServeController:
                         try:
                             m = ray_tpu.get(done[0])
                             r.ongoing = m.get("ongoing", 0)
+                            r.engine = m.get("engine")
                             new_models = m.get("model_ids", [])
                             if new_models != r.model_ids:
                                 r.model_ids = new_models
@@ -532,6 +558,23 @@ class ServeController:
         total_ongoing = sum(r.ongoing for r in running)
         desired = math.ceil(total_ongoing
                             / max(cfg.target_ongoing_requests, 1e-9))
+        # serve-SLO signals from the decode engines: sustained waiting
+        # queues or p99 TTFT past the SLO mean the replicas are saturated
+        # even if ongoing-request counts look tame (one engine request is
+        # "one ongoing" no matter how many are queued behind its slots)
+        engines = [r.engine for r in running if r.engine]
+        if engines:
+            if cfg.target_queue_depth > 0:
+                queued = sum(e.get("queue_depth", 0) for e in engines)
+                if queued:
+                    desired = max(desired, math.ceil(
+                        queued / cfg.target_queue_depth))
+                if queued / len(running) > cfg.target_queue_depth:
+                    desired = max(desired, len(running) + 1)
+            if cfg.ttft_slo_s > 0:
+                worst = max(e.get("ttft_p99_s", 0.0) for e in engines)
+                if worst > cfg.ttft_slo_s:
+                    desired = max(desired, len(running) + 1)
         desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
         now = time.time()
         if desired > ds.target_num_replicas:

@@ -22,12 +22,30 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
 
-from ._common import CONTROLLER_NAME
+from ._common import CONTROLLER_NAME, NoCapacityError
 from ._router import get_router
 
 logger = logging.getLogger(__name__)
 
 _ROUTES_TTL_S = 1.0
+
+
+def _shed_retry_after(e: BaseException) -> Optional[float]:
+    """Retry-After seconds when `e` is a load-shed signal, else None.
+    Local router sheds arrive typed (NoCapacityError); replica-side
+    engine rejections cross the task boundary as a wrapped error whose
+    text carries the exception name."""
+    if isinstance(e, NoCapacityError):
+        return e.retry_after_s
+    txt = str(e)
+    if "AdmissionRejected" in txt or "NoCapacityError" in txt:
+        try:
+            from .._private.config import cfg as _cfg
+
+            return _cfg().serve_retry_after_s
+        except Exception:
+            return 1.0
+    return None
 
 
 class Response:
@@ -220,6 +238,13 @@ class HTTPProxy:
         try:
             out = await loop.run_in_executor(None, call)
         except Exception as e:
+            retry = _shed_retry_after(e)
+            if retry is not None:
+                # overload is not an error: tell the client when to come
+                # back instead of letting queues collapse into timeouts
+                return web.Response(
+                    status=503, text=f"overloaded: {e}",
+                    headers={"Retry-After": f"{max(0.0, retry):g}"})
             logger.exception("request to %s failed", path)
             return web.Response(status=500,
                                text=f"{type(e).__name__}: {e}")
@@ -257,8 +282,18 @@ class HTTPProxy:
 
         loop = asyncio.get_event_loop()
         pool = self._stream_executor()
-        gen, done = await loop.run_in_executor(
-            pool, lambda: router.assign_streaming(None, (req,), {}, {}))
+        try:
+            gen, done = await loop.run_in_executor(
+                pool, lambda: router.assign_streaming(None, (req,), {}, {}))
+        except Exception as e:
+            retry = _shed_retry_after(e)
+            if retry is not None:
+                return web.Response(
+                    status=503, text=f"overloaded: {e}",
+                    headers={"Retry-After": f"{max(0.0, retry):g}"})
+            logger.exception("streaming assign to %s failed", req.path)
+            return web.Response(status=500,
+                                text=f"{type(e).__name__}: {e}")
         it = iter(gen)
         sentinel = object()
 
@@ -313,7 +348,12 @@ class HTTPProxy:
             logger.exception("streaming request to %s failed", req.path)
             if resp is None or not resp.prepared:
                 # nothing hit the wire yet (including prepare() itself
-                # failing): a plain 500 is still deliverable
+                # failing): a plain 500/503 is still deliverable
+                retry = _shed_retry_after(e)
+                if retry is not None:
+                    return web.Response(
+                        status=503, text=f"overloaded: {e}",
+                        headers={"Retry-After": f"{max(0.0, retry):g}"})
                 return web.Response(status=500,
                                     text=f"{type(e).__name__}: {e}")
             # headers already sent: abort the connection rather than

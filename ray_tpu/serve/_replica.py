@@ -140,12 +140,23 @@ class Replica:
 
     def get_metrics(self) -> Dict[str, Any]:
         """Queue-length probe (router p2c) + autoscaling stats + loaded
-        multiplexed models (router affinity)."""
+        multiplexed models (router affinity) + decode-engine scheduler
+        stats when the callable hosts one (queue depth / TTFT / page
+        headroom — the serve-SLO autoscaling signals)."""
         from .multiplex import loaded_model_ids
 
-        return {"ongoing": self._ongoing, "total": self._total,
-                "model_ids": loaded_model_ids(self._callable),
-                "ts": time.time()}
+        out = {"ongoing": self._ongoing, "total": self._total,
+               "model_ids": loaded_model_ids(self._callable),
+               "ts": time.time()}
+        stats_fn = getattr(self._callable, "engine_stats", None)
+        if stats_fn is not None:
+            try:
+                eng = stats_fn()
+                if eng:
+                    out["engine"] = eng
+            except Exception:
+                pass  # a metrics probe must never take the replica down
+        return out
 
     def check_health(self) -> bool:
         fn = getattr(self._callable, "check_health", None)

@@ -17,7 +17,7 @@ from typing import Any, Dict, Optional
 
 import ray_tpu
 
-from ._common import CONTROLLER_NAME
+from ._common import CONTROLLER_NAME, NoCapacityError
 
 _TABLE_TTL_S = 1.0
 
@@ -72,6 +72,21 @@ class Router:
             warm = [c for c in cands if model_id in c.get("model_ids", ())]
             if warm:
                 cands = warm
+        # admission control on engine headroom: replicas whose decode
+        # engine reports accepting=False (queue past the shed watermark)
+        # are skipped; with NOBODY accepting, shed the request here —
+        # the proxy turns NoCapacityError into 503 + Retry-After
+        accepting = [c for c in cands
+                     if not isinstance(c.get("engine"), dict)
+                     or c["engine"].get("accepting", True)]
+        if not accepting:
+            retry = max(c["engine"].get("retry_after_s", 1.0)
+                        for c in cands)
+            raise NoCapacityError(
+                f"all {len(cands)} replicas of "
+                f"{self.app_name}:{self.deployment_name} are shedding "
+                f"(engine queues past watermark)", retry_after_s=retry)
+        cands = accepting
         if len(cands) == 1:
             return cands[0]
         a, b = random.sample(cands, 2)
@@ -86,16 +101,23 @@ class Router:
         rid = replica["replica_id"]
         with self._lock:
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
-        m = getattr(replica["handle"], method)
-        if streaming:
-            m = m.options(num_returns="streaming")
-        ref = m.remote(method_name, args, kwargs, metadata or {})
 
         def done():
             with self._lock:
                 n = self._inflight.get(rid, 1)
                 self._inflight[rid] = max(0, n - 1)
 
+        try:
+            m = getattr(replica["handle"], method)
+            if streaming:
+                m = m.options(num_returns="streaming")
+            ref = m.remote(method_name, args, kwargs, metadata or {})
+        except BaseException:
+            # a submission that never produced a ref must not count
+            # against the replica forever (it would skew power-of-two
+            # choice until the replica left the table)
+            done()
+            raise
         return ref, done
 
     def assign(self, method_name: Optional[str], args, kwargs,

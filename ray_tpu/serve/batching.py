@@ -23,18 +23,48 @@ class _BatchQueue:
         self.max_batch_size = max_batch_size
         self.batch_wait_timeout_s = batch_wait_timeout_s
         self.queue: Optional[asyncio.Queue] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._flusher: Optional[asyncio.Task] = None
 
     def _ensure(self):
-        # bound to whichever loop first executes a request
-        if self.queue is None:
-            self.queue = asyncio.Queue()
-            self._flusher = asyncio.get_event_loop().create_task(
-                self._flush_loop())
+        # bound to the loop actually running the request; a dead flusher
+        # (raised, or cancelled when a previous loop was torn down — the
+        # between-tests case) or a loop change re-arms instead of
+        # silently queueing onto a task nobody is draining
+        loop = asyncio.get_running_loop()
+        if (self.queue is not None and self._loop is loop
+                and self._flusher is not None
+                and not self._flusher.done()):
+            return
+        if self.queue is not None:
+            err: BaseException
+            if (self._flusher is not None and self._flusher.done()
+                    and not self._flusher.cancelled()
+                    and self._flusher.exception() is not None):
+                err = self._flusher.exception()
+            else:
+                err = RuntimeError(
+                    "serve.batch flusher died (event loop torn down?)")
+            self._fail_pending(err)
+        self._loop = loop
+        self.queue = asyncio.Queue()
+        self._flusher = loop.create_task(self._flush_loop())
+
+    def _fail_pending(self, err: BaseException):
+        """Propagate a flusher death to everything still queued — their
+        futures may belong to an already-closed loop, so failures to
+        set are swallowed (the awaiter is gone with its loop)."""
+        while self.queue is not None and not self.queue.empty():
+            _, f = self.queue.get_nowait()
+            try:
+                if not f.done():
+                    f.set_exception(err)
+            except Exception:
+                pass
 
     async def submit(self, item: Any) -> Any:
         self._ensure()
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         self.queue.put_nowait((item, fut))
         return await fut
 
@@ -42,35 +72,42 @@ class _BatchQueue:
         while True:
             item, fut = await self.queue.get()
             batch = [(item, fut)]
-            deadline = asyncio.get_event_loop().time() \
-                + self.batch_wait_timeout_s
-            while len(batch) < self.max_batch_size:
-                remaining = deadline - asyncio.get_event_loop().time()
-                if remaining <= 0:
-                    break
-                try:
-                    batch.append(await asyncio.wait_for(
-                        self.queue.get(), timeout=remaining))
-                except asyncio.TimeoutError:
-                    break
-            items = [b[0] for b in batch]
-            futs = [b[1] for b in batch]
             try:
-                out = self.fn(items)
+                deadline = asyncio.get_event_loop().time() \
+                    + self.batch_wait_timeout_s
+                while len(batch) < self.max_batch_size:
+                    remaining = deadline - asyncio.get_event_loop().time()
+                    if remaining <= 0:
+                        break
+                    try:
+                        batch.append(await asyncio.wait_for(
+                            self.queue.get(), timeout=remaining))
+                    except asyncio.TimeoutError:
+                        break
+                out = self.fn([b[0] for b in batch])
                 if inspect.iscoroutine(out):
                     out = await out
                 if not isinstance(out, (list, tuple)) \
-                        or len(out) != len(items):
+                        or len(out) != len(batch):
                     raise TypeError(
                         f"@serve.batch function must return a list of "
-                        f"{len(items)} results, got {type(out).__name__}")
-                for f, r in zip(futs, out):
+                        f"{len(batch)} results, got {type(out).__name__}")
+                for (_, f), r in zip(batch, out):
                     if not f.done():
                         f.set_result(r)
             except BaseException as e:
-                for f in futs:
-                    if not f.done():
-                        f.set_exception(e)
+                # fn errors scatter to the batch and the flusher lives
+                # on; cancellation (loop teardown) also fails the batch
+                # it was holding — hung futures were the old failure
+                # mode — then propagates so _ensure can re-arm later
+                for _, f in batch:
+                    try:
+                        if not f.done():
+                            f.set_exception(e)
+                    except Exception:
+                        pass
+                if isinstance(e, (asyncio.CancelledError, GeneratorExit)):
+                    raise
 
 
 def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 10,

@@ -1,21 +1,27 @@
-"""LLM serving: batched KV-cache generation behind a Serve deployment.
+"""LLM serving: a continuous-batching inference engine behind Serve.
 
 The reference serves LLMs by delegating to an external engine (vLLM) and
 wiring it into Serve; here decoding is the framework's own jit program
-(models/gpt.py: init_cache/decode_step/generate), so the deployment is a
-thin batching + streaming shell around compiled code:
+(models/gpt.py), and by default each replica hosts a **continuous-
+batching engine** over a **paged KV cache** (serve/_engine.py): one
+fixed-shape compiled step program over a slot batch, sequences joining
+at prefill and leaving at EOS/max-tokens at every decode step, pages
+refcounted with live prompt-prefix sharing and copy-on-write.  Both the
+request/response route and token streaming ride the same engine, so a
+short request never waits behind a long one.
 
-  * non-streaming requests are micro-batched (serve.batch) and grouped
-    by (prompt_len, max_new, sampling params, seed) so each group runs
-    as ONE compiled generate() call.  Requests batch together only when
-    prompt lengths match exactly (token-id prompts are not padded —
-    left-pads would enter the causal window); the KV-cache length is
-    bucketed to multiples of 128 so max_new variations reuse compiles;
-  * streaming requests prefill the whole prompt as ONE jit program,
-    then loop a fused on-device sample+decode step (one compile per
-    cache bucket; only the 4-byte token id crosses to host per step)
-    and yield tokens as they are sampled — through Serve's generator
-    streaming this is SSE/chunked-transfer token streaming end to end.
+Engine selection (``RAY_TPU_SERVE_ENGINE`` or ``engine=`` at bind time):
+
+  * ``paged`` (default) — continuous batching, paged KV arena;
+  * ``contiguous`` — continuous batching over per-slot contiguous
+    caches (the bitwise-parity baseline for the paged path);
+  * ``static`` — the legacy ``serve.batch`` micro-batching path:
+    requests grouped by (prompt_len, max_new, sampling params, seed),
+    each group one stacked ``generate()`` call, streaming via a
+    dedicated per-request prefill + fused sample/decode step loop.
+
+All engine sizing knobs (slots, page size, arena pages, admission
+watermarks) are the ``RAY_TPU_SERVE_*`` flags in _private/config.py.
 
 Prompts and completions are token-id lists: tokenizers are deliberately
 out of scope (bring your own; nothing here depends on one).
@@ -27,6 +33,7 @@ import functools
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
+from .._private.config import cfg as _config
 from ._deployment import deployment
 from .api import run
 from .batching import batch
@@ -47,7 +54,9 @@ class _LLMServerImpl:
     `LLMServer().bind(params_loader=lambda: from_hf_gpt2("gpt2"))`."""
 
     def __init__(self, preset: str = "nano", cfg_kwargs: Optional[dict] = None,
-                 params_loader=None, max_seq: int = 512):
+                 params_loader=None, max_seq: int = 512,
+                 engine: Optional[str] = None,
+                 engine_kwargs: Optional[dict] = None):
         import jax
 
         from ray_tpu.models import gpt
@@ -80,7 +89,39 @@ class _LLMServerImpl:
         # bounded: a long-lived replica facing varied (max_new, temp,
         # top_k) tuples must not grow compile-cache memory without limit
         self._gen_cache: "OrderedDict[tuple, Any]" = OrderedDict()
-        self._gen_cache_cap = 8
+        self._gen_cache_cap = _config().serve_gen_cache_cap
+        self._engine_mode = engine or _config().serve_engine
+        if self._engine_mode not in ("paged", "contiguous", "static"):
+            raise ValueError(f"unknown engine {self._engine_mode!r}")
+        self._engine_kwargs = dict(engine_kwargs or {})
+        self._engine = None   # built lazily: direct construction (tests,
+        #                       tooling) must not allocate the device arena
+
+    def _get_engine(self):
+        if self._engine is None:
+            from ._engine import ContinuousEngine
+
+            c = _config()
+            kw = dict(cache=self._engine_mode,
+                      max_slots=c.serve_max_slots,
+                      page_size=c.serve_page_size,
+                      num_pages=c.serve_num_pages,
+                      max_total=c.serve_max_total,
+                      queue_cap=c.serve_queue_cap,
+                      shed_queue_depth=c.serve_shed_queue_depth,
+                      retry_after_s=c.serve_retry_after_s,
+                      prefill_bucket=c.serve_prefill_bucket)
+            kw.update(self._engine_kwargs)
+            self._engine = ContinuousEngine(self._gpt, self._cfg,
+                                            self._params, **kw)
+        return self._engine
+
+    def engine_stats(self) -> Optional[Dict[str, Any]]:
+        """Scheduler snapshot for the replica metrics poll (None until
+        the engine has processed its first request, or in static mode)."""
+        if self._engine is None:
+            return None
+        return self._engine.engine_stats()
 
     def _cached(self, key, build):
         """LRU-bounded compiled-program cache (every jitted variant a
@@ -199,14 +240,24 @@ class _LLMServerImpl:
 
     def stream_tokens(self, tokens: List[int], max_new_tokens: int = 16,
                       temperature: float = 0.0, seed: int = 0,
-                      top_k: Optional[int] = None):
+                      top_k: Optional[int] = None,
+                      eos_id: Optional[int] = None):
         """Yield one sampled token id at a time (generator => Serve
         streams it as SSE/chunked over HTTP, itemwise over handles).
-        Sampling shares gpt.sample_logits and the batched route's key
-        schedule (token-exact in f32; at bf16, fusion-order rounding
-        can flip near-tie logits)."""
+        Under the continuous engine the stream is fed by the shared
+        slot-batch step loop (tokens appear as the scheduler emits
+        them); in static mode it is a dedicated per-request decode
+        loop.  Sampling shares gpt.sample_logits and the batched
+        route's key schedule either way (token-exact in f32; at bf16,
+        fusion-order rounding can flip near-tie logits)."""
         import numpy as np
 
+        if self._engine_mode != "static":
+            eng = self._get_engine()
+            seq = eng.submit(tokens, max_new_tokens, temperature, seed,
+                             top_k, eos_id=eos_id, stream=True)
+            yield from eng.stream(seq)
+            return
         jax, gpt, cfg = self._jax, self._gpt, self._cfg
         if not tokens:
             raise ValueError("empty prompt: stream_tokens needs at "
@@ -230,6 +281,20 @@ class _LLMServerImpl:
                 logits, keys[max_new_tokens - 1])
             yield int(tok[0])
 
+    async def _engine_generate(self, body: Dict[str, Any]
+                               ) -> Dict[str, Any]:
+        """Request/response through the continuous engine: submit is a
+        queue append; the result future resolves on the engine thread
+        when the sequence leaves its slot."""
+        import asyncio
+
+        seq = self._get_engine().submit(
+            body["tokens"], int(body.get("max_new_tokens", 16)),
+            float(body.get("temperature", 0.0)),
+            int(body.get("seed", 0)), body.get("top_k"),
+            eos_id=body.get("eos_id"))
+        return await asyncio.wrap_future(seq.result)
+
     async def __call__(self, request):
         # handle calls pass the body dict directly; HTTP passes a Request
         is_http = not isinstance(request, dict)
@@ -246,7 +311,10 @@ class _LLMServerImpl:
             return self.stream_tokens(
                 body["tokens"], int(body.get("max_new_tokens", 16)),
                 float(body.get("temperature", 0.0)),
-                int(body.get("seed", 0)), body.get("top_k"))
+                int(body.get("seed", 0)), body.get("top_k"),
+                body.get("eos_id"))
+        if self._engine_mode != "static":
+            return await self._engine_generate(body)
         return await self.generate_batch(body)
 
 

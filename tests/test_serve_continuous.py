@@ -1,0 +1,457 @@
+"""Continuous-batching engine (serve/_engine.py) + paged KV cache
+(models/gpt.py paged_* / slot_*): scheduler correctness, paged vs
+contiguous parity, prefix sharing / copy-on-write, admission control,
+and the serve.batch / router regression fixes that rode along.
+
+Everything here is in-process (no cluster): the engine is a plain
+object plus a daemon thread, and the jit programs run on CPU.
+"""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import gpt
+from ray_tpu.serve._engine import (AdmissionRejected, ContinuousEngine,
+                                   PageAllocator)
+
+MAX_SEQ = 64
+PROMPT = [3, 14, 15, 92, 6, 5]
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = gpt.GPTConfig.nano(max_seq=MAX_SEQ)
+    params = gpt.init(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _make_engine(model, cache="paged", **kw):
+    cfg, params = model
+    defaults = dict(cache=cache, max_slots=4, page_size=8,
+                    prefill_bucket=8)
+    defaults.update(kw)
+    return ContinuousEngine(gpt, cfg, params, **defaults)
+
+
+def _expected(model, prompt, max_new, temperature=0.0, seed=0,
+              top_k=None):
+    cfg, params = model
+    out = gpt.generate(params, cfg, jnp.asarray([prompt]), max_new,
+                       temperature=temperature, top_k=top_k,
+                       rng=jax.random.PRNGKey(seed), max_seq=MAX_SEQ)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+# ---------------------------------------------------------------------------
+# parity
+
+
+def test_paged_matches_contiguous_and_generate_greedy(model):
+    prompts = [PROMPT, [7, 9, 2], list(range(1, 18))]
+    outs = {}
+    for mode in ("paged", "contiguous"):
+        eng = _make_engine(model, cache=mode)
+        try:
+            seqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+            outs[mode] = [eng.collect(s, timeout=120)["completion"]
+                          for s in seqs]
+        finally:
+            eng.stop()
+    # paged gathers its pages into the same [B, H, S, dh] attention
+    # view the contiguous cache holds natively: bitwise-identical
+    assert outs["paged"] == outs["contiguous"]
+    for p, got in zip(prompts, outs["paged"]):
+        assert got == _expected(model, p, 6)
+
+
+def test_sampled_decode_matches_generate(model):
+    # same per-request key schedule as gpt.generate => parity holds for
+    # sampled decodes too, not just greedy
+    eng = _make_engine(model)
+    try:
+        s = eng.submit(PROMPT, max_new_tokens=8, temperature=0.8,
+                       seed=123, top_k=16)
+        got = eng.collect(s, timeout=120)["completion"]
+    finally:
+        eng.stop()
+    assert got == _expected(model, PROMPT, 8, temperature=0.8,
+                            seed=123, top_k=16)
+
+
+# ---------------------------------------------------------------------------
+# scheduling
+
+
+def test_join_and_evict_mid_step(model):
+    """A short request submitted after a long one is already decoding
+    joins the running batch and finishes first — no batch-boundary
+    stall — and every completion still matches the reference decode."""
+    eng = _make_engine(model, max_slots=2)
+    try:
+        long = eng.submit(PROMPT, max_new_tokens=20)
+        # wait until the long sequence is actually in a slot
+        deadline = time.time() + 60
+        while eng.engine_stats()["active"] == 0:
+            assert time.time() < deadline
+            time.sleep(0.005)
+        short = eng.submit([7, 9, 2], max_new_tokens=3)
+        r_short = eng.collect(short, timeout=120)
+        r_long = eng.collect(long, timeout=120)
+        assert r_short["completion"] == _expected(model, [7, 9, 2], 3)
+        assert r_long["completion"] == _expected(model, PROMPT, 20)
+        # the short one co-resided with the long one
+        assert r_short["batch_size"] >= 2
+        st = eng.engine_stats()
+        assert st["active"] == 0
+        assert st["free_pages"] == st["num_pages"] - 1
+    finally:
+        eng.stop()
+
+
+def test_eos_evicts_early(model):
+    eng = _make_engine(model)
+    try:
+        ref = _expected(model, PROMPT, 8)
+        eos = ref[2]
+        s = eng.submit(PROMPT, max_new_tokens=8, eos_id=eos)
+        got = eng.collect(s, timeout=120)["completion"]
+    finally:
+        eng.stop()
+    # stops AT the first eos occurrence, inclusive
+    assert got == ref[:ref.index(eos) + 1]
+
+
+def test_streaming_interleaved_order(model):
+    """Two streams driven concurrently: each consumer sees its own
+    tokens, in order, matching the non-streaming result."""
+    eng = _make_engine(model, max_slots=4)
+    try:
+        prompts = [PROMPT, [11, 4, 8, 2]]
+        seqs = [eng.submit(p, max_new_tokens=10, stream=True)
+                for p in prompts]
+        got = [[] for _ in prompts]
+
+        def drain(i):
+            for tok in eng.stream(seqs[i]):
+                got[i].append(tok)
+
+        threads = [threading.Thread(target=drain, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive()
+        for p, g in zip(prompts, got):
+            assert g == _expected(model, p, 10)
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# paged allocator: prefix sharing + copy-on-write
+
+
+def test_page_allocator_share_and_release():
+    a = PageAllocator(num_pages=8, page_size=4)
+    toks = list(range(100, 110))   # 10 tokens: 2 full pages + tail
+    plan = a.plan(toks, 3)
+    assert plan["shared_len"] == 0 and not plan["copies"]
+    assert len(plan["pages"]) == 3
+    for i in range(2):             # register the two full pages
+        a.register_prefix(tuple(toks[:(i + 1) * 4]), plan["pages"][i])
+
+    # a second sequence with the same first 8 tokens shares both pages
+    plan2 = a.plan(toks[:8] + [7, 7], 3)
+    assert plan2["shared_len"] == 8 and plan2["n_shared"] == 2
+    assert plan2["pages"][:2] == plan["pages"][:2]
+    assert not plan2["copies"]
+    assert a.refcount(plan["pages"][0]) == 2
+
+    # release the second: shared pages survive (first still holds them)
+    a.release(plan2["pages"])
+    assert a.refcount(plan["pages"][0]) == 1
+    # release the first: registry purged, pages return to the free list
+    a.release(plan["pages"])
+    assert a.free_pages == 7
+    assert a.lookup_prefix(tuple(toks[:4])) is None
+
+
+def test_page_allocator_cow_on_exact_match():
+    """A prompt fully covered by registered pages must still recompute
+    its LAST position (it produces the first logits), so the final
+    shared page is copy-on-write'd into a private one."""
+    a = PageAllocator(num_pages=8, page_size=4)
+    toks = list(range(50, 58))     # exactly 2 pages
+    plan = a.plan(toks, 3)
+    for i in range(2):
+        a.register_prefix(tuple(toks[:(i + 1) * 4]), plan["pages"][i])
+    plan2 = a.plan(toks, 3)        # identical prompt
+    assert plan2["shared_len"] == 7          # clamped to plen - 1
+    assert len(plan2["copies"]) == 1
+    src, dst = plan2["copies"][0]
+    assert src == plan["pages"][1] and dst == plan2["pages"][1]
+    assert plan2["pages"][1] != plan["pages"][1]   # private copy
+    assert a.refcount(src) == 1    # COW did not ref the source
+
+
+def test_page_allocator_starved_plan_takes_no_refs():
+    a = PageAllocator(num_pages=4, page_size=4)
+    p1 = a.plan([1] * 8, 3)        # takes all 3 usable pages
+    assert p1 is not None and a.free_pages == 0
+    assert a.plan([2] * 8, 2) is None
+    a.release(p1["pages"])
+    assert a.free_pages == 3
+
+
+def test_prefix_sharing_cow_end_to_end(model):
+    """Two identical page-aligned prompts CO-RESIDENT in the engine
+    (sharing is live-sequence only): pages shared, one COW copy,
+    identical leading completions, full reclamation afterwards — and a
+    third distinct prompt is unaffected."""
+    prompt = list(range(40, 56))           # 16 tokens = 2 pages of 8
+    eng = _make_engine(model, max_slots=4)
+    try:
+        a = eng.submit(prompt, max_new_tokens=24)
+        # b must join while a is still live so a's registered prompt
+        # pages are shareable
+        deadline = time.time() + 60
+        while eng.engine_stats()["prefills"] < 1:
+            assert time.time() < deadline
+            time.sleep(0.005)
+        b = eng.submit(prompt, max_new_tokens=5)
+        c = eng.submit([9, 9, 1], max_new_tokens=5)
+        rb = eng.collect(b, timeout=120)
+        rc = eng.collect(c, timeout=120)
+        ra = eng.collect(a, timeout=120)
+        st = eng.engine_stats()
+    finally:
+        eng.stop()
+    assert ra["completion"] == _expected(model, prompt, 24)
+    assert rb["completion"] == ra["completion"][:5]
+    assert rc["completion"] == _expected(model, [9, 9, 1], 5)
+    assert st["shared_pages"] >= 1
+    assert st["cow_copies"] >= 1
+    assert st["free_pages"] == st["num_pages"] - 1
+
+
+# ---------------------------------------------------------------------------
+# admission control
+
+
+def test_oversized_request_rejected_up_front(model):
+    eng = _make_engine(model, num_pages=3)    # 2 usable pages = 16 toks
+    try:
+        with pytest.raises(ValueError, match="pages"):
+            eng.submit(list(range(20)), max_new_tokens=8)
+        # a fitting request still goes through
+        s = eng.submit(PROMPT, max_new_tokens=4)
+        assert len(eng.collect(s, timeout=120)["completion"]) == 4
+    finally:
+        eng.stop()
+
+
+def test_queue_cap_sheds_with_retry_after(model):
+    cfg, params = model
+    eng = ContinuousEngine(gpt, cfg, params, max_slots=1, page_size=8,
+                           prefill_bucket=8, queue_cap=2,
+                           shed_queue_depth=1, retry_after_s=2.5)
+    try:
+        first = eng.submit(PROMPT, max_new_tokens=40)
+        deadline = time.time() + 60         # wait until it holds the slot
+        while eng.engine_stats()["active"] < 1:
+            assert time.time() < deadline
+            time.sleep(0.005)
+        q1 = eng.submit(PROMPT, max_new_tokens=4)
+        q2 = eng.submit(PROMPT, max_new_tokens=4)   # queue at cap
+        with pytest.raises(AdmissionRejected) as ei:
+            eng.submit(PROMPT, max_new_tokens=4)
+        assert ei.value.retry_after_s == 2.5
+        st = eng.engine_stats()
+        assert st["rejected"] >= 1
+        assert st["accepting"] is False          # past shed watermark
+        for s in (first, q1, q2):
+            eng.collect(s, timeout=300)
+    finally:
+        eng.stop()
+
+
+def test_page_starved_request_waits_not_fails(model):
+    """A request that fits the arena but not RIGHT NOW parks at the
+    queue head and admits once pages free up."""
+    eng = _make_engine(model, max_slots=2, num_pages=5)  # 4 usable
+    try:
+        a = eng.submit(list(range(10)), max_new_tokens=10)  # 3 pages
+        b = eng.submit(list(range(20, 28)), max_new_tokens=10)  # needs 3
+        rb = eng.collect(b, timeout=300)
+        ra = eng.collect(a, timeout=300)
+    finally:
+        eng.stop()
+    assert ra["completion"] == _expected(model, list(range(10)), 10)
+    assert rb["completion"] == _expected(model, list(range(20, 28)), 10)
+
+
+def test_engine_stats_shape(model):
+    eng = _make_engine(model)
+    try:
+        s = eng.submit(PROMPT, max_new_tokens=4)
+        eng.collect(s, timeout=120)
+        st = eng.engine_stats()
+    finally:
+        eng.stop()
+    for key in ("cache", "active", "free_slots", "queue_depth",
+                "free_pages", "num_pages", "accepting", "retry_after_s",
+                "ttft_p50_s", "ttft_p99_s", "tokens_per_s", "requests",
+                "tokens", "steps", "prefills"):
+        assert key in st, key
+    assert st["cache"] == "paged"
+    assert st["requests"] == 1 and st["tokens"] == 4
+    assert st["ttft_p99_s"] > 0
+    assert eng.phase_ring()                      # phases were recorded
+
+
+def test_stop_fails_waiting_requests(model):
+    cfg, params = model
+    eng = ContinuousEngine(gpt, cfg, params, max_slots=1, page_size=8,
+                           prefill_bucket=8)
+    running = eng.submit(PROMPT, max_new_tokens=8)
+    waiting = eng.submit(PROMPT, max_new_tokens=8)
+    eng.stop()
+    with pytest.raises(RuntimeError):
+        eng.collect(waiting, timeout=10)
+    with pytest.raises(RuntimeError):
+        eng.submit(PROMPT)
+    del running
+
+
+# ---------------------------------------------------------------------------
+# serve.batch flusher regressions
+
+
+def test_batch_flusher_propagates_fn_error_and_recovers():
+    from ray_tpu.serve.batching import batch
+
+    calls = {"n": 0}
+
+    @batch(max_batch_size=4, batch_wait_timeout_s=0.01)
+    async def f(items):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("boom")
+        return [x * 2 for x in items]
+
+    async def main():
+        with pytest.raises(RuntimeError, match="boom"):
+            await f(1)
+        # the flusher survived the fn error: the next batch works
+        assert await f(3) == 6
+
+    asyncio.run(main())
+
+
+def test_batch_flusher_rearms_across_event_loops():
+    """A new event loop (fresh asyncio.run) must get a fresh flusher
+    bound to IT — the old one died with its loop."""
+    from ray_tpu.serve.batching import batch
+
+    @batch(max_batch_size=4, batch_wait_timeout_s=0.01)
+    async def g(items):
+        return [x + 1 for x in items]
+
+    assert asyncio.run(g(1)) == 2
+    assert asyncio.run(g(10)) == 11      # second loop: re-armed
+
+
+# ---------------------------------------------------------------------------
+# router regressions
+
+
+def _fake_router(table):
+    from ray_tpu.serve import _router
+
+    r = _router.Router("app", "dep", controller=object())
+    r._refresh = lambda force=False: None
+    r._replicas = {row["replica_id"]: row for row in table}
+    return r
+
+
+def test_router_decrements_inflight_when_submit_raises():
+    class BadHandle:
+        class handle_request:
+            @staticmethod
+            def remote(*a, **k):
+                raise RuntimeError("actor died")
+
+    r = _fake_router([{"replica_id": "r1", "handle": BadHandle}])
+    with pytest.raises(RuntimeError):
+        r.assign(None, (), {}, {})
+    assert r._inflight.get("r1", 0) == 0
+
+
+def test_router_sheds_when_every_engine_stops_accepting():
+    from ray_tpu.serve._common import NoCapacityError
+
+    table = [{"replica_id": f"r{i}", "handle": None,
+              "engine": {"accepting": False, "retry_after_s": 3.0}}
+             for i in range(2)]
+    r = _fake_router(table)
+    with pytest.raises(NoCapacityError) as ei:
+        r._pick()
+    assert ei.value.retry_after_s == 3.0
+
+
+def test_router_skips_shedding_replica():
+    ok = {"replica_id": "ok", "handle": None,
+          "engine": {"accepting": True}}
+    shed = {"replica_id": "shed", "handle": None,
+            "engine": {"accepting": False, "retry_after_s": 1.0}}
+    r = _fake_router([ok, shed])
+    for _ in range(8):
+        assert r._pick()["replica_id"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# config knobs
+
+
+def test_serve_knobs_resolve_from_env(monkeypatch):
+    from ray_tpu._private.config import Config
+
+    monkeypatch.setenv("RAY_TPU_SERVE_MAX_SLOTS", "3")
+    monkeypatch.setenv("RAY_TPU_SERVE_PAGE_SIZE", "4")
+    monkeypatch.setenv("RAY_TPU_SERVE_GEN_CACHE_CAP", "2")
+    monkeypatch.setenv("RAY_TPU_SERVE_ENGINE", "contiguous")
+    monkeypatch.delenv("RAY_TPU_SYSTEM_CONFIG", raising=False)
+    c = Config()
+    assert c.serve_max_slots == 3
+    assert c.serve_page_size == 4
+    assert c.serve_gen_cache_cap == 2
+    assert c.serve_engine == "contiguous"
+    assert c.is_set("serve_max_slots")
+    assert not c.is_set("serve_queue_cap")       # default untouched
+
+
+def test_llm_impl_reads_serve_knobs(monkeypatch, model):
+    from ray_tpu._private import config as _c
+    from ray_tpu.serve.llm import _LLMServerImpl
+
+    monkeypatch.setenv("RAY_TPU_SERVE_GEN_CACHE_CAP", "3")
+    monkeypatch.setenv("RAY_TPU_SERVE_ENGINE", "contiguous")
+    monkeypatch.delenv("RAY_TPU_SYSTEM_CONFIG", raising=False)
+    monkeypatch.setattr(_c, "_current", None)    # un-pin any system cfg
+    srv = _LLMServerImpl(preset="nano", max_seq=MAX_SEQ)
+    assert srv._gen_cache_cap == 3
+    assert srv._engine_mode == "contiguous"
+    # bind-time engine= beats the env knob
+    srv2 = _LLMServerImpl(preset="nano", max_seq=MAX_SEQ,
+                          engine="static")
+    assert srv2._engine_mode == "static"
+    with pytest.raises(ValueError):
+        _LLMServerImpl(preset="nano", max_seq=MAX_SEQ, engine="bogus")
