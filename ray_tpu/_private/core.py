@@ -499,11 +499,11 @@ class CoreWorker:
         # since its last pass as framed push_tasks batches.  Batch size
         # adapts to the submission rate (busy flusher -> bigger batches).
         self._flush_cv = threading.Condition()
-        self._flush_dirty: Set[SchedPool] = set()
+        self._flush_dirty: Set[SchedPool] = set()   # guarded-by: _flush_cv
         # telemetry: push_tasks batch-size histogram + flush-latency sums
         self._stats_lock = threading.Lock()
-        self._submit_hist: Dict[int, int] = {}
-        self._flush_stats = {"flushes": 0, "tasks": 0,
+        self._submit_hist: Dict[int, int] = {}      # guarded-by: _stats_lock
+        self._flush_stats = {"flushes": 0, "tasks": 0,  # guarded-by: _stats_lock
                              "latency_ms_total": 0.0, "latency_ms_max": 0.0}
         self._flush_thread = threading.Thread(
             target=self._submit_flush_loop, name="core-submit-flush",
@@ -1888,6 +1888,7 @@ class CoreWorker:
                 self._store_one(e, p["result"])
             d.resolve({"ok": True})
             return
+        ack = None
         with st.cv:
             if index < st.produced:
                 # duplicate from a retry/recovery attempt: usually
@@ -1898,23 +1899,28 @@ class CoreWorker:
                     e = self.objects.get(oid)
                 if e is not None and not e.ready:
                     self._store_one(e, p["result"])
-                d.resolve({"ok": True})
-                return
-            oid = common.object_id_for_return(tid, index)
-            with self.lock:
-                e = self.objects.get(oid) or self._new_entry(oid)
-                e.pins = max(e.pins, 1)
-                e.lineage = st.spec
-                self.local_ref_counts.setdefault(oid, 0)
-            self._store_one(e, p["result"])
-            st.produced = index + 1
-            st.ready.append(index)
-            st.cv.notify_all()
-            bp = st.spec.generator_backpressure
-            if bp and (st.produced - st.consumed) >= bp:
-                st.waiters.append(d)   # ack later, when consumed
+                ack = {"ok": True}
             else:
-                d.resolve({"ok": True})
+                oid = common.object_id_for_return(tid, index)
+                with self.lock:
+                    e = self.objects.get(oid) or self._new_entry(oid)
+                    e.pins = max(e.pins, 1)
+                    e.lineage = st.spec
+                    self.local_ref_counts.setdefault(oid, 0)
+                self._store_one(e, p["result"])
+                st.produced = index + 1
+                st.ready.append(index)
+                st.cv.notify_all()
+                bp = st.spec.generator_backpressure
+                if bp and (st.produced - st.consumed) >= bp:
+                    st.waiters.append(d)   # ack later, when consumed
+                else:
+                    ack = {"ok": True}
+        if ack is not None:
+            # the ack is a framed socket send (sock.sendall can block on
+            # a slow worker): never do it while holding st.cv, or every
+            # consumer in _next_stream_item stalls behind that socket
+            d.resolve(ack)
 
     def _next_stream_item(self, tid: str, timeout: Optional[float]):
         """Blocking pop of the next stream index -> ObjectRef (None =

@@ -62,8 +62,16 @@ class _ReplyBatcher:
                 if not batch:
                     self._sending = False
                     return
-            # push failure = owner gone; its on_disconnect reschedules
-            self._conn.push("tasks_done", batch)
+            try:
+                # push failure = owner gone; its on_disconnect reschedules
+                self._conn.push("tasks_done", batch)
+            except BaseException:
+                # push swallows OSError, but a serialization failure on
+                # one weird reply must not leave _sending stuck True —
+                # that would silently park every future ack in _pending
+                with self._lock:
+                    self._sending = False
+                raise
 
 
 class _BatchSlot:

@@ -1,0 +1,59 @@
+"""Seeded violations for the jax_purity pass (parsed, never imported).
+
+Expected findings:
+- side-effect        print in impure_print() and in kernel()
+- host-call          np.asarray and .item() in host_pull()
+- nondeterminism     random.random and time.time in nondet()
+- unhashable-static  list default of bad_static(); list literal at the
+                     caller() call site
+"""
+
+import functools
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+@jax.jit
+def impure_print(x):
+    print("tracing", x)
+    return x + 1
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def host_pull(x, block=(8, 8)):
+    y = np.asarray(x)
+    return jnp.sum(jnp.asarray(y)) + x.sum().item()
+
+
+@jax.jit
+def nondet(x):
+    return x * random.random() + time.time()
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def bad_static(x, cfg=[1, 2]):
+    return x
+
+
+def caller(x):
+    return bad_static(x, cfg=[3, 4])
+
+
+def kernel(x_ref, o_ref):
+    print("side effect")
+    o_ref[...] = x_ref[...]
+
+
+def run_kernel(x):
+    return pl.pallas_call(kernel, out_shape=x)(x)
+
+
+def clean(x):
+    # untraced: nothing here should be flagged
+    print("host side is fine")
+    return np.asarray(x)

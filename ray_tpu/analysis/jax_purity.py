@@ -1,0 +1,249 @@
+"""JAX-purity pass: impurity inside traced (jit/pjit/Pallas) functions.
+
+Traced-function discovery (all static, nothing is imported):
+
+- decorators: ``@jax.jit``, ``@jit``, ``@pjit``,
+  ``@functools.partial(jax.jit, ...)`` (and the pjit forms);
+- wrap-by-name: ``jax.jit(step)`` / ``jax.jit(functools.partial(step,
+  ...))`` anywhere in the module marks the def named ``step`` in that
+  module (including nested defs);
+- Pallas kernels: the first argument of ``pl.pallas_call(kernel, ...)``.
+
+Rules inside a traced body (nested defs included — they trace too):
+
+- ``side-effect``      ``print`` / ``open`` / ``global`` (``jax.debug.print``
+                       is allowed);
+- ``host-call``        ``np.*`` calls (dtype/iinfo-style constants are
+                       whitelisted) and ``.item()`` / ``.tolist()`` — these
+                       force a device->host sync per trace;
+- ``nondeterminism``   unseeded stdlib ``random.*``, ``np.random.*``,
+                       ``time.time/monotonic/perf_counter`` — baked in at
+                       trace time, silently frozen thereafter;
+- ``unhashable-static`` a ``static_argnames`` parameter with a mutable
+                       default, or a call site passing a list/dict/set
+                       literal for one — every such call recompiles.
+
+``# jax-ok`` on the offending line suppresses a site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ._model import Finding, FunctionInfo, Index, dotted
+
+PASS = "jax_purity"
+
+_NP_WHITELIST = {
+    "dtype", "float16", "float32", "float64", "int8", "int16", "int32",
+    "int64", "uint8", "uint16", "uint32", "uint64", "bool_", "bfloat16",
+    "iinfo", "finfo", "ndim", "shape", "issubdtype", "promote_types",
+    "result_type", "can_cast",
+}
+_TIME_FNS = {"time", "monotonic", "perf_counter", "time_ns",
+             "monotonic_ns", "perf_counter_ns"}
+
+
+def _jit_chain(chain: Optional[List[str]]) -> bool:
+    return bool(chain) and chain[-1] in ("jit", "pjit")
+
+
+def _decorated_static_names(dec: ast.expr) -> Set[str]:
+    """static_argnames from @functools.partial(jax.jit, static_argnames=..)"""
+    out: Set[str] = set()
+    if not isinstance(dec, ast.Call):
+        return out
+    for kw in dec.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else \
+                ([v] if isinstance(v, ast.Constant) else [])
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.add(e.value)
+    return out
+
+
+def _traced_functions(index: Index) -> Dict[Tuple[str, str], Set[str]]:
+    """(rel, qualname) -> static_argnames for every traced function."""
+    traced: Dict[Tuple[str, str], Set[str]] = {}
+    # pass 1: decorator-marked
+    for key, fn in index.functions.items():
+        node = fn.node
+        for dec in getattr(node, "decorator_list", []):
+            chain = dotted(dec)
+            if _jit_chain(chain):
+                traced.setdefault(key, set())
+                continue
+            if isinstance(dec, ast.Call):
+                fchain = dotted(dec.func)
+                if _jit_chain(fchain):
+                    traced.setdefault(key, set()).update(
+                        _decorated_static_names(dec))
+                elif fchain and fchain[-1] == "partial" and dec.args:
+                    if _jit_chain(dotted(dec.args[0])):
+                        traced.setdefault(key, set()).update(
+                            _decorated_static_names(dec))
+    # pass 2: wrap-by-name (jax.jit(step)) and pallas_call(kernel)
+    marked: Dict[str, Set[str]] = {}    # rel -> {bare names}
+    for m in index.modules:
+        names: Set[str] = set()
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func)
+            target: Optional[ast.expr] = None
+            if _jit_chain(chain) and node.args:
+                target = node.args[0]
+            elif chain and chain[-1] == "pallas_call" and node.args:
+                target = node.args[0]
+            if target is None:
+                continue
+            if isinstance(target, ast.Call):     # partial(fn, ...)
+                tch = dotted(target.func)
+                if tch and tch[-1] == "partial" and target.args:
+                    target = target.args[0]
+            ch = dotted(target)
+            if ch and len(ch) == 1:
+                names.add(ch[0])
+        if names:
+            marked[m.rel] = names
+    for key, fn in index.functions.items():
+        rel, qual = key
+        bare = qual.rsplit(".", 1)[-1]
+        if bare in marked.get(rel, ()):
+            traced.setdefault(key, set())
+    return traced
+
+
+def run(index: Index) -> List[Finding]:
+    traced = _traced_functions(index)
+    findings: List[Finding] = []
+    for key, statics in sorted(traced.items()):
+        fn = index.functions[key]
+        findings.extend(_check_body(index, fn, statics))
+        findings.extend(_check_static_defaults(fn, statics))
+    # call-site check for unhashable static literals, module-local by name
+    by_name: Dict[Tuple[str, str], Set[str]] = {}
+    for (rel, qual), statics in traced.items():
+        if statics:
+            by_name[(rel, qual.rsplit(".", 1)[-1])] = statics
+    if by_name:
+        findings.extend(_check_call_sites(index, by_name))
+    return findings
+
+
+def _ok(fn: FunctionInfo, line: int) -> bool:
+    return "# jax-ok" in fn.module.line_text(line)
+
+
+def _check_body(index: Index, fn: FunctionInfo,
+                statics: Set[str]) -> List[Finding]:
+    out: List[Finding] = []
+    mod = fn.module
+    np_names = {k for k, v in mod.imports.items() if v == "numpy"}
+    has_np = bool(np_names)
+    has_random = mod.imports.get("random", "") == "random"
+    has_time = mod.imports.get("time", "") == "time"
+
+    def add(rule: str, detail: str, msg: str, line: int) -> None:
+        if not _ok(fn, line):
+            out.append(Finding(PASS, rule, mod.rel, fn.qualname,
+                               detail, msg, line))
+
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Global):
+            add("side-effect", "global",
+                f"`global` statement inside traced {fn.qualname}",
+                node.lineno)
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        chain = dotted(node.func)
+        if chain == ["print"] or chain == ["open"]:
+            add("side-effect", chain[0],
+                f"{chain[0]}() inside traced {fn.qualname} runs at "
+                f"trace time only (use jax.debug.{chain[0]})",
+                node.lineno)
+        elif chain and chain[0] in np_names and len(chain) >= 2 \
+                and has_np:
+            if chain[1] == "random":
+                add("nondeterminism", ".".join(chain),
+                    f"unseeded {'.'.join(chain)} inside traced "
+                    f"{fn.qualname} is frozen at trace time",
+                    node.lineno)
+            elif chain[-1] not in _NP_WHITELIST:
+                add("host-call", ".".join(chain),
+                    f"host numpy call {'.'.join(chain)} inside traced "
+                    f"{fn.qualname} forces device->host sync",
+                    node.lineno)
+        elif chain and chain[0] == "random" and has_random \
+                and len(chain) == 2:
+            add("nondeterminism", ".".join(chain),
+                f"unseeded stdlib {'.'.join(chain)} inside traced "
+                f"{fn.qualname} is frozen at trace time", node.lineno)
+        elif chain and chain[0] == "time" and has_time \
+                and len(chain) == 2 and chain[1] in _TIME_FNS:
+            add("nondeterminism", ".".join(chain),
+                f"{'.'.join(chain)} inside traced {fn.qualname} is "
+                f"frozen at trace time", node.lineno)
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("item", "tolist") \
+                and not node.args:
+            add("host-call", f".{node.func.attr}",
+                f".{node.func.attr}() inside traced {fn.qualname} "
+                f"forces device->host sync", node.lineno)
+    return out
+
+
+def _check_static_defaults(fn: FunctionInfo,
+                           statics: Set[str]) -> List[Finding]:
+    out: List[Finding] = []
+    args = fn.node.args
+    defaults = list(args.defaults)
+    # align trailing defaults with trailing positional args
+    pos = list(args.posonlyargs) + list(args.args)
+    pos_with_default = pos[len(pos) - len(defaults):] if defaults else []
+    pairs = list(zip(pos_with_default, defaults)) + [
+        (a, d) for a, d in zip(args.kwonlyargs, args.kw_defaults)
+        if d is not None]
+    for a, d in pairs:
+        if a.arg in statics and isinstance(
+                d, (ast.List, ast.Dict, ast.Set)):
+            if not _ok(fn, d.lineno):
+                out.append(Finding(
+                    PASS, "unhashable-static", fn.module.rel,
+                    fn.qualname, f"default:{a.arg}",
+                    f"static arg {a.arg!r} of traced {fn.qualname} has "
+                    f"an unhashable {type(d).__name__.lower()} default "
+                    f"(jit will raise / recompile)", d.lineno))
+    return out
+
+
+def _check_call_sites(index: Index,
+                      by_name: Dict[Tuple[str, str], Set[str]]
+                      ) -> List[Finding]:
+    out: List[Finding] = []
+    for (rel, qual), fn in index.functions.items():
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func)
+            if not chain or len(chain) != 1:
+                continue
+            statics = by_name.get((rel, chain[0]))
+            if not statics:
+                continue
+            for kw in node.keywords:
+                if kw.arg in statics and isinstance(
+                        kw.value, (ast.List, ast.Dict, ast.Set)):
+                    if not _ok(fn, node.lineno):
+                        out.append(Finding(
+                            PASS, "unhashable-static", rel, qual,
+                            f"call:{chain[0]}:{kw.arg}",
+                            f"unhashable literal passed for static arg "
+                            f"{kw.arg!r} of {chain[0]} in {qual} "
+                            f"(recompiles on every call)",
+                            node.lineno))
+    return out

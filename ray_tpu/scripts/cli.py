@@ -465,6 +465,41 @@ def cmd_memory(args):
     print(json.dumps(state.summarize_objects(address=address), indent=2))
 
 
+def cmd_analyze(args):
+    from ray_tpu import analysis
+    from ray_tpu.analysis import baseline as bl
+
+    findings = analysis.run_analysis(args.paths or None)
+    bl_path = args.baseline or bl.default_path()
+    if args.update_baseline:
+        bl.save(bl_path, findings)
+        print(f"baseline updated: {len(findings)} finding(s) -> {bl_path}")
+        return
+    known = bl.load(bl_path)
+    new, suppressed, stale = bl.diff(findings, known)
+    if args.format == "json":
+        print(json.dumps({
+            "new": [{"key": f.key, "line": f.line, "file": f.file,
+                     "message": f.message} for f in new],
+            "suppressed": len(suppressed),
+            "stale": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f"NEW  {f.render()}")
+        if args.verbose:
+            for f in suppressed:
+                print(f"okay {f.render()}  [baselined]")
+        for k in stale:
+            print(f"stale baseline entry (fixed?): {k}")
+        print(f"analyze: {len(new)} new, {len(suppressed)} baselined, "
+              f"{len(stale)} stale")
+    if new:
+        print("new findings: fix them or re-run with --update-baseline",
+              file=sys.stderr)
+        raise SystemExit(1)
+
+
 # -- parser ------------------------------------------------------------------
 
 def build_parser() -> argparse.ArgumentParser:
@@ -574,6 +609,22 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address", default=None)
     sp.add_argument("--format", choices=("text", "json"), default="text")
     sp.set_defaults(fn=cmd_remediations)
+
+    sp = sub.add_parser(
+        "analyze",
+        help="static concurrency/JAX-purity analysis (AST-based)")
+    sp.add_argument("paths", nargs="*",
+                    help="files or directories (default: the ray_tpu "
+                         "package)")
+    sp.add_argument("--baseline", default=None,
+                    help="baseline JSON path (default: "
+                         "<repo>/analysis_baseline.json)")
+    sp.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from this scan")
+    sp.add_argument("--format", choices=("text", "json"), default="text")
+    sp.add_argument("-v", "--verbose", action="store_true",
+                    help="also list baselined findings")
+    sp.set_defaults(fn=cmd_analyze)
 
     sp = sub.add_parser("memory", help="object store summary")
     sp.add_argument("--address", default=None)

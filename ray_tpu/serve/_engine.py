@@ -301,7 +301,7 @@ class ContinuousEngine:
         self.prefill_bucket = int(prefill_bucket)
 
         self._lock = threading.Lock()
-        self._waiting: "deque[_Sequence]" = deque()
+        self._waiting: "deque[_Sequence]" = deque()   # guarded-by: _lock
         self._slots: List[Optional[_Sequence]] = [None] * self.max_slots
         self._alloc = (PageAllocator(self.num_pages, self.page_size)
                        if cache == "paged" else None)
@@ -327,9 +327,9 @@ class ContinuousEngine:
         self._topks = np.zeros(B, np.int32)
 
         # telemetry: per-iteration phase ring + running totals
-        self._ring: "deque[Dict[str, float]]" = deque(maxlen=ring_size)
-        self._ttfts: "deque[float]" = deque(maxlen=256)
-        self._t_window: "deque[Tuple[float, int]]" = deque(maxlen=512)
+        self._ring: "deque[Dict[str, float]]" = deque(maxlen=ring_size)  # guarded-by: _lock
+        self._ttfts: "deque[float]" = deque(maxlen=256)        # guarded-by: _lock
+        self._t_window: "deque[Tuple[float, int]]" = deque(maxlen=512)  # guarded-by: _lock
         self._totals = {"requests": 0, "rejected": 0, "tokens": 0,
                         "steps": 0, "prefills": 0, "cow_copies": 0,
                         "shared_pages": 0}
@@ -498,6 +498,7 @@ class ContinuousEngine:
                "active": stepped, "admitted": admitted, "ts": t2}
         with self._lock:
             self._ring.append(rec)
+            qd = len(self._waiting)
         m = _m_phase()
         if m:
             if admitted:
@@ -507,7 +508,7 @@ class ContinuousEngine:
             if stepped:
                 m.observe(rec["decode_s"], tags={"phase": "decode"})
         for which, val in (("active", stepped),
-                           ("queue", len(self._waiting)),
+                           ("queue", qd),
                            ("free_pages",
                             self._alloc.free_pages if self._alloc else 0)):
             g = _m_gauge(which)

@@ -28,6 +28,9 @@ class Router:
         self.deployment_name = deployment_name
         self._controller = controller
         self._lock = threading.Lock()
+        # signaled whenever _refresh lands a new replica table, so _pick
+        # waiters wake immediately instead of polling on a sleep
+        self._table_cv = threading.Condition(self._lock)
         self._replicas: Dict[str, Dict[str, Any]] = {}
         self._max_ongoing = 100
         self._inflight: Dict[str, int] = {}
@@ -53,6 +56,7 @@ class Router:
                 if rid not in self._replicas:
                     del self._inflight[rid]
             self._last_refresh = now
+            self._table_cv.notify_all()
 
     def _pick(self, model_id: Optional[str] = None) -> Dict[str, Any]:
         deadline = time.monotonic() + 30.0
@@ -60,14 +64,17 @@ class Router:
             self._refresh()
             with self._lock:
                 cands = list(self._replicas.values())
-            if cands:
-                break
-            if time.monotonic() > deadline:
-                raise RuntimeError(
-                    f"no RUNNING replicas of "
-                    f"{self.app_name}:{self.deployment_name}")
-            time.sleep(0.05)
-            self._last_refresh = 0.0  # force re-pull
+                if cands:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RuntimeError(
+                        f"no RUNNING replicas of "
+                        f"{self.app_name}:{self.deployment_name}")
+                self._last_refresh = 0.0  # force re-pull next loop
+                # wake as soon as any thread's _refresh lands replicas
+                # (the timeout only bounds the controller re-poll cadence)
+                self._table_cv.wait(timeout=min(0.25, remaining))
         if model_id is not None:
             warm = [c for c in cands if model_id in c.get("model_ids", ())]
             if warm:
