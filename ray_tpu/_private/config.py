@@ -160,6 +160,24 @@ CONFIG_DEFS: List[Tuple[str, type, Any, str]] = [
     ("serve_prefill_bucket", int, 32,
      "prefill token chunks are padded to multiples of this (bounds "
      "prefill compile variants to max_total/bucket)"),
+    ("serve_replay_budget", int, 2,
+     "replays per request after a replica dies mid-call (actor-died / "
+     "unreachable); exhausting the budget surfaces the ORIGINAL error"),
+    ("serve_call_deadline_s", float, 0.0,
+     "per-attempt deadline after which an unanswered replica call is "
+     "treated as a dead replica and replayed elsewhere; 0 = disabled "
+     "(rely on actor-death detection only)"),
+    ("serve_health_check_period_s", float, 2.0,
+     "controller-driven replica check_health probe cadence"),
+    ("serve_health_check_timeout_s", float, 10.0,
+     "an unanswered check_health probe older than this marks the "
+     "replica wedged and restarts it"),
+    ("serve_engine_stall_s", float, 10.0,
+     "check_health fails when the engine has active slots but its step "
+     "counter has not advanced for this long (hung jit step)"),
+    ("serve_drain_grace_s", float, 10.0,
+     "drain window granted to a replica's in-flight requests when its "
+     "node is preempted without an explicit deadline"),
     # -- misc
     ("usage_stats_enabled", bool, True, "local usage tagging"),
     ("log_to_driver_batch_lines", int, 200,

@@ -2750,7 +2750,11 @@ class CoreWorker:
             self._maybe_release_actor(actor_id)
             return
         try:
-            self._owner_client(owner_addr).notify(
+            # short timeout → the dead-owner negative cache applies: this
+            # runs from __del__ via flush_pending_deletes/_delete_loop, and
+            # dropping N handles of a killed actor must not stall put() and
+            # gc in 30s connect-retry quanta (same fix as object del_ref)
+            self._owner_client(owner_addr, connect_timeout=2.0).notify(
                 "actor_del_ref", {"actor_id": actor_id,
                                   "borrower": self.worker_id})
         except Exception:

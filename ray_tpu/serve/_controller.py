@@ -43,8 +43,12 @@ class _ReplicaState:
         self.model_ids: List[str] = []
         self.engine: Optional[Dict[str, Any]] = None  # decode-engine stats
         self.last_health_ts = time.time()
-        self.health_ref = None
+        self.health_ref = None       # in-flight check_health probe
+        self.health_fired_ts = 0.0   # when that probe was submitted
         self.metrics_ref = None
+        self.node_id: Optional[str] = None   # placement, for drain marks
+        self.draining = False        # node preemption/quarantine advisory
+        self.drain_deadline = 0.0    # wall time the node goes away
 
 
 class _DeploymentState:
@@ -68,6 +72,9 @@ class _DeploymentState:
 
 class ServeController:
     def __init__(self, http_host: str = "127.0.0.1", http_port: int = 8000):
+        from ray_tpu._private.config import cfg
+
+        c = cfg()
         self._apps: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.RLock()
         self._routing_version = 0
@@ -78,10 +85,140 @@ class ServeController:
         self._rpc_proxy = None
         self._grpc_proxy = None
         self._shutdown = False
+        self._health_period = c.serve_health_check_period_s
+        self._health_timeout = c.serve_health_check_timeout_s
+        self._drain_grace = c.serve_drain_grace_s
+        # preemption advisories: node_id -> wall-clock deadline the node
+        # goes away.  Fed by the pubsub edge (h_report_draining /
+        # h_report_quarantine events) and re-derived level-triggered from
+        # get_nodes so a missed push cannot strand a mark forever.
+        self._unsafe_nodes: Dict[str, float] = {}  # guarded-by: _lock
+        self._safe_node_exists = True  # guarded-by: _lock
+        self._last_node_sync = 0.0
+        try:
+            from ray_tpu._private.api import current_core
+
+            current_core().add_push_handler("pub:node", self._on_node_event)
+        except Exception:
+            # single-process / test harness without a control plane: the
+            # level-triggered sync (or nothing) covers it
+            logger.debug("node-event subscription unavailable",
+                         exc_info=True)
         self._reconciler = threading.Thread(target=self._reconcile_loop,
                                             name="serve-reconcile",
                                             daemon=True)
         self._reconciler.start()
+
+    # -- preemption advisories ----------------------------------------------
+
+    def _on_node_event(self, payload: Dict[str, Any]):
+        """Pubsub edge: drain/quarantine advisories land here the moment
+        the control plane publishes them (reference: the drain listener in
+        train/backend_executor.py) — the reconcile tick then pre-starts
+        replacements before the node's deadline instead of after its
+        death."""
+        try:
+            event = payload.get("event")
+            view = payload.get("node") or {}
+            nid = view.get("node_id")
+            if not nid:
+                return
+            if event in ("draining", "quarantined"):
+                grace = payload.get("grace_s")
+                deadline = time.time() + (float(grace)
+                                          if grace else self._drain_grace)
+                with self._lock:
+                    self._unsafe_nodes[nid] = deadline
+            elif event in ("drain_canceled", "quarantine_cleared",
+                           "removed"):
+                with self._lock:
+                    self._unsafe_nodes.pop(nid, None)
+        except Exception:
+            logger.debug("node event ignored", exc_info=True)
+
+    def _sync_node_state(self):
+        """Level-triggered reconciliation of the unsafe-node map against
+        get_nodes (≤1/s): catches advisories published before this
+        controller subscribed, prunes marks for nodes that drained away or
+        had the advisory cleared, and resolves replica -> node placement
+        for drain marking.  All control calls run OUTSIDE the lock."""
+        now = time.time()
+        if now - self._last_node_sync < 1.0:
+            return
+        self._last_node_sync = now
+        try:
+            from ray_tpu._private.api import current_core
+
+            core = current_core()
+            views = core.control.call("get_nodes", {}, timeout=5.0)
+        except Exception:
+            return
+        fresh: Dict[str, float] = {}
+        safe = False
+        live_ids = set()
+        for v in views or []:
+            nid = v.get("node_id")
+            if not nid:
+                continue
+            live_ids.add(nid)
+            if v.get("state") != "ALIVE" or v.get("disconnected"):
+                continue
+            unsafe = False
+            if v.get("draining"):
+                rem = v.get("draining_remaining_s")
+                fresh[nid] = now + (float(rem) if rem is not None
+                                    else self._drain_grace)
+                unsafe = True
+            if v.get("quarantined"):
+                rem = v.get("quarantine_remaining_s")
+                dl = now + (float(rem) if rem is not None
+                            else self._drain_grace)
+                fresh[nid] = max(fresh.get(nid, 0.0), dl)
+                unsafe = True
+            if not unsafe:
+                safe = True
+        with self._lock:
+            for nid in list(self._unsafe_nodes):
+                # prune: node gone, or the view says the advisory cleared
+                if nid in live_ids and nid not in fresh:
+                    self._unsafe_nodes.pop(nid)
+                elif nid not in live_ids:
+                    self._unsafe_nodes.pop(nid)
+            self._unsafe_nodes.update(fresh)
+            self._safe_node_exists = safe or not views
+        # resolve node placement for replicas that don't know theirs yet
+        pending = []
+        with self._lock:
+            for app in self._apps.values():
+                for ds in app["deployments"].values():
+                    for r in ds.replicas.values():
+                        if r.node_id is None and r.state == RUNNING:
+                            pending.append(r)
+        for r in pending:
+            try:
+                view = core.control.call(
+                    "get_actor", {"actor_id": r.handle._actor_id},
+                    timeout=5.0)
+                nid = (view or {}).get("node_id")
+            except Exception:
+                nid = None
+            if nid:
+                with self._lock:
+                    r.node_id = nid
+
+    @staticmethod
+    def _actor_dead(handle) -> bool:
+        """Best-effort liveness read from the control plane; False on any
+        doubt — a dead-looking replica still gets the kill, it just also
+        gets a useless prepare_shutdown first."""
+        try:
+            from ray_tpu._private.api import current_core
+
+            view = current_core().control.call(
+                "get_actor", {"actor_id": handle._actor_id}, timeout=2.0)
+            return (view or {}).get("state") == "DEAD"
+        except Exception:
+            return False
 
     # -- app deploy/delete --------------------------------------------------
 
@@ -145,16 +282,24 @@ class ServeController:
                 vs = list(ds.replicas.values())
                 ds.replicas.clear()
             victims.extend(vs)
+        # skip the drain wait for replicas the control plane already knows
+        # are dead — otherwise deleting an app whose replicas were killed
+        # burns the full drain timeout per call for actors that can never
+        # answer prepare_shutdown
         refs = []
         for r in victims:
+            if self._actor_dead(r.handle):
+                continue
             try:
                 refs.append(r.handle.prepare_shutdown.remote(drain_s))
             except Exception:
                 pass
-        try:
-            ray_tpu.wait(refs, num_returns=len(refs), timeout=drain_s + 2.0)
-        except Exception:
-            pass
+        if refs:
+            try:
+                ray_tpu.wait(refs, num_returns=len(refs),
+                             timeout=drain_s + 2.0)
+            except Exception:
+                pass
         for r in victims:
             try:
                 ray_tpu.kill(r.handle)
@@ -218,6 +363,7 @@ class ServeController:
                 "replicas": [
                     {"replica_id": r.replica_id, "handle": r.handle,
                      "model_ids": list(r.model_ids),
+                     "draining": r.draining,
                      "engine": dict(r.engine) if r.engine else None}
                     for r in ds.replicas.values() if r.state == RUNNING],
                 "max_ongoing_requests": ds.spec.get(
@@ -324,6 +470,10 @@ class ServeController:
 
     def _reconcile_loop(self):
         while not self._shutdown:
+            try:
+                self._sync_node_state()
+            except Exception:
+                logger.debug("node sync failed", exc_info=True)
             try:
                 self._reconcile_once()
             except Exception:
@@ -432,23 +582,79 @@ class ServeController:
                     elif all_ready:
                         self._apps[app_name]["status"] = APP_RUNNING
 
-    def _reconcile_deployment(self, ds: _DeploymentState):
+    def _reconcile_deployment(self, ds: _DeploymentState):  # holds: _lock
         # caller holds self._lock (RLock): replica-map mutations are never
         # concurrent with get_replica_table/status readers
         self._poll_replica_futures(ds)
         self._autoscale(ds)
+        self._mark_draining(ds)
         running_or_starting = [r for r in ds.replicas.values()
                                if r.state in (STARTING, RUNNING)]
+        # a draining replica stops counting toward target — its
+        # replacement pre-starts NOW, before the node's deadline — but
+        # only when somewhere safe exists to put it (otherwise a
+        # single-node drain would spawn-loop replicas that are instantly
+        # re-marked draining)
+        if self._safe_node_exists:
+            effective = [r for r in running_or_starting if not r.draining]
+        else:
+            effective = running_or_starting
         # scale up
-        while len(running_or_starting) < ds.target_num_replicas:
+        while len(effective) < ds.target_num_replicas:
             r = self._start_replica(ds)
-            running_or_starting.append(r)
-        # scale down (prefer draining STARTING last-in first)
-        excess = len(running_or_starting) - ds.target_num_replicas
+            effective.append(r)
+        # scale down (prefer draining STARTING last-in first; node-drain
+        # replicas retire through _retire_draining, never as generic
+        # excess — killing them early would drop their in-flight work)
+        excess = len(effective) - ds.target_num_replicas
         if excess > 0:
-            victims = sorted(running_or_starting,
+            victims = sorted(effective,
                              key=lambda r: (r.state == RUNNING, -r.ongoing))
             self._stop_replica_set(ds, victims[:excess])
+        self._retire_draining(ds)
+
+    def _mark_draining(self, ds: _DeploymentState):  # holds: _lock
+        """Flag replicas whose node has a preemption/quarantine advisory.
+        Marked replicas keep serving (the router deprioritizes but does
+        not refuse them — zero-drop when no safe node exists) while their
+        replacements start."""
+        if not self._unsafe_nodes:
+            return
+        changed = False
+        for r in ds.replicas.values():
+            if r.draining or r.node_id is None:
+                continue
+            deadline = self._unsafe_nodes.get(r.node_id)
+            if deadline is not None:
+                r.draining = True
+                r.drain_deadline = deadline
+                changed = True
+                logger.warning(
+                    "replica %s marked draining (node %s preempted, "
+                    "%.1fs left)", r.replica_id, r.node_id,
+                    max(0.0, deadline - time.time()))
+        if changed:
+            self._replica_version += 1
+
+    def _retire_draining(self, ds: _DeploymentState):  # holds: _lock
+        """Retire draining replicas once their replacements are RUNNING
+        (or the node deadline passed — at that point the node takes the
+        replica with it either way, so a last drain attempt is free)."""
+        draining = [r for r in ds.replicas.values()
+                    if r.draining and r.state in (STARTING, RUNNING)]
+        if not draining:
+            return
+        if not self._safe_node_exists:
+            return  # nowhere to retire TO: keep serving on the doomed node
+        ready = sum(1 for r in ds.replicas.values()
+                    if r.state == RUNNING and not r.draining)
+        now = time.time()
+        for r in draining:
+            if ready >= ds.target_num_replicas or now >= r.drain_deadline:
+                drain_s = max(0.5, min(5.0, r.drain_deadline - now))
+                logger.info("retiring draining replica %s (%.1fs drain)",
+                            r.replica_id, drain_s)
+                self._stop_replica_set(ds, [r], drain_s=drain_s)
 
     def _poll_replica_futures(self, ds: _DeploymentState):
         changed = False
@@ -481,7 +687,11 @@ class ServeController:
                             if new_models != r.model_ids:
                                 r.model_ids = new_models
                                 changed = True
-                            r.last_health_ts = time.time()
+                            # NOTE: metrics success does NOT refresh
+                            # last_health_ts — a wedged engine answers
+                            # metrics fine; only check_health (which
+                            # probes the engine's scheduler thread and
+                            # step counter) counts as proof of life
                         except Exception:
                             # replica died: drop + let scale-up replace it
                             logger.warning("replica %s died; replacing",
@@ -492,9 +702,59 @@ class ServeController:
                         r.metrics_ref = None
                 if r.metrics_ref is None:
                     r.metrics_ref = r.handle.get_metrics.remote()
+                # liveness probe: engine-level check_health on a period;
+                # a failed OR timed-out probe restarts the replica
+                now = time.time()
+                if r.health_ref is not None:
+                    done, _ = ray_tpu.wait([r.health_ref], num_returns=1,
+                                           timeout=0)
+                    if done:
+                        try:
+                            ray_tpu.get(done[0])
+                            r.last_health_ts = now
+                        except Exception as e:
+                            self._restart_replica(
+                                ds, r, f"health check failed: {e}")
+                            changed = True
+                            continue
+                        r.health_ref = None
+                    elif now - r.health_fired_ts > self._health_timeout:
+                        # probe never answered: replica event loop (or the
+                        # whole worker) is wedged even though the actor
+                        # is nominally alive
+                        self._restart_replica(
+                            ds, r, "health check timed out "
+                            f"({self._health_timeout:.0f}s): wedged")
+                        changed = True
+                        continue
+                if (r.health_ref is None
+                        and now - r.last_health_ts >= self._health_period):
+                    try:
+                        r.health_ref = r.handle.check_health.remote()
+                        r.health_fired_ts = now
+                    except Exception:
+                        pass  # submit fails only mid-shutdown
         if changed:
             with self._lock:
                 self._replica_version += 1
+
+    def _restart_replica(self, ds: _DeploymentState, r: _ReplicaState,
+                         reason: str):  # holds: _lock
+        """Drop a wedged/unhealthy replica; the scale-up pass replaces it
+        on the next tick.  The kill runs on a daemon thread — killing a
+        wedged worker can block, and this runs under the reconcile lock."""
+        logger.warning("restarting replica %s: %s", r.replica_id, reason)
+        ds.replicas.pop(r.replica_id, None)
+        ds.message = f"replica {r.replica_id} restarted: {reason}"
+        handle = r.handle
+
+        def _kill():
+            try:
+                ray_tpu.kill(handle)
+            except Exception:
+                pass
+
+        threading.Thread(target=_kill, daemon=True).start()
 
     def _start_replica(self, ds: _DeploymentState) -> _ReplicaState:
         rid = f"{ds.app_name}#{ds.name}#{ds.next_replica_no}"
@@ -517,24 +777,32 @@ class ServeController:
                           drain_s: float = 5.0):
         if not victims:
             return
-        refs, handles = [], []
+        handles = []
         for r in victims:
             ds.replicas.pop(r.replica_id, None)
             handles.append(r.handle)
-            try:
-                refs.append(r.handle.prepare_shutdown.remote(drain_s))
-            except Exception:
-                pass
         with self._lock:
             self._replica_version += 1
 
         def _drain_then_kill():
-            # drain off-thread so neither reconcile nor deploy_app blocks
-            try:
-                ray_tpu.wait(refs, num_returns=len(refs),
-                             timeout=drain_s + 2.0)
-            except Exception:
-                pass
+            # drain off-thread so neither reconcile nor deploy_app blocks;
+            # prepare_shutdown submission happens here too — submitting to
+            # a dead replica can block on connection setup, and the caller
+            # may hold the reconcile lock
+            refs = []
+            for h in handles:
+                if self._actor_dead(h):
+                    continue  # no drain to wait for: straight to the kill
+                try:
+                    refs.append(h.prepare_shutdown.remote(drain_s))
+                except Exception:
+                    pass
+            if refs:
+                try:
+                    ray_tpu.wait(refs, num_returns=len(refs),
+                                 timeout=drain_s + 2.0)
+                except Exception:
+                    pass
             for h in handles:
                 try:
                     ray_tpu.kill(h)

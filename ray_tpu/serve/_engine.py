@@ -243,13 +243,15 @@ class _Sequence:
     __slots__ = ("rid", "tokens", "max_new", "temperature", "top_k",
                  "seed", "eos_id", "out_q", "result", "slot", "pages",
                  "pos", "generated", "keys", "t_submit", "t_first",
-                 "peak", "stream")
+                 "peak", "stream", "request_id", "key_offset")
 
     def __init__(self, rid, tokens, max_new, temperature, top_k, seed,
-                 eos_id, stream):
+                 eos_id, stream, request_id=None, key_offset=0):
         import concurrent.futures
 
         self.rid = rid
+        self.request_id = request_id
+        self.key_offset = int(key_offset)
         self.tokens = list(tokens)
         self.max_new = int(max_new)
         self.temperature = float(temperature)
@@ -279,7 +281,7 @@ class ContinuousEngine:
                  num_pages: int = 0, max_total: int = 0,
                  queue_cap: int = 32, shed_queue_depth: int = 16,
                  retry_after_s: float = 1.0, prefill_bucket: int = 32,
-                 ring_size: int = 256):
+                 ring_size: int = 256, stall_s: float = 10.0):
         import jax
         import numpy as np
 
@@ -312,7 +314,10 @@ class ContinuousEngine:
         self._thread: Optional[threading.Thread] = None
         self._wake = threading.Event()
         self._stopped = False
+        self._draining = False        # guarded-by: _lock
         self._rid = 0
+        self.stall_s = float(stall_s)
+        self._health_snap: Optional[Tuple[int, float]] = None
 
         # device state (built lazily on the engine thread)
         self._cache = None
@@ -339,7 +344,8 @@ class ContinuousEngine:
     def submit(self, tokens: List[int], max_new_tokens: int = 16,
                temperature: float = 0.0, seed: int = 0,
                top_k: Optional[int] = None, eos_id: Optional[int] = None,
-               stream: bool = False) -> _Sequence:
+               stream: bool = False, request_id: Optional[str] = None,
+               key_offset: int = 0) -> _Sequence:
         """Thread-safe request entry: validates capacity, sheds when the
         waiting queue is full, wakes the engine loop."""
         if not tokens:
@@ -367,6 +373,14 @@ class ContinuousEngine:
         with self._lock:
             if self._stopped:
                 raise RuntimeError("engine stopped")
+            if self._draining:
+                self._totals["rejected"] += 1
+                m = _m_requests()
+                if m:
+                    m.inc(tags={"outcome": "rejected"})
+                raise AdmissionRejected(
+                    "engine draining (replica shutting down)",
+                    retry_after_s=self.retry_after_s)
             if len(self._waiting) >= self.queue_cap:
                 self._totals["rejected"] += 1
                 m = _m_requests()
@@ -377,7 +391,8 @@ class ContinuousEngine:
                     retry_after_s=self.retry_after_s)
             self._rid += 1
             seq = _Sequence(self._rid, tokens, max_new, temperature,
-                            top_k, seed, eos_id, stream)
+                            top_k, seed, eos_id, stream,
+                            request_id=request_id, key_offset=key_offset)
             self._waiting.append(seq)
             self._totals["requests"] += 1
             self._ensure_thread()
@@ -409,6 +424,10 @@ class ContinuousEngine:
             qd = len(self._waiting)
             ttfts = sorted(self._ttfts)
             window = [(t, n) for t, n in self._t_window if now - t <= 10.0]
+            draining = self._draining
+            req_ids = [s.request_id
+                       for s in list(self._slots) + list(self._waiting)
+                       if s is not None and s.request_id]
         toks = sum(n for _, n in window)
         span = (now - window[0][0]) if window else 0.0
         free_pages = self._alloc.free_pages if self._alloc else \
@@ -425,7 +444,9 @@ class ContinuousEngine:
             "queue_depth": qd,
             "free_pages": free_pages,
             "num_pages": self.num_pages,
-            "accepting": qd < self.shed_queue_depth,
+            "accepting": (not draining) and qd < self.shed_queue_depth,
+            "draining": draining,
+            "active_request_ids": req_ids,
             "retry_after_s": self.retry_after_s,
             "ttft_p50_s": pct(0.50),
             "ttft_p99_s": pct(0.99),
@@ -437,17 +458,88 @@ class ContinuousEngine:
         with self._lock:
             return list(self._ring)
 
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Graceful shutdown, phase 1: stop admitting (submit sheds,
+        engine_stats advertises accepting=False so the router steers
+        around this replica) and give in-flight sequences a
+        deadline-bounded chance to finish.  Returns True when everything
+        drained; leftovers are failed by the eventual stop()/kill and
+        the router replays them elsewhere."""
+        with self._lock:
+            self._draining = True
+        self._wake.set()
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while True:
+            with self._lock:
+                busy = bool(self._waiting) or any(
+                    s is not None for s in self._slots)
+            if not busy:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.02)
+
+    def check_health(self) -> bool:
+        """Engine liveness probe (controller health loop): raises when
+        the scheduler thread died with work pending, the step counter
+        stalls while slots are active (hung jit step), or the page
+        free-list went inconsistent — any of which means every future
+        request would hang, so the replica must be restarted."""
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("engine stopped")
+            active = sum(1 for s in self._slots if s is not None)
+            queued = len(self._waiting)
+            steps = self._totals["steps"]
+        thread = self._thread
+        if thread is not None and not thread.is_alive() \
+                and (active or queued):
+            raise RuntimeError(
+                f"engine scheduler thread died with work pending "
+                f"({active} active, {queued} queued)")
+        now = time.monotonic()
+        snap = self._health_snap
+        if active == 0 or snap is None or snap[0] != steps:
+            self._health_snap = (steps, now)
+        elif now - snap[1] > self.stall_s:
+            raise RuntimeError(
+                f"engine stalled: {active} active slots but no decode "
+                f"step for {now - snap[1]:.1f}s (> {self.stall_s:g}s)")
+        if self._alloc is not None:
+            a = self._alloc
+            in_use = len(a._refs)
+            if len(a._free) + in_use != a.num_pages - 1:
+                raise RuntimeError(
+                    f"page free-list inconsistent: {len(a._free)} free "
+                    f"+ {in_use} referenced != {a.num_pages - 1}")
+            if any(n <= 0 for n in a._refs.values()):
+                raise RuntimeError("page refcount <= 0 in allocator")
+        return True
+
     def stop(self):
         with self._lock:
             self._stopped = True
             waiting = list(self._waiting)
             self._waiting.clear()
         self._wake.set()
-        err = RuntimeError("engine stopped")
-        for s in waiting:
-            self._finish(s, error=err)
+        # let the loop finish its current iteration before touching the
+        # slots — clearing them mid-_step would double-release pages
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+        with self._lock:
+            active = [s for s in self._slots if s is not None]
+            self._slots = [None] * self.max_slots
+        self._pos[:] = 0
+        self._ptab[:] = 0
+        if self._alloc is not None:
+            for s in active:
+                self._alloc.release(s.pages)
+        err = RuntimeError("engine stopped")
+        # in-slot sequences must resolve too: a stream consumer blocked
+        # on out_q and a request/response caller blocked on the future
+        # would otherwise hang forever
+        for s in waiting + active:
+            self._finish(s, error=err)
 
     # -- engine loop --------------------------------------------------------
 
@@ -574,8 +666,13 @@ class ContinuousEngine:
             shared_len = 0
         seq.slot = slot
         seq.pos = plen
+        # key_offset: a resumed continuation (router replay) re-derives
+        # the ORIGINAL request's key schedule and skips the keys its
+        # already-delivered tokens consumed — sampled decode stays
+        # bitwise-identical across the resume, same as greedy
         seq.keys = np.asarray(jax.random.split(
-            jax.random.PRNGKey(seq.seed), seq.max_new))
+            jax.random.PRNGKey(seq.seed),
+            seq.key_offset + seq.max_new))[seq.key_offset:]
         self._pos[slot] = plen                  # first decode write pos
         self._temps[slot] = seq.temperature
         self._topks[slot] = int(seq.top_k or 0)

@@ -5,6 +5,12 @@ Reference: python/ray/serve/handle.py DeploymentHandle/DeploymentResponse —
 returns a `DeploymentResponse` future; handles pickle by (app, deployment)
 name so they can be shipped into other replicas for model composition, and
 `await response` works inside async replicas without blocking their loop.
+
+Responses resolve through the router's replay core (_router.py): a
+replica that dies mid-call is ejected and the request replayed on a
+survivor, transparently to the caller.  Streaming responses registered
+with a resume continuation (``options(resume="llm_tokens")``) continue
+from the last item the client received instead of restarting.
 """
 
 from __future__ import annotations
@@ -12,34 +18,28 @@ from __future__ import annotations
 import asyncio
 from typing import Any, Dict, Optional
 
-import ray_tpu
-
 from ._router import get_router
 
 
 class DeploymentResponse:
-    def __init__(self, ref, done_cb=None):
-        self._ref = ref
-        self._done_cb = done_cb
+    def __init__(self, router, sub):
+        self._router = router
+        self._sub = sub
         self._result = None
         self._have_result = False
 
     def result(self, timeout_s: Optional[float] = 300.0):
         if not self._have_result:
-            try:
-                self._result = ray_tpu.get(self._ref, timeout=timeout_s)
-            finally:
-                self._fire_done()
+            self._result = self._router.call(self._sub,
+                                             timeout_s=timeout_s)
             self._have_result = True
         return self._result
 
     def _to_object_ref(self):
-        return self._ref
+        return self._sub.ref
 
     def _fire_done(self):
-        if self._done_cb is not None:
-            cb, self._done_cb = self._done_cb, None
-            cb()
+        self._sub.fire_done()
 
     def __await__(self):
         loop = asyncio.get_event_loop()
@@ -54,59 +54,79 @@ class DeploymentResponse:
 class DeploymentResponseGenerator:
     """Streaming response: iterate to receive items as the deployment
     yields them (reference: handle.py DeploymentResponseGenerator).
-    Sync iteration blocks per item; `async for` hops via an executor."""
+    Sync iteration blocks per item; `async for` hops via an executor.
+    Abandoning the iteration (break / close / GC before exhaustion)
+    releases the router's in-flight slot instead of inflating the
+    replica's load score forever."""
 
-    def __init__(self, gen, done_cb=None):
-        self._gen = gen
-        self._done_cb = done_cb
+    def __init__(self, router, sub):
+        self._router = router
+        self._sub = sub
+        self._it = None
 
     def _fire_done(self):
-        if self._done_cb is not None:
-            cb, self._done_cb = self._done_cb, None
-            cb()
+        self._sub.fire_done()
+
+    def close(self):
+        """Abandon the stream: close the underlying iterator (its
+        finally releases the in-flight slot)."""
+        it, self._it = self._it, None
+        if it is not None:
+            try:
+                it.close()
+            except Exception:
+                pass
+        self._fire_done()
 
     def __iter__(self):
-        try:
-            for ref in self._gen:
-                yield ray_tpu.get(ref, timeout=300.0)
-        finally:
-            self._fire_done()
+        if self._it is None:
+            self._it = self._router.iter_stream(self._sub)
+        return self._it
 
     async def __aiter__(self):
         loop = asyncio.get_event_loop()
         it = iter(self)
         sentinel = object()
-        while True:
-            item = await loop.run_in_executor(
-                None, lambda: next(it, sentinel))
-            if item is sentinel:
-                return
-            yield item
+        try:
+            while True:
+                item = await loop.run_in_executor(
+                    None, lambda: next(it, sentinel))
+                if item is sentinel:
+                    return
+                yield item
+        finally:
+            # a client that stops consuming (disconnect, early break in
+            # `async for`) must release the in-flight slot NOW, not when
+            # the GC eventually finds the generator
+            await loop.run_in_executor(None, self.close)
 
     def __del__(self):
-        self._fire_done()
+        self.close()
 
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str,
                  method_name: Optional[str] = None,
                  multiplexed_model_id: Optional[str] = None,
-                 stream: bool = False):
+                 stream: bool = False, resume: Optional[str] = None):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method_name = method_name
         self._multiplexed_model_id = multiplexed_model_id
         self._stream = stream
+        self._resume = resume
 
     def options(self, *, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                resume: Optional[str] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name, self.app_name,
             method_name=method_name or self._method_name,
             multiplexed_model_id=(multiplexed_model_id
                                   or self._multiplexed_model_id),
-            stream=self._stream if stream is None else stream)
+            stream=self._stream if stream is None else stream,
+            resume=resume or self._resume)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
@@ -118,17 +138,18 @@ class DeploymentHandle:
         metadata: Dict[str, Any] = {}
         if self._multiplexed_model_id:
             metadata["multiplexed_model_id"] = self._multiplexed_model_id
+        if self._resume:
+            metadata["resume"] = self._resume
+        sub = router.submit(self._method_name, args, kwargs, metadata,
+                            streaming=self._stream)
         if self._stream:
-            gen, done = router.assign_streaming(self._method_name, args,
-                                                kwargs, metadata)
-            return DeploymentResponseGenerator(gen, done)
-        ref, done = router.assign(self._method_name, args, kwargs, metadata)
-        return DeploymentResponse(ref, done)
+            return DeploymentResponseGenerator(router, sub)
+        return DeploymentResponse(router, sub)
 
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.app_name, self._method_name,
-                 self._multiplexed_model_id, self._stream))
+                 self._multiplexed_model_id, self._stream, self._resume))
 
     def __repr__(self):
         return (f"DeploymentHandle(app={self.app_name!r}, "

@@ -18,6 +18,7 @@ import json
 import logging
 import threading
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
@@ -130,6 +131,16 @@ class _ControllerTableCache:
         return self._value
 
 
+def _request_id(headers: Dict[str, str]) -> str:
+    """Honor a caller-supplied x-request-id (so one id threads client ->
+    proxy -> handle -> replica -> engine stats and the router's replay
+    log lines); mint one otherwise."""
+    for k, v in headers.items():
+        if k.lower() == "x-request-id" and v:
+            return str(v)[:128]
+    return uuid.uuid4().hex[:16]
+
+
 def _chunk_bytes(item: Any) -> bytes:
     """Wire form of one streamed item: bytes pass through, strings encode
     (SSE framing is the deployment's own `yield "data: ...\\n\\n"`),
@@ -223,17 +234,15 @@ class HTTPProxy:
                       header_pairs=list(request.headers.items()))
         router = get_router(target["app"], target["deployment"])
         loop = asyncio.get_event_loop()
+        rid = _request_id(req.headers)
 
         if target.get("streaming") or target.get("asgi"):
             return await self._handle_streaming(request, req, target,
-                                                router)
+                                                router, rid)
 
         def call():
-            ref, done = router.assign(None, (req,), {}, {})
-            try:
-                return ray_tpu.get(ref, timeout=300.0)
-            finally:
-                done()
+            sub = router.submit(None, (req,), {}, {"request_id": rid})
+            return router.call(sub, timeout_s=300.0)
 
         try:
             out = await loop.run_in_executor(None, call)
@@ -244,11 +253,15 @@ class HTTPProxy:
                 # back instead of letting queues collapse into timeouts
                 return web.Response(
                     status=503, text=f"overloaded: {e}",
-                    headers={"Retry-After": f"{max(0.0, retry):g}"})
-            logger.exception("request to %s failed", path)
+                    headers={"Retry-After": f"{max(0.0, retry):g}",
+                             "x-request-id": rid})
+            logger.exception("request %s to %s failed", rid, path)
             return web.Response(status=500,
-                               text=f"{type(e).__name__}: {e}")
-        return self._to_http(out)
+                                text=f"{type(e).__name__}: {e}",
+                                headers={"x-request-id": rid})
+        resp = self._to_http(out)
+        resp.headers.setdefault("x-request-id", rid)
+        return resp
 
     # long-lived streams pin a thread per in-flight item wait; a
     # dedicated pool keeps ~32 SSE clients from starving the loop's
@@ -266,7 +279,8 @@ class HTTPProxy:
                     max_workers=64, thread_name_prefix="proxy-stream")
             return cls._stream_pool
 
-    async def _handle_streaming(self, aio_req, req, target, router):
+    async def _handle_streaming(self, aio_req, req, target, router,
+                                rid: str):
         """Chunked-transfer path for generator/ASGI ingress (reference:
         proxy.py:864 streaming plumbing): each item the deployment yields
         goes onto the wire as soon as its ref resolves — first-token
@@ -283,26 +297,29 @@ class HTTPProxy:
         loop = asyncio.get_event_loop()
         pool = self._stream_executor()
         try:
-            gen, done = await loop.run_in_executor(
-                pool, lambda: router.assign_streaming(None, (req,), {}, {}))
+            sub = await loop.run_in_executor(
+                pool, lambda: router.submit(
+                    None, (req,), {}, {"request_id": rid},
+                    streaming=True))
         except Exception as e:
             retry = _shed_retry_after(e)
             if retry is not None:
                 return web.Response(
                     status=503, text=f"overloaded: {e}",
-                    headers={"Retry-After": f"{max(0.0, retry):g}"})
-            logger.exception("streaming assign to %s failed", req.path)
+                    headers={"Retry-After": f"{max(0.0, retry):g}",
+                             "x-request-id": rid})
+            logger.exception("streaming submit %s to %s failed", rid,
+                             req.path)
             return web.Response(status=500,
-                                text=f"{type(e).__name__}: {e}")
-        it = iter(gen)
+                                text=f"{type(e).__name__}: {e}",
+                                headers={"x-request-id": rid})
+        # iter_stream resolves items AND replays on replica death; its
+        # finally releases the in-flight slot even on client disconnect
+        it = router.iter_stream(sub)
         sentinel = object()
 
         def nxt():
-            try:
-                ref = next(it)
-            except StopIteration:
-                return sentinel
-            return ray_tpu.get(ref, timeout=300.0)
+            return next(it, sentinel)
 
         resp = None
         try:
@@ -333,6 +350,7 @@ class HTTPProxy:
                     status=200,
                     headers={"Content-Type": "text/plain; charset=utf-8"})
                 pending = first
+            resp.headers.setdefault("x-request-id", rid)
             await resp.prepare(aio_req)
             if pending is not None and pending is not sentinel:
                 await resp.write(_chunk_bytes(pending))
@@ -345,7 +363,8 @@ class HTTPProxy:
             await resp.write_eof()
             return resp
         except Exception as e:
-            logger.exception("streaming request to %s failed", req.path)
+            logger.exception("streaming request %s to %s failed", rid,
+                             req.path)
             if resp is None or not resp.prepared:
                 # nothing hit the wire yet (including prepare() itself
                 # failing): a plain 500/503 is still deliverable
@@ -353,9 +372,11 @@ class HTTPProxy:
                 if retry is not None:
                     return web.Response(
                         status=503, text=f"overloaded: {e}",
-                        headers={"Retry-After": f"{max(0.0, retry):g}"})
+                        headers={"Retry-After": f"{max(0.0, retry):g}",
+                                 "x-request-id": rid})
                 return web.Response(status=500,
-                                    text=f"{type(e).__name__}: {e}")
+                                    text=f"{type(e).__name__}: {e}",
+                                    headers={"x-request-id": rid})
             # headers already sent: abort the connection rather than
             # emitting the normal chunked terminator — a clean write_eof
             # would make the truncated body indistinguishable from a
@@ -368,7 +389,12 @@ class HTTPProxy:
             resp.force_close()
             return resp
         finally:
-            done()
+            # closing the iterator runs iter_stream's finally (releases
+            # the router's in-flight slot) — including on client abandon
+            try:
+                it.close()
+            except Exception:
+                pass
 
     def _to_http(self, out: Any):
         from aiohttp import web
@@ -435,14 +461,12 @@ class RpcProxy:
                 # named-method ingress routes RPC method names: keep the
                 # __call__ fallback (same contract as the gRPC ingress);
                 # handle callers stay strict
-                ref, done = router.assign(p.get("method"), tuple(args),
-                                          dict(kwargs),
-                                          {"_method_fallback": True})
-                try:
-                    out = ray_tpu.get(ref, timeout=300.0)
-                finally:
-                    done()
-                d.resolve(out)
+                meta = {"_method_fallback": True}
+                if p.get("request_id"):
+                    meta["request_id"] = str(p["request_id"])[:128]
+                sub = router.submit(p.get("method"), tuple(args),
+                                    dict(kwargs), meta)
+                d.resolve(router.call(sub, timeout_s=300.0))
             except BaseException as e:
                 d.reject(f"{type(e).__name__}: {e}")
 
@@ -464,13 +488,14 @@ class RpcClient:
                               connect_timeout=connect_timeout)
 
     def call(self, app: str, *args, method: Optional[str] = None,
-             timeout: float = 300.0,
+             timeout: float = 300.0, request_id: Optional[str] = None,
              call_kwargs: Optional[Dict[str, Any]] = None, **kwargs):
         merged = {**(call_kwargs or {}), **kwargs}
-        return self._client.call("serve_call",
-                                 {"app": app, "method": method,
-                                  "args": args, "kwargs": merged},
-                                 timeout=timeout)
+        payload = {"app": app, "method": method,
+                   "args": args, "kwargs": merged}
+        if request_id:
+            payload["request_id"] = request_id
+        return self._client.call("serve_call", payload, timeout=timeout)
 
     def routes(self) -> Dict[str, Any]:
         return self._client.call("serve_routes", {}, timeout=30.0)

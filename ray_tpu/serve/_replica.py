@@ -175,10 +175,22 @@ class Replica:
 
     async def prepare_shutdown(self, drain_s: float = 5.0) -> bool:
         """Drain: wait (cooperatively — this replica is an async actor, so
-        in-flight requests keep running) until ongoing hits 0."""
+        in-flight requests keep running) until ongoing hits 0.  A callable
+        that owns a decode engine drains it first (stop admitting, let
+        active slots finish) instead of dropping the in-flight decodes
+        when the actor is killed."""
         import asyncio
 
         deadline = time.time() + drain_s
+        fn = getattr(self._callable, "prepare_shutdown", None)
+        if fn is not None:
+            # engine drain blocks: run it off the actor event loop so
+            # concurrent metric probes / streaming reads keep flowing
+            try:
+                await asyncio.get_event_loop().run_in_executor(
+                    None, lambda: fn(drain_s))
+            except Exception:
+                pass  # shutdown best-effort: the kill follows regardless
         while self._ongoing > 0 and time.time() < deadline:
             await asyncio.sleep(0.02)
         return True

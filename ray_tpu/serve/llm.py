@@ -110,7 +110,8 @@ class _LLMServerImpl:
                       queue_cap=c.serve_queue_cap,
                       shed_queue_depth=c.serve_shed_queue_depth,
                       retry_after_s=c.serve_retry_after_s,
-                      prefill_bucket=c.serve_prefill_bucket)
+                      prefill_bucket=c.serve_prefill_bucket,
+                      stall_s=c.serve_engine_stall_s)
             kw.update(self._engine_kwargs)
             self._engine = ContinuousEngine(self._gpt, self._cfg,
                                             self._params, **kw)
@@ -122,6 +123,31 @@ class _LLMServerImpl:
         if self._engine is None:
             return None
         return self._engine.engine_stats()
+
+    def check_health(self):
+        """Engine-level liveness probe (controller health loop): a hung
+        jit step or dead scheduler thread raises here, which gets this
+        replica restarted instead of timing out every request forever."""
+        if self._engine is not None:
+            self._engine.check_health()
+
+    def prepare_shutdown(self, drain_s: float = 5.0) -> bool:
+        """Graceful drain: stop admitting and let active decode slots
+        finish before the controller kills the actor."""
+        if self._engine is not None:
+            return self._engine.drain(drain_s)
+        return True
+
+    @staticmethod
+    def _request_id() -> Optional[str]:
+        """Per-request id from the replica request context (proxy/handle
+        propagate it via metadata) — threads it into engine stats so a
+        replayed request is traceable across replicas."""
+        from ._replica import _request_context
+
+        ctx = _request_context.get()
+        return (ctx or {}).get("request_id") if isinstance(ctx, dict) \
+            else None
 
     def _cached(self, key, build):
         """LRU-bounded compiled-program cache (every jitted variant a
@@ -241,7 +267,8 @@ class _LLMServerImpl:
     def stream_tokens(self, tokens: List[int], max_new_tokens: int = 16,
                       temperature: float = 0.0, seed: int = 0,
                       top_k: Optional[int] = None,
-                      eos_id: Optional[int] = None):
+                      eos_id: Optional[int] = None,
+                      key_offset: int = 0):
         """Yield one sampled token id at a time (generator => Serve
         streams it as SSE/chunked over HTTP, itemwise over handles).
         Under the continuous engine the stream is fed by the shared
@@ -255,7 +282,9 @@ class _LLMServerImpl:
         if self._engine_mode != "static":
             eng = self._get_engine()
             seq = eng.submit(tokens, max_new_tokens, temperature, seed,
-                             top_k, eos_id=eos_id, stream=True)
+                             top_k, eos_id=eos_id, stream=True,
+                             request_id=self._request_id(),
+                             key_offset=key_offset)
             yield from eng.stream(seq)
             return
         jax, gpt, cfg = self._jax, self._gpt, self._cfg
@@ -269,8 +298,11 @@ class _LLMServerImpl:
             self._params, cache, np.asarray(tokens, np.int32))
         # same key schedule as the batched route (gpt.generate splits
         # rng into max_new_tokens keys up front): seed parity holds for
-        # sampled decodes, not just greedy
-        keys = jax.random.split(jax.random.PRNGKey(seed), max_new_tokens)
+        # sampled decodes, not just greedy.  key_offset (router resume
+        # continuation) re-derives the original request's schedule and
+        # skips the keys its delivered tokens consumed.
+        keys = jax.random.split(jax.random.PRNGKey(seed),
+                                key_offset + max_new_tokens)[key_offset:]
         step = self._stream_step_fn(temperature, top_k, total)
         for i in range(max_new_tokens - 1):
             tok, logits, cache = step(self._params, cache, logits,
@@ -292,7 +324,7 @@ class _LLMServerImpl:
             body["tokens"], int(body.get("max_new_tokens", 16)),
             float(body.get("temperature", 0.0)),
             int(body.get("seed", 0)), body.get("top_k"),
-            eos_id=body.get("eos_id"))
+            eos_id=body.get("eos_id"), request_id=self._request_id())
         return await asyncio.wrap_future(seq.result)
 
     async def __call__(self, request):
@@ -350,10 +382,15 @@ class _LLMStreamIngress:
             await request.json()
         if self._h is None:
             self._h = get_app_handle(self._engine_app)
-        gen = self._h.options(stream=True).stream_tokens.remote(
+        # resume="llm_tokens": if the engine replica dies mid-stream the
+        # router replays prompt+tokens_so_far on a survivor, so the
+        # client stream continues instead of restarting from token 0
+        gen = self._h.options(
+            stream=True, resume="llm_tokens").stream_tokens.remote(
             body["tokens"], int(body.get("max_new_tokens", 16)),
             float(body.get("temperature", 0.0)),
-            int(body.get("seed", 0)), body.get("top_k"))
+            int(body.get("seed", 0)), body.get("top_k"),
+            body.get("eos_id"))
         async for tok in gen:
             yield _json.dumps({"token": int(tok)}) + "\n"
 
