@@ -1875,6 +1875,66 @@ def _write_bench_tasks(table: dict) -> int:
     return 0
 
 
+_CONTROL_NS = (50, 200, 500)
+_CONTROL_NS_QUICK = (50,)
+
+
+def _control_only_main(quick: bool = False) -> int:
+    """Virtual-node swarm bench of the control plane alone: heartbeat
+    RTT, lease grant cycles and pubsub fan-out at several swarm sizes,
+    each against a fresh control daemon.  Writes BENCH_CONTROL.json,
+    merging rows for sizes not rerun (quick mode reruns only N=50), and
+    gates on a forward-ratcheting per-size grant-rate floor: the run
+    fails when lease_grants_per_s falls below 0.9x the best recorded
+    rate for that size, and the recorded best only ever moves up."""
+    from ray_tpu._private.swarm import run_swarm_bench
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, "BENCH_CONTROL.json")
+    try:
+        with open(path) as f:
+            prev = json.load(f)
+    except (OSError, ValueError):
+        prev = {}
+    prev_rows = prev.get("rows", {})
+
+    sizes = _CONTROL_NS_QUICK if quick else _CONTROL_NS
+    rows = dict(prev_rows)
+    failures = []
+    for n in sizes:
+        row = run_swarm_bench(
+            n,
+            lease_secs=2.0 if quick else 4.0,
+            settle_s=0.5 if quick else 1.0,
+            pub_msgs=10 if quick else 20)
+        # quick rows live under their own key: the shorter measurement
+        # window yields a systematically higher grants/s, so letting a
+        # quick run ratchet the full-run floor would fail the next full
+        # run spuriously (and vice versa)
+        key = f"{n}-quick" if quick else str(n)
+        recorded = prev_rows.get(key, {}).get("recorded_grants_per_s")
+        got = row["lease_grants_per_s"]
+        if recorded and got < 0.9 * recorded:
+            failures.append(f"N={n}: lease_grants_per_s {got} < 0.9x "
+                            f"recorded {recorded}")
+        row["recorded_grants_per_s"] = round(
+            max(got, recorded or 0.0), 1)
+        rows[key] = row
+        print(json.dumps({f"control_swarm_{n}": row}), flush=True)
+
+    data = {"host_cpus": os.cpu_count(),
+            "quick": quick,
+            "gate": {"metric": "lease_grants_per_s",
+                     "floor_frac": 0.9},
+            "rows": rows}
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+    for msg in failures:
+        print(f"FAIL: {msg}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def main():
     # headline FIRST and flushed: the device extras below can hang on a
     # broken accelerator runtime, and the one-JSON-line contract must
@@ -1947,6 +2007,8 @@ if __name__ == "__main__":
         sys.exit(_serve_only_main())
     elif "--tasks-only" in sys.argv:
         sys.exit(_write_bench_tasks(bench_tasks_table()))
+    elif "--control-only" in sys.argv:
+        sys.exit(_control_only_main(quick="--quick" in sys.argv))
     elif "--table" in sys.argv:
         table = bench_table()
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),

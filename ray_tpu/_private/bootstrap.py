@@ -187,7 +187,30 @@ class Cluster:
         self.nodes.append(h)
         if wait:
             _wait_ping(h.addr, what="raylet")
+            # The raylet answers ping before its register_node round-trip
+            # completes; callers doing get_nodes/report_draining right
+            # after add_node raced that window.  Wait for the control
+            # plane's view too (skipped for proxy-routed raylets, whose
+            # registration may be deliberately severed mid-flight).
+            if control_addr is None:
+                self._wait_registered(nid)
         return h
+
+    def _wait_registered(self, nid: str, timeout_s: float = 30.0):
+        deadline = time.monotonic() + timeout_s
+        last: object = None
+        while time.monotonic() < deadline:
+            try:
+                cli = Client(self.control_addr, connect_timeout=2.0)
+                nodes = cli.call("get_nodes", timeout=5.0)
+                cli.close()
+                if any(n.get("node_id") == nid for n in nodes):
+                    return
+            except Exception as e:
+                last = e
+            time.sleep(0.05)
+        raise RuntimeError(
+            f"raylet {nid} never appeared in control get_nodes: {last}")
 
     def remove_node(self, h: NodeHandle, graceful: bool = False):
         if graceful:

@@ -35,6 +35,7 @@ from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from . import common
+from . import protocol
 from .common import add, fits, normalize_resources, subtract
 from .protocol import Client, DaemonPool, Deferred, Server, ServerConn
 
@@ -254,6 +255,19 @@ class ControlServer:
         # pending-actor scheduler queue (reference: GcsActorScheduler)
         self.pending_actors: List[ActorRecord] = []
         self._sched_event = threading.Event()
+        # flight-recorder counters (control_stats).  _obs_lock is a LEAF
+        # lock: publish() runs with self.lock held on some paths, so
+        # nothing may be called while holding it.  KV counters are
+        # loop-thread-only plain dicts.
+        self._obs_lock = threading.Lock()  # lock-ok: leaf, no calls inside
+        # ns -> [ops, bytes_in, bytes_out]
+        self._kv_stats: Dict[str, list] = {}
+        # topic -> [publishes, deliveries, drops, bytes_out,
+        #           fanout_s_sum, fanout_s_max]
+        self._pubsub_stats: Dict[str, list] = {}
+        # coalesced task-event relay accounting (see h_report_task_events)
+        self._relay_batches = 0
+        self._relay_dropped = 0
         # native C++ selection/planning engine (reference's scheduling core
         # is C++: cluster_resource_scheduler.h, hybrid_scheduling_policy.h);
         # Python keeps authoritative optimistic accounting and mirrors
@@ -308,6 +322,7 @@ class ControlServer:
         s.handle("list_task_events", self.h_list_task_events, deferred=True)
         s.handle("list_profile_events", self.h_list_profile_events,
                  deferred=True)
+        s.handle("control_stats", self.h_control_stats)
         s.on_disconnect(self.h_disconnect)
 
         self.health_thread = threading.Thread(
@@ -476,8 +491,22 @@ class ControlServer:
 
     # -- kv ----------------------------------------------------------------
 
+    def _kv_account(self, ns: str, bytes_in: int = 0, bytes_out: int = 0):
+        """Per-namespace op/byte counters: the `_metrics` / `serve` /
+        `remediation` namespaces are the control plane's chattiest
+        tenants and these numbers name them (all KV handlers run on the
+        RPC loop thread, as does the stats reader, so a plain dict
+        suffices)."""
+        st = self._kv_stats.get(ns)
+        if st is None:
+            st = self._kv_stats[ns] = [0, 0, 0]
+        st[0] += 1
+        st[1] += bytes_in
+        st[2] += bytes_out
+
     def h_kv_put(self, conn, p):
         ns, k, v, overwrite = p["ns"], p["key"], p["val"], p.get("overwrite", True)
+        self._kv_account(ns, bytes_in=len(v) if isinstance(v, (bytes, bytearray)) else 0)
         with self.lock:
             space = self.kv.setdefault(ns, {})
             if not overwrite and k in space:
@@ -490,9 +519,13 @@ class ControlServer:
 
     def h_kv_get(self, conn, p):
         with self.lock:
-            return self.kv.get(p["ns"], {}).get(p["key"])
+            v = self.kv.get(p["ns"], {}).get(p["key"])
+        self._kv_account(p["ns"], bytes_out=len(v)
+                         if isinstance(v, (bytes, bytearray)) else 0)
+        return v
 
     def h_kv_del(self, conn, p):
+        self._kv_account(p["ns"])
         with self.lock:
             found = self.kv.get(p["ns"], {}).pop(p["key"], None) is not None
             if found and self.pstore is not None:
@@ -501,10 +534,12 @@ class ControlServer:
 
     def h_kv_keys(self, conn, p):
         prefix = p.get("prefix", "")
+        self._kv_account(p["ns"])
         with self.lock:
             return [k for k in self.kv.get(p["ns"], {}) if k.startswith(prefix)]
 
     def h_kv_exists(self, conn, p):
+        self._kv_account(p["ns"])
         with self.lock:
             return p["key"] in self.kv.get(p["ns"], {})
 
@@ -995,7 +1030,25 @@ class ControlServer:
                          exc_info=True)
         with self.lock:
             conns = list(self.subs.get(topic, ()))
-        dead = [c for c in conns if not c.push(f"pub:{topic}", payload)]
+        # one pickle for the whole fan-out (500 subscribers = 1 dumps, not
+        # 500); the meta wall-clock stamp lets every subscriber measure
+        # publish->deliver latency (rpc_stats.record_pubsub_delivery)
+        t0 = time.perf_counter()
+        data = protocol._pack_frame(0, protocol.PUSH, f"pub:{topic}",
+                                    payload, {"ts": time.time()})
+        dead = [c for c in conns if not c.send_raw(data)]
+        fanout_s = time.perf_counter() - t0
+        with self._obs_lock:
+            st = self._pubsub_stats.get(topic)
+            if st is None:
+                st = self._pubsub_stats[topic] = [0, 0, 0, 0, 0.0, 0.0]
+            st[0] += 1
+            st[1] += len(conns) - len(dead)
+            st[2] += len(dead)
+            st[3] += len(data) * (len(conns) - len(dead))
+            st[4] += fanout_s
+            if fanout_s > st[5]:
+                st[5] = fanout_s
         if dead:
             with self.lock:
                 for c in dead:
@@ -1799,6 +1852,50 @@ class ControlServer:
         self._on_node_death(nid)
         return {"ok": True}
 
+    # -- control-plane flight recorder ------------------------------------
+
+    def h_control_stats(self, conn, p):
+        """One-stop control-plane health view: per-handler RPC stats,
+        event-loop lag, per-KV-namespace traffic, per-topic pubsub
+        fan-out and task-event ingest accounting.  Served by `ray-tpu
+        control-stats`, `GET /api/control/stats` and the dashboard's
+        ray_tpu_control_* Prometheus series."""
+        with self.lock:
+            nodes_total = len(self.nodes)
+            nodes_alive = sum(1 for n in self.nodes.values()
+                              if n.state == "ALIVE")
+            subs = {t: len(cs) for t, cs in self.subs.items() if cs}
+        with self._obs_lock:
+            pubsub = {
+                t: {"publishes": st[0], "deliveries": st[1],
+                    "dropped_subscribers": st[2], "bytes_out": st[3],
+                    "fanout_ms_total": round(st[4] * 1e3, 3),
+                    "fanout_ms_max": round(st[5] * 1e3, 3)}
+                for t, st in self._pubsub_stats.items()}
+            relay_batches = self._relay_batches
+            relay_dropped = self._relay_dropped
+        with self._events_lock:
+            events = {
+                "queue_depth": len(self._event_queue),
+                "dropped": self.task_events_dropped,
+                "task_records": len(self.task_records),
+                "profile_events": len(self.profile_events),
+                "relay_batches": relay_batches,
+                "relay_dropped": relay_dropped,
+            }
+        return {
+            "uptime_s": round(time.time() - self.start_time, 1),
+            "handlers": self.server.stats(),
+            "loop": self.server.loop_stats(),
+            "kv": {ns: {"ops": st[0], "bytes_in": st[1],
+                        "bytes_out": st[2]}
+                   for ns, st in self._kv_stats.items()},
+            "pubsub": pubsub,
+            "subscriptions": subs,
+            "events": events,
+            "nodes": {"alive": nodes_alive, "total": nodes_total},
+        }
+
     # -- state dump (state API source of truth) ---------------------------
 
     def h_state_dump(self, conn, p):
@@ -1833,17 +1930,32 @@ class ControlServer:
         the event loop under the global lock stalled lease scheduling
         (measured ~40% of headline tasks/s).  The queue is bounded: if
         the merge thread falls behind the oldest batch is dropped with
-        accounting (the reference's TaskEventBuffer does the same)."""
+        accounting (the reference's TaskEventBuffer does the same).
+
+        Accepts either one worker batch ({"events", "dropped", "common"})
+        or a raylet relay envelope ({"batches": [...], "dropped": n}) —
+        one framed pipe write carrying every worker batch a node
+        coalesced in its flush window."""
         q = self._event_queue
-        q.append(p)
-        if len(q) > self._event_queue_cap:
+        batches = p.get("batches")
+        if batches is not None:
+            with self._obs_lock:
+                self._relay_batches += 1
+                self._relay_dropped += p.get("dropped", 0)
+            if p.get("dropped"):
+                with self._events_lock:
+                    self.task_events_dropped += p["dropped"]
+            q.extend(batches)
+        else:
+            q.append(p)
+        while len(q) > self._event_queue_cap:
             try:
                 old = q.popleft()
                 with self._events_lock:
                     self.task_events_dropped += \
                         len(old.get("events", ())) + old.get("dropped", 0)
             except IndexError:
-                pass
+                break
         self._event_signal.set()
         return True
 

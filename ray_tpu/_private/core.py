@@ -503,6 +503,7 @@ class CoreWorker:
         # telemetry: push_tasks batch-size histogram + flush-latency sums
         self._stats_lock = threading.Lock()
         self._submit_hist: Dict[int, int] = {}      # guarded-by: _stats_lock
+        self._actor_sends = 0                       # guarded-by: _stats_lock
         self._flush_stats = {"flushes": 0, "tasks": 0,  # guarded-by: _stats_lock
                              "latency_ms_total": 0.0, "latency_ms_max": 0.0}
         self._flush_thread = threading.Thread(
@@ -514,9 +515,18 @@ class CoreWorker:
         from .task_events import NULL_BUFFER, TaskEventBuffer
 
         if _cfg().task_events:
+            # workers relay batches through their raylet (one control
+            # write per node per flush window instead of one per worker);
+            # drivers and rayletless processes report directly
+            transport = None
+            if mode == "worker" and self.raylet is not None:
+                raylet_cli = self.raylet
+                transport = lambda payload: raylet_cli.notify(
+                    "report_task_events", payload)
             self.task_events = TaskEventBuffer(
                 self.control, worker_id=self.worker_id,
-                node_id=self.node_id or "", job_id=self.job_id)
+                node_id=self.node_id or "", job_id=self.job_id,
+                transport=transport)
         else:
             self.task_events = NULL_BUFFER
 
@@ -669,6 +679,7 @@ class CoreWorker:
         """Snapshot of the submission-batching counters (bench/debug)."""
         with self._stats_lock:
             return {"batch_hist": dict(self._submit_hist),
+                    "actor_sends": self._actor_sends,
                     "flush": dict(self._flush_stats)}
 
     def _lease_reaper_loop(self):
@@ -2388,6 +2399,13 @@ class CoreWorker:
                 ac.buffer.append(spec)
                 return
             ac.inflight[spec.task_id] = spec
+        # actor sends bypass the combining flusher (one frame per call,
+        # straight to the actor's worker) — record them in the same
+        # batch histogram as size-1 rows so submit telemetry covers the
+        # actor path too, not just push_tasks batches
+        with self._stats_lock:
+            self._submit_hist[1] = self._submit_hist.get(1, 0) + 1
+            self._actor_sends += 1
         fut = client.call_async("actor_task", spec)
 
         def on_done(f):

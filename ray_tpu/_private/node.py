@@ -28,7 +28,8 @@ from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
 from . import accelerators, common
 from .common import add, fits, normalize_resources, subtract
-from .protocol import Backoff, Client, Deferred, Server, ServerConn
+from .protocol import (Backoff, Client, ConnectionLost, Deferred, Server,
+                       ServerConn)
 from .shm_store import ShmObjectStore
 
 logger = logging.getLogger(__name__)
@@ -135,7 +136,26 @@ class Raylet:
         s.handle("list_logs", self.h_list_logs)
         s.handle("read_log", self.h_read_log)
         s.handle("pending_demands", self.h_pending_demands)
+        s.handle("report_task_events", self.h_report_task_events)
         s.on_disconnect(self.h_disconnect)
+
+        # node-local task-event relay (ROADMAP item 5 "per-node batching
+        # of task events"): workers flush their task-event batches to
+        # THIS raylet over their existing socket; a relay loop coalesces
+        # every batch from the flush window into ONE framed pipe write
+        # to the control.  N workers/node no longer means N control
+        # writes per flush interval.  Bounded with drop-oldest
+        # accounting — never silent loss.
+        self._ev_relay: Deque[Dict[str, Any]] = deque()
+        self._ev_relay_lock = threading.Lock()
+        self._ev_relay_buffered = 0  # events currently buffered
+        self._ev_relay_cap = 20_000  # events; overflow drops oldest batch
+        self._ev_relay_pending_dropped = 0  # dropped, not yet reported
+        self._ev_relay_stats = {"batches_in": 0, "events_in": 0,
+                                "sends": 0, "coalesced": 0, "dropped": 0}
+        self._ev_relay_thread = threading.Thread(
+            target=self._task_event_relay_loop, name="raylet-task-events",
+            daemon=True)
 
         # prestarted warm workers (reference: worker_pool.h prestart):
         # interpreter + framework import is paid once off the critical path;
@@ -218,6 +238,7 @@ class Raylet:
         self._hb_thread.start()
         self._reap_thread.start()
         self._prestart_thread.start()
+        self._ev_relay_thread.start()
         if self._mem_thread is not None:
             self._mem_thread.start()
         # worker-log tailer -> control pubsub -> driver stderr
@@ -1480,7 +1501,73 @@ class Raylet:
                 "bundles": [{"pg_id": k[0], "index": k[1],
                              "state": b["state"]}
                             for k, b in self.bundles.items()],
+                "task_event_relay": self.task_event_relay_stats(),
             }
+
+    # -- task-event relay --------------------------------------------------
+
+    def task_event_relay_stats(self) -> Dict[str, Any]:
+        with self._ev_relay_lock:
+            return {**self._ev_relay_stats,
+                    "buffered_events": self._ev_relay_buffered}
+
+    def h_report_task_events(self, conn, p):
+        """Workers flush task-event batches here (one-way notify on the
+        socket they already hold) instead of each opening a control
+        write; the relay loop forwards them coalesced."""
+        nev = len(p.get("events", ()))
+        with self._ev_relay_lock:
+            self._ev_relay.append(p)
+            self._ev_relay_buffered += nev
+            rs = self._ev_relay_stats
+            rs["batches_in"] += 1
+            rs["events_in"] += nev
+            while self._ev_relay_buffered > self._ev_relay_cap \
+                    and len(self._ev_relay) > 1:
+                old = self._ev_relay.popleft()
+                n_old = len(old.get("events", ()))
+                dropped = n_old + old.get("dropped", 0)
+                self._ev_relay_buffered -= n_old
+                self._ev_relay_pending_dropped += dropped
+                rs["dropped"] += dropped
+        return True
+
+    def _task_event_relay_loop(self):
+        from .task_events import FLUSH_INTERVAL_S
+
+        while not self._stop.wait(FLUSH_INTERVAL_S):
+            self._flush_task_event_relay()
+        self._flush_task_event_relay()  # final drain on shutdown
+
+    def _flush_task_event_relay(self):
+        with self._ev_relay_lock:
+            if not self._ev_relay and not self._ev_relay_pending_dropped:
+                return
+            batches = list(self._ev_relay)
+            self._ev_relay.clear()
+            self._ev_relay_buffered = 0
+            dropped = self._ev_relay_pending_dropped
+            self._ev_relay_pending_dropped = 0
+        cli = self.control
+        try:
+            if cli is None or cli.closed:
+                raise ConnectionLost("no control connection")
+            # ONE framed write for the whole node-flush window
+            cli.notify("report_task_events", {
+                "batches": batches, "dropped": dropped,
+                "node_id": self.node_id,
+            })
+            with self._ev_relay_lock:
+                self._ev_relay_stats["sends"] += 1
+                self._ev_relay_stats["coalesced"] += len(batches)
+        except Exception:
+            # control unreachable: requeue (bounded by the cap on the
+            # next ingest) so a reconnect delivers rather than drops
+            with self._ev_relay_lock:
+                self._ev_relay.extendleft(reversed(batches))
+                self._ev_relay_buffered += sum(
+                    len(b.get("events", ())) for b in batches)
+                self._ev_relay_pending_dropped += dropped
 
     # -- memory pressure ---------------------------------------------------
 

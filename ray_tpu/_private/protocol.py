@@ -9,8 +9,13 @@ fast enough for the control path; the data plane (tensors) never moves through
 this layer: device arrays travel via compiled XLA collectives (ICI) and large
 host objects via the shared-memory store.
 
-Wire format: 8-byte header (<II: payload length, flags) + pickled
-(msg_id, kind, method, payload).  kind: 0=request 1=reply 2=error 3=push.
+Wire format: 4-byte header (<I: payload length) + pickled
+(msg_id, kind, method, payload[, meta]).  kind: 0=request 1=reply 2=error
+3=push.  The optional 5th element is a small dict of frame metadata —
+"tp" (W3C traceparent for cross-process span nesting), "ts" (publisher
+wall-clock stamp for pubsub fan-out latency), "re" (reply served from
+the idempotency replay cache) — attached only when non-empty so the
+common frame stays byte-identical to the 4-tuple format.
 """
 
 from __future__ import annotations
@@ -29,7 +34,24 @@ from collections import OrderedDict
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ray_tpu._private.rpc_stats import (LatencyHist, MethodStats, budget_ms,
+                                        record_pubsub_delivery)
+
 logger = logging.getLogger(__name__)
+
+# lazily-bound tracing module (None = not yet imported, False = unavailable)
+_tracing: Any = None
+
+
+def _trace_mod():
+    global _tracing
+    if _tracing is None:
+        try:
+            from ray_tpu.util import tracing as t
+            _tracing = t
+        except Exception:  # pragma: no cover - partial-install guard
+            _tracing = False
+    return _tracing
 
 _HEADER = struct.Struct("<I")
 REQUEST, REPLY, ERROR, PUSH = 0, 1, 2, 3
@@ -100,6 +122,14 @@ class Backoff:
 
 def _dumps(obj: Any) -> bytes:
     return pickle.dumps(obj, protocol=5)
+
+
+def _pack_frame(msg_id: int, kind: int, method: str, payload: Any,
+                meta: Optional[Dict[str, Any]] = None) -> bytes:
+    """Serialize one frame; the meta element rides only when non-empty."""
+    if meta:
+        return _dumps((msg_id, kind, method, payload, meta))
+    return _dumps((msg_id, kind, method, payload))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -218,7 +248,10 @@ class Client:
         self._on_disconnect = on_disconnect
         self._lock = threading.Lock()
         self._next_id = 0
-        self._inflight: Dict[int, Future] = {}
+        self._inflight: Dict[int, Tuple[Callable, str]] = {}
+        # per-method counters, guarded by _lock:
+        # [calls, bytes_out, replies, errors, bytes_in, replays]
+        self._cstats: Dict[str, list] = {}
         self._closed = False
         deadline = time.monotonic() + connect_timeout
         last_err: Optional[Exception] = None
@@ -262,6 +295,14 @@ class Client:
     # -- public ------------------------------------------------------------
 
     def call(self, method: str, payload: Any = None, timeout: Optional[float] = None) -> Any:
+        t = _trace_mod()
+        if t and t.is_enabled() and t._current() is not None:
+            # CLIENT span around the round trip; the traceparent rides
+            # the frame meta (call_cb) so the server handler nests under
+            with t.rpc_client_span(method, peer=f"{self.addr[0]}:"
+                                                f"{self.addr[1]}"):
+                return self.call_async(method, payload).result(
+                    timeout=timeout)
         fut = self.call_async(method, payload)
         return fut.result(timeout=timeout)
 
@@ -293,16 +334,28 @@ class Client:
             else:
                 self._next_id += 1
                 msg_id = self._next_id
-                self._inflight[msg_id] = cb  # bare callable: no per-call slot
+                self._inflight[msg_id] = (cb, method)
         if closed:
             _invoke(cb, None, ConnectionLost(f"client to {self.addr} closed"))
             return
+        meta = None
+        t = _trace_mod()
+        if t and t.is_enabled():
+            carrier = t.inject_context()
+            if carrier:
+                meta = {"tp": carrier["traceparent"]}
         try:
-            data = _dumps((msg_id, REQUEST, method, payload))
+            data = _pack_frame(msg_id, REQUEST, method, payload, meta)
         except BaseException:
             with self._lock:
                 self._inflight.pop(msg_id, None)
             raise
+        with self._lock:
+            st = self._cstats.get(method)
+            if st is None:
+                st = self._cstats[method] = [0, 0, 0, 0, 0, 0]
+            st[0] += 1
+            st[1] += len(data)
         try:
             self._enqueue(data)
         except ConnectionLost as e:
@@ -313,7 +366,26 @@ class Client:
 
     def notify(self, method: str, payload: Any = None) -> None:
         """One-way message; no reply expected (msg_id 0)."""
-        self._enqueue(_dumps((0, REQUEST, method, payload)))
+        data = _dumps((0, REQUEST, method, payload))
+        with self._lock:
+            st = self._cstats.get(method)
+            if st is None:
+                st = self._cstats[method] = [0, 0, 0, 0, 0, 0]
+            st[0] += 1
+            st[1] += len(data)
+        self._enqueue(data)
+
+    def stats_raw(self) -> Dict[str, list]:
+        """Per-method raw counters
+        [calls, bytes_out, replies, errors, bytes_in, replays]."""
+        with self._lock:
+            return {m: list(s) for m, s in self._cstats.items()}
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        """Client-side per-method stats (mirror of the server's view)."""
+        return {m: {"calls": s[0], "bytes_out": s[1], "replies": s[2],
+                    "errors": s[3], "bytes_in": s[4], "replays": s[5]}
+                for m, s in self.stats_raw().items()}
 
     def _enqueue(self, data: bytes) -> None:
         # after close/teardown the writer is gone — surface the failure
@@ -431,8 +503,8 @@ class Client:
             with self._out_cv:
                 self._out_cv.notify_all()  # release the writer thread
             lost = ConnectionLost(f"connection to {self.addr} lost")
-            for slot in inflight.values():
-                _invoke(slot, None, lost)
+            for cb, _method in inflight.values():
+                _invoke(cb, None, lost)
             if self._on_disconnect is not None:
                 try:
                     self._on_disconnect()
@@ -440,16 +512,30 @@ class Client:
                     logger.exception("disconnect handler failed")
 
     def _handle_frame(self, frame: bytes) -> None:
-        msg_id, kind, method, payload = pickle.loads(frame)
-        if kind == REPLY:
+        rec = pickle.loads(frame)
+        msg_id, kind, method, payload = rec[0], rec[1], rec[2], rec[3]
+        meta = rec[4] if len(rec) > 4 else None
+        if kind in (REPLY, ERROR):
             slot = self._inflight.pop(msg_id, None)
-            if slot is not None:
-                _invoke(slot, payload, None)
-        elif kind == ERROR:
-            slot = self._inflight.pop(msg_id, None)
-            if slot is not None:
-                _invoke(slot, None, RpcError(payload))
+            if slot is None:
+                return
+            cb, m = slot
+            with self._lock:
+                st = self._cstats.get(m)
+                if st is None:
+                    st = self._cstats[m] = [0, 0, 0, 0, 0, 0]
+                st[4] += len(frame)
+                st[2 if kind == REPLY else 3] += 1
+                if meta and meta.get("re"):
+                    st[5] += 1
+            if kind == REPLY:
+                _invoke(cb, payload, None)
+            else:
+                _invoke(cb, None, RpcError(payload))
         elif kind == PUSH:
+            if meta and "ts" in meta:
+                topic = method[4:] if method.startswith("pub:") else method
+                record_pubsub_delivery(topic, time.time() - meta["ts"])
             if self._on_push is not None:
                 try:
                     self._on_push(method, payload)
@@ -490,6 +576,13 @@ class ResilientClient:
         self._lock = threading.Lock()
         self._cli: Optional[Client] = None
         self._closed = False
+        # flap-cost accounting (see client_stats): per-method
+        # [attempts, retries]; plus stats carried over from replaced
+        # Client instances so reconnects don't zero the byte counters
+        self._rstats: Dict[str, list] = {}
+        self._reconnects = 0
+        self._backoff_s = 0.0
+        self._prev_cstats: Dict[str, list] = {}
 
     @property
     def addr(self) -> Tuple[str, int]:
@@ -538,7 +631,13 @@ class ResilientClient:
                     raise ConnectionLost(f"{self.name or 'client'} closed")
                 old, self._cli = self._cli, cli
             if old is not None and old is not cli:
+                from ray_tpu._private.rpc_stats import merge_client_stats
+
+                prev = old.stats_raw()
                 old.close()
+                with self._lock:
+                    self._reconnects += 1
+                    merge_client_stats(self._prev_cstats, prev)
             return cli
 
     def call(self, method: str, payload: Any = None,
@@ -554,6 +653,11 @@ class ResilientClient:
             if budget <= 0:
                 raise ConnectionLost(
                     f"deadline exceeded calling {method!r}")
+            with self._lock:
+                rs = self._rstats.get(method)
+                if rs is None:
+                    rs = self._rstats[method] = [0, 0]
+                rs[0] += 1
             try:
                 return cli.call(method, payload, timeout=budget)
             except (ConnectionLost, OSError) as e:
@@ -564,12 +668,40 @@ class ResilientClient:
                 if time.monotonic() >= deadline:
                     raise ConnectionLost(
                         f"deadline exceeded retrying {method!r}: {e}")
-                bo.sleep(max_s=max(0.0, deadline - time.monotonic()))
+                slept = bo.sleep(max_s=max(0.0,
+                                           deadline - time.monotonic()))
+                with self._lock:
+                    rs[1] += 1
+                    self._backoff_s += slept
 
     def notify(self, method: str, payload: Any = None,
                timeout: float = 5.0) -> None:
         cli = self._ensure(time.monotonic() + timeout)
         cli.notify(method, payload)
+
+    def client_stats(self) -> Dict[str, Any]:
+        """Partition-flap cost view: per-method wire counters (summed
+        across every connection epoch) plus attempts/retries from the
+        resilient retry loop, reconnect count and total backoff sleep."""
+        from ray_tpu._private.rpc_stats import merge_client_stats
+
+        with self._lock:
+            cli = self._cli
+            agg = {m: list(s) for m, s in self._prev_cstats.items()}
+            rstats = {m: list(v) for m, v in self._rstats.items()}
+            reconnects, backoff_s = self._reconnects, self._backoff_s
+        if cli is not None:
+            merge_client_stats(agg, cli.stats_raw())
+        methods = {}
+        for m in set(agg) | set(rstats):
+            s = agg.get(m, [0] * 6)
+            r = rstats.get(m, [0, 0])
+            methods[m] = {"calls": s[0], "bytes_out": s[1],
+                          "replies": s[2], "errors": s[3],
+                          "bytes_in": s[4], "replays": s[5],
+                          "attempts": r[0], "retries": r[1]}
+        return {"methods": methods, "reconnects": reconnects,
+                "backoff_s": round(backoff_s, 3)}
 
     def close(self) -> None:
         with self._lock:
@@ -597,48 +729,83 @@ class ServerConn:
         self._buf = bytearray()
         self._want = -1  # payload size being assembled, -1 = reading header
 
-    def push(self, topic: str, payload: Any) -> bool:
+    def push(self, topic: str, payload: Any,
+             meta: Optional[Dict[str, Any]] = None) -> bool:
         try:
-            data = _dumps((0, PUSH, topic, payload))
+            data = _pack_frame(0, PUSH, topic, payload, meta)
             with self.send_lock:
                 send_frame(self.sock, data)
             return True
         except OSError:
             return False
 
-    def reply(self, msg_id: int, payload: Any) -> None:
-        if msg_id == 0:
-            return
-        data = _dumps((msg_id, REPLY, "", payload))
-        with self.send_lock:
-            send_frame(self.sock, data)
+    def send_raw(self, data: bytes) -> bool:
+        """Send an already-serialized frame (fan-out paths pickle the
+        frame once and send it to N subscribers)."""
+        try:
+            with self.send_lock:
+                send_frame(self.sock, data)
+            return True
+        except OSError:
+            return False
 
-    def reply_error(self, msg_id: int, err: str) -> None:
+    def reply(self, msg_id: int, payload: Any,
+              meta: Optional[Dict[str, Any]] = None) -> int:
         if msg_id == 0:
-            return
-        data = _dumps((msg_id, ERROR, "", err))
+            return 0
+        data = _pack_frame(msg_id, REPLY, "", payload, meta)
         with self.send_lock:
             send_frame(self.sock, data)
+        return len(data)
+
+    def reply_error(self, msg_id: int, err: str,
+                    meta: Optional[Dict[str, Any]] = None) -> int:
+        if msg_id == 0:
+            return 0
+        data = _pack_frame(msg_id, ERROR, "", err, meta)
+        with self.send_lock:
+            send_frame(self.sock, data)
+        return len(data)
 
 
 class Deferred:
     """Return from a handler to defer the reply; call resolve/reject later."""
 
-    def __init__(self, conn: ServerConn, msg_id: int):
+    def __init__(self, conn: ServerConn, msg_id: int,
+                 server: Optional["Server"] = None,
+                 method: Optional[str] = None,
+                 t0: Optional[float] = None):
         self._conn = conn
         self._msg_id = msg_id
+        self._server = server
+        self._method = method
+        self._t0 = t0
+        self._done = False
+
+    def _finish(self, err: bool, nbytes: int) -> None:
+        # deferred replies are the true request latency for long-polls:
+        # record handle-time (and close the in-flight slot) at resolve
+        if self._done or self._server is None or self._t0 is None:
+            return
+        self._done = True
+        self._server._observe_done(
+            self._method, time.perf_counter() - self._t0, err, nbytes)
 
     def resolve(self, payload: Any = None) -> None:
+        nbytes = 0
         try:
-            self._conn.reply(self._msg_id, payload)
+            nbytes = self._conn.reply(self._msg_id, payload)
         except OSError:
             pass
+        self._finish(False, nbytes)
 
     def reject(self, err: str) -> None:
+        nbytes = 0
         try:
-            self._conn.reply_error(self._msg_id, err)
+            nbytes = self._conn.reply_error(self._msg_id, err)
         except OSError:
             pass
+        self._finish(True, nbytes)
 
 
 class _ReplayEntry:
@@ -660,9 +827,9 @@ class _RecordingDeferred(Deferred):
     (releasing parked duplicate callers) before replying."""
 
     def __init__(self, server: "Server", token: str, conn: ServerConn,
-                 msg_id: int):
-        super().__init__(conn, msg_id)
-        self._server = server
+                 msg_id: int, method: Optional[str] = None,
+                 t0: Optional[float] = None):
+        super().__init__(conn, msg_id, server=server, method=method, t0=t0)
         self._token = token
 
     def resolve(self, payload: Any = None) -> None:
@@ -700,11 +867,21 @@ class Server:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._conns: Dict[socket.socket, ServerConn] = {}
-        # per-handler event-loop latency stats (reference: event_stats.h
-        # asio handler instrumentation): method -> [count, total_s, max_s].
+        # per-handler flight recorder (reference: event_stats.h asio
+        # handler instrumentation): method -> MethodStats with count,
+        # in-flight, bytes, queue-wait and handle-time histograms.
         # Handlers run ON the loop thread, so a slow one stalls every
-        # connection — these numbers find it.
-        self._handler_stats: Dict[str, list] = {}
+        # connection — these numbers find it.  _stats_lock is a leaf
+        # lock (nothing is called while holding it): the loop thread and
+        # off-loop Deferred completions both write here.
+        self._stats_lock = threading.Lock()
+        self._mstats: Dict[str, MethodStats] = {}
+        # event-loop health: scheduled-vs-actual tick delta (a stalled
+        # loop shows up as lag even when no RPC is in flight) and
+        # frames-per-drain batching depth
+        self._loop_lag = LatencyHist()
+        self._loop_tick_s = 0.02
+        self._drain_stats = [0, 0, 0]  # [drains, frames, max_batch]
         # Idempotency replay cache: token -> _ReplayEntry.  Bounded LRU;
         # a duplicate of a still-running execution is parked, a duplicate
         # of a finished one gets the recorded reply without re-executing.
@@ -712,18 +889,65 @@ class Server:
         self._replay_cap = 4096
         self._replay_lock = threading.Lock()
         self.handle("rpc_stats", lambda c, p: self.stats())
+        self.handle("loop_stats", lambda c, p: self.loop_stats())
 
     def handle(self, method: str, fn: Callable, deferred: bool = False) -> None:
         self._handlers[method] = (fn, deferred)
 
-    def stats(self) -> Dict[str, Dict[str, float]]:
-        """Snapshot of per-handler loop occupancy."""
-        out = {}
-        for m, (n, total, mx) in list(self._handler_stats.items()):
-            out[m] = {"count": n, "total_s": round(total, 6),
-                      "mean_us": round(total / n * 1e6, 1) if n else 0.0,
-                      "max_us": round(mx * 1e6, 1)}
-        return out
+    def stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-handler flight-recorder snapshot: every REGISTERED method
+        gets a row (zeros until first call) so consumers see the full
+        handler surface, not just the hot set."""
+        with self._stats_lock:
+            for m in self._handlers:
+                if m not in self._mstats:
+                    self._mstats[m] = MethodStats(budget_ms(m))
+            return {m: st.snapshot() for m, st in self._mstats.items()}
+
+    def loop_stats(self) -> Dict[str, Any]:
+        """Event-loop health: tick lag + dispatch batching depth."""
+        with self._stats_lock:
+            drains, frames, max_batch = self._drain_stats
+            return {
+                "lag_ms": self._loop_lag.snapshot(),
+                "tick_s": self._loop_tick_s,
+                "drains": drains,
+                "frames": frames,
+                "max_drain_batch": max_batch,
+                "connections": len(self._conns),
+            }
+
+    def _observe_done(self, method: Optional[str], dt: float, err: bool,
+                      nbytes: int, st: Optional[MethodStats] = None) -> None:
+        """Close one request's accounting (sync reply, error reply, or a
+        Deferred resolving later from an executor thread)."""
+        if st is None:
+            if method is None:
+                return
+            with self._stats_lock:
+                st = self._mstats.get(method)
+            if st is None:
+                return
+        warn_over = None
+        with self._stats_lock:
+            st.inflight -= 1
+            st.handle.observe(dt)
+            st.bytes_out += nbytes
+            if err:
+                st.errors += 1
+            b = st.budget_ms
+            if b is not None and dt * 1e3 > b:
+                st.budget_exceeded += 1
+                now = time.monotonic()
+                if now - st.last_warn > 30.0:
+                    st.last_warn = now
+                    warn_over = (b, st.budget_exceeded)
+        if warn_over is not None:
+            logger.warning(
+                "%s: handler %r took %.1fms (budget %.1fms, %d "
+                "over-budget so far) — it runs on the event loop and "
+                "stalls every connection", self.name, method, dt * 1e3,
+                warn_over[0], warn_over[1])
 
     def on_disconnect(self, fn: Callable[[ServerConn], None]) -> None:
         self._on_disconnect = fn
@@ -752,13 +976,23 @@ class Server:
     # -- loop --------------------------------------------------------------
 
     def _loop(self) -> None:
+        # loop-lag probe: schedule a tick every _loop_tick_s; any handler
+        # that wedges the loop shows up as (actual - scheduled) lateness
+        tick = self._loop_tick_s
+        next_tick = time.perf_counter() + tick
         while not self._stop.is_set():
-            events = self._sel.select(timeout=0.5)
+            timeout = min(0.5, max(0.0, next_tick - time.perf_counter()))
+            events = self._sel.select(timeout=timeout)
             for key, _ in events:
                 if key.fileobj is self._listen:
                     self._accept()
                 else:
                     self._read(key.fileobj)
+            now = time.perf_counter()
+            if now >= next_tick:
+                with self._stats_lock:
+                    self._loop_lag.observe(now - next_tick)
+                next_tick = now + tick
         for sock in list(self._conns):
             self._drop(sock)
         self._sel.close()
@@ -793,26 +1027,41 @@ class Server:
             self._drop(sock)
             return
         conn._buf += data
-        self._drain(conn)
+        self._drain(conn, time.perf_counter())
 
-    def _drain(self, conn: ServerConn) -> None:
+    def _drain(self, conn: ServerConn, t_arr: Optional[float] = None) -> None:
         buf = conn._buf
+        nframes = 0
         while True:
             if conn._want < 0:
                 if len(buf) < _HEADER.size:
-                    return
+                    break
                 (conn._want,) = _HEADER.unpack(bytes(buf[: _HEADER.size]))
                 del buf[: _HEADER.size]
             if len(buf) < conn._want:
-                return
+                break
             frame = bytes(buf[: conn._want])
             del buf[: conn._want]
             conn._want = -1
-            self._dispatch(conn, frame)
+            nframes += 1
+            # t_arr is the recv time for the whole burst: frame N's
+            # queue-wait includes the handle time of frames 1..N-1 ahead
+            # of it in this drain batch — that IS the dispatch queue
+            self._dispatch(conn, frame, t_arr)
+        if nframes:
+            with self._stats_lock:
+                ds = self._drain_stats
+                ds[0] += 1
+                ds[1] += nframes
+                if nframes > ds[2]:
+                    ds[2] = nframes
 
-    def _dispatch(self, conn: ServerConn, frame: bytes) -> None:
+    def _dispatch(self, conn: ServerConn, frame: bytes,
+                  t_arr: Optional[float] = None) -> None:
         try:
-            msg_id, kind, method, payload = pickle.loads(frame)
+            rec = pickle.loads(frame)
+            msg_id, kind, method, payload = rec[0], rec[1], rec[2], rec[3]
+            meta = rec[4] if len(rec) > 4 else None
         except Exception:
             logger.exception("%s: bad frame from %s", self.name, conn.peer)
             return
@@ -822,41 +1071,70 @@ class Server:
         if entry is None:
             conn.reply_error(msg_id, f"no handler for {method!r}")
             return
+        t0 = time.perf_counter()
+        with self._stats_lock:
+            st = self._mstats.get(method)
+            if st is None:
+                st = self._mstats[method] = MethodStats(budget_ms(method))
+            st.count += 1
+            st.bytes_in += len(frame)
+            if t_arr is not None:
+                st.qwait.observe(t0 - t_arr)
         fn, wants_deferred = entry
         token = payload.get(IDEM_KEY) if isinstance(payload, dict) else None
         if token is not None and msg_id != 0:
             if self._replay_begin(conn, msg_id, token):
+                with self._stats_lock:
+                    st.replays += 1
                 return  # duplicate: answered from the cache or parked
-        t0 = time.perf_counter()
+        with self._stats_lock:
+            st.inflight += 1
+        span_cm = None
+        if meta is not None and meta.get("tp"):
+            t = _trace_mod()
+            if t and t.is_enabled():
+                span_cm = t.rpc_server_span(
+                    method, {"traceparent": meta["tp"]}, server=self.name)
+                span_cm.__enter__()
+        d: Optional[Deferred] = None
         try:
             if wants_deferred:
-                d = (Deferred(conn, msg_id) if token is None
-                     else _RecordingDeferred(self, token, conn, msg_id))
+                d = (Deferred(conn, msg_id, server=self, method=method,
+                              t0=t0) if token is None
+                     else _RecordingDeferred(self, token, conn, msg_id,
+                                             method=method, t0=t0))
                 fn(conn, payload, d)
             else:
                 result = fn(conn, payload)
                 if token is not None:
                     self._replay_finish(token, result)
-                conn.reply(msg_id, result)
-            dt = time.perf_counter() - t0
-            st = self._handler_stats.get(method)
-            if st is None:
-                self._handler_stats[method] = [1, dt, dt]
-            else:
-                st[0] += 1
-                st[1] += dt
-                if dt > st[2]:
-                    st[2] = dt
+                nbytes = conn.reply(msg_id, result)
+                self._observe_done(method, time.perf_counter() - t0,
+                                   False, nbytes, st=st)
         except Exception as e:
             tb = traceback.format_exc()
             logger.debug("%s: handler %s raised: %s", self.name, method, e)
             err = f"{type(e).__name__}: {e}\n{tb}"
             if token is not None:
                 self._replay_fail(token, err)
+            if d is not None:
+                # the deferred may never resolve after a raise — close
+                # its accounting here (unless it already resolved before
+                # raising) and make a late resolve a no-op
+                if not d._done:
+                    d._done = True
+                    self._observe_done(method, time.perf_counter() - t0,
+                                       True, 0, st=st)
+            else:
+                self._observe_done(method, time.perf_counter() - t0,
+                                   True, 0, st=st)
             try:
                 conn.reply_error(msg_id, err)
             except OSError:
                 self._drop(conn.sock)
+        finally:
+            if span_cm is not None:
+                span_cm.__exit__(None, None, None)
 
     # -- idempotency replay (see IDEM_KEY) ----------------------------------
 
@@ -882,9 +1160,9 @@ class Server:
             value, is_error = entry.value, entry.is_error
         try:
             if is_error:
-                conn.reply_error(msg_id, value)
+                conn.reply_error(msg_id, value, meta={"re": 1})
             else:
-                conn.reply(msg_id, value)
+                conn.reply(msg_id, value, meta={"re": 1})
         except OSError:
             pass
         return True
@@ -900,7 +1178,7 @@ class Server:
             waiters, entry.waiters = entry.waiters, []
         for conn, msg_id in waiters:
             try:
-                conn.reply(msg_id, value)
+                conn.reply(msg_id, value, meta={"re": 1})
             except OSError:
                 pass
 
@@ -912,7 +1190,7 @@ class Server:
             waiters = entry.waiters if entry is not None else []
         for conn, msg_id in waiters:
             try:
-                conn.reply_error(msg_id, err)
+                conn.reply_error(msg_id, err, meta={"re": 1})
             except OSError:
                 pass
 

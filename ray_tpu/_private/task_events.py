@@ -31,11 +31,20 @@ class TaskEventBuffer:
     """Thread-safe accumulator; a daemon thread flushes to the control plane."""
 
     def __init__(self, control_client, *, worker_id: str = "",
-                 node_id: str = "", job_id: str = ""):
+                 node_id: str = "", job_id: str = "",
+                 transport=None):
         self._client = control_client
+        # optional transport override: fn(payload) sending the batch
+        # somewhere other than the direct control call.  Workers pass a
+        # raylet-relay notify here so each node makes ONE control write
+        # per flush window instead of one per worker (satellite of
+        # ROADMAP item 5's per-node batching direction).
+        self._transport = transport
         self._worker_id = worker_id
         self._node_id = node_id
         self._job_id = job_id
+        self._flushed_batches = 0
+        self._flushed_events = 0
         self._lock = threading.Lock()
         # deque, NOT list: drop-oldest at capacity must stay O(1) —
         # list.pop(0) shifts the whole buffer per append once saturated,
@@ -149,13 +158,19 @@ class TaskEventBuffer:
             self._submit_dropped = 0
             if not batch and not dropped:
                 return
+        payload = {"events": batch, "dropped": dropped,
+                   "common": {"job_id": self._job_id,
+                              "node_id": self._node_id,
+                              "worker_id": self._worker_id}}
         try:
-            self._client.call("report_task_events",
-                              {"events": batch, "dropped": dropped,
-                               "common": {"job_id": self._job_id,
-                                          "node_id": self._node_id,
-                                          "worker_id": self._worker_id}},
-                              timeout=5.0)
+            if self._transport is not None:
+                self._transport(payload)
+            else:
+                self._client.call("report_task_events", payload,
+                                  timeout=5.0)
+            with self._lock:
+                self._flushed_batches += 1
+                self._flushed_events += len(batch)
         except Exception:
             # control plane unreachable: re-queue (bounded) so a blip
             # doesn't lose the whole window; anything truncated off the
@@ -167,6 +182,15 @@ class TaskEventBuffer:
                 self._events = collections.deque(merged[cut:],
                                                  maxlen=MAX_BUFFERED)
                 self._dropped += dropped + cut
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "buffered": len(self._events) + len(self._submit_ring),
+                "flushed_batches": self._flushed_batches,
+                "flushed_events": self._flushed_events,
+                "dropped": self._dropped + self._submit_dropped,
+            }
 
     def stop(self):
         self._stop.set()
@@ -187,6 +211,10 @@ class _NullBuffer:
 
     def flush(self):
         pass
+
+    def stats(self):
+        return {"buffered": 0, "flushed_batches": 0,
+                "flushed_events": 0, "dropped": 0}
 
     def stop(self):
         pass

@@ -122,10 +122,54 @@ def _scan_function(index: Index, fn: FunctionInfo) -> _FuncFacts:
     return facts
 
 
+def _budgeted_handlers(index: Index) -> Dict[Tuple[str, str], str]:
+    """(module rel, handler function name) -> budgeted RPC method, from
+    ``.handle("method", self.h_x)`` registration calls against the
+    runtime budget table (rpc_stats.HANDLER_BUDGETS_MS).  A budgeted
+    handler runs on a server event loop with a latency ceiling: holding
+    a lock across a blocking call there is not a style warning, it is a
+    stall of every connection — the lock-held-blocking pass promotes it
+    to a distinct, never-baselined rule."""
+    try:
+        from ray_tpu._private.rpc_stats import HANDLER_BUDGETS_MS
+    except Exception:   # analyzer must stand alone if the runtime moved
+        return {}
+    out: Dict[Tuple[str, str], str] = {}
+    for m in index.modules:
+        for node in ast.walk(m.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "handle"
+                    and len(node.args) >= 2):
+                continue
+            meth = node.args[0]
+            target = node.args[1]
+            if not (isinstance(meth, ast.Constant)
+                    and isinstance(meth.value, str)
+                    and meth.value in HANDLER_BUDGETS_MS):
+                continue
+            if isinstance(target, ast.Attribute):
+                out[(m.rel, target.attr)] = meth.value
+            elif isinstance(target, ast.Name):
+                out[(m.rel, target.id)] = meth.value
+    return out
+
+
 def run(index: Index) -> List[Finding]:
     facts: Dict[Tuple[str, str], _FuncFacts] = {}
     for key, fn in index.functions.items():
         facts[key] = _scan_function(index, fn)
+    budgeted = _budgeted_handlers(index)
+
+    def budget_method(fn: FunctionInfo) -> Optional[str]:
+        # nested defs inside a handler (waiter closures etc.) run on the
+        # same dispatch, so any qualname segment naming a budgeted
+        # handler taints the whole function
+        for seg in fn.qualname.split("."):
+            meth = budgeted.get((fn.module.rel, seg))
+            if meth is not None:
+                return meth
+        return None
 
     # transitive acquired-locks fixpoint over the resolved call graph
     for f in facts.values():
@@ -196,6 +240,7 @@ def run(index: Index) -> List[Finding]:
     for key, f in facts.items():
         fn = index.functions[key]
         direct_lines = set()
+        meth = budget_method(fn)
         for held, sym, line in f.blocking:
             if held and not _suppressed(fn, line):
                 direct_lines.add(line)
@@ -204,6 +249,16 @@ def run(index: Index) -> List[Finding]:
                     fn.qualname, f"{held[-1]}:{sym}",
                     f"blocking call {sym} while holding "
                     f"{', '.join(held)} in {fn.qualname}", line))
+                if meth is not None:
+                    findings.append(Finding(
+                        PASS, "budget-held-blocking", fn.module.rel,
+                        fn.qualname, f"{meth}:{held[-1]}:{sym}",
+                        f"blocking call {sym} while holding "
+                        f"{', '.join(held)} in {fn.qualname} — handler "
+                        f"of budgeted RPC {meth!r} "
+                        f"(rpc_stats.HANDLER_BUDGETS_MS); it stalls the "
+                        f"server event loop past its latency budget",
+                        line))
         for held, callee, name, line in f.calls:
             if not held or callee not in facts:
                 continue
@@ -217,6 +272,16 @@ def run(index: Index) -> List[Finding]:
                     fn.qualname, f"{held[-1]}:call:{name}",
                     f"call to {name} (which blocks) while holding "
                     f"{', '.join(held)} in {fn.qualname}", line))
+                if meth is not None:
+                    findings.append(Finding(
+                        PASS, "budget-held-blocking", fn.module.rel,
+                        fn.qualname, f"{meth}:{held[-1]}:call:{name}",
+                        f"call to {name} (which blocks) while holding "
+                        f"{', '.join(held)} in {fn.qualname} — handler "
+                        f"of budgeted RPC {meth!r} "
+                        f"(rpc_stats.HANDLER_BUDGETS_MS); it stalls the "
+                        f"server event loop past its latency budget",
+                        line))
     return findings
 
 

@@ -347,11 +347,23 @@ class DashboardHead:
                 from ray_tpu._private.usage_stats import usage_report
 
                 return self._json(usage_report(self.control))
+            if path == "/api/control/stats":
+                return self._json(
+                    self.control.call("control_stats", {}, timeout=10.0))
             if path == "/metrics":
                 from ray_tpu.util.metrics import (collect_cluster_metrics,
+                                                  control_stats_metrics,
                                                   prometheus_text)
 
                 merged = collect_cluster_metrics(self.control)
+                # the control daemon has no flusher of its own: synthesize
+                # its ray_tpu_control_* series from the control_stats RPC
+                try:
+                    merged.extend(control_stats_metrics(
+                        self.control.call("control_stats", {},
+                                          timeout=10.0)))
+                except Exception:
+                    pass
                 return 200, "text/plain; version=0.0.4", \
                     prometheus_text(merged)
             return 404, "text/plain", f"no route {path}"

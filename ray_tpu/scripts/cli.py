@@ -465,6 +465,88 @@ def cmd_memory(args):
     print(json.dumps(state.summarize_objects(address=address), indent=2))
 
 
+def cmd_control_stats(args):
+    """Control-plane flight recorder: per-handler latency table plus
+    loop-lag / KV / pubsub / event-relay counters."""
+    from ray_tpu.util.state import api as state
+
+    snap = state.control_stats(address=_resolve_address(args),
+                               per_node=args.per_node)
+    if args.format == "json":
+        print(json.dumps(snap, indent=2, default=str))
+        return
+
+    def _table(handlers):
+        rows = []
+        for method, s in sorted(handlers.items()):
+            if not s.get("count") and not args.all:
+                continue
+            q, h = s.get("queue_ms") or {}, s.get("handle_ms") or {}
+            budget = s.get("budget_ms")
+            rows.append((
+                method, s.get("count", 0), s.get("errors", 0),
+                s.get("in_flight", 0),
+                f"{q.get('p50_ms', 0):g}/{q.get('p99_ms', 0):g}",
+                f"{h.get('p50_ms', 0):g}/{h.get('p99_ms', 0):g}",
+                f"{budget:g}" if budget is not None else "-",
+                s.get("budget_exceeded", 0) if budget is not None else "-",
+            ))
+        if not rows:
+            print("  (no calls recorded)")
+            return
+        hdr = ("handler", "count", "err", "infl", "queue p50/p99 ms",
+               "handle p50/p99 ms", "budget", "over")
+        widths = [max(len(str(r[i])) for r in rows + [hdr])
+                  for i in range(len(hdr))]
+        for r in [hdr] + rows:
+            print("  " + "  ".join(str(v).ljust(w)
+                                   for v, w in zip(r, widths)).rstrip())
+
+    c = snap["control"]
+    print(f"control plane (up {c.get('uptime_s', 0):.0f}s, "
+          f"{c.get('nodes', {}).get('alive', 0)} alive node(s))")
+    _table(c.get("handlers") or {})
+    loop = c.get("loop") or {}
+    lag = loop.get("lag_ms") or {}
+    print(f"loop: lag p99 {lag.get('p99_ms', 0):g}ms "
+          f"max {lag.get('max_ms', 0):g}ms over {lag.get('count', 0)} "
+          f"ticks, {loop.get('frames', 0)} frames in "
+          f"{loop.get('drains', 0)} drains "
+          f"(max batch {loop.get('max_drain_batch', 0)}), "
+          f"{loop.get('connections', 0)} connection(s)")
+    kv = c.get("kv") or {}
+    if kv:
+        print("kv namespaces:")
+        for ns, s in sorted(kv.items(), key=lambda i: -i[1]["ops"]):
+            print(f"  {ns:24s} ops {s['ops']:<8d} "
+                  f"in {s['bytes_in']:<10d} out {s['bytes_out']}")
+    ps = c.get("pubsub") or {}
+    if ps:
+        print("pubsub topics:")
+        for t, s in sorted(ps.items(), key=lambda i: -i[1]["publishes"]):
+            n = max(1, s.get("publishes", 0))
+            print(f"  {t:24s} pub {s['publishes']:<7d} "
+                  f"deliv {s['deliveries']:<8d} "
+                  f"drop {s['dropped_subscribers']:<4d} "
+                  f"fanout avg {s['fanout_ms_total'] / n:.3f}ms "
+                  f"max {s['fanout_ms_max']:.3f}ms")
+    ev = c.get("events") or {}
+    print(f"task events: queue {ev.get('queue_depth', 0)}, "
+          f"records {ev.get('task_records', 0)}, "
+          f"dropped {ev.get('dropped', 0)}, relay batches "
+          f"{ev.get('relay_batches', 0)} "
+          f"(+{ev.get('relay_dropped', 0)} dropped in relays)")
+    for nid, r in (snap.get("raylets") or {}).items():
+        if "error" in r:
+            print(f"raylet {nid[:12]}: error: {r['error']}")
+            continue
+        rl = r.get("loop") or {}
+        rlag = rl.get("lag_ms") or {}
+        print(f"raylet {nid[:12]} (loop lag p99 "
+              f"{rlag.get('p99_ms', 0):g}ms)")
+        _table(r.get("handlers") or {})
+
+
 def cmd_analyze(args):
     from ray_tpu import analysis
     from ray_tpu.analysis import baseline as bl
@@ -629,6 +711,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("memory", help="object store summary")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser(
+        "control-stats",
+        help="control-plane flight recorder: per-handler RPC latency, "
+             "loop lag, KV/pubsub/event counters")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--per-node", action="store_true",
+                    help="also query every raylet's rpc/loop stats")
+    sp.add_argument("--all", action="store_true",
+                    help="include handlers with zero calls")
+    sp.add_argument("--format", choices=("text", "json"), default="text")
+    sp.set_defaults(fn=cmd_control_stats)
 
     return p
 

@@ -146,6 +146,123 @@ def collect_cluster_metrics(control_client) -> List[Dict]:
     return merged
 
 
+def control_stats_metrics(stats: Dict) -> List[Dict]:
+    """Synthesize ``ray_tpu_control_*`` metric dicts from one
+    ``control_stats`` RPC reply.
+
+    The control daemon has no CoreWorker, so it cannot flush through the
+    KV path like user processes do — the dashboard calls this instead and
+    merges the result into ``/metrics`` alongside the cluster snapshots.
+    Output shape matches registry snapshots (prometheus_text input).
+    """
+    from ray_tpu._private.rpc_stats import BOUNDS_MS
+
+    out: List[Dict] = []
+
+    def metric(name: str, type_: str, desc: str, series: Dict,
+               boundaries: Optional[List[float]] = None):
+        if not series:
+            return
+        m = {"name": name, "type": type_, "description": desc,
+             "series": series, "worker_id": "control"}
+        if boundaries is not None:
+            m["boundaries"] = boundaries
+        out.append(m)
+
+    def key(**tags) -> str:
+        return json.dumps(tags, sort_keys=True)
+
+    def hist_val(snap: Dict) -> Tuple[List[int], float, int]:
+        # LatencyHist snapshot -> (bucket_counts, sum, count); the
+        # overflow bucket folds into +Inf via the total count
+        return (list(snap["buckets"][:len(BOUNDS_MS)]),
+                snap["sum_ms"], snap["count"])
+
+    bounds = list(BOUNDS_MS)
+    counts: Dict[str, float] = {}
+    errors: Dict[str, float] = {}
+    inflight: Dict[str, float] = {}
+    rpc_bytes: Dict[str, float] = {}
+    budget_exc: Dict[str, float] = {}
+    handle_h: Dict[str, Tuple] = {}
+    queue_h: Dict[str, Tuple] = {}
+    for method, s in (stats.get("handlers") or {}).items():
+        k = key(Method=method)
+        counts[k] = s.get("count", 0)
+        errors[k] = s.get("errors", 0)
+        inflight[k] = s.get("in_flight", 0)
+        rpc_bytes[key(Method=method, Direction="in")] = s.get("bytes_in", 0)
+        rpc_bytes[key(Method=method, Direction="out")] = s.get("bytes_out", 0)
+        if "budget_exceeded" in s:
+            budget_exc[k] = s["budget_exceeded"]
+        if s.get("handle_ms"):
+            handle_h[k] = hist_val(s["handle_ms"])
+        if s.get("queue_ms"):
+            queue_h[k] = hist_val(s["queue_ms"])
+    metric("ray_tpu_control_rpc_total", "counter",
+           "RPCs dispatched per control-plane handler", counts)
+    metric("ray_tpu_control_rpc_errors_total", "counter",
+           "Handler invocations that raised", errors)
+    metric("ray_tpu_control_rpc_in_flight", "gauge",
+           "Requests currently being handled", inflight)
+    metric("ray_tpu_control_rpc_bytes_total", "counter",
+           "Request/reply payload bytes per handler", rpc_bytes)
+    metric("ray_tpu_control_rpc_budget_exceeded_total", "counter",
+           "Handler completions over their latency budget", budget_exc)
+    metric("ray_tpu_control_rpc_handle_ms", "histogram",
+           "Handler execution latency (dispatch start -> reply)",
+           handle_h, bounds)
+    metric("ray_tpu_control_rpc_queue_ms", "histogram",
+           "Dispatch-queue wait (frame received -> dispatch start)",
+           queue_h, bounds)
+
+    loop = stats.get("loop") or {}
+    if loop.get("lag_ms"):
+        metric("ray_tpu_control_loop_lag_ms", "histogram",
+               "Event-loop tick lag (scheduled vs actual)",
+               {key(): hist_val(loop["lag_ms"])}, bounds)
+
+    kv_ops: Dict[str, float] = {}
+    kv_bytes: Dict[str, float] = {}
+    for ns, s in (stats.get("kv") or {}).items():
+        kv_ops[key(Namespace=ns)] = s.get("ops", 0)
+        kv_bytes[key(Namespace=ns, Direction="in")] = s.get("bytes_in", 0)
+        kv_bytes[key(Namespace=ns, Direction="out")] = s.get("bytes_out", 0)
+    metric("ray_tpu_control_kv_ops_total", "counter",
+           "KV operations per namespace", kv_ops)
+    metric("ray_tpu_control_kv_bytes_total", "counter",
+           "KV payload bytes per namespace", kv_bytes)
+
+    pub: Dict[str, float] = {}
+    deliv: Dict[str, float] = {}
+    pdrop: Dict[str, float] = {}
+    for topic, s in (stats.get("pubsub") or {}).items():
+        k = key(Topic=topic)
+        pub[k] = s.get("publishes", 0)
+        deliv[k] = s.get("deliveries", 0)
+        pdrop[k] = s.get("dropped_subscribers", 0)
+    metric("ray_tpu_control_pubsub_publishes_total", "counter",
+           "Messages published per topic", pub)
+    metric("ray_tpu_control_pubsub_deliveries_total", "counter",
+           "Per-subscriber deliveries per topic", deliv)
+    metric("ray_tpu_control_pubsub_dropped_subscribers_total", "counter",
+           "Deliveries dropped on dead subscriber connections", pdrop)
+
+    ev = stats.get("events") or {}
+    if ev:
+        metric("ray_tpu_control_event_queue_depth", "gauge",
+               "Buffered task-event batches awaiting drain",
+               {key(): ev.get("queue_depth", 0)})
+        metric("ray_tpu_control_task_events_dropped_total", "counter",
+               "Task events dropped cluster-wide",
+               {key(): ev.get("dropped", 0)})
+    nodes = stats.get("nodes") or {}
+    if nodes:
+        metric("ray_tpu_control_nodes_alive", "gauge",
+               "Nodes currently ALIVE", {key(): nodes.get("alive", 0)})
+    return out
+
+
 def prometheus_text(metric_dicts: List[Dict]) -> str:
     """Render merged snapshots in Prometheus exposition format."""
     by_name: Dict[str, List[Dict]] = {}

@@ -26,6 +26,7 @@ __all__ = [
     "get_node", "get_actor", "get_task", "get_placement_group",
     "summarize_tasks", "summarize_actors", "summarize_objects",
     "cluster_resources", "available_resources", "timeline", "StateApiClient",
+    "control_stats",
 ]
 
 
@@ -74,6 +75,12 @@ class StateApiClient:
     def profile_events(self, limit=50000) -> List[Dict[str, Any]]:
         return self._control.call("list_profile_events", {"limit": limit},
                                   timeout=10.0)
+
+    def control_stats(self) -> Dict[str, Any]:
+        """Control-plane flight-recorder snapshot: per-handler RPC stats,
+        loop lag, KV namespace counters, pubsub fan-out, event-queue
+        depth (the `ray-tpu control-stats` CLI renders this)."""
+        return self._control.call("control_stats", {}, timeout=10.0)
 
     def per_node(self, method: str, payload=None) -> Dict[str, Any]:
         """Fan a query out to every alive raylet (node_id -> reply)."""
@@ -215,6 +222,24 @@ def get_log(name: str, address: Optional[str] = None, *,
             if node_id is not None and nid != node_id:
                 continue
             out[nid] = text
+        return out
+    return _run(address, go)
+
+
+def control_stats(address: Optional[str] = None,
+                  *, per_node: bool = False) -> Dict[str, Any]:
+    """Control-plane flight recorder snapshot; with ``per_node=True``
+    also fans ``rpc_stats`` + ``loop_stats`` out to every alive raylet
+    so one call covers every control-plane server in the cluster."""
+    def go(c):
+        out = {"control": c.control_stats()}
+        if per_node:
+            handlers = c.per_node("rpc_stats")
+            loops = c.per_node("loop_stats")
+            out["raylets"] = {
+                nid: (reply if isinstance(reply, dict) and "error" in reply
+                      else {"handlers": reply, "loop": loops.get(nid)})
+                for nid, reply in handlers.items()}
         return out
     return _run(address, go)
 

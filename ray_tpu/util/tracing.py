@@ -142,6 +142,22 @@ def execute_span(kind: str, name: str,
                  _extract(carrier), **attrs)
 
 
+def rpc_client_span(method: str, **attrs):
+    """CLIENT span around one framed-RPC round trip.  Only opened when a
+    span context is already active, so the control-plane conversation of
+    a traced task (submit -> lease -> push -> reply) nests under the
+    task's PRODUCER span instead of flooding the trace with orphans."""
+    return _span(f"rpc {method}", "CLIENT", None, **attrs)
+
+
+def rpc_server_span(method: str, carrier: Optional[Dict[str, str]],
+                    **attrs):
+    """SERVER span around handler execution, linked to the caller's
+    CLIENT span via the traceparent carried in the frame meta."""
+    return _span(f"rpc.handle {method}", "SERVER", _extract(carrier),
+                 **attrs)
+
+
 # -- built-in file exporter hook --------------------------------------------
 
 _file_lock = threading.Lock()

@@ -68,7 +68,7 @@ def _collect_cycles_after_test(request):
 # ~20-minute run.  Files not listed get `slow`.
 _QUICK_FILES = {
     "test_asyncio_api.py", "test_collective_compression.py",
-    "test_config.py", "test_core_actors.py",
+    "test_config.py", "test_control_stats.py", "test_core_actors.py",
     "test_core_objects.py", "test_core_tasks.py", "test_data.py",
     "test_data_remote_io.py", "test_elastic.py", "test_label_scheduling.py",
     "test_native_sched.py", "test_native_store.py", "test_ops.py",

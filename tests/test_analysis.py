@@ -55,6 +55,29 @@ def test_lock_order_fixture():
     assert len(_keys(fs)) == len(set(_keys(fs)))
 
 
+def test_budget_promotion_fixture():
+    fs = _scan("fx_budget_blocking.py")
+    # both handlers warn as plain lock-held-blocking
+    held = sorted(f.func for f in fs if f.rule == "lock-held-blocking")
+    assert held == ["MiniServer.h_kv_put", "MiniServer.h_wait_thing"]
+    # only the budgeted handler (kv_put in HANDLER_BUDGETS_MS) is
+    # promoted to the gating rule, with the RPC method in the detail
+    promoted = [f for f in fs if f.rule == "budget-held-blocking"]
+    assert [(f.func, f.detail) for f in promoted] == [
+        ("MiniServer.h_kv_put", "kv_put:MiniServer.lock:time.sleep")]
+    # the clean control stays silent under every rule
+    assert not any(f.func == "MiniServer.h_clean" for f in fs)
+
+
+def test_budget_promotion_repo_clean():
+    """The checked-in budget table deliberately excludes the long-poll
+    handlers owning the baselined lock-held-blocking findings, so the
+    promotion yields zero gating findings on the repo itself."""
+    fs = analysis.run_analysis()
+    assert [f.render() for f in fs
+            if f.rule == "budget-held-blocking"] == []
+
+
 def test_guarded_by_fixture():
     fs = _scan("fx_guarded_by.py")
     mine = [f for f in fs if f.pass_id == "guarded_by"]
