@@ -69,6 +69,11 @@ CONFIG_DEFS: List[Tuple[str, type, Any, str]] = [
      "max TaskSpecs coalesced into one framed push_tasks RPC per leased "
      "worker; 1 = escape hatch, bypasses the combining flusher and ships "
      "one spec per frame (bit-identical semantics, no coalescing)"),
+    ("submit_mux", bool, True,
+     "multi-client submit multiplexer: when a raylet sees >=2 concurrent "
+     "driver processes it relays their eligible plain tasks itself (one "
+     "framed stream per driver, no per-driver lease conversations); "
+     "0 = escape hatch, every driver keeps its own lease protocol"),
     ("lease_grant_batch", int, 16,
      "max leases requested from the raylet in one request_leases RPC "
      "(the vectorized ramp-up; 1 degrades to the old one-lease-per-"

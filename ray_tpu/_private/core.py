@@ -51,6 +51,9 @@ from .config import cfg as _cfg
 PIPELINE_DEPTH = _cfg().pipeline_depth  # pushes per lease before waiting
 DELETE_GRACE_S = _cfg().delete_grace_s
 IDLE_LEASE_TTL_S = _cfg().idle_lease_ttl_s
+# how long a DEAD-actor verdict from the control plane is trusted before
+# submit_actor_task re-probes for a revived incarnation
+DEAD_RECHECK_TTL_S = 1.0
 
 
 # ---------------------------------------------------------------------------
@@ -290,7 +293,7 @@ class ObjectEntry:
 
 class TaskRecord:
     __slots__ = ("spec", "pool_key", "deps", "pushed_to", "retries_left",
-                 "done", "canceled")
+                 "done", "canceled", "mux")
 
     def __init__(self, spec: TaskSpec, pool_key, retries_left: int):
         self.spec = spec
@@ -300,6 +303,7 @@ class TaskRecord:
         self.retries_left = retries_left
         self.done = False
         self.canceled = False
+        self.mux = False          # routed via the raylet submit relay
 
 
 class LeasedWorker:
@@ -353,16 +357,23 @@ class SchedPool:
 class ActorConn:
     def __init__(self, actor_id: str):
         self.actor_id = actor_id
-        self.client: Optional[Client] = None
+        self.client: Optional[Client] = None   # guarded-by: lock
         self.addr = None
         self.incarnation = -1
-        self.seq = 0
-        self.state = "PENDING"
-        self.buffer: deque = deque()       # specs not yet sent
-        self.inflight: Dict[str, TaskSpec] = {}
+        self.seq = 0                           # guarded-by: lock
+        self.state = "PENDING"                 # guarded-by: lock
+        # staging queue: specs not yet shipped.  In batched mode
+        # (submit_batch > 1) submit_actor_task appends here and the
+        # combining flusher drains it; in legacy mode it holds only
+        # calls staged while the conn is PENDING/RECONNECTING.
+        self.buffer: deque = deque()           # guarded-by: lock
+        self.inflight: Dict[str, TaskSpec] = {}  # guarded-by: lock
         self.lock = threading.Lock()
         self.resolving = False
         self.dead_error: Optional[str] = None
+        # monotonic deadline below which a DEAD verdict is trusted
+        # without re-probing the control plane (revival-probe TTL)
+        self.dead_recheck_at = 0.0             # guarded-by: lock
         self.max_task_retries = 0
 
 
@@ -423,7 +434,8 @@ class CoreWorker:
         self.raylet_addr = None
         if raylet_addr is not None:
             self.raylet = Client(raylet_addr, name=f"{mode}->raylet",
-                                 on_push=self._on_raylet_push)
+                                 on_push=self._on_raylet_push,
+                                 on_disconnect=self._on_raylet_lost)
             self.raylet_addr = tuple(raylet_addr)
 
         # local shm store access (same node as raylet)
@@ -500,9 +512,22 @@ class CoreWorker:
         # adapts to the submission rate (busy flusher -> bigger batches).
         self._flush_cv = threading.Condition()
         self._flush_dirty: Set[SchedPool] = set()   # guarded-by: _flush_cv
+        # actor conns with staged calls awaiting a flusher pass
+        self._flush_dirty_actors: Set[ActorConn] = set()  # guarded-by: _flush_cv
+        # multi-client submit multiplexer (raylet-side relay).  Eligible
+        # plain tasks stage here instead of a SchedPool once the raylet
+        # reports >=2 concurrent drivers; the flusher ships them as
+        # framed mux_push_tasks envelopes and the raylet schedules them
+        # without per-driver lease conversations.
+        self._mux_enabled = bool(getattr(c, "submit_mux", True)) \
+            and self._submit_batch > 1
+        self._mux_on = False                        # guarded-by: lock
+        self._mux_staged: deque = deque()           # guarded-by: lock
+        self._mux_dirty = False                     # guarded-by: _flush_cv
         # telemetry: push_tasks batch-size histogram + flush-latency sums
         self._stats_lock = threading.Lock()
         self._submit_hist: Dict[int, int] = {}      # guarded-by: _stats_lock
+        self._actor_hist: Dict[int, int] = {}       # guarded-by: _stats_lock
         self._actor_sends = 0                       # guarded-by: _stats_lock
         self._flush_stats = {"flushes": 0, "tasks": 0,  # guarded-by: _stats_lock
                              "latency_ms_total": 0.0, "latency_ms_max": 0.0}
@@ -656,9 +681,13 @@ class CoreWorker:
         submission rate is low)."""
         while not self._shutdown:
             with self._flush_cv:
-                while not self._flush_dirty and not self._shutdown:
+                while (not self._flush_dirty and not self._flush_dirty_actors
+                       and not self._mux_dirty and not self._shutdown):
                     self._flush_cv.wait(0.5)
                 dirty, self._flush_dirty = self._flush_dirty, set()
+                dirty_actors, self._flush_dirty_actors = \
+                    self._flush_dirty_actors, set()
+                mux_dirty, self._mux_dirty = self._mux_dirty, False
             if self._shutdown:
                 return
             t0 = time.monotonic()
@@ -667,6 +696,16 @@ class CoreWorker:
                     self._pump(pool)
                 except Exception:
                     logger.exception("submit flush failed")
+            for ac in dirty_actors:
+                try:
+                    self._flush_actor_conn(ac)
+                except Exception:
+                    logger.exception("actor submit flush failed")
+            if mux_dirty:
+                try:
+                    self._flush_mux()
+                except Exception:
+                    logger.exception("mux submit flush failed")
             ms = (time.monotonic() - t0) * 1000.0
             with self._stats_lock:
                 st = self._flush_stats
@@ -679,6 +718,7 @@ class CoreWorker:
         """Snapshot of the submission-batching counters (bench/debug)."""
         with self._stats_lock:
             return {"batch_hist": dict(self._submit_hist),
+                    "actor_batch_hist": dict(self._actor_hist),
                     "actor_sends": self._actor_sends,
                     "flush": dict(self._flush_stats)}
 
@@ -1486,6 +1526,7 @@ class CoreWorker:
         # ONE lock acquisition for all submission bookkeeping: this path
         # runs once per .remote() and ping-pongs the core lock with the
         # reply thread during 100k-task bursts
+        pool = None
         with self.lock:
             for oid in spec.return_ids():
                 e = self.objects.get(oid)
@@ -1499,14 +1540,25 @@ class CoreWorker:
                 e.lineage = spec
                 e.attempts += 1
                 refs.append(ObjectRef(oid, self.addr, self.worker_id))
-            pool = self.pools.get(key)
-            if pool is None:
-                pool = self.pools[key] = SchedPool(key)
-            pool.queue.append(rec)
+            if self._mux_on and self._mux_eligible(spec):
+                # relay mode: the raylet schedules this task itself, no
+                # per-driver lease conversation.  Staged specs still live
+                # in task_records so cancel()/liveness checks see them.
+                rec.mux = True
+                self._mux_staged.append(rec)
+            else:
+                pool = self.pools.get(key)
+                if pool is None:
+                    pool = self.pools[key] = SchedPool(key)
+                pool.queue.append(rec)
             self.task_records[spec.task_id] = rec  # cancel() lookup
         self.task_events.record_submit(
             spec.task_id, spec.function_name, "NORMAL_TASK")
-        if self._submit_batch <= 1:
+        if pool is None:
+            with self._flush_cv:
+                self._mux_dirty = True
+                self._flush_cv.notify()
+        elif self._submit_batch <= 1:
             # escape hatch: bypass the combining flusher, ship inline
             # exactly like the pre-batching path
             self._pump(pool)
@@ -1748,6 +1800,11 @@ class CoreWorker:
                     canceled = True
                     continue
                 raise RuntimeError(f"lease request failed: {r}")
+            if r.get("mux") and self._mux_enabled \
+                    and addr in (None, self.raylet_addr):
+                # the local raylet sees multiple concurrent submitters:
+                # route future eligible submissions through the relay
+                self._mux_flip_on()
             node_id = r["node_id"]
             for g in r.get("grants", []):
                 with self.lock:
@@ -2122,6 +2179,176 @@ class CoreWorker:
         if topic == "reclaim_idle_leases":
             # off the push thread: returning leases does RPCs
             self.pool_executor.submit(self.flush_idle_leases)
+        elif topic == "submit_mux":
+            if self._mux_enabled and payload.get("on"):
+                self._mux_flip_on()
+        elif topic == "mux_tasks_done":
+            self._on_mux_tasks_done(payload)
+        elif topic == "mux_task_failed":
+            self._on_mux_task_failed(payload)
+
+    # ------------------------------------------------------------------
+    # multi-client submit multiplexer (driver side).  The raylet flips
+    # mux on when it observes >=2 concurrent external submitters; from
+    # then on eligible plain tasks ship as framed mux_push_tasks
+    # notifies on the ONE existing driver->raylet connection and the
+    # raylet schedules them itself — N drivers stop holding N separate
+    # pick_nodes/request_leases/push conversations with the control
+    # plane and each other's reclaim storms.
+    # ------------------------------------------------------------------
+
+    def _mux_flip_on(self):
+        """First submit_mux signal: route future eligible submissions
+        through the relay AND migrate eligible tasks already staged in
+        classic pools.  On a saturated node the relay can hold every
+        worker slot, so a task parked in a pool behind an unanswered
+        lease request would otherwise starve until the relay queue
+        drains; moving it keeps one burst from straddling both paths."""
+        moved = False
+        with self.lock:
+            if self._mux_on:
+                return
+            self._mux_on = True
+            for pool in self.pools.values():
+                keep: deque = deque()
+                while pool.queue:
+                    rec = pool.queue.popleft()
+                    if not rec.canceled and not rec.done \
+                            and self._mux_eligible(rec.spec):
+                        rec.mux = True
+                        self._mux_staged.append(rec)
+                        moved = True
+                    else:
+                        keep.append(rec)
+                pool.queue.extend(keep)
+        if moved:
+            with self._flush_cv:
+                self._mux_dirty = True
+                self._flush_cv.notify()
+
+    def _mux_eligible(self, spec: TaskSpec) -> bool:
+        # only the plain-CPU fast path rides the relay: placement
+        # groups, affinity strategies, custom resources and streaming
+        # generators keep the classic per-driver lease conversation
+        return (self.raylet is not None
+                and spec.placement_group_id is None
+                and spec.scheduling_strategy is None
+                and spec.num_returns != STREAMING_RETURNS
+                and spec.resources == self._DEFAULT_RESOURCES)
+
+    def _flush_mux(self):
+        """Flusher-thread drain of the mux staging queue (mirrors
+        _push_batched, with the raylet as the single destination)."""
+        with self.lock:
+            staged = list(self._mux_staged)
+            self._mux_staged.clear()
+            for rec in staged:
+                rec.pushed_to = "__mux__"
+            raylet = self.raylet
+        if not staged or raylet is None:
+            return
+        for i in range(0, len(staged), self._submit_batch):
+            chunk = staged[i:i + self._submit_batch]
+            with self._stats_lock:
+                h = self._submit_hist
+                h[len(chunk)] = h.get(len(chunk), 0) + 1
+                self._flush_stats["tasks"] += len(chunk)
+            try:
+                raylet.notify("mux_push_tasks",
+                              {"client_id": self.worker_id,
+                               "specs": [rec.spec for rec in chunk]})
+            except (ConnectionLost, OSError) as e:
+                for rec in chunk:
+                    self._mux_task_failed(rec, str(e))
+
+    def _on_mux_tasks_done(self, items):
+        """Coalesced completions relayed by the raylet (reader thread);
+        the lease-free twin of _on_tasks_done."""
+        finished: List[Tuple[TaskRecord, Dict[str, Any]]] = []
+        with self.lock:
+            for task_id, reply in items:
+                rec = self.task_records.get(task_id)
+                if rec is None or rec.done:
+                    continue   # late duplicate (e.g. post-retry ack)
+                rec.done = True
+                self.task_records.pop(task_id, None)
+                finished.append((rec, reply))
+        for rec, reply in finished:
+            if rec.canceled and reply.get("status") != "ok":
+                reply = {"status": "error",
+                         "error": serialization.dumps_inline(
+                             TaskCancelledError(
+                                 f"task {rec.spec.function_name} "
+                                 f"was cancelled"))}
+            self._store_results(rec.spec, reply)
+
+    def _on_mux_task_failed(self, items):
+        """The raylet reports relay tasks whose worker died: retry by
+        restaging, else error out (same policy as _on_task_failure)."""
+        retry = False
+        failed: List[Tuple[TaskRecord, str]] = []
+        with self.lock:
+            for task_id, errstr in items:
+                rec = self.task_records.get(task_id)
+                # pushed_to guard: a restaged rec (pushed_to None) must
+                # not be claimed twice by duplicate failure reports
+                if rec is None or rec.done or not rec.mux \
+                        or rec.pushed_to != "__mux__":
+                    continue
+                rec.pushed_to = None
+                if rec.retries_left > 0 and not self._shutdown \
+                        and not rec.canceled:
+                    rec.retries_left -= 1
+                    self._mux_staged.append(rec)
+                    retry = True
+                else:
+                    self.task_records.pop(task_id, None)
+                    failed.append((rec, errstr))
+        if retry:
+            with self._flush_cv:
+                self._mux_dirty = True
+                self._flush_cv.notify()
+        for rec, errstr in failed:
+            self._mux_error_out(rec, errstr)
+
+    def _mux_task_failed(self, rec: TaskRecord, errstr: str):
+        """Synchronous-send failure for ONE staged rec (raylet conn
+        already closed at enqueue)."""
+        self._on_mux_task_failed([(rec.spec.task_id, errstr)])
+
+    def _mux_error_out(self, rec: TaskRecord, errstr: str):
+        if rec.canceled:
+            err: BaseException = TaskCancelledError(
+                f"task {rec.spec.function_name} was cancelled")
+        else:
+            err = WorkerCrashedError(
+                f"task {rec.spec.function_name} failed: worker died "
+                f"({errstr})")
+        self.task_events.record_status(
+            rec.spec.task_id, "FAILED", name=rec.spec.function_name,
+            error=str(err))
+        for oid in rec.spec.return_ids():
+            with self.lock:
+                e = self.objects.get(oid)
+            if e is not None and not e.ready:
+                e.error = err
+                e.event.set()
+
+    def _on_raylet_lost(self):
+        """The raylet connection died: every relay-routed task loses its
+        transport AND its completion channel — error them all out (the
+        classic path's lease conversations die through their own worker
+        conns)."""
+        if self._shutdown:
+            return
+        with self.lock:
+            recs = [r for r in self.task_records.values()
+                    if r.mux and not r.done]
+            for r in recs:
+                self.task_records.pop(r.spec.task_id, None)
+            self._mux_staged.clear()
+        for rec in recs:
+            self._mux_error_out(rec, "raylet connection lost")
 
     def flush_idle_leases(self) -> None:
         """Return EVERY currently-idle lease now (on-demand reclaim: the
@@ -2261,6 +2488,8 @@ class CoreWorker:
                         tuple(view["worker_addr"]),
                         name=f"core->actor-{actor_id[:8]}",
                         on_disconnect=lambda: self._on_actor_conn_lost(actor_id),
+                        on_push=lambda topic, payload, aid=actor_id:
+                            self._on_actor_push(aid, topic, payload),
                         connect_timeout=5.0)
                 except (ConnectionLost, OSError):
                     # stale view: this incarnation already died and the
@@ -2273,10 +2502,23 @@ class CoreWorker:
                     ac.addr = tuple(view["worker_addr"])
                     ac.incarnation = view["incarnation"]
                     ac.state = "ALIVE"
-                    buffered = list(ac.buffer)
-                    ac.buffer.clear()
-                for spec in buffered:
-                    self._send_actor_task(ac, spec)
+                    if self._submit_batch > 1:
+                        # batched mode: leave the backlog staged and let
+                        # the flusher ship it as framed envelopes
+                        buffered = None
+                        has_backlog = bool(ac.buffer)
+                    else:
+                        buffered = list(ac.buffer)
+                        ac.buffer.clear()
+                        has_backlog = False
+                if buffered is None:
+                    if has_backlog:
+                        with self._flush_cv:
+                            self._flush_dirty_actors.add(ac)
+                            self._flush_cv.notify()
+                else:
+                    for spec in buffered:
+                        self._send_actor_task(ac, spec)
                 return
         finally:
             with ac.lock:
@@ -2306,9 +2548,6 @@ class CoreWorker:
         if num_returns == "streaming":
             num_returns = STREAMING_RETURNS
         ac = self._actor_conn(actor_id)
-        with ac.lock:
-            ac.seq += 1
-            seq = ac.seq
         tid = common.task_id()
         spec = TaskSpec(
             task_id=tid,
@@ -2317,7 +2556,7 @@ class CoreWorker:
             args_blob=self.serialize_args(args, kwargs, task_id=tid),
             num_returns=num_returns,
             actor_id=actor_id,
-            seq_no=seq,
+            seq_no=0,   # assigned with the stage/send decision below
             owner_id=self.worker_id,
             owner_addr=self.addr,
             parent_task_id=EXECUTING_TASK_ID.get(),
@@ -2346,33 +2585,53 @@ class CoreWorker:
         # A locally-DEAD conn may be stale: during control-plane failover
         # the conn can be marked dead (lost worker + transient control
         # unavailability) while the restored control has since restarted
-        # the actor.  Re-check the authoritative record once and revive
-        # the conn if the actor is in fact coming back.
+        # the actor.  Re-check the authoritative record and revive the
+        # conn if the actor is in fact coming back.  The verdict is
+        # TTL-cached per conn: the probe is a synchronous control
+        # round-trip (timeout=10.0) that must not tax every call to a
+        # genuinely dead actor.
         if ac.state == "DEAD":
-            try:
-                view = self._control_call(
-                    "get_actor", {"actor_id": actor_id}, timeout=10.0)
-            except Exception:
-                view = None
-            if view and view["state"] in ("ALIVE", "RESTARTING", "PENDING"):
+            with ac.lock:
+                probe = ac.state == "DEAD" \
+                    and time.monotonic() >= ac.dead_recheck_at
+            if probe:
+                try:
+                    view = self._control_call(
+                        "get_actor", {"actor_id": actor_id}, timeout=10.0)
+                except Exception:
+                    view = None
                 with ac.lock:
-                    if ac.state == "DEAD":
-                        ac.state = "RECONNECTING"
-                        ac.dead_error = None
-                        ac.client = None
-        # single critical section decides buffer vs send (no double-send
-        # race with _resolve_actor's buffer flush)
+                    if view and view["state"] in ("ALIVE", "RESTARTING",
+                                                  "PENDING"):
+                        if ac.state == "DEAD":
+                            ac.state = "RECONNECTING"
+                            ac.dead_error = None
+                            ac.client = None
+                    else:
+                        ac.dead_recheck_at = \
+                            time.monotonic() + DEAD_RECHECK_TTL_S
+        # single critical section assigns the seq AND decides
+        # stage/send — splitting the two let a concurrent submitter
+        # interleave between seq assignment and enqueue, shipping seqs
+        # out of FIFO order; it also closes the double-send race with
+        # _resolve_actor's buffer flush
+        batched = self._submit_batch > 1
         with ac.lock:
             if ac.state == "DEAD":
                 dead = True
                 need_resolve = False
+                staged = False
             else:
                 dead = False
-                if ac.client is None:
+                ac.seq += 1
+                spec.seq_no = ac.seq
+                if batched or ac.client is None:
                     ac.buffer.append(spec)
-                    need_resolve = not ac.resolving
+                    staged = True
+                    need_resolve = ac.client is None and not ac.resolving
                     spec = None
                 else:
+                    staged = False
                     need_resolve = False
         if dead:
             e = ActorDiedError(ac.dead_error or "actor is dead")
@@ -2388,7 +2647,13 @@ class CoreWorker:
             return refs
         if need_resolve:
             self.pool_executor.submit(self._resolve_actor, actor_id)
-        if spec is not None:
+        if staged and batched:
+            # hand the send to the combining flusher (a conn with no
+            # client yet is skipped there; _resolve_actor re-marks it)
+            with self._flush_cv:
+                self._flush_dirty_actors.add(ac)
+                self._flush_cv.notify()
+        elif spec is not None:
             self._send_actor_task(ac, spec)
         return refs
 
@@ -2422,6 +2687,61 @@ class CoreWorker:
                 self._finish_stream(spec.task_id, reply)
 
         fut.add_done_callback(on_done)
+
+    def _flush_actor_conn(self, ac: ActorConn):
+        """Flusher-thread drain of one actor conn's staging queue: move
+        the whole backlog to inflight under the conn lock, ship it as
+        framed push_tasks envelopes outside it.  seq/FIFO order is
+        preserved end to end: submit stages in seq order, one flusher
+        thread drains, and the client's combining writer is strict FIFO
+        per conn."""
+        with ac.lock:
+            if ac.client is None or ac.state != "ALIVE" or not ac.buffer:
+                # PENDING/RECONNECTING: _resolve_actor re-marks the conn
+                # dirty once it lands; DEAD drains through _fail_actor
+                return
+            specs = list(ac.buffer)
+            ac.buffer.clear()
+            for spec in specs:
+                ac.inflight[spec.task_id] = spec
+            client = ac.client
+        for i in range(0, len(specs), self._submit_batch):
+            chunk = specs[i:i + self._submit_batch]
+            with self._stats_lock:
+                h = self._actor_hist
+                h[len(chunk)] = h.get(len(chunk), 0) + 1
+                self._actor_sends += len(chunk)
+                self._flush_stats["tasks"] += len(chunk)
+            try:
+                client.notify("push_tasks", chunk)
+            except (ConnectionLost, OSError):
+                # conn died between stage and ship: everything already
+                # sits in inflight, and the on_disconnect sweep
+                # (_on_actor_conn_lost) claims it all — retry vs error
+                # is decided there from the control-plane view
+                return
+
+    def _on_actor_push(self, actor_id: str, topic: str, payload):
+        """Server-push from an actor's worker (reader thread): coalesced
+        tasks_done acks for batched actor calls (the actor twin of
+        _on_tasks_done; lease/pool bookkeeping does not apply)."""
+        if topic != "tasks_done":
+            return
+        with self.lock:
+            ac = self.actors.get(actor_id)
+        if ac is None:
+            return
+        finished = []
+        with ac.lock:
+            for task_id, reply in payload:
+                spec = ac.inflight.pop(task_id, None)
+                if spec is None:
+                    continue   # late duplicate after a conn-loss sweep
+                finished.append((spec, reply))
+        for spec, reply in finished:
+            self._store_results(spec, reply)
+            if spec.num_returns == STREAMING_RETURNS:
+                self._finish_stream(spec.task_id, reply)
 
     def _on_actor_conn_lost(self, actor_id: str):
         ac = self._actor_conn(actor_id)
@@ -2505,11 +2825,18 @@ class CoreWorker:
             if rec is not None:
                 rec.canceled = True
                 rec.retries_left = 0
-                pool = self.pools.get(rec.pool_key)
-                queued = pool is not None and rec in pool.queue
-                if queued:
-                    pool.queue.remove(rec)
-                    self.task_records.pop(tid, None)
+                if rec.mux:
+                    pool = None
+                    queued = rec in self._mux_staged
+                    if queued:
+                        self._mux_staged.remove(rec)
+                        self.task_records.pop(tid, None)
+                else:
+                    pool = self.pools.get(rec.pool_key)
+                    queued = pool is not None and rec in pool.queue
+                    if queued:
+                        pool.queue.remove(rec)
+                        self.task_records.pop(tid, None)
         if rec is None:
             return self._cancel_actor_task(tid, force, recursive)
         if queued:
@@ -2527,6 +2854,33 @@ class CoreWorker:
                     e.event.set()
             if rec.spec.num_returns == STREAMING_RETURNS:
                 self._fail_stream(tid, err)
+            return True
+        if rec.mux:
+            # relay-routed: only the raylet knows which worker (if any)
+            # runs it.  Delivery is best-effort with the same 15s
+            # owner-side fallback as the direct path — the cancelled
+            # reply arrives through mux_tasks_done when confirmed.
+            def mux_fallback(rec=rec):
+                if not rec.done:
+                    logger.warning(
+                        "mux cancel of %s not confirmed; resolving "
+                        "owner-side", rec.spec.task_id[:12])
+                    self._fail_canceled_entries(rec)
+
+            raylet = self.raylet
+            if raylet is None:
+                self._fail_canceled_entries(rec)
+                return True
+            try:
+                raylet.notify("mux_cancel",
+                              {"task_id": tid, "client_id": self.worker_id,
+                               "force": force, "recursive": recursive})
+            except Exception:
+                mux_fallback()
+                return True
+            t = threading.Timer(15.0, mux_fallback)
+            t.daemon = True
+            t.start()
             return True
         # pushed: tell the executing worker (it propagates to children
         # when recursive — they are owned by that worker, not us).

@@ -36,6 +36,12 @@ logger = logging.getLogger(__name__)
 
 LEASE_GRANT_TICK_S = 0.01
 WORKER_SPAWN_HARD_CAP_FACTOR = 10
+# submit multiplexer: how recently a client must have submitted to count
+# as a concurrent submitter, and how long a relay worker may sit idle
+# before it returns to the shared pool
+MUX_WINDOW_S = 10.0
+MUX_IDLE_RELEASE_S = 1.0
+MUX_CLIENT_ID = "__mux__"
 
 
 class WorkerRecord:
@@ -100,6 +106,23 @@ class Raylet:
         # lessee core conns, for on-demand idle-lease reclaim pushes
         self.client_conns: Dict[str, Any] = {}
         self._last_reclaim_push = 0.0
+        # multi-client submit multiplexer (relay): once >=2 distinct
+        # external clients submit within MUX_WINDOW_S, eligible plain
+        # tasks arrive as framed mux_push_tasks notifies and are
+        # scheduled HERE against the shared worker pool — N drivers stop
+        # holding N separate pick_nodes/request_leases conversations.
+        from .config import cfg as _mcfg
+
+        self.mux_enabled = bool(_mcfg().submit_mux)
+        self.mux_on = False                        # guarded-by: lock
+        # FIFO of (client_id, spec) awaiting a worker slot
+        self.mux_queue: Deque[Tuple[str, Any]] = deque()  # guarded-by: lock
+        # wid -> {"rec", "inflight": {tid: (cid, spec)}, "idle_since"}
+        self.mux_workers: Dict[str, Dict[str, Any]] = {}  # guarded-by: lock
+        self.mux_seen: Dict[str, float] = {}       # guarded-by: lock
+        self.mux_avg_ms: Optional[float] = None    # guarded-by: lock
+        self.mux_stats = {"submitted": 0, "completed": 0,  # guarded-by: lock
+                          "failed": 0, "released": 0}
         self.bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}  # (pg,idx)->{resources,state}
         self._next_token = 0
         self._stop = threading.Event()
@@ -137,6 +160,9 @@ class Raylet:
         s.handle("read_log", self.h_read_log)
         s.handle("pending_demands", self.h_pending_demands)
         s.handle("report_task_events", self.h_report_task_events)
+        s.handle("mux_push_tasks", self.h_mux_push_tasks)
+        s.handle("mux_tasks_done", self.h_mux_tasks_done)
+        s.handle("mux_cancel", self.h_mux_cancel)
         s.on_disconnect(self.h_disconnect)
 
         # node-local task-event relay (ROADMAP item 5 "per-node batching
@@ -691,6 +717,7 @@ class Raylet:
         self._kill_worker(rec)
         # its core may have held leases on other workers for nested tasks
         self._reclaim_leases_of_dead_client(rec.worker_id)
+        self._mux_on_worker_gone(rec.worker_id)
         self._try_grant()
         return True
 
@@ -717,6 +744,7 @@ class Raylet:
             # use or return (the leak that starved a node after a burst
             # of short-lived drivers)
             self._purge_pending_of_client(cid)
+            self._mux_purge_client(cid)
             self._reclaim_leases_of_dead_client(cid)
         if gone_clients:
             self._try_grant()
@@ -754,6 +782,7 @@ class Raylet:
                 rec.state = "dead"
                 self.workers.pop(wid, None)
                 self.workers_by_token.pop(rec.token, None)
+        self._mux_on_worker_gone(wid)
         if killed_path:
             self._reclaim_leases_of_dead_client(wid)
             return
@@ -857,10 +886,15 @@ class Raylet:
         with self.lock:
             if cid:
                 self.client_conns[cid] = conn
+                activated = self._mux_note_client(cid)
+            else:
+                activated = False
             self.pending_leases.append(
                 PendingLease(demand, d, cid, bundle,
                              retriable=p.get("retriable", True),
                              count=count, vector=vector))
+        if activated:
+            self._mux_announce()
         self._try_grant()
 
     def _pg_bundles_locked(self, pg_id: str):
@@ -907,6 +941,10 @@ class Raylet:
         while not self._stop.is_set():
             time.sleep(LEASE_GRANT_TICK_S)
             self._try_grant()
+            try:
+                self._mux_tick()
+            except Exception:
+                logger.exception("mux tick failed")
 
     def _prestart_loop(self):
         while not self._stop.is_set():
@@ -933,6 +971,7 @@ class Raylet:
         spawn_tpu = False
         starved = False
         with self.lock:
+            mux_flag = self.mux_on
             while self.pending_leases:
                 pl = self.pending_leases[0]
                 wants_tpu = any(k.startswith(common.TPU)
@@ -1013,6 +1052,9 @@ class Raylet:
             if pl.vector:
                 pl.deferred.resolve({
                     "ok": True, "node_id": self.node_id,
+                    # relay advisory: late-joining drivers learn the mux
+                    # is open without waiting for a submit_mux push
+                    "mux": mux_flag,
                     "grants": [{"lease_id": w.lease_id,
                                 "worker_id": w.worker_id,
                                 "worker_addr": w.addr} for w in ws],
@@ -1110,6 +1152,306 @@ class Raylet:
             self.idle.append(rec)
         self._try_grant()
         return True
+
+    # -- submit multiplexer (relay) ---------------------------------------
+    # Reference shape: the reference raylet's lease-less actor submission
+    # path — here generalized so N concurrent drivers' plain tasks share
+    # ONE framed stream per driver into this raylet, which schedules them
+    # against the pool and fans coalesced acks back out.  rpc_stats
+    # before/after shows request_leases/return_lease traffic collapsing.
+
+    def _mux_note_client(self, cid: str) -> bool:  # holds: lock
+        """Track distinct concurrent external submitters; True when this
+        observation just flipped the mux on (caller announces, outside
+        the lock).  Caller holds lock.  Worker cores doing nested
+        submits don't count — they ride their host driver's workload."""
+        if not self.mux_enabled or not cid or cid in self.workers:
+            return False
+        now = time.monotonic()
+        self.mux_seen[cid] = now
+        if self.mux_on:
+            return False
+        live = sum(1 for ts in self.mux_seen.values()
+                   if now - ts < MUX_WINDOW_S)
+        if live >= 2:
+            self.mux_on = True   # sticky for the session
+            return True
+        return False
+
+    def _mux_announce(self):
+        """Tell every known lessee core the relay is open (late joiners
+        learn via the mux flag on request_leases replies)."""
+        with self.lock:
+            conns = list(self.client_conns.values())
+        for conn in conns:
+            try:
+                conn.push("submit_mux", {"on": True})
+            except Exception:
+                pass
+
+    def _mux_depth_locked(self) -> int:  # holds: lock
+        """Pushes in flight per relay worker before it stops getting
+        more (same EWMA-driven pipelining rule as SchedPool.depth)."""
+        if self.mux_avg_ms is None:
+            return 1
+        if self.mux_avg_ms < 2.0:
+            return 16
+        if self.mux_avg_ms < 20.0:
+            return 4
+        return 1
+
+    def h_mux_push_tasks(self, conn: ServerConn, p):
+        """A driver's flusher ships a framed batch of relay tasks."""
+        cid = p.get("client_id", "")
+        specs = p.get("specs") or []
+        activated = False
+        with self.lock:
+            if cid:
+                self.client_conns[cid] = conn
+                activated = self._mux_note_client(cid)
+            for spec in specs:
+                self.mux_queue.append((cid, spec))
+            self.mux_stats["submitted"] += len(specs)
+        if activated:
+            self._mux_announce()
+        self._mux_pump()
+        return True
+
+    def _mux_pump(self):
+        """Dispatch queued relay tasks to workers with pipeline room,
+        claiming idle workers (or spawning) toward the backlog.  All
+        socket sends happen outside the lock."""
+        to_push: List[Tuple[Any, List[Any]]] = []
+        spawn = 0
+        starved = False
+        with self.lock:
+            if not self.mux_queue:
+                return
+            demand = normalize_resources({common.CPU: 1})
+            per_worker: Dict[str, Tuple[Any, List[Any]]] = {}
+            while self.mux_queue:
+                depth = self._mux_depth_locked()
+                best = None
+                for mw in self.mux_workers.values():
+                    rec = mw["rec"]
+                    if rec.state != "leased" or rec.conn is None \
+                            or rec.blocked:
+                        continue
+                    if len(mw["inflight"]) >= depth:
+                        continue
+                    if best is None \
+                            or len(mw["inflight"]) < len(best["inflight"]):
+                        best = mw
+                if best is None:
+                    if self._mux_claim_worker_locked(demand):
+                        continue
+                    if fits(self.available, demand):
+                        # fits but no idle worker: spawn toward the
+                        # backlog (mirrors _try_grant's vector warmup)
+                        n_starting = sum(
+                            1 for r in self.workers.values()
+                            if r.state == "starting"
+                            and r.actor_id is None and not r.tpu)
+                        room = self.max_workers - len(self.workers)
+                        spawn = max(0, min(
+                            len(self.mux_queue) - n_starting, room))
+                    else:
+                        starved = True
+                    break
+                cid, spec = self.mux_queue.popleft()
+                best["inflight"][spec.task_id] = (cid, spec)
+                rec = best["rec"]
+                ent = per_worker.get(rec.worker_id)
+                if ent is None:
+                    ent = per_worker[rec.worker_id] = (rec.conn, [])
+                ent[1].append(spec)
+            to_push = list(per_worker.values())
+        for _ in range(spawn):
+            try:
+                self._spawn_worker()
+            except Exception:
+                logger.exception("mux worker spawn failed")
+        for wconn, specs in to_push:
+            try:
+                if not wconn.push("mux_push_tasks", specs):
+                    raise OSError("push failed")
+            except Exception:
+                # dead worker conn: its h_disconnect sweep fails these
+                # back to their owners via _mux_on_worker_gone
+                pass
+        if starved:
+            self._request_idle_reclaim()
+
+    def _mux_claim_worker_locked(self, demand) -> bool:  # holds: lock
+        """Claim one idle CPU worker for the relay (caller holds lock).
+        The claim books a full lease record — blocked-task lending, OOM
+        policy and disconnect reclaim all see a normal leased worker."""
+        if not fits(self.available, demand):
+            return False
+        w = None
+        skipped: List[WorkerRecord] = []
+        while self.idle:
+            cand = self.idle.popleft()
+            if cand.state != "idle":
+                continue
+            if cand.tpu:
+                skipped.append(cand)  # keep device workers for leases
+                continue
+            w = cand
+            break
+        self.idle.extend(skipped)
+        if w is None:
+            return False
+        subtract(self.available, demand)
+        w.state = "leased"
+        w.leased_at = time.monotonic()
+        w.lease_id = common.new_id("lease-")
+        w.lease_resources = demand
+        w.lease_retriable = True
+        w.lease_client_id = MUX_CLIENT_ID
+        self.mux_workers[w.worker_id] = {
+            "rec": w, "inflight": {}, "idle_since": time.monotonic()}
+        return True
+
+    def h_mux_tasks_done(self, conn: ServerConn, batch):
+        """A relay worker's coalesced completions: fan them back out to
+        the owning drivers, one framed push per driver."""
+        wid = conn.meta.get("worker_id")
+        per_client: Dict[str, List] = {}
+        with self.lock:
+            mw = self.mux_workers.get(wid)
+            if mw is None:
+                return True
+            for task_id, reply in batch:
+                ent = mw["inflight"].pop(task_id, None)
+                if ent is None:
+                    continue
+                cid, _spec = ent
+                ms = reply.get("exec_ms")
+                if ms is not None:
+                    self.mux_avg_ms = ms if self.mux_avg_ms is None \
+                        else 0.8 * self.mux_avg_ms + 0.2 * ms
+                per_client.setdefault(cid, []).append((task_id, reply))
+                self.mux_stats["completed"] += 1
+            if not mw["inflight"]:
+                mw["idle_since"] = time.monotonic()
+            conns = {cid: self.client_conns.get(cid) for cid in per_client}
+        for cid, items in per_client.items():
+            c = conns.get(cid)
+            if c is None:
+                continue   # owner gone; disconnect reclaim handles it
+            try:
+                c.push("mux_tasks_done", items)
+            except Exception:
+                pass
+        self._mux_pump()
+        return True
+
+    def h_mux_cancel(self, conn: ServerConn, p):
+        """Owner-requested cancel of a relay task: a still-queued task
+        reports straight back through mux_task_failed (the owner maps it
+        to TaskCancelledError — rec.canceled is already set there); a
+        dispatched one is forwarded to its worker."""
+        tid = p.get("task_id")
+        cid = p.get("client_id", "")
+        owner_conn = None
+        worker_conn = None
+        with self.lock:
+            queued = next((i for i, (_c, s) in enumerate(self.mux_queue)
+                           if s.task_id == tid), None)
+            if queued is not None:
+                del self.mux_queue[queued]
+                owner_conn = self.client_conns.get(cid)
+            else:
+                for mw in self.mux_workers.values():
+                    if tid in mw["inflight"]:
+                        worker_conn = mw["rec"].conn
+                        break
+        if owner_conn is not None:
+            try:
+                owner_conn.push("mux_task_failed",
+                                [(tid, "cancelled before start")])
+            except Exception:
+                pass
+        elif worker_conn is not None:
+            try:
+                worker_conn.push("mux_cancel", p)
+            except Exception:
+                pass
+        return True
+
+    def _mux_on_worker_gone(self, wid: str):
+        """A relay worker died: report its in-flight tasks to their
+        owners (retry vs error is the owner's call — same policy as a
+        lost lease conn)."""
+        per_client: Dict[str, List] = {}
+        with self.lock:
+            mw = self.mux_workers.pop(wid, None)
+            if mw is None:
+                return
+            for task_id, (cid, _spec) in mw["inflight"].items():
+                per_client.setdefault(cid, []).append(
+                    (task_id, f"worker {wid[:12]} died"))
+                self.mux_stats["failed"] += 1
+            conns = {cid: self.client_conns.get(cid) for cid in per_client}
+        for cid, items in per_client.items():
+            c = conns.get(cid)
+            if c is None:
+                continue
+            try:
+                c.push("mux_task_failed", items)
+            except Exception:
+                pass
+        self._mux_pump()
+
+    def _mux_purge_client(self, cid: str):
+        """Drop a departed client's queued relay tasks (its in-flight
+        ones finish and their acks fall on the floor)."""
+        with self.lock:
+            self.mux_seen.pop(cid, None)
+            if self.mux_queue:
+                self.mux_queue = deque(
+                    (c, s) for c, s in self.mux_queue if c != cid)
+
+    def _mux_tick(self):
+        """Periodic relay maintenance (grant-loop tick): re-pump in case
+        capacity freed, and hand relay workers back to the shared pool
+        once idle past the TTL — immediately when classic lease requests
+        are starving and the relay queue is empty."""
+        released = False
+        gone: List[str] = []
+        with self.lock:
+            if not self.mux_on:
+                return
+            now = time.monotonic()
+            force = bool(self.pending_leases) and not self.mux_queue
+            for wid, mw in list(self.mux_workers.items()):
+                rec = mw["rec"]
+                if rec.state != "leased":
+                    # reclaimed/killed behind our back (e.g. reap loop):
+                    # report its in-flight work, outside the lock
+                    gone.append(wid)
+                    continue
+                if mw["inflight"]:
+                    continue
+                if not force and (self.mux_queue
+                                  or now - mw["idle_since"]
+                                  < MUX_IDLE_RELEASE_S):
+                    continue
+                self.mux_workers.pop(wid, None)
+                self._free_lease_resources(rec)
+                rec.blocked = False
+                rec.state = "idle"
+                rec.lease_id = None
+                rec.lease_client_id = None
+                self.idle.append(rec)
+                self.mux_stats["released"] += 1
+                released = True
+        for wid in gone:
+            self._mux_on_worker_gone(wid)
+        if released:
+            self._try_grant()
+        self._mux_pump()
 
     def _purge_pending_of_client(self, cid: str) -> int:
         canceled = []
@@ -1502,6 +1844,10 @@ class Raylet:
                              "state": b["state"]}
                             for k, b in self.bundles.items()],
                 "task_event_relay": self.task_event_relay_stats(),
+                "submit_mux": {"on": self.mux_on,
+                               "queued": len(self.mux_queue),
+                               "workers": len(self.mux_workers),
+                               **self.mux_stats},
             }
 
     # -- task-event relay --------------------------------------------------

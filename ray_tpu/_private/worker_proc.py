@@ -35,43 +35,102 @@ logger = logging.getLogger(__name__)
 _ASYNC_INFLIGHT = object()  # sentinel: reply will come from the aio loop
 
 
+# ack coalescing knobs: while the worker's run queue is non-empty a
+# completed reply may wait up to the linger for batchmates (and never
+# longer than the hold cap in total) before its frame ships — the hold
+# cap bounds how long a dependent task parked on ANOTHER worker can be
+# stalled by ack framing.  An idle queue always flushes immediately, so
+# sequential get() chains pay zero added latency.
+ACK_LINGER_S = 0.002
+ACK_HOLD_MAX_S = 0.005
+ACK_BATCH_CAP = 64
+
+
 class _ReplyBatcher:
     """Combining sender for coalesced task acks: completions are framed
-    into `tasks_done` pushes on the owner connection.  The first
-    completion ships immediately; completions that land while a push is
-    on the wire coalesce into the next frame — the ack batch size adapts
-    to the completion rate exactly like the owner's submit flusher.  A
-    completed reply is NEVER held back waiting for more (a task whose
-    downstream depends on it would deadlock the batch)."""
+    into `tasks_done` pushes on the owner connection (or, for
+    mux-relayed tasks, one framed `mux_tasks_done` stream to the
+    raylet).  With the worker's run queue idle the ack ships inline on
+    the completing thread (the pre-linger latency path, bit-for-bit);
+    under backlog a dedicated sender thread lingers briefly so
+    back-to-back completions coalesce into one frame instead of one
+    push per task."""
 
-    def __init__(self, conn: ServerConn):
+    def __init__(self, conn: ServerConn = None, send=None, backlog=None):
+        # default transport: tasks_done pushes on the owner connection;
+        # mux-relayed tasks instead ack through the raylet (one framed
+        # mux_tasks_done stream per node, fanned back out to owners)
         self._conn = conn
-        self._lock = threading.Lock()
-        self._pending: list = []
-        self._sending = False
+        self._send = send if send is not None \
+            else (lambda batch: conn.push("tasks_done", batch))
+        # "more completions are imminent" probe (the worker's run-queue
+        # emptiness); lingering is pointless — pure latency — without it
+        self._backlog = backlog if backlog is not None else (lambda: False)
+        self._cv = threading.Condition()
+        self._pending: list = []        # guarded-by: _cv
+        self._thread = None             # guarded-by: _cv
+        self._draining = False          # guarded-by: _cv
 
     def add(self, task_id: str, reply):
-        with self._lock:
+        with self._cv:
             self._pending.append((task_id, reply))
-            if self._sending:
-                return   # the in-flight sender will pick this up
-            self._sending = True
+            if self._draining:
+                self._cv.notify()   # the active sender picks this up
+                return
+            if self._backlog():
+                # more completions imminent: hand off to the linger
+                # thread so this frame can fill up
+                if self._thread is None:
+                    self._thread = threading.Thread(
+                        target=self._run, name="ack-batcher", daemon=True)
+                    self._thread.start()
+                else:
+                    self._cv.notify()
+                return
+            # idle queue: ship inline on the executor thread (it has
+            # nothing else to do) — the exact pre-linger latency path
+            self._draining = True
+        self._drain()
+
+    def _drain(self):
+        """Send frames until _pending runs dry.  Caller owns _draining;
+        acks landing while a frame is on the wire coalesce into the
+        next one."""
         while True:
-            with self._lock:
+            with self._cv:
                 batch, self._pending = self._pending, []
                 if not batch:
-                    self._sending = False
+                    self._draining = False
                     return
             try:
-                # push failure = owner gone; its on_disconnect reschedules
-                self._conn.push("tasks_done", batch)
-            except BaseException:
-                # push swallows OSError, but a serialization failure on
-                # one weird reply must not leave _sending stuck True —
-                # that would silently park every future ack in _pending
-                with self._lock:
-                    self._sending = False
-                raise
+                # push failure = owner gone; its on_disconnect resched-
+                # ules.  Any other failure (one unserializable reply)
+                # must not kill the sender for future acks.
+                self._send(batch)
+            except Exception:
+                logger.exception("ack batch push failed")
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._pending or self._draining:
+                    self._cv.wait(timeout=60.0)
+                    if not self._pending and not self._draining \
+                            and not getattr(self._conn, "alive", True):
+                        # owner gone and nothing queued: let the thread
+                        # die (a late add() starts a fresh one)
+                        self._thread = None
+                        return
+                held0 = time.monotonic()
+                while (len(self._pending) < ACK_BATCH_CAP
+                       and self._backlog()
+                       and time.monotonic() - held0 < ACK_HOLD_MAX_S):
+                    n = len(self._pending)
+                    self._cv.wait(timeout=ACK_LINGER_S)
+                    if len(self._pending) == n:
+                        break   # linger expired with no new completion
+                self._draining = True
+            self._drain()
 
 
 class _BatchSlot:
@@ -115,6 +174,9 @@ class WorkerMain:
         self.task_queue: "queue.Queue" = queue.Queue()
         # one reply batcher per owner connection (batched submissions)
         self._reply_batchers: dict = {}
+        # lazily-built ack batcher for mux-relayed tasks (acks go to the
+        # raylet, which fans them back out to the owning drivers)
+        self._mux_batcher = None        # guarded-by: _batcher_lock
         self._batcher_lock = threading.Lock()
         # cancellation state (reference: core_worker HandleCancelTask):
         # queued task ids to drop + the id/thread of the running task
@@ -240,10 +302,13 @@ class WorkerMain:
                               if not c.alive]:
                         del self._reply_batchers[c]
                     batcher = self._reply_batchers[conn] = \
-                        _ReplyBatcher(conn)
+                        _ReplyBatcher(conn, backlog=self._ack_backlog)
         for spec in specs:
+            # actor calls ride the same framed envelopes since the owner
+            # flusher batches them too — route by spec, not by handler
+            kind = "actor" if spec.actor_id else "normal"
             self.task_queue.put(
-                ("normal", spec, _BatchSlot(batcher, spec.task_id)))
+                (kind, spec, _BatchSlot(batcher, spec.task_id)))
 
     def h_actor_task(self, conn: ServerConn, spec: TaskSpec, d: Deferred):
         self.task_queue.put(("actor", spec, d))
@@ -300,9 +365,33 @@ class WorkerMain:
                 self.core.cancel_children, tid, force)
         return True
 
+    def _mux_batcher_get(self) -> _ReplyBatcher:
+        with self._batcher_lock:
+            if self._mux_batcher is None:
+                raylet = self.core.raylet
+                self._mux_batcher = _ReplyBatcher(
+                    send=lambda batch: raylet.notify(
+                        "mux_tasks_done", batch),
+                    backlog=self._ack_backlog)
+            return self._mux_batcher
+
+    def _ack_backlog(self) -> bool:
+        """More completions imminent? drives ack-frame lingering."""
+        return not self.task_queue.empty()
+
     def _on_raylet_push(self, topic, payload):
         if topic == "shutdown":
             self._exit_soon()
+        elif topic == "mux_push_tasks":
+            # relay-routed batch from the raylet: same execution pipeline
+            # as h_push_tasks, but acks flow back through the raylet
+            batcher = self._mux_batcher_get()
+            for spec in payload:
+                kind = "actor" if spec.actor_id else "normal"
+                self.task_queue.put(
+                    (kind, spec, _BatchSlot(batcher, spec.task_id)))
+        elif topic == "mux_cancel":
+            self.h_cancel_task(None, payload)
         elif topic == "assign_actor":
             # prestarted-worker reuse (reference: worker_pool.h PopWorker):
             # a warm idle worker becomes this actor's dedicated process,

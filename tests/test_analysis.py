@@ -237,11 +237,15 @@ def test_generator_item_ack_sent_outside_cv():
 
 
 def test_reply_batcher_survives_push_exception():
-    """A non-OSError failure inside one push must not leave the batcher
-    wedged with _sending=True (every later ack would silently park)."""
+    """A non-OSError failure inside one push must not wedge the sender
+    thread (every later ack would silently park in _pending)."""
+    import time as _time
+
     from ray_tpu._private.worker_proc import _ReplyBatcher
 
     class FlakyConn:
+        alive = True
+
         def __init__(self):
             self.pushed = []
             self.fail_next = False
@@ -253,16 +257,49 @@ def test_reply_batcher_survives_push_exception():
             self.pushed.append((kind, list(batch)))
             return True
 
+    def _wait(pred, timeout=5.0):
+        deadline = _time.monotonic() + timeout
+        while not pred():
+            if _time.monotonic() > deadline:
+                raise AssertionError("ack never shipped")
+            _time.sleep(0.005)
+
     conn = FlakyConn()
     b = _ReplyBatcher(conn)
     b.add("t0", {"status": "ok"})
+    _wait(lambda: conn.pushed)
     assert conn.pushed[-1][1] == [("t0", {"status": "ok"})]
     conn.fail_next = True
-    with pytest.raises(ValueError):
-        b.add("t1", {"status": "ok"})
+    b.add("t1", {"status": "ok"})   # push raises inside the sender
+    _wait(lambda: not conn.fail_next)   # poisoned push was attempted
     # the wedge: before the fix this ack parked in _pending forever
     b.add("t2", {"status": "ok"})
-    assert conn.pushed[-1][1][-1][0] == "t2"
+    _wait(lambda: conn.pushed and conn.pushed[-1][1][-1][0] == "t2")
+
+
+def test_reply_batcher_lingers_only_under_backlog():
+    """With the worker's run queue non-empty, back-to-back completions
+    coalesce into one frame; with it idle the ack ships immediately."""
+    import time as _time
+
+    from ray_tpu._private.worker_proc import _ReplyBatcher
+
+    sent = []
+    busy = {"backlog": True}
+    b = _ReplyBatcher(send=lambda batch: sent.append(list(batch)),
+                      backlog=lambda: busy["backlog"])
+    b.add("t0", {"status": "ok"})
+    b.add("t1", {"status": "ok"})
+    busy["backlog"] = False          # queue drained: flush now
+    b.add("t2", {"status": "ok"})
+    deadline = _time.monotonic() + 5.0
+    while sum(len(x) for x in sent) < 3:
+        if _time.monotonic() > deadline:
+            raise AssertionError(f"acks never shipped: {sent}")
+        _time.sleep(0.005)
+    # every ack arrived exactly once, order preserved end-to-end
+    flat = [tid for batch in sent for tid, _ in batch]
+    assert flat == ["t0", "t1", "t2"]
 
 
 def test_router_pick_wakes_on_refresh(monkeypatch):

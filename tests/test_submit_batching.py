@@ -242,3 +242,168 @@ def test_native_acquire_n():
     assert s.acquire_n("a", {"CPU": 1 * G}, 1) == 0
     assert s.acquire_n("missing", {"CPU": 1 * G}, 1) == 0
     assert s.acquire_n("a", {"CPU": 1 * G}, 0) == 0
+
+
+# -- actor-call batching (per-ActorConn staging + combining flusher) --------
+
+
+@ray_tpu.remote
+class _Tally:
+    """Order-sensitive state: bump() returns the running total, so any
+    reorder or drop inside a framed batch shows up in the values."""
+
+    def __init__(self):
+        self.n = 0
+
+    def bump(self, k):
+        self.n += k
+        return self.n
+
+    def stream(self, n):
+        for i in range(n):
+            yield i * 10
+
+    def block(self, path):
+        import time as _t
+
+        while not os.path.exists(path):
+            _t.sleep(0.05)
+        return "unblocked"
+
+    def die(self):
+        os._exit(1)
+
+
+def _actor_workload():
+    a = _Tally.remote()
+    vals = ray_tpu.get([a.bump.remote(1) for _ in range(100)], timeout=120)
+    mixed = ray_tpu.get([a.bump.remote(i) for i in range(5)], timeout=120)
+    return vals, mixed
+
+
+def test_actor_batch_matches_batch1(private_cluster_slot, monkeypatch):
+    """The framed actor path and the RAY_TPU_SUBMIT_BATCH=1 legacy path
+    (one spec per frame, inline send) must be observably identical."""
+    ray_tpu.init(num_cpus=4)
+    try:
+        batched = _actor_workload()
+        tel = _core().submit_telemetry()
+        assert sum(tel["actor_batch_hist"].values()) >= 1
+    finally:
+        ray_tpu.shutdown()
+    monkeypatch.setenv("RAY_TPU_SUBMIT_BATCH", "1")
+    ray_tpu.init(num_cpus=4)
+    try:
+        assert _core()._submit_batch == 1
+        legacy = _actor_workload()
+        assert _core().submit_telemetry()["actor_batch_hist"] == {}
+    finally:
+        ray_tpu.shutdown()
+    assert batched == legacy
+
+
+def test_actor_seq_order_across_flush_boundary(ray_cluster):
+    """150 calls (>2 frames at submit_batch=64) on one actor: the
+    running totals prove per-caller FIFO held across frame boundaries."""
+    a = _Tally.remote()
+    vals = ray_tpu.get([a.bump.remote(1) for _ in range(150)], timeout=120)
+    assert vals == list(range(1, 151))
+
+
+def test_cancel_actor_call_inside_batch(ray_cluster, tmp_path):
+    """Cancelling one queued call of a framed actor batch affects only
+    that call; batchmates before and after it still run in order."""
+    from ray_tpu import RayTpuError, TaskCancelledError
+
+    def _is_cancel(err):
+        return (isinstance(err, TaskCancelledError)
+                or "TaskCancelledError" in str(err))
+
+    gate = str(tmp_path / "gate")
+    a = _Tally.remote()
+    ray_tpu.get(a.bump.remote(0), timeout=60)
+    blocker = a.block.remote(gate)
+    before = [a.bump.remote(1) for _ in range(5)]
+    victim = a.bump.remote(1000)
+    after = [a.bump.remote(1) for _ in range(5)]
+    time.sleep(0.3)
+    assert ray_tpu.cancel(victim)
+    time.sleep(0.3)   # cancel RPC must land before the actor unblocks
+    open(gate, "w").close()
+    assert ray_tpu.get(blocker, timeout=60) == "unblocked"
+    with pytest.raises(RayTpuError) as ei:
+        ray_tpu.get(victim, timeout=60)
+    assert _is_cancel(ei.value)
+    # the cancelled call's +1000 never landed; everyone else did, FIFO
+    assert ray_tpu.get(before, timeout=120) == list(range(1, 6))
+    assert ray_tpu.get(after, timeout=120) == list(range(6, 11))
+
+
+def test_actor_death_mid_batch_isolated(ray_cluster):
+    """An actor dying mid-frame fails only ITS calls: the sibling
+    actor's framed calls and plain tasks complete untouched."""
+    victim = _Tally.remote()
+    healthy = _Tally.remote()
+    ray_tpu.get([victim.bump.remote(0), healthy.bump.remote(0)],
+                timeout=60)
+    good = [healthy.bump.remote(1) for _ in range(30)]
+    plain = [_add.remote(i, 1) for i in range(10)]
+    doomed = [victim.bump.remote(1) for _ in range(10)]
+    kill = victim.die.remote()
+    doomed += [victim.bump.remote(1) for _ in range(10)]
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(kill, timeout=60)
+    failures = 0
+    for r in doomed:
+        try:
+            ray_tpu.get(r, timeout=60)
+        except ray_tpu.ActorDiedError:
+            failures += 1
+    assert failures >= 10  # everything after die() fails, nothing hangs
+    assert ray_tpu.get(good, timeout=120) == list(range(1, 31))
+    assert ray_tpu.get(plain, timeout=120) == [i + 1 for i in range(10)]
+
+
+def test_actor_restart_retries_batched_calls(ray_cluster):
+    """max_task_retries: calls pending in a frame when the actor dies
+    replay against the restarted incarnation instead of erroring."""
+
+    @ray_tpu.remote(max_restarts=2, max_task_retries=2)
+    class Flaky:
+        def __init__(self):
+            self.boomed = os.path.exists("/tmp/_rtpu_flaky_boomed")
+
+        def poke(self, i):
+            return i
+
+        def boom(self):
+            if not self.boomed:
+                open("/tmp/_rtpu_flaky_boomed", "w").close()
+                os._exit(1)
+            return "ok"
+
+    try:
+        a = Flaky.remote()
+        ray_tpu.get(a.poke.remote(-1), timeout=60)
+        burst = [a.poke.remote(i) for i in range(10)]
+        mid = a.boom.remote()
+        tail = [a.poke.remote(i) for i in range(10, 20)]
+        assert ray_tpu.get(mid, timeout=120) == "ok"
+        assert ray_tpu.get(burst, timeout=120) == list(range(10))
+        assert ray_tpu.get(tail, timeout=120) == list(range(10, 20))
+    finally:
+        if os.path.exists("/tmp/_rtpu_flaky_boomed"):
+            os.remove("/tmp/_rtpu_flaky_boomed")
+
+
+def test_streaming_actor_method_inside_batch(ray_cluster):
+    """A streaming actor method framed between plain calls keeps exact
+    item order and doesn't disturb its batchmates."""
+    a = _Tally.remote()
+    head = [a.bump.remote(1) for _ in range(8)]
+    g = a.stream.options(num_returns="streaming").remote(5)
+    tail = [a.bump.remote(1) for _ in range(8)]
+    items = [ray_tpu.get(r, timeout=60) for r in g]
+    assert items == [0, 10, 20, 30, 40]
+    assert ray_tpu.get(head, timeout=120) == list(range(1, 9))
+    assert ray_tpu.get(tail, timeout=120) == list(range(9, 17))
