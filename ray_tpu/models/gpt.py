@@ -281,6 +281,36 @@ def _attn_out_and_mlp(x, o, layer, cfg: GPTConfig):
     return x + m
 
 
+def _scan_blocks(x, layers, cfg: GPTConfig, rope, mesh=None,
+                 allow_manual: bool = True):
+    """Scan a (stacked) layer slice over x — the one block recipe shared
+    by the full SPMD forward, the SPMD pp stage_fn, and the MPMD
+    per-stage programs (parallel/mpmd.py), so every pipelining story
+    computes bit-for-bit the same math as the reference stack."""
+
+    def block(x, layer):
+        q, k, v = _qkv_proj(x, layer, cfg, rope)
+        q = _constrain(q, "batch", "heads", "seq", "head_dim")
+        k = _constrain(k, "batch", "heads", "seq", "head_dim")
+        v = _constrain(v, "batch", "heads", "seq", "head_dim")
+        o = _attention_op(q, k, v, cfg, mesh, allow_manual=allow_manual)
+        x = _attn_out_and_mlp(x, o, layer, cfg)
+        return _constrain(x, "batch", "seq", "embed")
+
+    def scan_body(x, layer):
+        if cfg.remat:
+            policy = (jax.checkpoint_policies
+                      .dots_with_no_batch_dims_saveable
+                      if cfg.remat_policy == "dots" else None)
+            x = jax.checkpoint(block, policy=policy)(x, layer)
+        else:
+            x = block(x, layer)
+        return x, None
+
+    x, _ = jax.lax.scan(scan_body, x, layers)
+    return x
+
+
 def apply_hidden(params, tokens, cfg: GPTConfig, mesh=None):
     """Transformer stack up to (and including) the final norm: tokens
     [B, S] int32 -> hidden [B, S, D].  The vocab projection is split out
@@ -296,25 +326,6 @@ def apply_hidden(params, tokens, cfg: GPTConfig, mesh=None):
     x = _constrain(x, "batch", "seq", "embed")
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
 
-    def block(x, layer):
-        q, k, v = _qkv_proj(x, layer, cfg, rope)
-        q = _constrain(q, "batch", "heads", "seq", "head_dim")
-        k = _constrain(k, "batch", "heads", "seq", "head_dim")
-        v = _constrain(v, "batch", "heads", "seq", "head_dim")
-        o = _attention_op(q, k, v, cfg, mesh, allow_manual=(pp == 1))
-        x = _attn_out_and_mlp(x, o, layer, cfg)
-        return _constrain(x, "batch", "seq", "embed")
-
-    def scan_body(x, layer):
-        if cfg.remat:
-            policy = (jax.checkpoint_policies
-                      .dots_with_no_batch_dims_saveable
-                      if cfg.remat_policy == "dots" else None)
-            x = jax.checkpoint(block, policy=policy)(x, layer)
-        else:
-            x = block(x, layer)
-        return x, None
-
     if pp > 1:
         from ray_tpu.parallel.pipeline import (merge_microbatches,
                                                pipeline_apply,
@@ -326,8 +337,8 @@ def apply_hidden(params, tokens, cfg: GPTConfig, mesh=None):
         M = cfg.num_microbatches or pp
 
         def stage_fn(stage_layers, xm):
-            out, _ = jax.lax.scan(scan_body, xm, stage_layers)
-            return out
+            return _scan_blocks(xm, stage_layers, cfg, rope, mesh,
+                                allow_manual=False)
 
         stacked = jax.tree.map(
             lambda p: p.reshape(pp, cfg.n_layers // pp, *p.shape[1:]),
@@ -335,9 +346,110 @@ def apply_hidden(params, tokens, cfg: GPTConfig, mesh=None):
         x = merge_microbatches(
             pipeline_apply(stage_fn, stacked, split_microbatches(x, M), mesh))
     else:
-        x, _ = jax.lax.scan(scan_body, x, params["layers"])
+        x = _scan_blocks(x, params["layers"], cfg, rope, mesh,
+                         allow_manual=True)
     x = _norm(x, params["final_norm"], params.get("final_norm_b"), cfg.norm)
     return x
+
+
+# ---------------------------------------------------------------------------
+# MPMD pipeline partitioning (parallel/mpmd.py).  Unlike the SPMD pp path
+# above — ONE compiled program where every rank holds every stage's
+# schedule — these helpers slice the model into per-stage param trees and
+# per-stage forward programs, each compiled alone on its own worker gang,
+# so model depth is no longer capped by what a single program can hold.
+
+
+def partition_stage_params(params, cfg: GPTConfig, stages: int):
+    """Slice init()'s tree into `stages` contiguous per-stage trees.
+
+    Stage 0 owns embed (+ learned positions); the last stage owns the
+    final norm and the vocab projection.  With tied embeddings BOTH end
+    stages hold the table (stage 0 for lookup, the last for unembed) —
+    parallel/mpmd.py keeps the two copies identical by exchanging embed
+    grads between them every step."""
+    if cfg.n_layers % stages:
+        raise ValueError(f"n_layers {cfg.n_layers} not divisible by "
+                         f"stages {stages}")
+    per = cfg.n_layers // stages
+    out = []
+    for s in range(stages):
+        st = {"layers": jax.tree.map(lambda p: p[s * per:(s + 1) * per],
+                                     params["layers"])}
+        if s == 0:
+            st["embed"] = params["embed"]
+            if cfg.pos == "learned":
+                st["pos_embed"] = params["pos_embed"]
+        if s == stages - 1:
+            st["final_norm"] = params["final_norm"]
+            if cfg.norm == "ln":
+                st["final_norm_b"] = params["final_norm_b"]
+            if cfg.tie_embeddings:
+                st.setdefault("embed", params["embed"])
+            else:
+                st["unembed"] = params["unembed"]
+        out.append(st)
+    return out
+
+
+def merge_stage_trees(stage_trees, cfg: GPTConfig, grads: bool = False,
+                      tie_summed: bool = False):
+    """Inverse of partition_stage_params: reassemble the full tree.
+
+    For params (grads=False) the tied embed copies are identical and
+    stage 0's is taken; for grads (grads=True) the two ends' partials
+    are SUMMED — the chain-rule contributions of the lookup and the
+    unembed projection to the one shared table.  When the pipeline has
+    already run its tied-embed exchange both copies hold the total
+    (tie_summed=True): take one instead of double-counting."""
+    stages = len(stage_trees)
+    first, last = stage_trees[0], stage_trees[-1]
+    out = {"layers": jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0),
+                                  *[t["layers"] for t in stage_trees])}
+    out["embed"] = first["embed"]
+    if grads and cfg.tie_embeddings and stages > 1 and not tie_summed:
+        out["embed"] = out["embed"] + last["embed"]
+    if cfg.pos == "learned":
+        out["pos_embed"] = first["pos_embed"]
+    out["final_norm"] = last["final_norm"]
+    if cfg.norm == "ln":
+        out["final_norm_b"] = last["final_norm_b"]
+    if not cfg.tie_embeddings:
+        out["unembed"] = last["unembed"]
+    return out
+
+
+def stage_hidden(stage_params, x, cfg: GPTConfig, stage: int, stages: int):
+    """One MPMD stage's forward: tokens [B, S] (stage 0) or hidden
+    [B, S, D] -> hidden [B, S, D] (final-normed on the last stage)."""
+    if stage == 0:
+        S = x.shape[1]
+        h = stage_params["embed"][x].astype(cfg.dtype)
+        if cfg.pos == "learned":
+            h = h + stage_params["pos_embed"][:S][None].astype(cfg.dtype)
+    else:
+        S = x.shape[1]
+        h = x.astype(cfg.dtype)
+    rope = (None if cfg.pos == "learned"
+            else rope_table(S, cfg.d_head, dtype=jnp.float32))
+    h = _scan_blocks(h, stage_params["layers"], cfg, rope, mesh=None)
+    if stage == stages - 1:
+        h = _norm(h, stage_params["final_norm"],
+                  stage_params.get("final_norm_b"), cfg.norm)
+    return h
+
+
+def stage_loss(stage_params, x, targets, cfg: GPTConfig, stage: int,
+               stages: int):
+    """Last-stage forward + next-token CE (mean over this microbatch;
+    with equal microbatch sizes the mean-of-means equals loss_fn's
+    global mean, which the MPMD<->SPMD parity tests pin down)."""
+    h = stage_hidden(stage_params, x, cfg, stage, stages)
+    table = (stage_params["embed"].T if cfg.tie_embeddings
+             else stage_params["unembed"]).astype(cfg.dtype)
+    logits = jnp.einsum("bsd,dv->bsv", h.astype(cfg.dtype), table)
+    return jnp.mean(softmax_cross_entropy(logits, targets,
+                                          z_loss=cfg.z_loss))
 
 
 def _unembed_table(params, cfg: GPTConfig):

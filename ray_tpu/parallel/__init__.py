@@ -2,6 +2,8 @@ from .mesh import (AXIS_ORDER, MeshSpec, batch_sharding, data_axes,
                    default_mesh, get_default_mesh, local_mesh, make_mesh,
                    make_multislice_mesh, replicated, set_default_mesh,
                    slice_groups)
+from .mpmd import (SCHEDULES, MPMDPipeline, PipelineConfig, get_schedule,
+                   replay_bubble)
 from .sharding import (DEFAULT_RULES, GradientSynchronizer, Logical,
                        shard_tree, spec_from_logical, tree_shardings,
                        with_constraint)
@@ -13,4 +15,6 @@ __all__ = [
     "set_default_mesh", "get_default_mesh", "default_mesh",
     "DEFAULT_RULES", "GradientSynchronizer", "Logical", "spec_from_logical",
     "tree_shardings", "shard_tree", "with_constraint",
+    "SCHEDULES", "MPMDPipeline", "PipelineConfig", "get_schedule",
+    "replay_bubble",
 ]

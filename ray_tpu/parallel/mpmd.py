@@ -1,0 +1,1274 @@
+"""MPMD pipeline parallelism: per-stage jit programs on separate gangs.
+
+The SPMD path (parallel/pipeline.py) compiles ONE program in which every
+pp rank holds every stage's schedule — model depth is capped by what a
+single compiled program can hold, and the fill-drain loop burns a
+(n-1)/(M+n-1) bubble every step.  Here the model is partitioned into
+per-stage programs (models/gpt.py partition_stage_params / stage_hidden /
+stage_loss), each compiled and run by its own gang scheduler, with
+activation and gradient edges flowing over the dag/ device-tensor channel
+envelope (dag/channel.py TAG_DEVICE 0x04: one device->shm raw-buffer copy
+per hop, no pickle of array data).
+
+Schedules (one `PipelineSchedule` interface):
+  fill_drain  all M forwards, then all M backwards (GPipe)
+  1f1b        warmup F's, steady (F,B) pairs, drain B's — same bubble as
+              fill-drain but activation stash bounded by pipeline depth
+  zb          zero-bubble (ZB-H1 family): backward split into Bx (input
+              grad only — XLA DCEs the weight-grad einsums) and W (weight
+              grads only); W ops run opportunistically whenever the gang
+              would otherwise idle in a channel wait, filling the warmup/
+              drain bubbles
+
+Bubble measurement: on a host with fewer cores than stages, wall-clock
+interleaving is serialization noise, so `replay_bubble` replays the
+recorded per-op durations and p2p edge costs in *virtual time* — each
+stage gets a dedicated executor, op start = max(executor free, dependency
+ready + edge cost) — recovering the schedule's intrinsic bubble structure.
+Per-stage metrics land in the flight recorder as dotted sub-phases
+(`pipeline.fwd` / `pipeline.bwd` / `pipeline.p2p` / `pipeline.idle`), so
+chrome traces show the schedule visually.
+
+Elastic: each stage commits params at step boundaries and snapshots them
+through elastic.emergency's peer-replicated vault; a dead stage gang is
+respawned from its `EmergencyCheckpoint` while survivors roll back to the
+committed step — the pipeline never collapses.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import queue
+import shutil
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+SCHEDULES = ("fill_drain", "1f1b", "zb")
+
+_ENV_SPEC = "RAY_TPU_TRAIN_PIPELINE"
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    """MPMD pipeline shape (JaxConfig.pipeline / MPMDPipeline).
+
+    stages: number of pipeline stages (one gang each).
+    schedule: "fill_drain" | "1f1b" | "zb".
+    microbatches: per-step microbatch count M (default = stages).
+    transport: "threads" (in-process gangs, shm channels — tests/bench)
+               or "actors" (one ray_tpu actor per stage gang).
+    grad_sync_group: when set, each stage syncs its grads through
+        GradientSynchronizer over collective group "<name>-s<stage>"
+        (per-stage bucketed async allreduce for dp>1 gangs).
+    snapshot_every: emergency-vault snapshot cadence in steps.
+    """
+
+    stages: int = 2
+    schedule: str = "1f1b"
+    microbatches: Optional[int] = None
+    transport: str = "threads"
+    grad_sync_group: Optional[str] = None
+    snapshot_every: int = 1
+    slot_bytes: int = 8 << 20
+    nslots: int = 4
+
+    def __post_init__(self):
+        if self.schedule not in SCHEDULES:
+            raise ValueError(f"unknown schedule {self.schedule!r}; "
+                             f"one of {SCHEDULES}")
+        if self.stages < 1:
+            raise ValueError(f"stages must be >= 1, got {self.stages}")
+        if self.transport not in ("threads", "actors"):
+            raise ValueError(f"unknown transport {self.transport!r}")
+        if self.microbatches is not None and self.microbatches < 1:
+            raise ValueError("microbatches must be >= 1")
+
+    @property
+    def num_microbatches(self) -> int:
+        return self.microbatches or self.stages
+
+    def to_spec(self) -> str:
+        """Env-var form (see from_spec): the train backend publishes this
+        to workers as RAY_TPU_TRAIN_PIPELINE."""
+        parts = [f"stages={self.stages}", f"schedule={self.schedule}",
+                 f"microbatches={self.num_microbatches}",
+                 f"transport={self.transport}"]
+        if self.grad_sync_group:
+            parts.append(f"grad_sync_group={self.grad_sync_group}")
+        if self.snapshot_every != 1:
+            parts.append(f"snapshot_every={self.snapshot_every}")
+        return ",".join(parts)
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "PipelineConfig":
+        kw: Dict[str, Any] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"bad pipeline spec item {part!r} "
+                                 f"in {spec!r}")
+            k, v = part.split("=", 1)
+            k = k.strip()
+            if k in ("stages", "microbatches", "snapshot_every",
+                     "slot_bytes", "nslots"):
+                kw[k] = int(v)
+            elif k in ("schedule", "transport", "grad_sync_group"):
+                kw[k] = v.strip()
+            else:
+                raise ValueError(f"unknown pipeline spec key {k!r}")
+        return cls(**kw)
+
+    @classmethod
+    def from_env(cls) -> Optional["PipelineConfig"]:
+        spec = os.environ.get(_ENV_SPEC, "").strip()
+        return cls.from_spec(spec) if spec else None
+
+
+# ---------------------------------------------------------------------------
+# Schedule library
+
+
+class PipelineSchedule:
+    """Per-stage op streams behind one interface.
+
+    ops() returns the ordered (kind, microbatch) list one gang scheduler
+    executes: kind in {"F", "B", "Bx", "W"}.  Cross-stage consistency is
+    the schedule's contract — stage s emits sends in exactly the order
+    stage s±1 posts the matching recvs.
+    """
+
+    name = "base"
+    split_backward = False  # zb: B split into Bx (input grad) + W (weights)
+
+    def ops(self, stage: int, stages: int, microbatches: int
+            ) -> List[Tuple[str, int]]:
+        raise NotImplementedError
+
+    @staticmethod
+    def theoretical_fill_drain_bubble(stages: int, microbatches: int
+                                      ) -> float:
+        """(n-1)/(M+n-1): the GPipe bubble both SPMD pipeline.py and the
+        fill_drain schedule here pay — the floor MPMD schedules beat."""
+        n, m = stages, microbatches
+        return (n - 1) / (m + n - 1) if n > 1 else 0.0
+
+
+class FillDrain(PipelineSchedule):
+    name = "fill_drain"
+
+    def ops(self, stage, stages, microbatches):
+        # backwards in LIFO order (the GPipe activation stack)
+        return ([("F", i) for i in range(microbatches)]
+                + [("B", i) for i in reversed(range(microbatches))])
+
+
+class OneFOneB(PipelineSchedule):
+    name = "1f1b"
+
+    def ops(self, stage, stages, microbatches):
+        warm = min(microbatches, stages - 1 - stage)
+        out = [("F", i) for i in range(warm)]
+        b = 0
+        for f in range(warm, microbatches):
+            out.append(("F", f))
+            out.append(("B", b))
+            b += 1
+        out.extend(("B", i) for i in range(b, microbatches))
+        return out
+
+
+class ZeroBubble(OneFOneB):
+    """1F1B skeleton with B split into Bx + W.  The W ops listed at the
+    tail are a completeness fallback: the gang scheduler runs pending W's
+    early whenever a channel wait would otherwise idle the gang."""
+
+    name = "zb"
+    split_backward = True
+
+    def ops(self, stage, stages, microbatches):
+        base = super().ops(stage, stages, microbatches)
+        out = [("Bx", mb) if kind == "B" else (kind, mb)
+               for kind, mb in base]
+        out.extend(("W", i) for i in range(microbatches))
+        return out
+
+
+_SCHEDULE_CLASSES = {c.name: c for c in (FillDrain, OneFOneB, ZeroBubble)}
+
+
+def get_schedule(name: str) -> PipelineSchedule:
+    try:
+        return _SCHEDULE_CLASSES[name]()
+    except KeyError:
+        raise ValueError(f"unknown schedule {name!r}; one of {SCHEDULES}")
+
+
+# ---------------------------------------------------------------------------
+# Bubble measurement: virtual-time replay of the recorded event log
+
+
+def replay_bubble(events_by_stage: List[List[Dict[str, Any]]]
+                  ) -> Dict[str, Any]:
+    """Replay per-op durations against the schedule's dependency graph.
+
+    Dependencies: F(s,mb) needs F(s-1,mb) + fwd edge cost; B/Bx(s,mb)
+    needs B/Bx(s+1,mb) + bwd edge cost (last stage: its own F(mb));
+    W(s,mb) needs Bx(s,mb).  Edge cost = measured send dur (writer) +
+    recv dur (reader).  Per-stage ops execute in recorded order on a
+    dedicated virtual executor, so a W that really ran inside a channel
+    wait replays inside the same gap.
+
+    Returns per-stage bubble fractions (1 - busy/span), their mean (the
+    headline metric) and max, and the virtual makespan.
+    """
+    n = len(events_by_stage)
+    comp: List[List[Tuple[str, int, float]]] = [[] for _ in range(n)]
+    edge_f: List[Dict[int, float]] = [dict() for _ in range(n)]
+    edge_b: List[Dict[int, float]] = [dict() for _ in range(n)]
+    for s, evs in enumerate(events_by_stage):
+        for e in evs:
+            k, mb, dur = e["kind"], e.get("mb", -1), e["dur"]
+            if k in ("F", "B", "Bx", "W"):
+                comp[s].append((k, mb, dur))
+            elif k == "send_f":
+                edge_f[s][mb] = edge_f[s].get(mb, 0.0) + dur
+            elif k == "recv_f" and s > 0:
+                edge_f[s - 1][mb] = edge_f[s - 1].get(mb, 0.0) + dur
+            elif k == "send_b":
+                edge_b[s][mb] = edge_b[s].get(mb, 0.0) + dur
+            elif k == "recv_b" and s + 1 < n:
+                edge_b[s + 1][mb] = edge_b[s + 1].get(mb, 0.0) + dur
+
+    end: Dict[Tuple[int, str, int], float] = {}
+
+    def dep_ready(s: int, kind: str, mb: int) -> Optional[float]:
+        if kind == "F":
+            if s == 0:
+                return 0.0
+            t = end.get((s - 1, "F", mb))
+            return None if t is None else t + edge_f[s - 1].get(mb, 0.0)
+        if kind in ("B", "Bx"):
+            if s == n - 1:
+                return end.get((s, "F", mb))
+            t = end.get((s + 1, "B", mb))
+            return None if t is None else t + edge_b[s + 1].get(mb, 0.0)
+        return end.get((s, "B", mb))  # W
+
+    idx = [0] * n
+    cursor = [0.0] * n
+    first = [None] * n
+    last = [0.0] * n
+    busy = [0.0] * n
+    total = sum(len(c) for c in comp)
+    done = 0
+    while done < total:
+        progressed = False
+        for s in range(n):
+            while idx[s] < len(comp[s]):
+                kind, mb, dur = comp[s][idx[s]]
+                dep = dep_ready(s, kind, mb)
+                if dep is None:
+                    break
+                t0 = max(cursor[s], dep)
+                t1 = t0 + dur
+                cursor[s] = t1
+                key = "B" if kind in ("B", "Bx") else kind
+                end[(s, key, mb)] = t1
+                if first[s] is None:
+                    first[s] = t0
+                last[s] = t1
+                busy[s] += dur
+                idx[s] += 1
+                done += 1
+                progressed = True
+        if not progressed:
+            missing = [(s, comp[s][idx[s]]) for s in range(n)
+                       if idx[s] < len(comp[s])]
+            raise RuntimeError(f"replay deadlock; blocked ops: {missing}")
+
+    bubbles = []
+    for s in range(n):
+        span = last[s] - (first[s] or 0.0)
+        bubbles.append(0.0 if span <= 0 else max(0.0, 1 - busy[s] / span))
+    return {
+        "per_stage": bubbles,
+        "mean": sum(bubbles) / max(1, n),
+        "max": max(bubbles) if bubbles else 0.0,
+        "span_s": max(last) if n else 0.0,
+    }
+
+
+_TRACE_NAMES = {"F": "pipeline.fwd", "B": "pipeline.bwd",
+                "Bx": "pipeline.bwd", "W": "pipeline.bwd_w",
+                "send_f": "pipeline.p2p", "recv_f": "pipeline.p2p",
+                "send_b": "pipeline.p2p", "recv_b": "pipeline.p2p",
+                "send_tie": "pipeline.p2p", "recv_tie": "pipeline.p2p",
+                "wait": "pipeline.idle"}
+
+
+def schedule_chrome_trace(events_by_stage: List[List[Dict[str, Any]]]
+                          ) -> List[Dict[str, Any]]:
+    """Per-op chrome trace (one pid per stage): load in chrome://tracing
+    or Perfetto to SEE the schedule — F/B/W slices, p2p edges, idle."""
+    out: List[Dict[str, Any]] = []
+    t_base = min((e["t0"] for evs in events_by_stage for e in evs),
+                 default=0.0)
+    for s, evs in enumerate(events_by_stage):
+        out.append({"ph": "M", "pid": s, "tid": 0, "name": "process_name",
+                    "args": {"name": f"pipeline stage {s}"}})
+        for e in evs:
+            out.append({
+                "ph": "X", "pid": s, "tid": 0,
+                "name": _TRACE_NAMES.get(e["kind"], e["kind"]),
+                "cat": "pipeline",
+                "ts": (e["t0"] - t_base) * 1e6,
+                "dur": max(0.01, e["dur"] * 1e6),
+                "args": {"kind": e["kind"], "mb": e.get("mb", -1)},
+            })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Stage runtime: one gang's programs, params, and scheduler loop
+
+
+def _add_trees(a, b):
+    import jax
+
+    return jax.tree.map(lambda x, y: x + y, a, b)
+
+
+_PROG_LOCK = threading.Lock()
+_PROGRAM_CACHE: Dict[Any, Dict[str, Any]] = {}  # guarded-by: _PROG_LOCK
+
+
+def _stage_programs(cfg, stage: int, stages: int) -> Dict[str, Any]:
+    """fwd/bwd/bwd_x/bwd_w programs for one stage slice, memoized
+    process-wide — GPTConfig is frozen/hashable, so (cfg, stage, stages)
+    is a stable key and rebuilding a pipeline (elastic recovery on a
+    surviving host, repeated construction in one process) reuses the XLA
+    executables instead of re-tracing and recompiling every stage."""
+    key = (cfg, stage, stages)
+    with _PROG_LOCK:
+        progs = _PROGRAM_CACHE.get(key)
+    if progs is not None:
+        return progs
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt
+
+    n, s = stages, stage
+    first, last = s == 0, s == stages - 1
+
+    if last:
+        def full(p, x, tgt):
+            def f(p_, x_):
+                return gpt.stage_loss(p_, x_, tgt, cfg, s, n)
+
+            if first:  # single-stage pipeline: no input grad needed
+                loss, vjp = jax.vjp(lambda p_: f(p_, x), p)
+                (dp,) = vjp(jnp.ones_like(loss))
+                return x, dp
+            loss, vjp = jax.vjp(f, p, x)
+            dp, dx = vjp(jnp.ones_like(loss))
+            return dx, dp
+
+        progs = {
+            "fwd": jax.jit(
+                lambda p, x, tgt: gpt.stage_loss(p, x, tgt, cfg, s, n)),
+            "bwd": jax.jit(full),
+            # zb split: jit of one output each — XLA dead-code-eliminates
+            # the other half's einsums, so Bx carries no weight-grad work
+            "bwd_x": jax.jit(lambda p, x, g: full(p, x, g)[0]),
+            "bwd_w": jax.jit(lambda p, x, g: full(p, x, g)[1]),
+        }
+    elif first:
+        def full0(p, x, g):
+            _, vjp = jax.vjp(
+                lambda p_: gpt.stage_hidden(p_, x, cfg, s, n), p)
+            (dp,) = vjp(g)
+            return dp
+
+        bwd0 = jax.jit(full0)
+        progs = {
+            "fwd": jax.jit(lambda p, x: gpt.stage_hidden(p, x, cfg, s, n)),
+            "bwd": bwd0,
+            "bwd_x": None,  # tokens have no grad; all of B is W work
+            "bwd_w": bwd0,
+        }
+    else:
+        def fullm(p, x, g):
+            _, vjp = jax.vjp(
+                lambda p_, x_: gpt.stage_hidden(p_, x_, cfg, s, n),
+                p, x)
+            dp, dx = vjp(g)
+            return dx, dp
+
+        progs = {
+            "fwd": jax.jit(lambda p, x: gpt.stage_hidden(p, x, cfg, s, n)),
+            "bwd": jax.jit(fullm),
+            "bwd_x": jax.jit(lambda p, x, g: fullm(p, x, g)[0]),
+            "bwd_w": jax.jit(lambda p, x, g: fullm(p, x, g)[1]),
+        }
+    with _PROG_LOCK:
+        # a concurrent builder may have won the race; keep ITS programs so
+        # every runtime shares one executable set
+        progs = _PROGRAM_CACHE.setdefault(key, progs)
+    return progs
+
+
+class StageRuntime:
+    """One pipeline stage: per-stage jit programs + the gang scheduler.
+
+    Transport-agnostic: the threads transport runs this on a dedicated
+    thread (one per gang, distinct virtual devices); the actors transport
+    runs it inside a dedicated ray_tpu actor process.  All mutable state
+    is owned by the single scheduler thread driving run_step — cross-
+    thread traffic happens only through shm channels and the transport's
+    queues.
+    """
+
+    def __init__(self, cfg, pcfg: PipelineConfig, stage: int, stage_params,
+                 tx=None, opt_state=None, device_index: Optional[int] = None,
+                 telemetry: bool = False, vault_tag: Optional[str] = None,
+                 restore=None, grad_sync=None, incarnation: int = 0):
+        import jax
+
+        self.cfg = cfg
+        self.pcfg = pcfg
+        self.stage = stage
+        self.stages = pcfg.stages
+        self.M = pcfg.num_microbatches
+        self._schedule = get_schedule(pcfg.schedule)
+        self._zb = self._schedule.split_backward
+        self._tx = tx
+        self._device = None
+        if device_index is not None:
+            devs = jax.local_devices()
+            self._device = devs[device_index % len(devs)]
+        if restore is not None:
+            # fold the lost gang's state back from its emergency shards
+            payload = restore.load()[0]
+            stage_params = payload["params"]
+            opt_state = payload["opt_state"]
+        if self._device is not None:
+            stage_params = jax.device_put(stage_params, self._device)
+            if opt_state is not None:
+                opt_state = jax.device_put(opt_state, self._device)
+        self._params = stage_params
+        self._opt_state = (opt_state if opt_state is not None
+                           else (tx.init(stage_params) if tx else None))
+        self._committed = (-1, self._params, self._opt_state)
+        self._grad_sync = grad_sync
+        if grad_sync is None and pcfg.grad_sync_group:
+            from ray_tpu.parallel.sharding import GradientSynchronizer
+
+            self._grad_sync = GradientSynchronizer(
+                group_name=f"{pcfg.grad_sync_group}-s{stage}")
+        self._ckpt = None
+        if vault_tag:
+            from ray_tpu.elastic.emergency import EmergencyCheckpointer
+
+            self._ckpt = EmergencyCheckpointer(
+                vault_tag, rank=stage, world_size=self.stages,
+                replication_factor=(1 if pcfg.transport == "actors" else 0),
+                keep_steps=2, snapshot_every=pcfg.snapshot_every)
+        self._vault_tag = vault_tag
+        self._timer = None
+        if telemetry:
+            from ray_tpu.telemetry.recorder import StepTimer
+
+            self._timer = StepTimer(rank=stage, incarnation=incarnation)
+        self._chans: Dict[str, Any] = {}
+        self._epoch = -1
+        self._make_programs()
+
+    # -- program construction ---------------------------------------------
+
+    def _make_programs(self):
+        import jax
+
+        progs = _stage_programs(self.cfg, self.stage, self.stages)
+        self._fwd = progs["fwd"]
+        self._bwd = progs["bwd"]
+        self._bwd_x = progs["bwd_x"]
+        self._bwd_w = progs["bwd_w"]
+
+        if self._tx is not None:
+            tx = self._tx
+
+            def upd(g, o, p):
+                import optax
+
+                updates, o2 = tx.update(g, o, p)
+                return optax.apply_updates(p, updates), o2
+
+            self._update = jax.jit(upd)
+
+    # -- channels -----------------------------------------------------------
+
+    def connect(self, paths: Dict[str, str], epoch: int):
+        """(Re-)open this stage's channel endpoints for `epoch` (recovery
+        bumps the epoch so survivors drop closed rings and re-attach)."""
+        if epoch == self._epoch and self._chans:
+            return
+        from ray_tpu.dag.channel import Channel
+
+        self.disconnect()
+        self._chans = {
+            k: Channel(p, slot_bytes=self.pcfg.slot_bytes,
+                       nslots=self.pcfg.nslots)
+            for k, p in paths.items()}
+        self._epoch = epoch
+
+    def disconnect(self):
+        for ch in self._chans.values():
+            try:
+                ch.release()
+            except Exception:
+                pass
+        self._chans = {}
+
+    def abort_step(self):
+        """A peer died mid-step: drop partial state, restore the commit."""
+        _, self._params, self._opt_state = self._committed
+        self.disconnect()
+        if self._timer is not None:
+            # discard the partial step (a fresh step_start resets phases)
+            self._timer.step_start(None)
+
+    # -- the scheduler loop --------------------------------------------------
+
+    def run_step(self, step: int, mbs_in=None, mbs_tgt=None,
+                 apply_update: bool = True, return_grads: bool = False,
+                 fail_at: Optional[int] = None,
+                 deadline_s: float = 180.0) -> Dict[str, Any]:
+        import jax
+        import numpy as np
+
+        from ray_tpu.dag.channel import (TAG_ERROR, TAG_STOP, ChannelClosed,
+                                         ChannelTimeout)
+
+        n, s, M = self.stages, self.stage, self.M
+        first, last = s == 0, s == n - 1
+        deadline = time.monotonic() + deadline_s
+        ev: List[Dict[str, Any]] = []
+        stash: Dict[int, Any] = {}
+        gstash: Dict[int, Any] = {}
+        tgts: Dict[int, Any] = {}
+        pending_w: "collections.deque[int]" = collections.deque()
+        acc = [None]
+        loss_sum = 0.0
+        p2p_bytes = 0
+        peak_stash = 0
+        if self._timer is not None:
+            self._timer.step_start(step)
+
+        def put(x):
+            return (jax.device_put(x, self._device)
+                    if self._device is not None else x)
+
+        def record(kind, mb, t0, t1, **kw):
+            ev.append({"kind": kind, "mb": mb, "t0": t0,
+                       "dur": t1 - t0, **kw})
+
+        def run_w(mb):
+            g = gstash.pop(mb)
+            x = stash.pop(mb)
+            t0 = time.perf_counter()
+            dp = jax.block_until_ready(self._bwd_w(self._params, x, g))
+            record("W", mb, t0, time.perf_counter())
+            acc[0] = dp if acc[0] is None else _add_trees(acc[0], dp)
+
+        def check_deadline():
+            if time.monotonic() > deadline:
+                raise ChannelTimeout(
+                    f"stage {s} step {step} exceeded {deadline_s}s")
+
+        def recv(chan, kind, mb):
+            """Poll-read so channel waits are measured as idle (and, for
+            zb, filled with pending W work) separately from the shm->
+            device copy of the successful read."""
+            nonlocal p2p_bytes
+            t_wait0 = time.perf_counter()
+            while True:
+                # timeout 0 = immediate check: a successful read's duration
+                # is then the pure shm->host copy, never hidden peer-wait
+                # (which would inflate the replay's edge costs)
+                t0 = time.perf_counter()
+                try:
+                    tag, val = chan.read(timeout_s=0.0)
+                    break
+                except ChannelTimeout:
+                    if self._zb and pending_w:
+                        run_w(pending_w.popleft())
+                    else:
+                        time.sleep(0.0002)
+                    check_deadline()
+            waited = t0 - t_wait0
+            t1 = time.perf_counter()
+            if tag == TAG_ERROR:
+                raise val if isinstance(val, BaseException) \
+                    else RuntimeError(str(val))
+            if tag == TAG_STOP:
+                raise ChannelClosed(chan.path)
+            if waited > 1e-6:
+                ev.append({"kind": "wait", "mb": mb, "t0": t_wait0,
+                           "dur": waited})
+            record(kind, mb, t0, t1)
+            return val
+
+        def send(chan, val, kind, mb):
+            """Poll-write: backpressure from a full ring is idle, not
+            p2p — and zb fills it with W work too."""
+            nonlocal p2p_bytes
+            t_wait0 = time.perf_counter()
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    chan.write(val, timeout_s=0.0)
+                    break
+                except ChannelTimeout:
+                    if self._zb and pending_w:
+                        run_w(pending_w.popleft())
+                    else:
+                        time.sleep(0.0002)
+                    check_deadline()
+            waited = t0 - t_wait0
+            t1 = time.perf_counter()
+            if waited > 1e-6:
+                ev.append({"kind": "wait", "mb": mb, "t0": t_wait0,
+                           "dur": waited})
+            nb = int(getattr(val, "nbytes", 0))
+            record(kind, mb, t0, t1, bytes=nb)
+            p2p_bytes += nb
+
+        ops = self._schedule.ops(s, n, M)
+        for op_idx, (kind, mb) in enumerate(ops):
+            if fail_at is not None and op_idx == fail_at:
+                raise RuntimeError(
+                    f"injected gang failure: stage {s} step {step} "
+                    f"op {op_idx} ({kind}{mb})")
+            if kind == "F":
+                if first:
+                    x = put(mbs_in[mb])
+                else:
+                    x = put(recv(self._chans["in"], "recv_f", mb))
+                stash[mb] = x
+                peak_stash = max(peak_stash, len(stash))
+                t0 = time.perf_counter()
+                if last:
+                    tgts[mb] = put(mbs_tgt[mb])
+                    loss = jax.block_until_ready(
+                        self._fwd(self._params, x, tgts[mb]))
+                    record("F", mb, t0, time.perf_counter())
+                    loss_sum += float(loss)
+                else:
+                    y = jax.block_until_ready(self._fwd(self._params, x))
+                    record("F", mb, t0, time.perf_counter())
+                    send(self._chans["out"], y, "send_f", mb)
+            elif kind == "B":
+                if last:
+                    g = tgts.pop(mb)
+                else:
+                    g = put(recv(self._chans["gin"], "recv_b", mb))
+                x = stash.pop(mb)
+                t0 = time.perf_counter()
+                if last or not first:  # the last-stage program returns
+                    dx, dp = jax.block_until_ready(  # (dx, dp) even at n==1
+                        self._bwd(self._params, x, g))
+                else:
+                    dp = jax.block_until_ready(self._bwd(self._params, x, g))
+                    dx = None
+                record("B", mb, t0, time.perf_counter())
+                if not first:
+                    send(self._chans["gout"], dx, "send_b", mb)
+                acc[0] = dp if acc[0] is None else _add_trees(acc[0], dp)
+            elif kind == "Bx":
+                if last:
+                    g = tgts.pop(mb)
+                else:
+                    g = put(recv(self._chans["gin"], "recv_b", mb))
+                gstash[mb] = g
+                t0 = time.perf_counter()
+                if self._bwd_x is not None:
+                    dx = jax.block_until_ready(
+                        self._bwd_x(self._params, stash[mb], g))
+                record("Bx", mb, t0, time.perf_counter())
+                if not first:
+                    send(self._chans["gout"], dx, "send_b", mb)
+                pending_w.append(mb)
+            else:  # "W" — skip if an idle-fill already ran it
+                if pending_w:
+                    run_w(pending_w.popleft())
+
+        # -- step finalize: grad average, tied-embed exchange, sync, apply
+        grads = jax.tree.map(lambda a: a / M, acc[0])
+        if self.cfg.tie_embeddings and n > 1 and (first or last):
+            # both end stages hold the tied table; exchange partials so
+            # each applies the TOTAL grad and the copies stay identical
+            if last:
+                send(self._chans["tie_out"], grads["embed"], "send_tie", -1)
+                grads = dict(grads)
+                grads["embed"] = put(recv(self._chans["tie_in"],
+                                          "recv_tie", -1))
+            else:
+                partial = put(recv(self._chans["tie_in"], "recv_tie", -1))
+                grads = dict(grads)
+                grads["embed"] = grads["embed"] + partial
+                send(self._chans["tie_out"], grads["embed"], "send_tie", -1)
+        if self._grad_sync is not None:
+            grads = self._grad_sync(grads)
+        loss = (loss_sum / M) if last else None
+        if apply_update and self._tx is not None:
+            self._params, self._opt_state = jax.block_until_ready(
+                self._update(grads, self._opt_state, self._params))
+        if apply_update:
+            self._committed = (step, self._params, self._opt_state)
+            if self._ckpt is not None:
+                self._ckpt.snapshot(
+                    {"pipeline": self._vault_tag, "stage": s,
+                     "step": step,
+                     "params": self._params,
+                     "opt_state": self._opt_state}, step=step)
+
+        fwd_t = sum(e["dur"] for e in ev if e["kind"] == "F")
+        bwd_t = sum(e["dur"] for e in ev
+                    if e["kind"] in ("B", "Bx", "W"))
+        p2p_t = sum(e["dur"] for e in ev
+                    if e["kind"].startswith(("send_", "recv_")))
+        idle_t = sum(e["dur"] for e in ev if e["kind"] == "wait")
+        if self._timer is not None:
+            self._timer.add_phase_time("pipeline", fwd_t + bwd_t + p2p_t
+                                       + idle_t)
+            self._timer.add_phase_time("pipeline.fwd", fwd_t)
+            self._timer.add_phase_time("pipeline.bwd", bwd_t)
+            self._timer.add_phase_time("pipeline.p2p", p2p_t)
+            self._timer.add_phase_time("pipeline.idle", idle_t)
+            self._timer.step_end(step)
+
+        res: Dict[str, Any] = {
+            "stage": s, "step": step, "loss": loss, "events": ev,
+            "p2p_bytes": p2p_bytes, "peak_stash": peak_stash,
+            "phase_s": {"fwd": fwd_t, "bwd": bwd_t, "p2p": p2p_t,
+                        "idle": idle_t},
+        }
+        if self._timer is not None:
+            res["telemetry"] = self._timer.snapshot()
+        if return_grads:
+            res["grads"] = jax.device_get(grads)
+        return res
+
+    def committed_step(self) -> int:
+        return self._committed[0]
+
+    def wait_snapshot(self, timeout: float = 10.0) -> bool:
+        return self._ckpt.wait_idle(timeout) if self._ckpt else True
+
+    def close(self):
+        self.disconnect()
+        if self._ckpt is not None:
+            self._ckpt.stop()
+
+
+# ---------------------------------------------------------------------------
+# Threads transport: one scheduler thread per gang
+
+
+class _StageThread:
+    """Thread-transport gang handle.  Commands flow through Queues (their
+    internal lock is the synchronization); the runtime itself is owned by
+    the scheduler thread alone.  A generic exception kills the gang (the
+    runtime is dropped, modeling host loss); ChannelClosed means a PEER
+    died — the gang aborts the step, restores its commit, and waits for
+    a new epoch."""
+
+    def __init__(self, stage: int, make_runtime: Callable[[], StageRuntime]):
+        self.stage = stage
+        self._make = make_runtime
+        self._inbox: "queue.Queue" = queue.Queue()
+        self._outbox: "queue.Queue" = queue.Queue()
+        self._runtime: Optional[StageRuntime] = None
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"mpmd-stage{stage}")
+        self._thread.start()
+
+    def _loop(self):
+        from ray_tpu.dag.channel import ChannelClosed
+
+        try:
+            self._runtime = self._make()
+        except BaseException as e:  # noqa: BLE001 — report, don't hang
+            self._outbox.put(("failed", -1, e))
+            return
+        self._outbox.put(("ready", -1, None))
+        while True:
+            cmd = self._inbox.get()
+            if cmd[0] == "stop":
+                self._runtime.close()
+                self._outbox.put(("stopped", -1, None))
+                return
+            _, step, paths, epoch, kwargs = cmd
+            try:
+                self._runtime.connect(paths, epoch)
+                res = self._runtime.run_step(step, **kwargs)
+                self._outbox.put(("ok", step, res))
+            except ChannelClosed as e:
+                self._runtime.abort_step()
+                self._outbox.put(("aborted", step, e))
+            except BaseException as e:  # noqa: BLE001 — gang death
+                rt, self._runtime = self._runtime, None
+                try:
+                    rt.disconnect()
+                except Exception:
+                    pass
+                self._outbox.put(("failed", step, e))
+                return
+
+    def submit(self, step, paths, epoch, kwargs):
+        self._inbox.put(("step", step, paths, epoch, kwargs))
+
+    def stop(self):
+        if self._thread.is_alive():
+            self._inbox.put(("stop",))
+
+    def result(self, timeout: float):
+        return self._outbox.get(timeout=timeout)
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# Actors transport: one ray_tpu actor per gang (the per-gang scheduler
+# actor).  Defined lazily so importing mpmd never requires a cluster.
+
+_STAGE_ACTOR_CLS = None
+
+
+def _stage_actor_cls():
+    global _STAGE_ACTOR_CLS
+    if _STAGE_ACTOR_CLS is not None:
+        return _STAGE_ACTOR_CLS
+    import ray_tpu
+
+    @ray_tpu.remote
+    class MPMDStageActor:
+        """Per-gang scheduler actor: owns one StageRuntime and drives its
+        schedule; activations/grads ride shm channels, NOT actor RPC."""
+
+        def __init__(self, blob):
+            import cloudpickle
+
+            kw = cloudpickle.loads(blob)
+            self._rt = StageRuntime(**kw)
+
+        def run_step(self, step, paths, epoch, blob):
+            import cloudpickle
+
+            from ray_tpu.dag.channel import ChannelClosed
+
+            kwargs = cloudpickle.loads(blob)
+            try:
+                self._rt.connect(paths, epoch)
+                res = self._rt.run_step(step, **kwargs)
+                return ("ok", step, res)
+            except ChannelClosed as e:
+                self._rt.abort_step()
+                return ("aborted", step, repr(e))
+
+        def vault_inventory(self):
+            from ray_tpu.elastic import emergency
+
+            return emergency._inventory()
+
+        def vault_fetch(self, step, stage):
+            from ray_tpu.elastic import emergency
+
+            return emergency._fetch(step, stage)
+
+        def wait_snapshot(self, timeout=10.0):
+            return self._rt.wait_snapshot(timeout)
+
+        def close(self):
+            self._rt.close()
+            return True
+
+    _STAGE_ACTOR_CLS = MPMDStageActor
+    return MPMDStageActor
+
+
+class _StageActorHandle:
+    def __init__(self, stage: int, runtime_kwargs: Dict[str, Any]):
+        import cloudpickle
+
+        self.stage = stage
+        self._actor = _stage_actor_cls().remote(
+            cloudpickle.dumps(runtime_kwargs))
+        self._pending = None
+
+    def submit(self, step, paths, epoch, kwargs):
+        import cloudpickle
+
+        self._pending = self._actor.run_step.remote(
+            step, paths, epoch, cloudpickle.dumps(kwargs))
+
+    def result(self, timeout: float):
+        import ray_tpu
+
+        try:
+            return ray_tpu.get(self._pending, timeout=timeout)
+        except Exception as e:
+            if "timeout" in type(e).__name__.lower() \
+                    or "timeout" in str(e).lower():
+                raise queue.Empty() from None  # still running: poll again
+            return ("failed", -1, e)  # actor death / RPC error = gang loss
+
+    def stop(self):
+        try:
+            import ray_tpu
+
+            ray_tpu.get(self._actor.close.remote(), timeout=10)
+        except Exception:
+            pass
+
+    @property
+    def alive(self) -> bool:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Driver
+
+
+class MPMDPipeline:
+    """Driver for an MPMD pipeline over `models/gpt.py`.
+
+    Partitions params into per-stage trees (partition_stage_params),
+    spawns one gang per stage (threads or actors transport), wires
+    activation/grad channels, and drives steps.  See tests/test_mpmd.py
+    and `bench.py --pipeline-only`.
+    """
+
+    def __init__(self, cfg, pcfg: PipelineConfig, params=None, key=None,
+                 tx=None, telemetry: bool = False, base_dir: Optional[str]
+                 = None, grad_sync_factory: Optional[Callable[[int], Any]]
+                 = None, auto_recover: bool = True):
+        from ray_tpu.models import gpt
+
+        if params is None:
+            import jax
+
+            params = gpt.init(key if key is not None
+                              else jax.random.PRNGKey(0), cfg)
+        self.cfg = cfg
+        self.pcfg = pcfg
+        self.M = pcfg.num_microbatches
+        self._tx = tx
+        self._telemetry = telemetry
+        self._grad_sync_factory = grad_sync_factory
+        self._auto_recover = auto_recover
+        self._tag = f"mpmd-{os.getpid()}-{id(self) & 0xffff:x}"
+        root = base_dir
+        if root is None:
+            shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+            root = tempfile.mkdtemp(prefix="mpmd-", dir=shm)
+            self._owns_dir = True
+        else:
+            os.makedirs(root, exist_ok=True)
+            self._owns_dir = False
+        self._dir = root
+        self._epoch = 0
+        self._last_step = -1
+        self._last_results: List[Dict[str, Any]] = []
+        self._fail_next: Dict[int, int] = {}
+        self._init_state = gpt.partition_stage_params(params, cfg,
+                                                      pcfg.stages)
+        self._reg_lock = threading.Lock()
+        self._runtimes: Dict[int, StageRuntime] = {}  # guarded-by: _reg_lock
+        self._handles: List[Any] = [
+            self._spawn(s, self._init_state[s], restore=None)
+            for s in range(pcfg.stages)]
+        for h in self._handles:
+            if self.pcfg.transport == "threads":
+                status, _, err = h.result(timeout=300.0)
+                if status != "ready":
+                    raise RuntimeError(
+                        f"stage {h.stage} failed to start") from err
+
+    # -- gang lifecycle ----------------------------------------------------
+
+    def _runtime_kwargs(self, stage: int, stage_params, restore):
+        return dict(
+            cfg=self.cfg, pcfg=self.pcfg, stage=stage,
+            stage_params=stage_params, tx=self._tx,
+            device_index=(stage if self.pcfg.transport == "threads"
+                          else None),
+            telemetry=self._telemetry, vault_tag=self._tag,
+            restore=restore, incarnation=self._epoch)
+
+    def _spawn(self, stage: int, stage_params, restore):
+        if self.pcfg.transport == "actors":
+            kw = self._runtime_kwargs(stage, stage_params, restore)
+            import jax
+
+            kw["stage_params"] = jax.device_get(kw["stage_params"])
+            return _StageActorHandle(stage, kw)
+
+        def make(stage=stage, restore=restore):
+            grad_sync = (self._grad_sync_factory(stage)
+                         if self._grad_sync_factory else None)
+            rt = StageRuntime(grad_sync=grad_sync,
+                              **self._runtime_kwargs(stage, stage_params,
+                                                     restore))
+            with self._reg_lock:
+                self._runtimes[stage] = rt
+            return rt
+
+        return _StageThread(stage, make)
+
+    def _paths(self, stage: int) -> Dict[str, str]:
+        d = os.path.join(self._dir, f"e{self._epoch}")
+        os.makedirs(d, exist_ok=True)
+        n = self.pcfg.stages
+        p: Dict[str, str] = {}
+        if stage > 0:
+            p["in"] = os.path.join(d, f"act{stage - 1}")
+            p["gout"] = os.path.join(d, f"grad{stage - 1}")
+        if stage < n - 1:
+            p["out"] = os.path.join(d, f"act{stage}")
+            p["gin"] = os.path.join(d, f"grad{stage}")
+        if self.cfg.tie_embeddings and n > 1:
+            if stage == n - 1:
+                p["tie_out"] = os.path.join(d, "tie_a")
+                p["tie_in"] = os.path.join(d, "tie_b")
+            elif stage == 0:
+                p["tie_in"] = os.path.join(d, "tie_a")
+                p["tie_out"] = os.path.join(d, "tie_b")
+        return p
+
+    def _close_epoch_channels(self):
+        """Wake every gang blocked on an epoch-e channel (recovery)."""
+        from ray_tpu.dag.channel import Channel
+
+        d = os.path.join(self._dir, f"e{self._epoch}")
+        if not os.path.isdir(d):
+            return
+        for name in os.listdir(d):
+            try:
+                ch = Channel(os.path.join(d, name),
+                             slot_bytes=self.pcfg.slot_bytes,
+                             nslots=self.pcfg.nslots)
+                ch.close()
+                ch.release()
+            except Exception:
+                pass
+
+    # -- stepping ----------------------------------------------------------
+
+    def _split(self, batch):
+        import numpy as np
+
+        if "inputs" in batch:
+            inputs, targets = batch["inputs"], batch["targets"]
+        else:
+            toks = batch["tokens"]
+            inputs, targets = toks[:, :-1], toks[:, 1:]
+        from ray_tpu.parallel.pipeline import split_microbatches
+
+        inp = np.asarray(split_microbatches(np.asarray(inputs), self.M))
+        tgt = np.asarray(split_microbatches(np.asarray(targets), self.M))
+        return ([inp[i] for i in range(self.M)],
+                [tgt[i] for i in range(self.M)])
+
+    def inject_failure(self, stage: int, op_index: int = 0):
+        """Kill stage's gang at op_index of the NEXT step (tests/bench:
+        proves a lost gang folds back from emergency checkpoints)."""
+        self._fail_next[stage] = op_index
+
+    def step(self, batch, apply_update: bool = True,
+             return_grads: bool = False, deadline_s: float = 180.0,
+             _retry: bool = False) -> Dict[str, Any]:
+        step = self._last_step + 1
+        mbs_in, mbs_tgt = self._split(batch)
+        n = self.pcfg.stages
+        for s, h in enumerate(self._handles):
+            kwargs = dict(apply_update=apply_update,
+                          return_grads=return_grads,
+                          deadline_s=deadline_s,
+                          fail_at=self._fail_next.pop(s, None))
+            if s == 0:
+                kwargs["mbs_in"] = mbs_in
+            if s == n - 1:
+                kwargs["mbs_tgt"] = mbs_tgt
+            h.submit(step, self._paths(s), self._epoch, kwargs)
+        statuses: List[Optional[Tuple]] = [None] * n
+        t_end = time.monotonic() + deadline_s + 30.0
+        failed: List[int] = []
+        while any(st is None for st in statuses):
+            for s, h in enumerate(self._handles):
+                if statuses[s] is not None:
+                    continue
+                try:
+                    out = h.result(timeout=0.2)
+                except queue.Empty:
+                    continue
+                statuses[s] = out
+                if out[0] == "failed":
+                    failed.append(s)
+                    # wake peers blocked on this gang's channels so they
+                    # abort instead of timing out
+                    self._close_epoch_channels()
+            if time.monotonic() > t_end:
+                raise TimeoutError(
+                    f"pipeline step {step} stuck; statuses="
+                    f"{[st and st[0] for st in statuses]}")
+        if failed:
+            if any(st[0] == "ok" for st in statuses):
+                # a gang already committed this step while another died:
+                # rolling the committed gang back is not supported, so
+                # surface it rather than silently diverge
+                raise RuntimeError(
+                    f"unrecoverable: gang(s) {failed} died after "
+                    f"{sum(st[0] == 'ok' for st in statuses)} gang(s) "
+                    f"committed step {step}")
+            if _retry or not self._auto_recover:
+                errs = [statuses[s][2] for s in failed]
+                raise RuntimeError(
+                    f"stage gang(s) {failed} died: {errs}") from errs[0]
+            self.recover(failed)
+            return self.step(batch, apply_update=apply_update,
+                             return_grads=return_grads,
+                             deadline_s=deadline_s, _retry=True)
+        aborted = [s for s, st in enumerate(statuses) if st[0] != "ok"]
+        if aborted:
+            raise RuntimeError(
+                f"gang(s) {aborted} aborted step {step} without a "
+                f"detected failure: {[statuses[s][2] for s in aborted]}")
+        results = [st[2] for st in statuses]
+        self._last_step = step
+        self._last_results = results
+        out: Dict[str, Any] = {
+            "step": step,
+            "loss": results[-1]["loss"],
+            "p2p_bytes": sum(r["p2p_bytes"] for r in results),
+            "peak_stash": [r["peak_stash"] for r in results],
+            "events": [r["events"] for r in results],
+            "recovered": _retry,
+        }
+        if return_grads:
+            from ray_tpu.models import gpt
+
+            out["grads"] = gpt.merge_stage_trees(
+                [r["grads"] for r in results], self.cfg, grads=True,
+                tie_summed=True)  # the step's exchange already totalled it
+        return out
+
+    def forward_backward(self, batch) -> Tuple[float, Any]:
+        """One no-update pass: (loss, full reassembled grad tree) — the
+        parity-test surface against loss_fn + jax.grad."""
+        res = self.step(batch, apply_update=False, return_grads=True)
+        return res["loss"], res["grads"]
+
+    # -- elastic recovery --------------------------------------------------
+
+    def recover(self, dead_stages: List[int]):
+        """Respawn dead gangs from their freshest emergency shards; the
+        survivors already rolled back to the committed step when their
+        channels closed.  Channels are rebuilt under a new epoch."""
+        from ray_tpu.elastic import emergency
+        from ray_tpu.elastic.emergency import EmergencyCheckpoint
+
+        step = self._last_step
+        self._close_epoch_channels()
+        self._epoch += 1
+        for s in dead_stages:
+            restore = None
+            with self._reg_lock:
+                rt = self._runtimes.pop(s, None)
+            if rt is not None:
+                rt.wait_snapshot(10.0)
+            if step >= 0:
+                payload = emergency._fetch(step, s)
+                if payload is None and self.pcfg.transport == "actors":
+                    import ray_tpu
+
+                    # the dead gang's shard lives in its ring successors'
+                    # vaults (EmergencyCheckpointer peer replication)
+                    for h in self._handles:
+                        if h.stage == s:
+                            continue
+                        try:
+                            payload = ray_tpu.get(
+                                h._actor.vault_fetch.remote(step, s),
+                                timeout=30)
+                        except Exception:
+                            continue
+                        if payload is not None:
+                            break
+                if payload is not None:
+                    restore = EmergencyCheckpoint(step, self.pcfg.stages,
+                                                  {s: payload})
+            if restore is None and step >= 0:
+                raise RuntimeError(
+                    f"no emergency shard for stage {s} at step {step}")
+            self._handles[s] = self._spawn(s, self._init_state[s], restore)
+            if self.pcfg.transport == "threads":
+                status, _, err = self._handles[s].result(timeout=300.0)
+                if status != "ready":
+                    raise RuntimeError(
+                        f"stage {s} respawn failed") from err
+
+    # -- reporting ---------------------------------------------------------
+
+    def bubble_report(self) -> Dict[str, Any]:
+        """Measured (virtual-replay) bubble of the LAST step vs the
+        fill-drain theoretical floor at the same (n, M)."""
+        if not self._last_results:
+            raise RuntimeError("no step recorded yet")
+        rep = replay_bubble([r["events"] for r in self._last_results])
+        rep["theoretical_fill_drain"] = \
+            PipelineSchedule.theoretical_fill_drain_bubble(
+                self.pcfg.stages, self.M)
+        rep["schedule"] = self.pcfg.schedule
+        return rep
+
+    def chrome_trace(self) -> List[Dict[str, Any]]:
+        if not self._last_results:
+            return []
+        return schedule_chrome_trace(
+            [r["events"] for r in self._last_results])
+
+    def telemetry_snapshots(self) -> List[Dict[str, Any]]:
+        return [r["telemetry"] for r in self._last_results
+                if "telemetry" in r]
+
+    def close(self):
+        for h in self._handles:
+            try:
+                h.stop()
+            except Exception:
+                pass
+        if self.pcfg.transport == "threads":
+            for h in self._handles:
+                try:
+                    h.result(timeout=10.0)
+                except Exception:
+                    pass
+        if self._owns_dir:
+            shutil.rmtree(self._dir, ignore_errors=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -102,14 +102,24 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_mb, mesh: Mesh,
 
 
 def split_microbatches(x, num_microbatches: int):
-    """[B, ...] -> [M, B/M, ...]"""
-    b = x.shape[0]
-    if b % num_microbatches:
-        raise ValueError(f"batch {b} not divisible by microbatches "
-                         f"{num_microbatches}")
-    return x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+    """Leaves [B, ...] -> [M, B/M, ...] over any pytree (pipeline_apply
+    already accepts pytrees; a bare array is the one-leaf case)."""
+    M = int(num_microbatches)
+
+    def split_leaf(path, leaf):
+        b = leaf.shape[0] if leaf.ndim else 0
+        if leaf.ndim == 0 or b % M:
+            where = jax.tree_util.keystr(path) or "<root>"
+            raise ValueError(
+                f"batch {b if leaf.ndim else '<scalar>'} at leaf {where} "
+                f"(shape {tuple(leaf.shape)}) not divisible by "
+                f"microbatches {M}")
+        return leaf.reshape(M, b // M, *leaf.shape[1:])
+
+    return jax.tree_util.tree_map_with_path(split_leaf, x)
 
 
 def merge_microbatches(x):
-    """[M, mb, ...] -> [B, ...]"""
-    return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+    """Leaves [M, mb, ...] -> [B, ...] (inverse of split_microbatches)."""
+    return jax.tree.map(
+        lambda l: l.reshape(l.shape[0] * l.shape[1], *l.shape[2:]), x)

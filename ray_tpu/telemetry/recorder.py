@@ -35,7 +35,9 @@ TELEMETRY_KEY_PREFIX = "telemetry:"
 # measured its stages) — children overlap the parent's time, so summaries
 # and the step residual must not double-count them (see step_end).
 PHASE_ORDER = ("data", "compute", "collective", "collective.quantize",
-               "collective.transfer", "collective.dequantize", "checkpoint")
+               "collective.transfer", "collective.dequantize", "checkpoint",
+               "pipeline", "pipeline.fwd", "pipeline.bwd", "pipeline.bwd_w",
+               "pipeline.p2p", "pipeline.idle")
 
 _STEP_BOUNDARIES = [0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5,
                     10, 30, 60, 300]

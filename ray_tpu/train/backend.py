@@ -29,6 +29,7 @@ from ray_tpu.collective.compression import CompressionConfig, parse_compression
 
 if TYPE_CHECKING:
     from ray_tpu.elastic.config import ElasticConfig
+    from ray_tpu.parallel.mpmd import PipelineConfig
     from ray_tpu.telemetry.config import TelemetryConfig
 
 logger = logging.getLogger(__name__)
@@ -216,6 +217,13 @@ class JaxConfig(BackendConfig):
     # thresholds; False to disable step timing + goodput accounting
     telemetry: Union[None, bool, Dict[str, Any],
                      "TelemetryConfig"] = None
+    # MPMD pipeline parallelism across worker gangs: a
+    # parallel.mpmd.PipelineConfig (stages/schedule/microbatches) or a
+    # spec string ("stages=4,schedule=1f1b,microbatches=8").  Published
+    # to every worker as RAY_TPU_TRAIN_PIPELINE so the train fn can
+    # build its stage via PipelineConfig.from_env(); string annotation +
+    # lazy parse keep control-plane processes jax-free
+    pipeline: Union[None, str, "PipelineConfig"] = None
 
     def backend_cls(self):
         return _JaxBackend
@@ -272,6 +280,14 @@ class _JaxBackend(Backend):
             # the flag form reaches subprocesses a worker may itself
             # spawn; the group default below covers the workers directly
             env["RAY_TPU_COLLECTIVE_COMPRESSION"] = comp_spec
+        if backend_config.pipeline is not None:
+            pcfg = backend_config.pipeline
+            if isinstance(pcfg, str):
+                # validate the spec here, on the driver, where the error
+                # is actionable — not inside N workers
+                from ray_tpu.parallel.mpmd import PipelineConfig
+                pcfg = PipelineConfig.from_spec(pcfg)
+            env["RAY_TPU_TRAIN_PIPELINE"] = pcfg.to_spec()
         import ray_tpu
 
         ray_tpu.get([

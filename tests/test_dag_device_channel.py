@@ -66,6 +66,37 @@ def test_device_tensor_bf16(chan):
                                   np.full((16, 16), 3.0, np.float32))
 
 
+def test_steady_state_varying_shapes_reuse(chan):
+    """The MPMD pipeline's steady state: microbatch-sized activations
+    alternating with scalar losses/grad edges of OTHER shapes and dtypes
+    through ONE channel, ≥100 round-trips.  The device path must stay
+    enabled the whole time (every message TAG_DEVICE), values must
+    survive bit-exact (header stays aligned as slot payload sizes jump
+    around), and the ring must not leak slots."""
+    shapes = [((4, 16, 8), jnp.float32),   # activation
+              ((), jnp.float32),           # scalar loss
+              ((2, 32, 8), jnp.bfloat16),  # half-precision activation
+              ((8, 8), jnp.int32),         # token block
+              ((3, 5, 7), jnp.float32)]    # odd strides
+    for i in range(120):
+        shape, dt = shapes[i % len(shapes)]
+        x = (jnp.full(shape, i % 97, dt) if shape
+             else jnp.asarray(float(i), dt))
+        chan.write(x, timeout_s=10)
+        tag, y = chan.read(timeout_s=10)
+        assert tag == TAG_DEVICE, f"device path fell back at round {i}"
+        assert y.shape == tuple(shape) and y.dtype == dt, (i, y.shape)
+        np.testing.assert_array_equal(np.asarray(y, np.float32),
+                                      np.asarray(x, np.float32))
+    # no slot leaked: the full ring capacity is still writable without a
+    # reader draining it
+    for j in range(DEFAULT_NSLOTS):
+        chan.write(jnp.full((16,), j, jnp.float32), timeout_s=10)
+    for j in range(DEFAULT_NSLOTS):
+        tag, y = chan.read(timeout_s=10)
+        assert tag == TAG_DEVICE and float(y[0]) == float(j)
+
+
 def test_non_array_values_unchanged(chan):
     chan.write({"a": 1})
     tag, v = chan.read(timeout_s=10)
