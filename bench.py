@@ -2218,6 +2218,172 @@ def _pipeline_only_main() -> int:
     return _write_bench_pipeline(bench_pipeline())
 
 
+# ---------------------------------------------------------------------------
+# Podracer RL mode (`python bench.py --rl-only [--quick]`): Anakin (the
+# fused single-host scan) and Sebulba (elastic actor gangs streaming to
+# the learner) under a sustained ChaosSchedule.  Emits BENCH_RL.json.
+# Gates: forward-ratcheting 0.9x floors on Anakin env steps/s and
+# Sebulba learner samples/s, availability exactly 1.0 (no learner stall
+# past the bound), and staleness p99 within the configured bound.
+# ---------------------------------------------------------------------------
+
+
+def bench_rl(quick: bool = False) -> dict:
+    import ray_tpu
+    from ray_tpu.rl.podracer import (AnakinConfig, ChaosSchedule,
+                                     SebulbaConfig, run_anakin, run_sebulba)
+
+    if quick:
+        acfg = AnakinConfig(num_envs=16, rollout_len=8, num_updates=12,
+                            hidden=(16,), seed=0)
+    else:
+        acfg = AnakinConfig(num_envs=64, rollout_len=16, num_updates=30,
+                            hidden=(32, 32), seed=0)
+    a = run_anakin(acfg)
+    anakin_row = {
+        "num_envs": acfg.num_envs, "rollout_len": acfg.rollout_len,
+        "num_updates": acfg.num_updates,
+        "env_steps_per_s": round(a["env_steps_per_s"], 1),
+        "updates_per_s": round(a["updates_per_s"], 2),
+        "compile_s": round(a["compile_s"], 2),
+        "final_loss": round(a["final_loss"], 4),
+    }
+    print(json.dumps({"anakin": anakin_row}), flush=True)
+
+    # Sebulba under sustained chaos: the schedule is seeded from
+    # RAY_TPU_CHAOS_SEED (default 0) so soak drivers can vary the storm
+    # while any one seed stays reproducible; chaos may move WHEN batches
+    # arrive, never what they contain
+    G, N = (2, 12) if quick else (3, 24)
+    chaos = (ChaosSchedule.sustained(N, G, kills=1, stragglers=0,
+                                     preemptions=0)
+             if quick else
+             ChaosSchedule.sustained(N, G, kills=1, stragglers=1,
+                                     preemptions=1, straggle_delay_s=1.2,
+                                     grace_s=5.0))
+    scfg = SebulbaConfig(
+        num_gangs=G, num_envs=4 if quick else 8, rollout_len=8,
+        num_updates=N, hidden=(16,), seed=0, window=1,
+        trial="bench_rl_quick" if quick else "bench_rl",
+        # the 0.2s batch floor keeps respawn-compile CPU contention
+        # proportionally small against the straggler threshold (the
+        # same rationale as the chaos e2e test)
+        min_produce_s=0.2, straggler_multiple=3.0, straggler_sustain=2,
+        remediation_max_episodes=1, remediation_effect_window=2,
+        remediation_recover_tolerance=0.75, drain_grace_s=5.0)
+    ray_tpu.init(num_cpus=max(4, (os.cpu_count() or 1)),
+                 ignore_reinit_error=True)
+    try:
+        s = run_sebulba(scfg, chaos)
+    finally:
+        ray_tpu.shutdown()
+    sebulba_row = {
+        "num_gangs": G, "num_updates": N, "num_envs": scfg.num_envs,
+        "rollout_len": scfg.rollout_len,
+        "learner_samples_per_s": round(s["learner_samples_per_s"], 1),
+        "env_steps_per_s": round(s["env_steps_per_s"], 1),
+        "staleness_p99": s["staleness"]["p99"],
+        "staleness_bound": s["staleness"]["bound"],
+        "availability": s["availability"],
+        "chaos_events": len(s["chaos_fired"]),
+        "deaths": len(s["deaths"]),
+        "respawns": s["respawns"],
+        "final_goodput": s["goodput_trace"][-1] if s["goodput_trace"]
+        else None,
+        "params_digest": s["params_digest"],
+        "elapsed_s": round(s["elapsed_s"], 1),
+    }
+    print(json.dumps({"sebulba": sebulba_row}), flush=True)
+    return {"anakin": anakin_row, "sebulba": sebulba_row}
+
+
+def _rl_only_main(quick: bool = False) -> int:
+    """Write BENCH_RL.json (merging rows for modes not rerun) and gate.
+
+    Ratchet floors follow the _RATCHET_ROWS rationale: the mark is 0.9x
+    the best observed value (this shared host swings run to run), only
+    ever moves up, and a run below 0.9x of it fails.  Quick rows live
+    under their own -quick keys so the smaller workload never ratchets
+    the full run's floor (or vice versa).  Availability and staleness
+    are hard correctness gates, not ratchets: a chaos run that stalls
+    the learner past the bound or leaks staleness is a regression no
+    matter how fast it went."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, "BENCH_RL.json")
+    try:
+        with open(path) as f:
+            prev_rows = json.load(f).get("rows", {})
+    except (OSError, ValueError):
+        prev_rows = {}
+
+    got = bench_rl(quick=quick)
+    failures = []
+    rows = dict(prev_rows)
+    suffix = "-quick" if quick else ""
+    for name, metric in (("anakin", "env_steps_per_s"),
+                         ("sebulba", "learner_samples_per_s")):
+        key = name + suffix
+        row = got[name]
+        recorded = prev_rows.get(key, {}).get("recorded")
+        val = row[metric]
+        if recorded and val < 0.9 * recorded:
+            failures.append(f"{key} {metric} {val} < 0.9x recorded "
+                            f"{recorded}")
+        row["recorded"] = round(max(0.9 * val, recorded or 0.0), 1)
+        rows[key] = row
+    srow = got["sebulba"]
+    if srow["availability"] != 1.0:
+        failures.append(f"sebulba availability {srow['availability']} "
+                        f"!= 1.0 (learner stalled past the bound)")
+    if srow["staleness_p99"] > srow["staleness_bound"]:
+        failures.append(f"sebulba staleness p99 {srow['staleness_p99']} "
+                        f"> bound {srow['staleness_bound']}")
+
+    data = {"host_cpus": os.cpu_count(),
+            "chaos_seed": int(os.environ.get("RAY_TPU_CHAOS_SEED", "0")),
+            "gate": {"anakin_metric": "env_steps_per_s",
+                     "sebulba_metric": "learner_samples_per_s",
+                     "floor_frac": 0.9,
+                     "availability_must_be": 1.0},
+            "rows": rows}
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+    print(json.dumps(data, indent=2))
+    for msg in failures:
+        print(f"FAIL: {msg}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+def _run_rl_quick_gate() -> int:
+    """The cheap tier-1 RL gate `--table` runs: `--rl-only --quick` in a
+    bounded cpu-pinned subprocess (a wedged accelerator tunnel must not
+    hang the table run)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--rl-only",
+             "--quick"],
+            capture_output=True, text=True, timeout=600, env=env)
+    except subprocess.TimeoutExpired:
+        print("FAIL: rl quick gate timed out after 600s", file=sys.stderr)
+        return 1
+    for line in (out.stdout or "").strip().splitlines():
+        print(line, flush=True)
+    if out.returncode != 0:
+        print(f"FAIL: rl quick gate exited {out.returncode}: "
+              f"{(out.stderr or '')[-500:]}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main():
     # headline FIRST and flushed: the device extras below can hang on a
     # broken accelerator runtime, and the one-JSON-line contract must
@@ -2294,6 +2460,8 @@ if __name__ == "__main__":
         sys.exit(_write_bench_tasks(bench_tasks_table()))
     elif "--control-only" in sys.argv:
         sys.exit(_control_only_main(quick="--quick" in sys.argv))
+    elif "--rl-only" in sys.argv:
+        sys.exit(_rl_only_main(quick="--quick" in sys.argv))
     elif "--table" in sys.argv:
         table = bench_table()
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -2319,6 +2487,9 @@ if __name__ == "__main__":
         print(json.dumps(table, indent=2))
         # the tasks view regenerates with every table refresh so the two
         # files never disagree about the submission rows
-        _write_bench_tasks(table)
+        rc = _write_bench_tasks(table)
+        # the cheap RL chaos gate rides along with every table refresh:
+        # Anakin + a 2-gang Sebulba with one kill, ratcheted floors
+        sys.exit(rc or _run_rl_quick_gate())
     else:
         main()

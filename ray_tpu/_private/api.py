@@ -92,19 +92,24 @@ class RemoteFunction:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
+                 generator_backpressure: int = 0):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._generator_backpressure = generator_backpressure
 
-    def options(self, num_returns: int = 1):
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns: int = 1,
+                _generator_backpressure_num_objects: int = 0):
+        return ActorMethod(self._handle, self._name, num_returns,
+                           _generator_backpressure_num_objects or 0)
 
     def remote(self, *args, **kwargs):
         core = current_core()
-        refs = core.submit_actor_task(self._handle._actor_id, self._name,
-                                      args, kwargs,
-                                      num_returns=self._num_returns)
+        refs = core.submit_actor_task(
+            self._handle._actor_id, self._name, args, kwargs,
+            num_returns=self._num_returns,
+            generator_backpressure=self._generator_backpressure)
         # streaming methods return one ObjectRefGenerator
         return refs[0] if self._num_returns in (1, "streaming") else refs
 

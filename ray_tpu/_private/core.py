@@ -2544,7 +2544,8 @@ class CoreWorker:
                     ent.event.set()
 
     def submit_actor_task(self, actor_id: str, method_name: str, args, kwargs,
-                          num_returns: int = 1) -> List[ObjectRef]:
+                          num_returns: int = 1,
+                          generator_backpressure: int = 0) -> List[ObjectRef]:
         if num_returns == "streaming":
             num_returns = STREAMING_RETURNS
         ac = self._actor_conn(actor_id)
@@ -2561,6 +2562,7 @@ class CoreWorker:
             owner_addr=self.addr,
             parent_task_id=EXECUTING_TASK_ID.get(),
             job_id=EXECUTING_JOB_ID.get() or self.job_id,
+            generator_backpressure=generator_backpressure,
         )
         from ray_tpu.util import tracing
 
@@ -2790,6 +2792,12 @@ class CoreWorker:
 
     def _error_specs(self, specs, err):
         for spec in specs:
+            if spec.num_returns == STREAMING_RETURNS:
+                # a consumer may be blocked in ObjectRefGenerator.next()
+                # waiting for the item the dead actor never reported:
+                # finish the stream with the error so the wait raises now
+                # instead of hanging on the reconnect quantum
+                self._fail_stream(spec.task_id, err)
             for oid in spec.return_ids():
                 with self.lock:
                     e = self.objects.get(oid)

@@ -39,6 +39,12 @@ class PreemptionNotice:
     reason: str = "preemption"
     #: seconds until the host is expected to go away (advisory)
     grace_s: Optional[float] = None
+    #: opaque per-event identity stamped by the source.  The watcher
+    #: never re-fires the identity it last consumed: a source that
+    #: re-arms while still holding the SAME event (a file renamed back,
+    #: a stale re-read) is a replay, not a new edge.  None opts out
+    #: (sources that cannot distinguish events keep pure edge semantics).
+    key: Optional[object] = None
 
 
 class PreemptionSource:
@@ -56,11 +62,14 @@ class FakePreemptionSource(PreemptionSource):
     def __init__(self):
         self._lock = threading.Lock()
         self._notice: Optional[PreemptionNotice] = None
+        self._seq = 0  # guarded-by: _lock
 
     def trigger(self, reason: str = "test-preemption",
                 grace_s: Optional[float] = None):
         with self._lock:
-            self._notice = PreemptionNotice(reason=reason, grace_s=grace_s)
+            self._seq += 1
+            self._notice = PreemptionNotice(reason=reason, grace_s=grace_s,
+                                            key=("fake", self._seq))
 
     def clear(self):
         with self._lock:
@@ -80,7 +89,9 @@ class FilePreemptionSource(PreemptionSource):
         self.path = path
 
     def poll(self) -> Optional[PreemptionNotice]:
-        if not os.path.exists(self.path):
+        try:
+            st = os.stat(self.path)
+        except OSError:
             return None
         reason, grace = "preemption", None
         try:
@@ -93,7 +104,10 @@ class FilePreemptionSource(PreemptionSource):
                     grace = float(spec["grace_s"])
         except Exception:
             pass  # an empty/garbled sentinel still means "draining"
-        return PreemptionNotice(reason=reason, grace_s=grace)
+        # identity rides the mtime: the same untouched sentinel seen again
+        # after a re-arm is the SAME event; a rewritten file is a new one
+        return PreemptionNotice(reason=reason, grace_s=grace,
+                                key=("file", st.st_mtime_ns))
 
 
 class TpuMetadataSource(PreemptionSource):
@@ -158,6 +172,8 @@ class PreemptionWatcher:
         self._stop = threading.Event()
         self._armed = True  # fire on the first positive poll
         self._last_fired_at: Optional[float] = None
+        self._last_fired_key: Optional[object] = None
+        self._replay_logged = False
         self._pending_flap = False  # edge swallowed inside the window
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="preemption-watcher")
@@ -182,6 +198,21 @@ class PreemptionWatcher:
         if notice is None:
             self._armed = True
             self._pending_flap = False  # the flap cleared: nothing owed
+            self._replay_logged = False
+            return False
+        if (notice.key is not None
+                and notice.key == self._last_fired_key):
+            # the source re-armed but still holds the identity we already
+            # consumed (e.g. a sentinel file briefly unreadable, then the
+            # same bytes again): a replay, never an edge — do NOT fire it
+            # into the fresh incarnation.  Stay armed so a genuinely new
+            # identity fires on its next poll.
+            if self._armed and not self._replay_logged:
+                self._replay_logged = True
+                self.notices_suppressed += 1
+                logger.info(
+                    "preemption notice (%s) is a replay of the already-"
+                    "consumed event: suppressed", notice.reason)
             return False
         in_window = (self.debounce_s > 0.0
                      and self._last_fired_at is not None
@@ -204,6 +235,8 @@ class PreemptionWatcher:
         self._armed = False
         self._pending_flap = False
         self._last_fired_at = self._clock()
+        self._last_fired_key = notice.key
+        self._replay_logged = False
         self.notices_fired += 1
         try:
             self.on_notice(notice)

@@ -11,7 +11,8 @@ from .algorithms.appo import APPO, APPOConfig
 from .algorithms.cql import CQL, CQLConfig
 from .algorithms.dqn import DQN, DQNConfig
 from .algorithms.dreamerv3 import DreamerV3, DreamerV3Config
-from .algorithms.impala import IMPALA, Impala, ImpalaConfig
+from .algorithms.impala import (IMPALA, Impala, ImpalaConfig,
+                                make_impala_learner)
 from .algorithms.marwil import BC, BCConfig, MARWIL, MARWILConfig
 from .algorithms.ppo import PPO, PPOConfig
 from .algorithms.sac import SAC, SACConfig
@@ -26,6 +27,8 @@ from .env.multi_agent_env import (CooperativeMatchEnv, MultiAgentEnv,
                                   MultiAgentEnvRunner,
                                   MultiAgentEnvRunnerGroup)
 from .env.multi_agent_env import register_env as register_multi_agent_env
+from .podracer import (AnakinConfig, ChaosEvent, ChaosSchedule, Sebulba,
+                       SebulbaConfig, run_anakin, run_sebulba)
 from .utils.replay_buffer import ReplayBuffer
 from . import connectors
 from .offline import OfflineData, record_rollouts
@@ -43,4 +46,6 @@ __all__ = [
     "MultiAgentPPO", "MultiAgentPPOConfig", "MultiRLModule",
     "CooperativeMatchEnv", "register_multi_agent_env",
     "connectors", "OfflineData", "record_rollouts",
+    "AnakinConfig", "ChaosEvent", "ChaosSchedule", "Sebulba",
+    "SebulbaConfig", "make_impala_learner", "run_anakin", "run_sebulba",
 ]

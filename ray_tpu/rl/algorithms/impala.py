@@ -85,6 +85,18 @@ class ImpalaLearner(Learner):
                           jnp.exp(target_logp - batch["logp"]))}
 
 
+def make_impala_learner(env_spec: Dict[str, Any],
+                        hidden=(64, 64), **hyperparams) -> ImpalaLearner:
+    """A standalone ImpalaLearner over the default discrete policy
+    module — the piece Podracer shares with the Impala Algorithm without
+    dragging in runner groups (see rl/podracer.py).  ``hyperparams``
+    pass through to ImpalaLearner/Learner (gamma, vf_coeff,
+    entropy_coeff, clip_rho, clip_c, lr, grad_clip, seed, ...)."""
+    module = DiscretePolicyModule(env_spec["obs_dim"],
+                                  env_spec["num_actions"], hidden)
+    return ImpalaLearner(module, **hyperparams)
+
+
 class ImpalaConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__()

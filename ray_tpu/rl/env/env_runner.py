@@ -155,6 +155,35 @@ class JaxEnvRunner:
         return {"batch": batch, "stats": stats}
 
 
+def fixed_shape_batch(env, module, params, rng, num_envs: int,
+                      num_steps: int) -> Dict[str, np.ndarray]:
+    """One deterministic fixed-shape trajectory batch from a FRESH
+    vectorized carry.
+
+    Unlike JaxEnvRunner.sample (which threads env state across calls for
+    continuous sampling), the batch here is a pure function of
+    (env, module, params, rng, shapes): no hidden state survives the
+    call.  That purity is what lets a replacement Podracer actor gang
+    regenerate, bit for bit, the batches its dead predecessor owed the
+    learner (see rl/podracer.py) — respawn cost is O(1), not O(history).
+
+    Returns a dict of [B, T, ...] numpy arrays (batch-major, the order
+    ImpalaLearner.compute_loss consumes) for obs/action/reward/done/logp
+    plus final_vf [B] (the V-trace bootstrap tail).
+    """
+    from ray_tpu.rl.env import jax_env
+
+    carry = jax_env.init_carry(env, rng, num_envs)
+    # module.forward_exploration hashes stably across accesses (same
+    # bound method), so rollout's static policy_fn arg never retraces
+    carry, batch = jax_env.rollout(env, module.forward_exploration,
+                                   params, carry, num_steps)
+    out = {k: np.swapaxes(np.asarray(batch[k]), 0, 1)
+           for k in ("obs", "action", "reward", "done", "logp")}
+    out["final_vf"] = np.asarray(module.value(params, carry[1]))
+    return out
+
+
 class GymEnvRunner:
     """Host-side gymnasium sampling (reference:
     single_agent_env_runner.py with gym.vector.SyncVectorEnv)."""

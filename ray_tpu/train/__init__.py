@@ -7,7 +7,7 @@ module-level session API (train/_internal/session.py:667-790).
 """
 
 from .backend import (Backend, BackendConfig, JaxConfig, TensorflowConfig,
-                      TorchConfig, TPUConfig)
+                      TorchConfig, TPUConfig, publish_run_state)
 from .backend_executor import (BackendExecutor, TrainingFailedError,
                                TrainingWorkerError, WorkerDrainedError)
 from .checkpoint import Checkpoint
@@ -35,5 +35,5 @@ __all__ = [
     "TrainingFailedError",
     "TrainingWorkerError", "WorkerDrainedError", "WorkerGroup",
     "get_checkpoint", "get_context",
-    "get_dataset_shard", "report",
+    "get_dataset_shard", "publish_run_state", "report",
 ]

@@ -35,6 +35,38 @@ if TYPE_CHECKING:
 logger = logging.getLogger(__name__)
 
 
+def publish_run_state(trial_name: str, status: str, *, name: str,
+                      workers: int, rounds: int,
+                      metrics: Optional[Dict[str, Any]] = None,
+                      telemetry: Optional[Dict[str, Any]] = None):
+    """Run-state snapshot into the control KV (ns 'train') for the
+    dashboard and the autoscaler's LoadMetrics (reference:
+    TrainStateActor feeding dashboard/modules/train/train_head.py).
+    Advisory, never raises: a run must not fail because the dashboard
+    missed a frame.  Shared by JaxTrainer and non-Trainer run loops
+    (the Podracer Sebulba supervisor) so every training-shaped workload
+    speaks one state schema — including the telemetry.goodput field the
+    autoscaler's GoodputPolicy scales on."""
+    try:
+        import json as _json
+        import time as _time
+
+        from ray_tpu._private.api import current_core
+
+        state: Dict[str, Any] = {
+            "name": name, "trial": trial_name, "status": status,
+            "workers": workers, "rounds": rounds,
+            "last_metrics": metrics, "ts": _time.time(),
+        }
+        if telemetry is not None:
+            state["telemetry"] = telemetry
+        current_core().control.call("kv_put", {
+            "ns": "train", "key": trial_name,
+            "val": _json.dumps(state).encode()})
+    except Exception:
+        pass
+
+
 @dataclass
 class BackendConfig:
     def backend_cls(self):

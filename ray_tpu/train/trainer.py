@@ -116,25 +116,13 @@ class JaxTrainer:
         dashboard (reference: TrainStateActor feeding
         dashboard/modules/train/train_head.py) — advisory, never fails
         the run."""
-        try:
-            import json as _json
+        from ray_tpu.train.backend import publish_run_state
 
-            from ray_tpu._private.api import current_core
-
-            state = {
-                "name": self.run_config.name, "trial": trial_name,
-                "status": status,
-                "workers": self.scaling_config.num_workers,
-                "rounds": rounds,
-                "last_metrics": metrics, "ts": time.time(),
-            }
-            if telemetry is not None:
-                state["telemetry"] = telemetry
-            current_core().control.call("kv_put", {
-                "ns": "train", "key": trial_name,
-                "val": _json.dumps(state).encode()})
-        except Exception:
-            pass
+        publish_run_state(trial_name, status,
+                          name=self.run_config.name,
+                          workers=self.scaling_config.num_workers,
+                          rounds=rounds, metrics=metrics,
+                          telemetry=telemetry)
 
     def _run(self, trial_dir: str, experiment_name: str, trial_name: str,
              on_report: Optional[Callable[[Dict[str, Any]], None]] = None,

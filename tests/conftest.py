@@ -73,7 +73,8 @@ _QUICK_FILES = {
     "test_data_remote_io.py", "test_elastic.py", "test_label_scheduling.py",
     "test_mpmd.py",
     "test_native_sched.py", "test_native_store.py", "test_ops.py",
-    "test_parallel.py", "test_partition.py", "test_remediation.py",
+    "test_parallel.py", "test_partition.py", "test_podracer.py",
+    "test_remediation.py",
     "test_resource_sync.py", "test_runtime_env.py",
     "test_serve.py", "test_serve_fault.py", "test_serve_grpc.py",
     "test_state.py",

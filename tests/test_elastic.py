@@ -133,6 +133,58 @@ def test_file_preemption_source(tmp_path):
     assert n.reason == "maintenance" and n.grace_s == 12.0
 
 
+def test_preemption_replay_not_refired_into_fresh_incarnation(tmp_path):
+    """A re-armed source still holding an already-consumed notice is a
+    replay, not a new edge: the watcher must not fire it again (e.g. a
+    stale preemption file reappearing after the gang already drained and
+    regrew — replaying it would drain the fresh incarnation for no
+    reason).  Identity is the source-stamped per-event key, NOT the
+    notice content: a genuinely new event with identical reason/grace
+    must still fire."""
+    p = tmp_path / "preempt"
+    src = FilePreemptionSource(str(p))
+    fired = []
+    w = PreemptionWatcher(src, fired.append, poll_interval_s=0.01)
+    p.write_text('{"reason": "spot-reclaim"}')
+    assert w.poll_once() and len(fired) == 1     # the real event
+    assert not w.poll_once()                     # level-held
+
+    # file vanishes (drain completed, someone cleaned up) -> re-arm
+    os.rename(p, tmp_path / "stash")
+    assert not w.poll_once()
+    # ... then the SAME file (same mtime -> same identity) reappears:
+    # a replay into the fresh incarnation — must be suppressed, and the
+    # suppression counter must not inflate on repeated polls
+    os.rename(tmp_path / "stash", p)
+    assert not w.poll_once()
+    assert not w.poll_once()
+    assert len(fired) == 1 and w.notices_fired == 1
+    assert w.notices_suppressed == 1
+
+    # a genuinely NEW notice (rewrite -> new mtime) with the SAME
+    # content fires immediately: the watcher stayed armed through the
+    # replay, and identity is per-event, not per-content
+    time.sleep(0.01)  # ensure mtime_ns advances
+    p.write_text('{"reason": "spot-reclaim"}')
+    assert w.poll_once()
+    assert len(fired) == 2 and fired[1].reason == "spot-reclaim"
+
+
+def test_preemption_fake_source_retriggers_same_content():
+    """FakePreemptionSource stamps a fresh identity per trigger: two
+    triggers with identical reason/grace are two events, both fire."""
+    src = FakePreemptionSource()
+    fired = []
+    w = PreemptionWatcher(src, fired.append, poll_interval_s=0.01)
+    src.trigger("spot-reclaim", grace_s=5.0)
+    assert w.poll_once()
+    src.clear()
+    assert not w.poll_once()
+    src.trigger("spot-reclaim", grace_s=5.0)    # same content, new event
+    assert w.poll_once()
+    assert w.notices_fired == 2 and w.notices_suppressed == 0
+
+
 def test_emergency_checkpoint_roundtrip():
     import pickle
 

@@ -721,3 +721,116 @@ def test_remediation_advisory_records_but_changes_nothing(
     recos = [e for e in events
              if e.get("event") == "remediation_recommended"]
     assert len(recos) == 1 and recos[0]["action"]["dry_run"] is True
+
+
+# ---------------------------------------------------------------------------
+# Remediation + drain racing on the SAME node: exactly one shrink
+# ---------------------------------------------------------------------------
+
+
+def _straggle_and_self_drain_loop(config):
+    """Like _selfheal_loop, but the straggling rank also posts a drain
+    advisory against its OWN node mid-step-2 — after the trainer's
+    round-3 drain check has passed, before the step-2 results that ripen
+    the quarantine decision arrive.  The quarantine thus lands on a node
+    that is already draining."""
+    from ray_tpu import collective, elastic, telemetry
+    from ray_tpu import train as _train
+    from ray_tpu.elastic.emergency import EmergencyCheckpoint as _EC
+
+    ctx = _train.get_context()
+    G = ctx.extra["global_batch_size"]
+    pb = ctx.extra["per_replica_batch"]
+    off = ctx.extra["batch_offset"]
+    group = os.environ["RAY_TPU_TRAIN_COLLECTIVE_GROUP"]
+
+    state = {"w": 1.0, "step": 0}
+    ck = _train.get_checkpoint()
+    if isinstance(ck, _EC):
+        state = dict(max(ck.load(), key=lambda s: s["step"]))
+
+    while state["step"] < config["steps"]:
+        t = state["step"]
+        straggler = ctx.get_world_rank() == 1 and ctx.get_world_size() == 3
+        with telemetry.phase("data"):
+            idx = np.arange(off, off + pb, dtype=np.float64)
+            time.sleep(0.05)
+            if straggler:
+                time.sleep(0.15)
+        if straggler and t == 2:
+            from ray_tpu._private.api import current_core
+
+            current_core().control.call("report_draining", {
+                "node_id": os.environ["RAY_TPU_NODE_ID"],
+                "grace_s": 60.0, "reason": "spot-reclaim"}, timeout=10.0)
+        gsum = float(np.sum(np.sin(idx + t) * state["w"] + idx * 0.01))
+        total = collective.allreduce(np.array([gsum]), group_name=group)
+        state = {"w": state["w"] - 0.1 * float(total[0]) / G,
+                 "step": t + 1}
+        elastic.snapshot(state, state["step"])
+        assert elastic.wait_replicated(20.0)
+        _train.report({"step": state["step"], "w": state["w"],
+                       "world_size": ctx.get_world_size()})
+
+
+def test_quarantine_on_draining_node_shrinks_once(private_cluster_slot,
+                                                  multi_node_cluster,
+                                                  tmp_path):
+    """A quarantine decision landing while the victim's node is already
+    draining must shrink the gang exactly ONCE: elastic recovery taints
+    the node through both sets (draining | quarantined) and sheds it in
+    a single rebalance — never a second drain-triggered shrink for the
+    same host.  min_workers=1 makes a double-shrink observable (the gang
+    would reach width 1 instead of 2)."""
+    STEPS, G = 12, 12
+    core, events, _ = _selfheal_cluster(multi_node_cluster)
+    trainer = train.JaxTrainer(
+        _straggle_and_self_drain_loop, train_loop_config={"steps": STEPS},
+        backend_config=JaxConfig(
+            mode="local",
+            elastic=ElasticConfig(
+                min_workers=1, replication_factor=1, global_batch_size=G,
+                recover_timeout_s=5.0,
+                remediation_mode="enforce",
+                remediation_confirm_rounds=1,
+                remediation_cooldown_s=5.0,
+                remediation_max_episodes=2,
+                # window 3: the median discards the one-off replication
+                # stall the first post-recovery round absorbs
+                remediation_effect_window=3),
+            telemetry=TelemetryConfig(flush_interval_s=0.0,
+                                      straggler_multiple=2.0,
+                                      straggler_sustain=2)),
+        scaling_config=ScalingConfig(num_workers=3),
+        run_config=RunConfig(name="drainrace", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["step"] == STEPS
+
+    # shrunk exactly once: 3 -> 2, NOT 3 -> 2 -> 1
+    assert result.metrics["world_size"] == 2
+
+    # the quarantine path won (a drain-first recovery would record no
+    # remediation episode) and it fired exactly once
+    records = fetch_records(core.control, "drainrace_00000")
+    assert len(records) == 1, records
+    rec = records[0]
+    assert rec["mode"] == "enforce"
+    assert rec["cause"]["rank"] == 1
+    act = rec["action"]
+    assert act["kind"] == "quarantine_rebalance" and not act["dry_run"]
+    assert act["new_world"] == 2
+    assert rec["effect"] is not None and rec["effect"]["recovered"]
+
+    # the victim node wears BOTH hats in the control plane's view —
+    # the drain advisory was live when the quarantine landed
+    nodes = core.control.call("get_nodes", {}, timeout=10.0)
+    victim = [n for n in nodes if n["node_id"] == act["node_id"]]
+    assert len(victim) == 1
+    assert victim[0]["quarantined"], victim
+    assert victim[0]["draining"], victim
+    assert victim[0]["draining_reason"] == "spot-reclaim"
+    # and no other node was touched by either mechanism
+    assert [n["node_id"] for n in nodes
+            if n.get("quarantined") or n.get("draining")] \
+        == [act["node_id"]]

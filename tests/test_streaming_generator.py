@@ -245,3 +245,32 @@ def test_streaming_generator_drop_stops_producer(ray_cluster):
             time.sleep(0.5)
     else:
         raise AssertionError("producer never released its worker")
+
+
+def test_streaming_actor_death_unblocks_consumer(ray_cluster):
+    """A producing actor dying BETWEEN yields must surface ActorDiedError
+    to a consumer blocked in next() within the dead-owner short-connect
+    window — not hang until the get timeout.
+
+    Regression: _error_specs only failed the per-object entries, so a
+    stream whose next item was never reported had nothing to error — the
+    blocked next() waited out the full reconnect quantum."""
+    import os
+
+    @ray_tpu.remote(max_restarts=0, max_task_retries=0)
+    class Doomed:
+        def stream(self):
+            yield "only-item"
+            time.sleep(1.0)  # let the consumer block in next() first
+            os._exit(1)  # dies before the second yield is ever reported
+
+    a = Doomed.remote()
+    g = a.stream.options(num_returns="streaming").remote()
+    assert ray_tpu.get(next(g), timeout=60) == "only-item"
+    t0 = time.time()
+    with pytest.raises(RayTpuError):
+        # the item that will never come: must raise promptly, not hang
+        # (and not StopIteration — death is an error, not end-of-stream)
+        ray_tpu.get(next(g), timeout=120)
+    waited = time.time() - t0
+    assert waited < 30, f"blocked consumer hung {waited:.1f}s on dead actor"
