@@ -144,6 +144,12 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
             _tracing.run_hook(_tracing_startup_hook, _tracing_config)
             _tracing.register_hook(_core.control, _tracing_startup_hook,
                                    _tracing_config)
+            # the hook may have just enabled tracing — attach the span
+            # collector the CoreWorker init skipped while it was off
+            _tracing.ensure_collector(_core.control, proc="driver",
+                                      worker_id=_core.worker_id,
+                                      node_id=_core.node_id or "",
+                                      job_id=_core.job_id)
         return connection_info()
 
 

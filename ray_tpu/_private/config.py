@@ -120,6 +120,25 @@ CONFIG_DEFS: List[Tuple[str, type, Any, str]] = [
      "structured cluster events retained by the control plane "
      "(node/actor/pg/job lifecycle; separate from task events so "
      "tuning one buffer never evicts the other's history)"),
+    # -- distributed tracing
+    ("trace_sample", float, 0.0,
+     "head-based trace sampling ratio in [0,1]: >0 auto-enables "
+     "tracing and samples that fraction of new traces (deterministic "
+     "on trace_id, so every process agrees); 0 leaves the sampler off "
+     "— tracing enabled explicitly via a startup hook records all"),
+    ("trace_buffer_cap", int, 4096,
+     "finished spans buffered per process before drop-oldest (the "
+     "span buffer flushing batched report_spans to the control plane)"),
+    ("trace_flush_interval_s", float, 0.5,
+     "span-buffer flush period (rate limit on report_spans pushes)"),
+    ("trace_store_cap", int, 512,
+     "traces retained by the control plane's span collector (LRU "
+     "eviction beyond this)"),
+    ("trace_store_ttl_s", float, 600.0,
+     "idle TTL before a collected trace is evicted from the control "
+     "plane's _tracing KV namespace"),
+    ("trace_spans_per_trace", int, 512,
+     "max spans stored per trace (overflow counted, not stored)"),
     # -- runtime env
     ("rtenv_max_bytes", int, 256 * 1024 * 1024,
      "max size of one runtime_env package"),

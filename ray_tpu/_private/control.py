@@ -26,6 +26,7 @@ ICI-connected slice.
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import os
 import pickle
@@ -268,6 +269,30 @@ class ControlServer:
         # coalesced task-event relay accounting (see h_report_task_events)
         self._relay_batches = 0
         self._relay_dropped = 0
+        # distributed-trace span collector (see h_report_spans): batched
+        # report_spans notifies land in a bounded queue; a dedicated
+        # merge thread folds them per-trace and mirrors each trace as a
+        # JSON blob into the _tracing KV namespace (so kv_get serves
+        # trace reads), with LRU-cap + idle-TTL eviction
+        self._span_queue: deque = deque()  # batches; overflow drops oldest
+        self._span_queue_cap = 1024
+        self._span_signal = threading.Event()
+        self._traces_lock = threading.Lock()
+        # trace_id -> list of span dicts
+        self.trace_spans: Dict[str, List[Dict[str, Any]]] = {}  # guarded-by: _traces_lock
+        # trace_id -> last-merge monotonic ts, LRU-ordered for eviction
+        self._trace_index: "OrderedDict[str, float]" = OrderedDict()  # guarded-by: _traces_lock
+        self._spans_received = 0       # guarded-by: _traces_lock
+        self._span_batches = 0         # guarded-by: _traces_lock
+        self._spans_dropped = 0        # guarded-by: _traces_lock
+        self._trace_span_overflow = 0  # guarded-by: _traces_lock
+        self._traces_evicted = 0       # guarded-by: _traces_lock
+        self._trace_store_cap = _cfg().trace_store_cap
+        self._trace_store_ttl_s = _cfg().trace_store_ttl_s
+        self._trace_spans_per_trace = _cfg().trace_spans_per_trace
+        self._span_thread = threading.Thread(
+            target=self._span_merge_loop, name="control-trace-spans",
+            daemon=True)
         # native C++ selection/planning engine (reference's scheduling core
         # is C++: cluster_resource_scheduler.h, hybrid_scheduling_policy.h);
         # Python keeps authoritative optimistic accounting and mirrors
@@ -317,6 +342,7 @@ class ControlServer:
         s.handle("cluster_resources", self.h_cluster_resources)
         s.handle("state_dump", self.h_state_dump)
         s.handle("report_task_events", self.h_report_task_events)
+        s.handle("report_spans", self.h_report_spans)
         s.handle("list_events", self.h_list_events)
         s.handle("report_event", self.h_report_event)
         s.handle("list_task_events", self.h_list_task_events, deferred=True)
@@ -469,6 +495,7 @@ class ControlServer:
     def start(self, block: bool = False):
         self.health_thread.start()
         self._event_thread.start()
+        self._span_thread.start()
         self._actor_sched_thread = threading.Thread(
             target=self._actor_sched_loop, name="control-actor-sched",
             daemon=True)
@@ -478,8 +505,11 @@ class ControlServer:
     def stop(self):
         self._stop.set()
         self._event_signal.set()
+        self._span_signal.set()
         if self._event_thread.is_alive():
             self._event_thread.join(timeout=2.0)
+        if self._span_thread.is_alive():
+            self._span_thread.join(timeout=2.0)
         self.server.stop()
         self.pool.shutdown(wait=False)
         if self.pstore is not None:
@@ -1883,6 +1913,16 @@ class ControlServer:
                 "relay_batches": relay_batches,
                 "relay_dropped": relay_dropped,
             }
+        with self._traces_lock:
+            tracing = {
+                "queue_depth": len(self._span_queue),
+                "traces": len(self.trace_spans),
+                "spans": self._spans_received,
+                "span_batches": self._span_batches,
+                "dropped": self._spans_dropped,
+                "span_overflow": self._trace_span_overflow,
+                "traces_evicted": self._traces_evicted,
+            }
         return {
             "uptime_s": round(time.time() - self.start_time, 1),
             "handlers": self.server.stats(),
@@ -1893,6 +1933,7 @@ class ControlServer:
             "pubsub": pubsub,
             "subscriptions": subs,
             "events": events,
+            "tracing": tracing,
             "nodes": {"alive": nodes_alive, "total": nodes_total},
         }
 
@@ -2043,6 +2084,105 @@ class ControlServer:
                 return list(self.profile_events[-limit:])
 
         self._defer(d, run)
+
+    # -- distributed-trace span collector ---------------------------------
+
+    def h_report_spans(self, conn, p):
+        """Span ingest mirrors task-event ingest: batches queue here and
+        a dedicated thread merges them per-trace off the RPC loop, so a
+        burst of sampled traces never stalls lease scheduling.  Accepts
+        one process batch ({"spans", "dropped", "common"}) or a relay
+        envelope ({"batches": [...], "dropped": n}); the queue is
+        bounded with drop-oldest accounting."""
+        q = self._span_queue
+        batches = p.get("batches")
+        if batches is not None:
+            if p.get("dropped"):
+                with self._traces_lock:
+                    self._spans_dropped += p["dropped"]
+            q.extend(batches)
+        else:
+            q.append(p)
+        while len(q) > self._span_queue_cap:
+            try:
+                old = q.popleft()
+                with self._traces_lock:
+                    self._spans_dropped += \
+                        len(old.get("spans", ())) + old.get("dropped", 0)
+            except IndexError:
+                break
+        self._span_signal.set()
+        return True
+
+    def _span_merge_loop(self):
+        while not self._stop.is_set():
+            self._span_signal.wait(0.5)
+            self._span_signal.clear()
+            self._drain_span_queue()
+        self._drain_span_queue()  # final drain: keep pre-stop batches
+
+    def _drain_span_queue(self):
+        while self._span_queue:
+            try:
+                self._merge_spans(self._span_queue.popleft())
+            except IndexError:
+                break
+            except Exception:
+                logger.exception("span merge failed")
+
+    def _merge_spans(self, p):
+        """Fold one batch into the per-trace store, evict (LRU cap +
+        idle TTL), then mirror touched traces into the _tracing KV
+        namespace as pre-encoded JSON blobs — the encode happens outside
+        self.lock, so the global lock is held only for dict updates."""
+        common_fields = p.get("common") or {}
+        proc = common_fields.get("proc")
+        now = time.monotonic()
+        with self._traces_lock:
+            self._span_batches += 1
+            self._spans_dropped += p.get("dropped", 0)
+            touched = set()
+            for sp in p.get("spans", []):
+                tid = sp.get("trace_id")
+                if not tid:
+                    continue
+                if proc and "proc" not in sp:
+                    sp["proc"] = proc
+                lst = self.trace_spans.get(tid)
+                if lst is None:
+                    lst = self.trace_spans[tid] = []
+                if len(lst) >= self._trace_spans_per_trace:
+                    self._trace_span_overflow += 1
+                    continue
+                lst.append(sp)
+                self._spans_received += 1
+                self._trace_index[tid] = now
+                self._trace_index.move_to_end(tid)
+                touched.add(tid)
+            evicted = []
+            while len(self._trace_index) > self._trace_store_cap:
+                old, _ = self._trace_index.popitem(last=False)
+                self.trace_spans.pop(old, None)
+                evicted.append(old)
+                self._traces_evicted += 1
+            while self._trace_index:
+                old, ts = next(iter(self._trace_index.items()))
+                if now - ts <= self._trace_store_ttl_s:
+                    break
+                self._trace_index.popitem(last=False)
+                self.trace_spans.pop(old, None)
+                evicted.append(old)
+                self._traces_evicted += 1
+            blobs = {tid: json.dumps(self.trace_spans[tid]).encode()
+                     for tid in touched if tid in self.trace_spans}
+        if not blobs and not evicted:
+            return
+        with self.lock:
+            ns = self.kv.setdefault("_tracing", {})
+            for tid, blob in blobs.items():
+                ns[f"trace:{tid}"] = blob
+            for tid in evicted:
+                ns.pop(f"trace:{tid}", None)
 
 
 def _standby_watch(peer: str, interval: float, misses_to_promote: int):

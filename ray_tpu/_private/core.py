@@ -21,6 +21,7 @@ Design departures for TPU:
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import threading
@@ -293,7 +294,7 @@ class ObjectEntry:
 
 class TaskRecord:
     __slots__ = ("spec", "pool_key", "deps", "pushed_to", "retries_left",
-                 "done", "canceled", "mux")
+                 "done", "canceled", "mux", "staged_ns")
 
     def __init__(self, spec: TaskSpec, pool_key, retries_left: int):
         self.spec = spec
@@ -304,6 +305,7 @@ class TaskRecord:
         self.done = False
         self.canceled = False
         self.mux = False          # routed via the raylet submit relay
+        self.staged_ns = None     # stage clock for sampled traces only
 
 
 class LeasedWorker:
@@ -554,6 +556,18 @@ class CoreWorker:
                 transport=transport)
         else:
             self.task_events = NULL_BUFFER
+
+        # distributed tracing: install this process's span collector
+        # (SpanBuffer -> batched report_spans) — a no-op unless tracing
+        # is already enabled or RAY_TPU_TRACE_SAMPLE asks for it
+        from ray_tpu.util import tracing as _tracing
+
+        _tracing.ensure_collector(
+            self.control,
+            proc=("driver" if mode == "driver"
+                  else f"worker:{self.worker_id[:8]}"),
+            worker_id=self.worker_id, node_id=self.node_id or "",
+            job_id=self.job_id)
 
         if mode == "driver":
             self.control.call("register_job", {"job_id": self.job_id,
@@ -836,6 +850,10 @@ class CoreWorker:
             self.task_events.stop()
         except Exception:
             pass
+        # final span flush while the control client is still open
+        from ray_tpu.util import tracing as _tracing
+
+        _tracing.detach_collector()
         try:
             self.control.close()
         except Exception:
@@ -1512,6 +1530,19 @@ class CoreWorker:
                 spec.trace_ctx = tracing.inject_context()
         return self._submit_spec(spec, retries_left=max_retries)
 
+    @staticmethod
+    def _trace_stage_ns(carrier) -> Optional[int]:
+        """Stage-clock read for the driver.stage_wait phase — taken only
+        for specs riding a sampled trace, so the untraced hot path pays
+        one None check."""
+        if carrier is None:
+            return None
+        from ray_tpu.util import tracing
+
+        if tracing.carrier_sampled(carrier):
+            return time.time_ns()
+        return None
+
     def _submit_spec(self, spec: TaskSpec, retries_left: int,
                      recovery: bool = False):
         # recovery resubmission of a streaming spec: the stream is long
@@ -1523,6 +1554,7 @@ class CoreWorker:
         refs = []
         key = self._pool_key(spec)
         rec = TaskRecord(spec, key, retries_left)
+        rec.staged_ns = self._trace_stage_ns(spec.trace_ctx)
         # ONE lock acquisition for all submission bookkeeping: this path
         # runs once per .remote() and ping-pongs the core lock with the
         # reply thread during 100k-task bursts
@@ -1689,9 +1721,19 @@ class CoreWorker:
         single request_leases RPC.  _pump pre-charged pending_requests by
         `count`; it is decremented by exactly `count` here on every path
         (partial grants simply leave the shortfall for the next _pump)."""
+        from ray_tpu.util import tracing
+
+        carrier = None
+        if tracing.is_enabled():
+            with self.lock:
+                spec0 = pool.queue[0].spec if pool.queue else None
+            carrier = spec0.trace_ctx if spec0 is not None else None
         outcome, err = "error", None
         try:
-            outcome = self._request_lease_inner(pool, count)
+            # the lease phase span covers pick_nodes + request_leases;
+            # their CLIENT rpc spans nest under it via the contextvar
+            with tracing.phase_span("driver.lease", carrier, count=count):
+                outcome = self._request_lease_inner(pool, count)
         except Exception as e:
             err = e
         finally:
@@ -1845,6 +1887,36 @@ class CoreWorker:
 
         lw.client.call_cb("push_task", rec.spec, on_reply)
 
+    def _trace_flush_cm(self, chunk: List[TaskRecord], transport: str):
+        """Sampled-trace bookkeeping for one shipped batch: emit a retro
+        driver.stage_wait span per sampled rec (staged -> picked up by
+        the combining flusher), then return a driver.flush_batch span
+        contextmanager parented to the first sampled trace in the chunk,
+        annotated with batch size and payload bytes.  nullcontext when
+        tracing is off or nothing in the chunk is sampled."""
+        from ray_tpu.util import tracing
+
+        if not tracing.is_enabled():
+            return contextlib.nullcontext()
+        carrier = None
+        now_ns = time.time_ns()
+        for rec in chunk:
+            if rec.staged_ns is None:
+                continue
+            tracing.record_span(
+                "driver.stage_wait", "INTERNAL", rec.staged_ns, now_ns,
+                tracing._extract(rec.spec.trace_ctx), batch=len(chunk))
+            rec.staged_ns = None   # a retried rec must not re-report
+            if carrier is None:
+                carrier = rec.spec.trace_ctx
+        if carrier is None:
+            return contextlib.nullcontext()
+        payload_bytes = sum(len(rec.spec.args_blob or b"")
+                            for rec in chunk)
+        return tracing.phase_span(
+            "driver.flush_batch", carrier, batch=len(chunk),
+            payload_bytes=payload_bytes, transport=transport)
+
     def _push_batched(self, pool: SchedPool,
                       to_push: List[Tuple[LeasedWorker, TaskRecord]]):
         """Ship the picked (lease, task) pairs.  Batched mode groups by
@@ -1866,8 +1938,9 @@ class CoreWorker:
                     h[len(chunk)] = h.get(len(chunk), 0) + 1
                     self._flush_stats["tasks"] += len(chunk)
                 try:
-                    lw.client.notify("push_tasks",
-                                     [rec.spec for rec in chunk])
+                    with self._trace_flush_cm(chunk, "lease"):
+                        lw.client.notify("push_tasks",
+                                         [rec.spec for rec in chunk])
                 except (ConnectionLost, OSError) as e:
                     # synchronous failure only (conn already closed at
                     # enqueue); async write failures surface through the
@@ -2254,9 +2327,10 @@ class CoreWorker:
                 h[len(chunk)] = h.get(len(chunk), 0) + 1
                 self._flush_stats["tasks"] += len(chunk)
             try:
-                raylet.notify("mux_push_tasks",
-                              {"client_id": self.worker_id,
-                               "specs": [rec.spec for rec in chunk]})
+                with self._trace_flush_cm(chunk, "mux"):
+                    raylet.notify("mux_push_tasks",
+                                  {"client_id": self.worker_id,
+                                   "specs": [rec.spec for rec in chunk]})
             except (ConnectionLost, OSError) as e:
                 for rec in chunk:
                     self._mux_task_failed(rec, str(e))
@@ -2569,6 +2643,11 @@ class CoreWorker:
         if tracing.is_enabled():
             with tracing.submit_span("actor_task", method_name):
                 spec.trace_ctx = tracing.inject_context()
+            staged_ns = self._trace_stage_ns(spec.trace_ctx)
+            if staged_ns is not None:
+                # local-only attr: TaskSpec.__reduce__ pickles declared
+                # fields, so the stage clock never rides the wire
+                spec._staged_ns = staged_ns
         streaming = spec.num_returns == STREAMING_RETURNS
         task_id_for_stream = spec.task_id
         if streaming and spec.task_id not in self.streams:
@@ -2715,13 +2794,40 @@ class CoreWorker:
                 self._actor_sends += len(chunk)
                 self._flush_stats["tasks"] += len(chunk)
             try:
-                client.notify("push_tasks", chunk)
+                with self._trace_actor_flush_cm(chunk):
+                    client.notify("push_tasks", chunk)
             except (ConnectionLost, OSError):
                 # conn died between stage and ship: everything already
                 # sits in inflight, and the on_disconnect sweep
                 # (_on_actor_conn_lost) claims it all — retry vs error
                 # is decided there from the control-plane view
                 return
+
+    def _trace_actor_flush_cm(self, chunk: List[TaskSpec]):
+        """Actor twin of _trace_flush_cm: the staging queue holds bare
+        specs, so the stage clock rides a local-only spec attribute."""
+        from ray_tpu.util import tracing
+
+        if not tracing.is_enabled():
+            return contextlib.nullcontext()
+        carrier = None
+        now_ns = time.time_ns()
+        for spec in chunk:
+            staged_ns = getattr(spec, "_staged_ns", None)
+            if staged_ns is None:
+                continue
+            tracing.record_span(
+                "driver.stage_wait", "INTERNAL", staged_ns, now_ns,
+                tracing._extract(spec.trace_ctx), batch=len(chunk))
+            spec._staged_ns = None
+            if carrier is None:
+                carrier = spec.trace_ctx
+        if carrier is None:
+            return contextlib.nullcontext()
+        payload_bytes = sum(len(spec.args_blob or b"") for spec in chunk)
+        return tracing.phase_span(
+            "driver.flush_batch", carrier, batch=len(chunk),
+            payload_bytes=payload_bytes, transport="actor")
 
     def _on_actor_push(self, actor_id: str, topic: str, payload):
         """Server-push from an actor's worker (reader thread): coalesced

@@ -16,6 +16,7 @@ workers block in ray.get).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import logging
 import os
 import shutil
@@ -260,6 +261,13 @@ class Raylet:
             "labels": self.labels,
         }, timeout=30.0)
         self._registered_at = time.monotonic()
+        # span collector: the raylet reports its relay/mux phase spans to
+        # the control plane like every other traced process
+        from ray_tpu.util import tracing as _tracing
+
+        _tracing.ensure_collector(self.control,
+                                  proc=f"raylet:{self.node_id[:8]}",
+                                  node_id=self.node_id)
         self._grant_thread.start()
         self._hb_thread.start()
         self._reap_thread.start()
@@ -1204,6 +1212,7 @@ class Raylet:
         """A driver's flusher ships a framed batch of relay tasks."""
         cid = p.get("client_id", "")
         specs = p.get("specs") or []
+        self._trace_stamp_relay(specs)
         activated = False
         with self.lock:
             if cid:
@@ -1273,14 +1282,56 @@ class Raylet:
                 logger.exception("mux worker spawn failed")
         for wconn, specs in to_push:
             try:
-                if not wconn.push("mux_push_tasks", specs):
-                    raise OSError("push failed")
+                with self._trace_relay_cm(specs):
+                    if not wconn.push("mux_push_tasks", specs):
+                        raise OSError("push failed")
             except Exception:
                 # dead worker conn: its h_disconnect sweep fails these
                 # back to their owners via _mux_on_worker_gone
                 pass
         if starved:
             self._request_idle_reclaim()
+
+    @staticmethod
+    def _trace_stamp_relay(specs) -> None:
+        """Stamp relay-queue entry clocks on sampled specs (local-only
+        attr — TaskSpec.__reduce__ keeps it off the wire)."""
+        from ray_tpu.util import tracing
+
+        if not tracing.is_enabled():
+            return
+        now = time.time_ns()
+        for spec in specs:
+            if tracing.carrier_sampled(getattr(spec, "trace_ctx", None)):
+                spec._relay_ns = now
+
+    @staticmethod
+    def _trace_relay_cm(specs):
+        """Retro ``raylet.relay`` spans (relay-queue dwell) for each
+        sampled spec in the outgoing batch, plus a ``raylet.mux_push``
+        phase span around the worker push itself."""
+        from ray_tpu.util import tracing
+
+        if not tracing.is_enabled():
+            return contextlib.nullcontext()
+        now_ns = time.time_ns()
+        carrier = None
+        for spec in specs:
+            relay_ns = getattr(spec, "_relay_ns", None)
+            if relay_ns is None:
+                continue
+            spec._relay_ns = None
+            tracing.record_span("raylet.relay", "INTERNAL", relay_ns,
+                                now_ns, tracing._extract(spec.trace_ctx),
+                                batch=len(specs))
+            if carrier is None:
+                carrier = spec.trace_ctx
+        if carrier is None:
+            return contextlib.nullcontext()
+        payload_bytes = sum(len(s.args_blob or b"") for s in specs)
+        return tracing.phase_span("raylet.mux_push", carrier,
+                                  batch=len(specs),
+                                  payload_bytes=payload_bytes)
 
     def _mux_claim_worker_locked(self, demand) -> bool:  # holds: lock
         """Claim one idle CPU worker for the relay (caller holds lock).

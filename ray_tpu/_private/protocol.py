@@ -296,7 +296,7 @@ class Client:
 
     def call(self, method: str, payload: Any = None, timeout: Optional[float] = None) -> Any:
         t = _trace_mod()
-        if t and t.is_enabled() and t._current() is not None:
+        if t and t.is_enabled() and t.frame_traceparent() is not None:
             # CLIENT span around the round trip; the traceparent rides
             # the frame meta (call_cb) so the server handler nests under
             with t.rpc_client_span(method, peer=f"{self.addr[0]}:"
@@ -341,9 +341,11 @@ class Client:
         meta = None
         t = _trace_mod()
         if t and t.is_enabled():
-            carrier = t.inject_context()
-            if carrier:
-                meta = {"tp": carrier["traceparent"]}
+            # sampled contexts only: suppressed requests skip the meta
+            # dict + traceparent formatting (and the server-side parse)
+            tp = t.frame_traceparent()
+            if tp:
+                meta = {"tp": tp}
         try:
             data = _pack_frame(msg_id, REQUEST, method, payload, meta)
         except BaseException:

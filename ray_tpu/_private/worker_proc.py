@@ -29,6 +29,7 @@ from . import common, serialization
 from .common import TaskError, TaskSpec
 from .core import CoreWorker, ObjectRef
 from .protocol import Deferred, ServerConn
+from ray_tpu.util import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -68,12 +69,18 @@ class _ReplyBatcher:
         self._backlog = backlog if backlog is not None else (lambda: False)
         self._cv = threading.Condition()
         self._pending: list = []        # guarded-by: _cv
+        # (traceparent carrier, add-clock) per sampled ack awaiting its
+        # frame — swapped out together with _pending so each ship pass
+        # reports its own linger spans; wire batches stay 2-tuples
+        self._tp_pending: list = []     # guarded-by: _cv
         self._thread = None             # guarded-by: _cv
         self._draining = False          # guarded-by: _cv
 
-    def add(self, task_id: str, reply):
+    def add(self, task_id: str, reply, tp=None):
         with self._cv:
             self._pending.append((task_id, reply))
+            if tp is not None:
+                self._tp_pending.append((tp, time.time_ns()))
             if self._draining:
                 self._cv.notify()   # the active sender picks this up
                 return
@@ -99,9 +106,12 @@ class _ReplyBatcher:
         while True:
             with self._cv:
                 batch, self._pending = self._pending, []
+                traced, self._tp_pending = self._tp_pending, []
                 if not batch:
                     self._draining = False
                     return
+            if traced:
+                self._emit_linger_spans(traced, len(batch))
             try:
                 # push failure = owner gone; its on_disconnect resched-
                 # ules.  Any other failure (one unserializable reply)
@@ -109,6 +119,19 @@ class _ReplyBatcher:
                 self._send(batch)
             except Exception:
                 logger.exception("ack batch push failed")
+
+    @staticmethod
+    def _emit_linger_spans(traced, batch_n: int):
+        """Retro worker.ack_linger spans: completion handed to the
+        batcher -> its tasks_done frame actually shipping (the coalesce
+        wait a sampled task's reply paid, with the frame it rode in)."""
+        from ray_tpu.util import tracing
+
+        now_ns = time.time_ns()
+        for tp, add_ns in traced:
+            tracing.record_span("worker.ack_linger", "INTERNAL", add_ns,
+                                now_ns, tracing._extract(tp),
+                                batch=batch_n)
 
     def _run(self):
         while True:
@@ -139,19 +162,20 @@ class _BatchSlot:
     reply routes into the per-connection ack batcher instead of a
     per-call reply frame."""
 
-    __slots__ = ("_batcher", "_task_id")
+    __slots__ = ("_batcher", "_task_id", "_tp")
 
-    def __init__(self, batcher: _ReplyBatcher, task_id: str):
+    def __init__(self, batcher: _ReplyBatcher, task_id: str, tp=None):
         self._batcher = batcher
         self._task_id = task_id
+        self._tp = tp   # traceparent carrier when the task is sampled
 
     def resolve(self, reply):
-        self._batcher.add(self._task_id, reply)
+        self._batcher.add(self._task_id, reply, tp=self._tp)
 
     def reject(self, exc):
         self._batcher.add(self._task_id, {
             "status": "error",
-            "error": serialization.dumps_inline(exc)})
+            "error": serialization.dumps_inline(exc)}, tp=self._tp)
 
 
 class WorkerMain:
@@ -211,6 +235,13 @@ class WorkerMain:
         from ray_tpu.util import tracing
 
         tracing.apply_hook_from_kv(self.core.control)
+        # the hook (or RAY_TPU_TRACE_SAMPLE) may have enabled tracing
+        # after CoreWorker init skipped the collector — attach it now
+        tracing.ensure_collector(
+            self.core.control,
+            proc=f"worker:{self.core.worker_id[:8]}",
+            worker_id=self.core.worker_id,
+            node_id=self.core.node_id or "", job_id=self.core.job_id)
 
         n_threads = 1
         self.exec_threads = [
@@ -285,7 +316,28 @@ class WorkerMain:
 
     # -- rpc handlers ------------------------------------------------------
 
+    @staticmethod
+    def _trace_enqueue(spec) -> None:
+        """Stamp the run-queue entry clock on sampled specs (local-only
+        attr; feeds the retro worker.queue_wait span at dequeue)."""
+        if tracing.is_enabled() and tracing.carrier_sampled(
+                getattr(spec, "trace_ctx", None)):
+            spec._enq_ns = time.time_ns()
+
+    @staticmethod
+    def _trace_tp(spec):
+        """Traceparent carrier for sampled specs, else None (what the
+        ack batcher needs to report linger spans).  Also stamps the
+        run-queue entry clock — one sampling probe covers both, keeping
+        the batched enqueue loops at a single call per spec."""
+        if tracing.is_enabled() and tracing.carrier_sampled(
+                getattr(spec, "trace_ctx", None)):
+            spec._enq_ns = time.time_ns()
+            return spec.trace_ctx
+        return None
+
     def h_push_task(self, conn: ServerConn, spec: TaskSpec, d: Deferred):
+        self._trace_enqueue(spec)
         self.task_queue.put(("normal", spec, d))
 
     def h_push_tasks(self, conn: ServerConn, specs):
@@ -308,9 +360,11 @@ class WorkerMain:
             # flusher batches them too — route by spec, not by handler
             kind = "actor" if spec.actor_id else "normal"
             self.task_queue.put(
-                (kind, spec, _BatchSlot(batcher, spec.task_id)))
+                (kind, spec,
+                 _BatchSlot(batcher, spec.task_id, self._trace_tp(spec))))
 
     def h_actor_task(self, conn: ServerConn, spec: TaskSpec, d: Deferred):
+        self._trace_enqueue(spec)
         self.task_queue.put(("actor", spec, d))
 
     def h_cancel_task(self, conn: ServerConn, p):
@@ -389,7 +443,8 @@ class WorkerMain:
             for spec in payload:
                 kind = "actor" if spec.actor_id else "normal"
                 self.task_queue.put(
-                    (kind, spec, _BatchSlot(batcher, spec.task_id)))
+                    (kind, spec,
+                     _BatchSlot(batcher, spec.task_id, self._trace_tp(spec))))
         elif topic == "mux_cancel":
             self.h_cancel_task(None, payload)
         elif topic == "assign_actor":
@@ -434,6 +489,15 @@ class WorkerMain:
             kind, spec, d = self.task_queue.get(timeout=0.2)
         except queue.Empty:
             return
+        enq_ns = getattr(spec, "_enq_ns", None)
+        if enq_ns is not None:
+            spec._enq_ns = None
+            from ray_tpu.util import tracing
+
+            tracing.record_span(
+                "worker.queue_wait", "INTERNAL", enq_ns, time.time_ns(),
+                tracing._extract(spec.trace_ctx),
+                queue_depth=self.task_queue.qsize())
         with self._cancel_lock:
             if spec.task_id in self._cancelled:
                 self._cancelled.discard(spec.task_id)

@@ -350,6 +350,23 @@ class DashboardHead:
             if path == "/api/control/stats":
                 return self._json(
                     self.control.call("control_stats", {}, timeout=10.0))
+            if path.startswith("/api/traces"):
+                # distributed traces from the span collector: /api/traces
+                # lists ids, /api/traces/<id> returns the reassembled
+                # trace (span tree + critical-path attribution); add
+                # ?format=chrome for Perfetto trace-event JSON
+                from ray_tpu.telemetry import trace_assembly as ta
+
+                rest = path[len("/api/traces"):].strip("/")
+                if not rest:
+                    return self._json({"traces": ta.list_trace_ids(
+                        self.control)})
+                spans = ta.fetch_trace(self.control, rest)
+                if not spans:
+                    return 404, "text/plain", f"no trace {rest}"
+                if (query.get("format") or [""])[0] == "chrome":
+                    return self._json(ta.chrome_trace(spans))
+                return self._json(ta.analyze(spans))
             if path == "/metrics":
                 from ray_tpu.util.metrics import (collect_cluster_metrics,
                                                   control_stats_metrics,

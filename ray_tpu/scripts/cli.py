@@ -413,6 +413,46 @@ def cmd_timeline(args):
     print(f"wrote {args.output}")
 
 
+def cmd_trace(args):
+    """Reassemble a distributed trace from the control plane's span
+    collector: span tree + critical-path phase/process attribution, or
+    a cross-trace latency summary with --summary."""
+    from ray_tpu._private.protocol import Client
+    from ray_tpu.telemetry import trace_assembly as ta
+
+    address = _resolve_address(args)
+    host, port = address.rsplit(":", 1)
+    control = Client((host, int(port)), name="cli-trace")
+    try:
+        if args.summary or not args.trace_id:
+            summary = ta.summarize(control, job_id=args.job)
+            if args.format == "json":
+                print(json.dumps(summary, indent=2, default=str))
+            else:
+                print(ta.render_summary_text(summary))
+            return
+        spans = ta.fetch_trace(control, args.trace_id)
+        if not spans:
+            ids = ta.list_trace_ids(control)
+            print(f"trace {args.trace_id!r} not found "
+                  f"({len(ids)} trace(s) in the collector"
+                  + (": " + ", ".join(i[:16] + "…" for i in ids[:8])
+                     if ids else "") + ")", file=sys.stderr)
+            raise SystemExit(1)
+        analysis = ta.analyze(spans)
+        if args.output:
+            with open(args.output, "w") as f:
+                json.dump(ta.chrome_trace(spans), f)
+            print(f"wrote {args.output} ({len(spans)} spans)",
+                  file=sys.stderr)
+        if args.format == "json":
+            print(json.dumps(analysis, indent=2, default=str))
+        else:
+            print(ta.render_text(analysis))
+    finally:
+        control.close()
+
+
 def cmd_remediations(args):
     """List a training run's cause→action→effect self-healing log."""
     from ray_tpu._private.protocol import Client
@@ -536,6 +576,15 @@ def cmd_control_stats(args):
           f"dropped {ev.get('dropped', 0)}, relay batches "
           f"{ev.get('relay_batches', 0)} "
           f"(+{ev.get('relay_dropped', 0)} dropped in relays)")
+    tr = c.get("tracing") or {}
+    if tr.get("spans") or tr.get("traces"):
+        print(f"trace spans: queue {tr.get('queue_depth', 0)}, "
+              f"traces {tr.get('traces', 0)}, "
+              f"spans {tr.get('spans', 0)} in "
+              f"{tr.get('span_batches', 0)} batches, "
+              f"dropped {tr.get('dropped', 0)}, "
+              f"per-trace overflow {tr.get('span_overflow', 0)}, "
+              f"evicted {tr.get('traces_evicted', 0)}")
     for nid, r in (snap.get("raylets") or {}).items():
         if "error" in r:
             print(f"raylet {nid[:12]}: error: {r['error']}")
@@ -683,6 +732,25 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-o", "--output", default="timeline.json")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser(
+        "trace",
+        help="reassemble a distributed trace (span tree + critical-path "
+             "attribution) from the control-plane span collector")
+    sp.add_argument("trace_id", nargs="?", default=None,
+                    help="32-hex trace id (from a span record or "
+                         "BENCH_TASKS.json critical_path row)")
+    sp.add_argument("--summary", action="store_true",
+                    help="aggregate phase attribution across all stored "
+                         "traces instead of showing one")
+    sp.add_argument("--job", default=None,
+                    help="with --summary: only traces touching this job")
+    sp.add_argument("-o", "--output", default=None,
+                    help="also write the trace as Perfetto/Chrome "
+                         "trace-event JSON")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--format", choices=("text", "json"), default="text")
+    sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("remediations",
                         help="list a run's cause→action→effect "
