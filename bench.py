@@ -1514,6 +1514,45 @@ def _serve_trace(n_req: int, vocab: int):
     return prompts, gen_lens
 
 
+def _ledger_mark():
+    """Compilation-ledger checkpoint taken right before a steady-state
+    timed window (telemetry/device.py); None when --emit-telemetry is
+    off so the gate stays inert on plain runs."""
+    if os.environ.get("BENCH_EMIT_TELEMETRY") != "1":
+        return None
+    try:
+        from ray_tpu.telemetry import device as devtel
+
+        return devtel.get_ledger().counts()
+    except Exception:
+        return None
+
+
+def _ledger_delta(mark) -> "dict | None":
+    """Recompiles recorded since ``_ledger_mark``.  A program's FIRST
+    compile inside the window is not a recompile (a cold prefill bucket
+    is legitimate); any compile beyond the first of the same program is
+    — the steady-state gate wants that total at exactly zero."""
+    if mark is None:
+        return None
+    try:
+        from ray_tpu.telemetry import device as devtel
+
+        now = devtel.get_ledger().counts()
+        by_program = {}
+        window_compiles = 0
+        for name, n in now.items():
+            window_compiles += max(0, n - mark.get(name, 0))
+            d = n - max(mark.get(name, 0), 1)
+            if d > 0:
+                by_program[name] = d
+        return {"total": sum(by_program.values()),
+                "by_program": by_program,
+                "window_compiles": window_compiles}
+    except Exception:
+        return None
+
+
 def bench_serve() -> dict:
     import jax
     import numpy as np
@@ -1582,6 +1621,7 @@ def bench_serve() -> dict:
     eng = srv2._get_engine()
     warm = eng.submit(prompts[0], max_new_tokens=4)
     eng.collect(warm, timeout=600)         # compile prefill + step
+    led_mark = _ledger_mark()              # steady state starts here
     done_at = {}
     t0 = time.perf_counter()
     seqs = []
@@ -1597,10 +1637,14 @@ def bench_serve() -> dict:
         wall, sum(len(r["completion"]) for _, _, r in results),
         [r["ttft_s"] for _, _, r in results if r["ttft_s"] is not None],
         [done_at[i] - t_sub for i, t_sub, _ in results])
+    steady = _ledger_delta(led_mark)
     stats = eng.engine_stats()
     eng.stop()
 
     return {
+        **({"steady_state_recompiles": steady["total"],
+            "steady_state_recompiled_programs": steady["by_program"]}
+           if steady is not None else {}),
         "backend": jax.default_backend(),
         "host_cpus": os.cpu_count(),
         "arch": arch,
@@ -1725,6 +1769,15 @@ def _write_bench_serve(row: dict) -> int:
     if regressed:
         print(f"FAIL: continuous tokens/s {got} < 0.9x recorded "
               f"{prior}", file=sys.stderr)
+        return 1
+    # zero-recompile gate (--emit-telemetry only): once warmup compiled
+    # the engine's programs, a steady-state request stream must never
+    # re-trace — a nonzero count here is a shape-stability regression
+    if row.get("steady_state_recompiles"):
+        print(f"FAIL: {row['steady_state_recompiles']} steady-state "
+              f"recompile(s): "
+              f"{row.get('steady_state_recompiled_programs')}",
+              file=sys.stderr)
         return 1
     if row["speedup_tokens_per_s"] < 1.5:
         print(f"WARNING: continuous/static speedup "
@@ -2218,6 +2271,7 @@ def bench_pipeline() -> dict:
                               microbatches=M, slot_bytes=4 << 20)
         with MPMDPipeline(cfg, pcfg, params=params) as pipe:
             pipe.step(batch_d, apply_update=False)  # compile warmup
+            led_mark = _ledger_mark()  # steady state starts here
             t0 = time.perf_counter()
             p2p = 0
             res = None
@@ -2225,10 +2279,14 @@ def bench_pipeline() -> dict:
                 res = pipe.step(batch_d, apply_update=False)
                 p2p += res["p2p_bytes"]
             wall = time.perf_counter() - t0
+            steady = _ledger_delta(led_mark)
             rep = pipe.bubble_report()
             if sched == "1f1b":
                 trace = schedule_chrome_trace(res["events"])
         schedules[sched] = {
+            **({"steady_state_recompiles": steady["total"],
+                "steady_state_recompiled_programs": steady["by_program"]}
+               if steady is not None else {}),
             "tokens_per_s": round(steps * tokens_per_step / wall, 1),
             "step_s": round(wall / steps, 3),
             "bubble_mean": round(rep["mean"], 4),
@@ -2350,6 +2408,16 @@ def _write_bench_pipeline(row: dict) -> int:
     regressed = prior is not None and got < 0.9 * prior
     if regressed:
         failures.append(f"1f1b tokens/s {got} < 0.9x recorded {prior}")
+
+    # gate 4: zero steady-state recompiles (--emit-telemetry only) —
+    # after the warmup step, every schedule's timed steps replay
+    # identical shapes, so any compile the ledger saw is a regression
+    for sched, srow in row["schedules"].items():
+        if srow.get("steady_state_recompiles"):
+            failures.append(
+                f"{sched}: {srow['steady_state_recompiles']} steady-state"
+                f" recompile(s): "
+                f"{srow.get('steady_state_recompiled_programs')}")
     row["headline_tokens_per_s"] = round(max(0.9 * got, prior or 0.0), 1)
     row["recorded_unix_time"] = int(time.time())
     row["gates"] = {

@@ -6,6 +6,11 @@ Expected findings:
 - nondeterminism     random.random and time.time in nondet()
 - unhashable-static  list default of bad_static(); list literal at the
                      caller() call site
+- jit-per-call       jit built inside the loop of loop_jit(); jit of a
+                     local def invoked in the same scope in
+                     per_call_closure() and per_call_decorated()
+                     (jit_factory(), which RETURNS the wrapper, is the
+                     negative control)
 """
 
 import functools
@@ -57,3 +62,36 @@ def clean(x):
     # untraced: nothing here should be flagged
     print("host side is fine")
     return np.asarray(x)
+
+
+def loop_jit(xs):
+    out = []
+    for x in xs:
+        g = jax.jit(lambda v: v + 1)
+        out.append(g(x))
+    return out
+
+
+def per_call_closure(x):
+    def inner(v):
+        return v * 2
+
+    f = jax.jit(inner)
+    return f(x)
+
+
+def per_call_decorated(x):
+    @jax.jit
+    def inner2(v):
+        return v - 1
+
+    return inner2(x)
+
+
+def jit_factory(scale):
+    # negative control: the wrapper is returned, so its trace cache
+    # outlives this scope — a legitimate factory
+    def inner(v):
+        return v * scale
+
+    return jax.jit(inner)

@@ -23,6 +23,18 @@ Rules inside a traced body (nested defs included — they trace too):
                        default, or a call site passing a list/dict/set
                        literal for one — every such call recompiles.
 
+Plus one rule about where the jit wrap itself happens:
+
+- ``jit-per-call``     ``jax.jit``/``pjit`` applied inside a ``for``/
+                       ``while`` loop, or applied to a callable built
+                       per call (local def, lambda, inline ``partial``)
+                       and then invoked in the same function scope —
+                       each outer call makes a fresh wrapper whose
+                       trace cache is thrown away, so every call
+                       recompiles.  Factories that *return* the jitted
+                       callable are fine (the wrapper outlives the
+                       scope).
+
 ``# jax-ok`` on the offending line suppresses a site.
 """
 
@@ -131,6 +143,7 @@ def run(index: Index) -> List[Finding]:
             by_name[(rel, qual.rsplit(".", 1)[-1])] = statics
     if by_name:
         findings.extend(_check_call_sites(index, by_name))
+    findings.extend(_check_jit_per_call(index))
     return findings
 
 
@@ -218,6 +231,133 @@ def _check_static_defaults(fn: FunctionInfo,
                     f"static arg {a.arg!r} of traced {fn.qualname} has "
                     f"an unhashable {type(d).__name__.lower()} default "
                     f"(jit will raise / recompile)", d.lineno))
+    return out
+
+
+def _is_jit_dec(dec: ast.expr) -> bool:
+    """Decorator forms: @jax.jit / @devtel.jit(name=..) /
+    @functools.partial(jax.jit, ...)."""
+    if _jit_chain(dotted(dec)):
+        return True
+    if isinstance(dec, ast.Call):
+        fchain = dotted(dec.func)
+        if _jit_chain(fchain):
+            return True
+        if fchain and fchain[-1] == "partial" and dec.args:
+            return _jit_chain(dotted(dec.args[0]))
+    return False
+
+
+def _wrap_target(call: ast.Call) -> Tuple[str, bool]:
+    """(display name, built-per-call?) for the callable a jit wrap
+    receives.  Lambdas, inline ``partial(...)`` and other call results
+    are fresh objects on every evaluation, so the jit cache they carry
+    dies with the enclosing scope."""
+    t = call.args[0]
+    if isinstance(t, ast.Lambda):
+        return "<lambda>", True
+    if isinstance(t, ast.Call):
+        tch = dotted(t.func)
+        if tch and tch[-1] == "partial" and t.args:
+            inner = dotted(t.args[0])
+            return (inner[-1] if inner else "<partial>"), True
+        return (tch[-1] if tch else "<call>") + "()", True
+    ch = dotted(t)
+    return (".".join(ch) if ch else "<expr>"), False
+
+
+def _check_jit_per_call(index: Index) -> List[Finding]:
+    """jit/pjit wraps whose cache cannot outlive the call: wraps inside
+    a loop body, and wraps of per-call callables that are then invoked
+    in the same function scope (the xla_group closure-jit bug class)."""
+    out: List[Finding] = []
+    for key, fn in sorted(index.functions.items()):
+        rel, qual = key
+        # immediate-scope walk with loop depth; nested def/class/lambda
+        # bodies belong to their own FunctionInfo scope
+        nodes: List[Tuple[ast.AST, int]] = []
+        stack = [(c, 0) for c in ast.iter_child_nodes(fn.node)]
+        while stack:
+            n, depth = stack.pop()
+            nodes.append((n, depth))
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            d = depth + 1 if isinstance(
+                n, (ast.For, ast.AsyncFor, ast.While)) else depth
+            stack.extend((c, d) for c in ast.iter_child_nodes(n))
+
+        wraps: List[Tuple[ast.Call, int]] = []
+        called_names: Set[str] = set()
+        invoked_wraps: Set[int] = set()          # id() of jit(f)(x) wraps
+        assigns: List[ast.Assign] = []
+        nested_defs: List[Tuple[ast.AST, int]] = []
+        for n, depth in nodes:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested_defs.append((n, depth))
+                continue
+            if isinstance(n, ast.Assign):
+                assigns.append(n)
+            if not isinstance(n, ast.Call):
+                continue
+            if isinstance(n.func, ast.Name):
+                called_names.add(n.func.id)
+            if isinstance(n.func, ast.Call):
+                invoked_wraps.add(id(n.func))
+            if _jit_chain(dotted(n.func)) and n.args:
+                wraps.append((n, depth))
+
+        def add(detail: str, msg: str, line: int) -> None:
+            if not _ok(fn, line):
+                out.append(Finding(PASS, "jit-per-call", rel, qual,
+                                   detail, msg, line))
+
+        for call, depth in wraps:
+            name, per_call = _wrap_target(call)
+            if depth > 0:
+                add(f"loop:{name}",
+                    f"jit({name}) inside a loop in {qual} builds a "
+                    f"fresh wrapper (and trace cache) per iteration — "
+                    f"hoist the jit out of the loop", call.lineno)
+                continue
+            local_def = (not per_call and "." not in name
+                         and (rel, f"{qual}.{name}") in index.functions)
+            if not (per_call or local_def):
+                continue
+            # invoked in this scope?  directly (jit(f)(x)) or via a
+            # name it was assigned to
+            invoked = id(call) in invoked_wraps
+            if not invoked:
+                for a in assigns:
+                    if any(n is call for n in ast.walk(a.value)):
+                        invoked = any(
+                            isinstance(t, ast.Name)
+                            and t.id in called_names for t in a.targets)
+                        if invoked:
+                            break
+            if invoked:
+                add(f"closure:{name}",
+                    f"jit({name}) wraps a per-call callable and is "
+                    f"invoked in the same scope ({qual}) — every call "
+                    f"of {qual} recompiles; hoist the jit to module "
+                    f"scope or return the wrapper", call.lineno)
+
+        for nd, depth in nested_defs:
+            decs = [d for d in getattr(nd, "decorator_list", [])
+                    if _is_jit_dec(d)]
+            if not decs:
+                continue
+            line = decs[0].lineno
+            if depth > 0:
+                add(f"loop:{nd.name}",
+                    f"@jit def {nd.name} inside a loop in {qual} "
+                    f"recompiles every iteration — hoist it out",
+                    line)
+            elif nd.name in called_names:
+                add(f"closure:{nd.name}",
+                    f"@jit def {nd.name} is local to {qual} and called "
+                    f"there — every call of {qual} recompiles; hoist "
+                    f"the jitted def or return it", line)
     return out
 
 

@@ -202,7 +202,15 @@ class DashboardHead:
                 snaps = collect_snapshots(self.control, trial=trial)
                 rems = collect_remediations(self.control, trial=trial) \
                     if trial else []
-                return self._json(chrome_trace(snaps, remediations=rems))
+                from ray_tpu.telemetry.timeline import \
+                    collect_device_workers
+
+                # compile slices are cluster-wide, but a ?trial= that
+                # matches no run must stay a truly empty trace
+                dev = collect_device_workers(self.control) \
+                    if (not trial or snaps) else {}
+                return self._json(chrome_trace(snaps, remediations=rems,
+                                               device_workers=dev))
             if path == "/api/train/remediations":
                 # a run's cause→action→effect self-healing log (see
                 # elastic/remediation.py); ?trial= selects the run
@@ -350,6 +358,14 @@ class DashboardHead:
             if path == "/api/control/stats":
                 return self._json(
                     self.control.call("control_stats", {}, timeout=10.0))
+            if path == "/api/device/stats":
+                # cluster-wide XLA compilation ledger + device-memory
+                # census (telemetry/device.py): per-program compile /
+                # recompile counts, last recompile cause diffs, storm
+                # advisories, live HBM bytes and KV page occupancy
+                from ray_tpu.telemetry.device import collect_device_stats
+
+                return self._json(collect_device_stats(self.control))
             if path.startswith("/api/traces"):
                 # distributed traces from the span collector: /api/traces
                 # lists ids, /api/traces/<id> returns the reassembled

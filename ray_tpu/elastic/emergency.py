@@ -281,6 +281,16 @@ def _clear_vault() -> bool:
     return True
 
 
+def vault_footprint() -> Dict[str, Any]:
+    """Bytes resident in this worker's emergency vault — the device
+    memory census reports this alongside the KV page arena so recovery
+    headroom is visible (telemetry/device.py)."""
+    with _LOCK:
+        return {"entries": len(_VAULT),
+                "bytes": sum(len(v) for v in _VAULT.values()),
+                "steps": len(_VAULT_WORLDS)}
+
+
 # -- driver-side recovery helpers ------------------------------------------
 
 

@@ -251,6 +251,36 @@ class RemediationEngine:
                            f"{(median or 0.0):.4f}s"),
                 "grace_s": self.quarantine_grace_s}
 
+    def observe_advisory(self, advisory: Dict[str, Any]) -> None:
+        """Record a device-runtime advisory (``recompile_storm`` /
+        ``memory_watermark`` from telemetry/device.py) as a cause-only
+        record in advisory mode.  No enforcement action exists for
+        these yet — the record puts the storm in the trial's
+        cause→action→effect log, so the timeline and ``ray-tpu
+        remediations`` answer "why did goodput dip here" when the
+        answer is the device runtime, not a straggler.  Never raises."""
+        try:
+            kind = advisory.get("kind", "device")
+            record = {
+                "id": f"rem-{len(self.records)}",
+                "trial": self.trial,
+                "mode": self.mode,
+                "ts": advisory.get("ts", self._wall()),
+                "cause": dict(advisory),
+                "action": {"kind": f"observe_{kind}", "dry_run": True,
+                           "ts": self._wall()},
+                "effect": None,
+            }
+            self.records.append(record)
+            logger.warning(
+                "remediation (advisory): device %s on trial %s recorded "
+                "(program=%s)", kind, self.trial,
+                advisory.get("program", "n/a"))
+            self._emit("remediation_recommended", record)
+            self._flush()
+        except Exception:
+            logger.exception("remediation observe_advisory failed")
+
     # -- enforcement feedback from the trainer -----------------------------
 
     def note_enforced(self, decision: Dict[str, Any],

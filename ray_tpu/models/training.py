@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.parallel.mesh import batch_sharding
 from ray_tpu.parallel.sharding import Logical, spec_from_logical, tree_shardings
+from ray_tpu.telemetry import device as devtel
 
 from . import gpt
 
@@ -57,8 +58,10 @@ def init_sharded(key, cfg: gpt.GPTConfig, mesh: Mesh):
     the full model — each device materializes only its shard)."""
     shardings = param_shardings(cfg, mesh)
     with _use_mesh(mesh):
-        init_fn = jax.jit(functools.partial(gpt.init, cfg=cfg),
-                          out_shardings=shardings)
+        # once-per-run init: jit only for out_shardings materialization
+        init_fn = devtel.jit(functools.partial(gpt.init, cfg=cfg),  # jax-ok
+                             name="train.init_sharded",
+                             out_shardings=shardings)
         return init_fn(key)
 
 
@@ -88,7 +91,8 @@ def make_train_step(cfg: gpt.GPTConfig, mesh: Mesh, tx=None,
                        "step": NamedSharding(mesh, P())}
 
     with _use_mesh(mesh):
-        init_state_fn = jax.jit(init_state, out_shardings=state_shardings)
+        init_state_fn = devtel.jit(init_state, name="train.init_state",
+                                   out_shardings=state_shardings)
 
     def step(state, batch):
         def loss(p):
@@ -105,8 +109,9 @@ def make_train_step(cfg: gpt.GPTConfig, mesh: Mesh, tx=None,
                  "grad_norm": gnorm.astype(jnp.float32)})
 
     with _use_mesh(mesh):
-        step_fn = jax.jit(
+        step_fn = devtel.jit(
             step,
+            name="train.step",
             in_shardings=(state_shardings, None),
             out_shardings=(state_shardings, None),
             donate_argnums=(0,) if donate else (),
@@ -130,7 +135,8 @@ def make_eval_step(cfg: gpt.GPTConfig, mesh: Mesh):
         return gpt.loss_fn(params, batch, cfg, mesh)
 
     with _use_mesh(mesh):
-        fn = jax.jit(eval_step, in_shardings=(p_shardings, None))
+        fn = devtel.jit(eval_step, name="train.eval_step",
+                        in_shardings=(p_shardings, None))
 
     def wrapped(params, batch):
         with _use_mesh(mesh):

@@ -364,9 +364,11 @@ def _stage_programs(cfg, stage: int, stages: int) -> Dict[str, Any]:
     import jax.numpy as jnp
 
     from ray_tpu.models import gpt
+    from ray_tpu.telemetry import device as devtel
 
     n, s = stages, stage
     first, last = s == 0, s == stages - 1
+    tag = f"mpmd.s{s}of{n}"
 
     if last:
         def full(p, x, tgt):
@@ -382,13 +384,18 @@ def _stage_programs(cfg, stage: int, stages: int) -> Dict[str, Any]:
             return dx, dp
 
         progs = {
-            "fwd": jax.jit(
+            "fwd": devtel.instrument(jax.jit(
                 lambda p, x, tgt: gpt.stage_loss(p, x, tgt, cfg, s, n)),
-            "bwd": jax.jit(full),
+                name=f"{tag}.fwd"),
+            "bwd": devtel.instrument(jax.jit(full), name=f"{tag}.bwd"),
             # zb split: jit of one output each — XLA dead-code-eliminates
             # the other half's einsums, so Bx carries no weight-grad work
-            "bwd_x": jax.jit(lambda p, x, g: full(p, x, g)[0]),
-            "bwd_w": jax.jit(lambda p, x, g: full(p, x, g)[1]),
+            "bwd_x": devtel.instrument(
+                jax.jit(lambda p, x, g: full(p, x, g)[0]),
+                name=f"{tag}.bwd_x"),
+            "bwd_w": devtel.instrument(
+                jax.jit(lambda p, x, g: full(p, x, g)[1]),
+                name=f"{tag}.bwd_w"),
         }
     elif first:
         def full0(p, x, g):
@@ -397,9 +404,11 @@ def _stage_programs(cfg, stage: int, stages: int) -> Dict[str, Any]:
             (dp,) = vjp(g)
             return dp
 
-        bwd0 = jax.jit(full0)
+        bwd0 = devtel.instrument(jax.jit(full0), name=f"{tag}.bwd")
         progs = {
-            "fwd": jax.jit(lambda p, x: gpt.stage_hidden(p, x, cfg, s, n)),
+            "fwd": devtel.instrument(
+                jax.jit(lambda p, x: gpt.stage_hidden(p, x, cfg, s, n)),
+                name=f"{tag}.fwd"),
             "bwd": bwd0,
             "bwd_x": None,  # tokens have no grad; all of B is W work
             "bwd_w": bwd0,
@@ -413,10 +422,16 @@ def _stage_programs(cfg, stage: int, stages: int) -> Dict[str, Any]:
             return dx, dp
 
         progs = {
-            "fwd": jax.jit(lambda p, x: gpt.stage_hidden(p, x, cfg, s, n)),
-            "bwd": jax.jit(fullm),
-            "bwd_x": jax.jit(lambda p, x, g: fullm(p, x, g)[0]),
-            "bwd_w": jax.jit(lambda p, x, g: fullm(p, x, g)[1]),
+            "fwd": devtel.instrument(
+                jax.jit(lambda p, x: gpt.stage_hidden(p, x, cfg, s, n)),
+                name=f"{tag}.fwd"),
+            "bwd": devtel.instrument(jax.jit(fullm), name=f"{tag}.bwd"),
+            "bwd_x": devtel.instrument(
+                jax.jit(lambda p, x, g: fullm(p, x, g)[0]),
+                name=f"{tag}.bwd_x"),
+            "bwd_w": devtel.instrument(
+                jax.jit(lambda p, x, g: fullm(p, x, g)[1]),
+                name=f"{tag}.bwd_w"),
         }
     with _PROG_LOCK:
         # a concurrent builder may have won the race; keep ITS programs so
@@ -511,7 +526,10 @@ class StageRuntime:
                 updates, o2 = tx.update(g, o, p)
                 return optax.apply_updates(p, updates), o2
 
-            self._update = jax.jit(upd)
+            from ray_tpu.telemetry import device as devtel
+
+            self._update = devtel.instrument(
+                jax.jit(upd), name=f"mpmd.s{self.stage}.update")
 
     # -- channels -----------------------------------------------------------
 

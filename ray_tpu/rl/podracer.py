@@ -132,7 +132,11 @@ def run_anakin(cfg: AnakinConfig) -> Dict[str, Any]:
         params, opt_state, metrics = update_fn(params, opt_state, upd, up_rng)
         return (params, opt_state, env_carry, rng), metrics
 
-    @jax.jit
+    from ray_tpu.telemetry import device as devtel
+
+    # one fused program per Anakin run; the ledger pins the "second call
+    # is compile-free" claim the steady-state timing below relies on
+    @devtel.jit(name="rl.anakin.train")  # jax-ok — once per Anakin run
     def train(params, opt_state, env_carry, rng):
         return jax.lax.scan(one_update, (params, opt_state, env_carry, rng),
                             None, length=cfg.num_updates)
@@ -744,6 +748,13 @@ class Sebulba:
                          "phases": {"compute": produce_last.get(s, 0.0)},
                          "rank": s, "incarnation": self._incs[s]}
                         for s in range(G)])
+                    try:
+                        from ray_tpu.telemetry import device as _devtel
+
+                        for adv in _devtel.get_ledger().drain_advisories():
+                            self._eng.observe_advisory(adv)
+                    except Exception:
+                        pass
                     decision = self._eng.observe_round(self._agg)
                     if decision is not None:
                         self._enforce(decision, t, round_idx)

@@ -596,6 +596,87 @@ def cmd_control_stats(args):
         _table(r.get("handlers") or {})
 
 
+def cmd_device_stats(args):
+    """Device runtime observability: per-program compile/recompile
+    counts with recompile cause diffs, storm advisories, and HBM /
+    KV-page memory census per worker."""
+    from ray_tpu.util.state import api as state
+
+    snap = state.device_stats(address=_resolve_address(args))
+    if args.format == "json":
+        print(json.dumps(snap, indent=2, default=str))
+        return
+
+    def _mb(n):
+        return f"{n / (1 << 20):.1f}MB"
+
+    def _cause(cause):
+        if isinstance(cause, dict):
+            note = cause.get("note")
+            cause = cause.get("changes")
+            if not cause:
+                return note or "-"
+        if not cause:
+            return "-"
+        parts = [f"{c.get('arg')}: {c.get('kind')} "
+                 f"{c.get('old')} -> {c.get('new')}" for c in cause[:3]]
+        if len(cause) > 3:
+            parts.append(f"(+{len(cause) - 3} more)")
+        return "; ".join(parts)
+
+    progs = snap.get("programs") or {}
+    print(f"compilation ledger: {len(snap.get('workers') or {})} "
+          f"worker(s), {snap.get('total_compiles', 0)} compile(s), "
+          f"{snap.get('total_recompiles', 0)} recompile(s), "
+          f"live HBM {_mb(snap.get('live_bytes', 0))}")
+    if progs:
+        rows = [(name, st["compiles"], st["recompiles"],
+                 st["storm_episodes"], st["workers"],
+                 _cause(st.get("last_cause")))
+                for name, st in sorted(progs.items())]
+        hdr = ("program", "compiles", "recomp", "storms", "workers",
+               "last recompile cause")
+        widths = [max(len(str(r[i])) for r in rows + [hdr])
+                  for i in range(len(hdr))]
+        for r in [hdr] + rows:
+            print("  " + "  ".join(str(v).ljust(w)
+                                   for v, w in zip(r, widths)).rstrip())
+    else:
+        print("  (no compiles recorded)")
+    advs = snap.get("advisories") or []
+    if advs:
+        print("advisories:")
+        for a in advs[-10:]:
+            kind = a.get("kind", "?")
+            if kind == "recompile_storm":
+                print(f"  [{a.get('worker_id', '?')[:12]}] storm: "
+                      f"{a.get('program')} x{a.get('compiles_in_window')}"
+                      f" in {a.get('window_s')}s — "
+                      f"{_cause(a.get('cause'))}")
+            elif kind == "memory_watermark":
+                print(f"  [{a.get('worker_id', '?')[:12]}] watermark: "
+                      f"live {_mb(a.get('live_bytes', 0))} >= "
+                      f"{_mb(a.get('watermark_bytes', 0))}")
+            else:
+                print(f"  [{a.get('worker_id', '?')[:12]}] {kind}: {a}")
+    for wid, wsnap in sorted((snap.get("workers") or {}).items()):
+        mem = wsnap.get("memory") or {}
+        live = mem.get("live") or {}
+        line = (f"worker {wid[:16]}: live {_mb(live.get('total_bytes', 0))}"
+                f" in {live.get('count', 0)} buffer(s)")
+        owners = mem.get("owners") or {}
+        for tag, rep in sorted(owners.items()):
+            pages = rep.get("pages")
+            if isinstance(pages, dict):
+                line += (f"; {tag}: pages free {pages.get('free', 0)} "
+                         f"used {pages.get('used', 0)} "
+                         f"shared {pages.get('shared', 0)} "
+                         f"cow {pages.get('cow', 0)}")
+            elif "bytes" in rep:
+                line += f"; {tag}: {_mb(rep.get('bytes', 0))}"
+        print(line)
+
+
 def cmd_analyze(args):
     from ray_tpu import analysis
     from ray_tpu.analysis import baseline as bl
@@ -791,6 +872,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="include handlers with zero calls")
     sp.add_argument("--format", choices=("text", "json"), default="text")
     sp.set_defaults(fn=cmd_control_stats)
+
+    sp = sub.add_parser(
+        "device-stats",
+        help="XLA compilation ledger + device-memory census: per-program "
+             "compile/recompile counts, recompile cause diffs, storm "
+             "advisories, HBM/KV-page occupancy")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--format", choices=("text", "json"), default="text")
+    sp.set_defaults(fn=cmd_device_stats)
 
     return p
 

@@ -233,6 +233,21 @@ class PageAllocator:
         for p in pages:
             self.unref(p)
 
+    def occupancy(self) -> Dict[str, int]:
+        """Arena occupancy for the device-memory census (page 0, the
+        reserved null page, is in neither free nor used):
+        ``live_shared`` counts pages currently referenced by more than
+        one sequence (live prefix sharing, distinct from the engine's
+        cumulative ``shared_pages`` total)."""
+        return {
+            "num_pages": self.num_pages,
+            "page_size": self.page_size,
+            "free": len(self._free),
+            "used": len(self._refs),
+            "live_shared": sum(1 for n in self._refs.values() if n > 1),
+            "prefix_keys": len(self._prefix),
+        }
+
 
 # ---------------------------------------------------------------------------
 
@@ -338,6 +353,17 @@ class ContinuousEngine:
         self._totals = {"requests": 0, "rejected": 0, "tokens": 0,
                         "steps": 0, "prefills": 0, "cow_copies": 0,
                         "shared_pages": 0}
+
+        # device-memory census: report this engine's page-arena
+        # occupancy under a per-instance tag (unregistered in stop())
+        self._census_tag = f"serve.engine.{id(self):x}"
+        try:
+            from ..telemetry import device as _devtel
+
+            _devtel.get_census().register_owner(self._census_tag,
+                                                self._census_report)
+        except Exception:
+            pass
 
     # -- public api ---------------------------------------------------------
 
@@ -454,6 +480,29 @@ class ContinuousEngine:
             **self._totals,
         }
 
+    def _census_report(self) -> Dict[str, Any]:
+        """Owner callback for telemetry/device.DeviceMemoryCensus: the
+        ``pages`` sub-dict feeds ``ray_tpu_kv_pages{state=…}`` — free /
+        used are live arena occupancy, shared / cow are the engine's
+        cumulative prefix-sharing totals (the serve bench row's
+        ``shared_pages`` / ``cow_copies``)."""
+        with self._lock:
+            totals = dict(self._totals)
+        rep: Dict[str, Any] = {"cache": self.cache_mode,
+                               "num_pages": self.num_pages,
+                               "max_slots": self.max_slots}
+        if self._alloc is not None:
+            occ = self._alloc.occupancy()
+            rep["pages"] = {
+                "free": occ["free"],
+                "used": occ["used"],
+                "shared": totals["shared_pages"],
+                "cow": totals["cow_copies"],
+                "live_shared": occ["live_shared"],
+            }
+            rep["prefix_keys"] = occ["prefix_keys"]
+        return rep
+
     def phase_ring(self) -> List[Dict[str, float]]:
         with self._lock:
             return list(self._ring)
@@ -517,6 +566,12 @@ class ContinuousEngine:
         return True
 
     def stop(self):
+        try:
+            from ..telemetry import device as _devtel
+
+            _devtel.get_census().unregister_owner(self._census_tag)
+        except Exception:
+            pass
         with self._lock:
             self._stopped = True
             waiting = list(self._waiting)
@@ -804,6 +859,10 @@ class ContinuousEngine:
         jax, gpt, cfg = self._jax, self._gpt, self._cfg
         jnp = jax.numpy
         paged = self.cache_mode == "paged"
+        # every engine program routes through the compilation ledger:
+        # "the step program never recompiles" (module docstring) is now
+        # a measured claim — bench gates steady-state recompiles at 0
+        from ..telemetry import device as devtel
 
         if key == "step":
             def sample(logits, keys, temps, topks):
@@ -844,20 +903,29 @@ class ContinuousEngine:
                         params, cache, toks, pos, cfg)
                     return toks, new_logits.astype(jnp.float32), cache
 
-            fn = self._fns[key] = jax.jit(step)
+            fn = self._fns[key] = devtel.instrument(
+                jax.jit(step), name="serve.step")
         elif key == "setrow":
-            fn = self._fns[key] = jax.jit(
+            fn = self._fns[key] = devtel.instrument(jax.jit(
                 lambda L, row, slot: L.at[slot].set(
-                    row.astype(jnp.float32)))
+                    row.astype(jnp.float32))), name="serve.setrow")
         elif key == "copy_page":
-            fn = self._fns[key] = jax.jit(gpt.copy_page)
+            fn = self._fns[key] = devtel.instrument(
+                jax.jit(gpt.copy_page), name="serve.copy_page")
         elif isinstance(key, tuple) and key[0] == "prefill":
+            # per-bucket program name: a healthy engine compiles each
+            # padded-length bucket once; the SAME bucket recompiling is
+            # the storm signal, a new bucket is not
             if paged:
-                fn = self._fns[key] = jax.jit(functools.partial(
-                    gpt.paged_prefill, cfg=cfg))
+                fn = self._fns[key] = devtel.instrument(
+                    jax.jit(functools.partial(
+                        gpt.paged_prefill, cfg=cfg)),
+                    name=f"serve.prefill:{key[1]}")
             else:
-                fn = self._fns[key] = jax.jit(functools.partial(
-                    gpt.slot_prefill, cfg=cfg))
+                fn = self._fns[key] = devtel.instrument(
+                    jax.jit(functools.partial(
+                        gpt.slot_prefill, cfg=cfg)),
+                    name=f"serve.prefill:{key[1]}")
         else:
             raise KeyError(key)
         return fn

@@ -16,6 +16,11 @@ Four cooperating pieces (see COMPONENTS.md):
     ``straggler_detected`` advisories on the "train" topic.
   * timeline   — Chrome trace-event export for Perfetto, serving
     ``GET /api/train/timeline`` and ``ray-tpu timeline <job>``.
+  * device     — XLA compilation ledger (instrumented jit entry point,
+    recompile cause diffs, storm advisories) + device-memory census
+    (live buffers, KV page arena occupancy); flushes to KV ns
+    ``_device`` and serves ``ray-tpu device-stats`` /
+    ``GET /api/device/stats``.
 
 Exports resolve lazily (PEP 562) so importing ``ray_tpu`` does not drag
 the train stack in.
@@ -37,8 +42,19 @@ _EXPORTS = {
     "stamp": "goodput",
     "StepAggregator": "aggregator",
     "collect_snapshots": "timeline",
+    "collect_device_workers": "timeline",
     "chrome_trace": "timeline",
     "validate_chrome_trace": "timeline",
+    "CompilationLedger": "device",
+    "DeviceMemoryCensus": "device",
+    "InstrumentedProgram": "device",
+    "get_ledger": "device",
+    "get_census": "device",
+    "instrument": "device",
+    "device_snapshot": "device",
+    "flush_device_snapshot": "device",
+    "collect_device_stats": "device",
+    "DEVICE_NS": "device",
 }
 
 __all__ = sorted(_EXPORTS)

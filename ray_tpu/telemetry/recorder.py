@@ -142,7 +142,8 @@ class StepTimer:
 
     def __init__(self, ring_size: int = 512, rank: int = 0,
                  incarnation: int = 0, trial: str = "",
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 wall: Callable[[], float] = time.time):
         self._ring: deque = deque(maxlen=max(1, int(ring_size)))
         self.rank = int(rank)
         self.incarnation = int(incarnation)
@@ -154,13 +155,26 @@ class StepTimer:
         self._step: Optional[int] = None
         self._phases: Dict[str, float] = {}
         self._last_flush = 0.0
+        # ONE wall<->monotonic anchor per incarnation: every wall stamp
+        # this timer emits is derived from the monotonic clock via this
+        # pair, so an NTP step mid-run shifts nothing — Chrome traces
+        # and goodput windows stay mutually consistent (phases already
+        # used perf_counter; mixing raw time.time() into "ts" let the
+        # two clocks skew).
+        self._anchor = (wall(), clock())
+
+    def wall_now(self) -> float:
+        """Anchor-derived wall time (monotonic progression since the
+        one wall reading taken at construction)."""
+        anchor_wall, anchor_mono = self._anchor
+        return anchor_wall + (self._clock() - anchor_mono)
 
     # -- step lifecycle ------------------------------------------------
 
     def step_start(self, step: Optional[int] = None) -> None:
         with self._lock:
             self._t0 = self._clock()
-            self._wall0 = time.time()
+            self._wall0 = self.wall_now()
             self._step = step
             self._phases = {}
 
@@ -373,13 +387,24 @@ def flush_snapshot(timer: StepTimer, interval_s: float = 2.0,
             cli.call("kv_put", {
                 "ns": metrics_mod.METRICS_NS,
                 "key": key,
-                "val": pickle.dumps({"ts": time.time(), "metrics": [],
+                # anchor-derived stamp: must agree with the ring's
+                # per-step "ts" values even across an NTP step
+                "val": pickle.dumps({"ts": timer.wall_now(),
+                                     "metrics": [],
                                      "telemetry": timer.snapshot()}),
             }, timeout=5.0)
         except Exception:
             # degraded, not dead: fail fast here, heal in the background
             _kick_reattach(core, cli)
             return False
+        try:
+            # piggyback the device-observability flush on the same
+            # rate-limited heartbeat (telemetry/device.py)
+            from .device import flush_device_snapshot
+
+            flush_device_snapshot(interval_s=interval_s)
+        except Exception:
+            pass
         return True
     except Exception:
         return False

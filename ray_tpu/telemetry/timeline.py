@@ -73,14 +73,29 @@ def _children_of(phases: Dict[str, float], parent: str) -> List[str]:
     return [p for p in _phase_sorted(phases) if p.startswith(pre)]
 
 
+def collect_device_workers(control_client) -> Dict[str, Dict[str, Any]]:
+    """Pull every worker's ``_device`` snapshot for compile-slice
+    overlay (see telemetry/device.py)."""
+    try:
+        from .device import collect_device_stats
+
+        return collect_device_stats(control_client).get("workers", {})
+    except Exception:
+        return {}
+
+
 def chrome_trace(snapshots: List[Dict[str, Any]],
-                 remediations: Optional[List[Dict[str, Any]]] = None
+                 remediations: Optional[List[Dict[str, Any]]] = None,
+                 device_workers: Optional[Dict[str, Dict[str, Any]]] = None
                  ) -> Dict[str, Any]:
     """Render snapshots as a Chrome trace: one process per worker rank,
     an "X" span per step plus sequential per-phase child spans.
     Remediation records land as global instant events ("i") at their
     cause/action/effect wall timestamps, so the timeline answers "why
-    did the cluster change shape right here"."""
+    did the cluster change shape right here".  ``device_workers``
+    (from ``collect_device_workers``) adds one process of XLA-compile
+    slices per worker so a recompile storm is visible against the very
+    steps it stalled."""
     events: List[Dict[str, Any]] = []
     for snap in sorted(snapshots, key=lambda s: s.get("rank", 0)):
         rank = snap.get("rank", 0)
@@ -152,6 +167,13 @@ def chrome_trace(snapshots: List[Dict[str, Any]],
                 "pid": 0, "tid": 0, "s": "g",  # global-scope instant
                 "args": {"remediation": rec, "phase": phase},
             })
+    if device_workers:
+        try:
+            from .device import compile_trace_events
+
+            events.extend(compile_trace_events(device_workers))
+        except Exception:
+            pass
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

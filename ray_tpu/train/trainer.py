@@ -210,6 +210,15 @@ class JaxTrainer:
                                 if isinstance(m, dict) else None
                                 for _, m, _ in results])
                             if remediation is not None:
+                                try:
+                                    from ray_tpu.telemetry import (
+                                        device as _devtel)
+
+                                    for adv in (_devtel.get_ledger()
+                                                .drain_advisories()):
+                                        remediation.observe_advisory(adv)
+                                except Exception:
+                                    pass
                                 decision = remediation.observe_round(
                                     aggregator)
                                 if decision is not None:

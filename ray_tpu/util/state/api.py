@@ -26,7 +26,7 @@ __all__ = [
     "get_node", "get_actor", "get_task", "get_placement_group",
     "summarize_tasks", "summarize_actors", "summarize_objects",
     "cluster_resources", "available_resources", "timeline", "StateApiClient",
-    "control_stats",
+    "control_stats", "device_stats",
 ]
 
 
@@ -81,6 +81,15 @@ class StateApiClient:
         loop lag, KV namespace counters, pubsub fan-out, event-queue
         depth (the `ray-tpu control-stats` CLI renders this)."""
         return self._control.call("control_stats", {}, timeout=10.0)
+
+    def device_stats(self) -> Dict[str, Any]:
+        """Cluster-wide device runtime observability: merged XLA
+        compilation ledgers (compile/recompile counts, cause diffs,
+        storm advisories) + device-memory censuses (the `ray-tpu
+        device-stats` CLI and `GET /api/device/stats` render this)."""
+        from ray_tpu.telemetry.device import collect_device_stats
+
+        return collect_device_stats(self._control)
 
     def per_node(self, method: str, payload=None) -> Dict[str, Any]:
         """Fan a query out to every alive raylet (node_id -> reply)."""
@@ -242,6 +251,12 @@ def control_stats(address: Optional[str] = None,
                 for nid, reply in handlers.items()}
         return out
     return _run(address, go)
+
+
+def device_stats(address: Optional[str] = None) -> Dict[str, Any]:
+    """Cluster-wide compilation-ledger + memory-census merge (see
+    telemetry/device.py)."""
+    return _run(address, lambda c: c.device_stats())
 
 
 # -- get_* ------------------------------------------------------------------

@@ -70,7 +70,8 @@ _QUICK_FILES = {
     "test_asyncio_api.py", "test_collective_compression.py",
     "test_config.py", "test_control_stats.py", "test_core_actors.py",
     "test_core_objects.py", "test_core_tasks.py", "test_data.py",
-    "test_data_remote_io.py", "test_elastic.py", "test_label_scheduling.py",
+    "test_data_remote_io.py", "test_device_telemetry.py", "test_elastic.py",
+    "test_label_scheduling.py",
     "test_mpmd.py",
     "test_native_sched.py", "test_native_store.py", "test_ops.py",
     "test_parallel.py", "test_partition.py", "test_podracer.py",

@@ -106,6 +106,9 @@ def test_jax_purity_fixture():
     assert got == [
         ("host-call", "host_pull", ".item"),
         ("host-call", "host_pull", "np.asarray"),
+        ("jit-per-call", "loop_jit", "loop:<lambda>"),
+        ("jit-per-call", "per_call_closure", "closure:inner"),
+        ("jit-per-call", "per_call_decorated", "closure:inner2"),
         ("nondeterminism", "nondet", "random.random"),
         ("nondeterminism", "nondet", "time.time"),
         ("side-effect", "impure_print", "print"),
@@ -113,8 +116,9 @@ def test_jax_purity_fixture():
         ("unhashable-static", "bad_static", "default:cfg"),
         ("unhashable-static", "caller", "call:bad_static:cfg"),
     ]
-    # the untraced clean() control is never flagged
-    assert not any(f.func == "clean" for f in mine)
+    # negative controls: the untraced clean() and the factory that
+    # RETURNS its jitted wrapper are never flagged
+    assert not any(f.func in ("clean", "jit_factory") for f in mine)
 
 
 # -- baseline round-trip ------------------------------------------------------

@@ -112,6 +112,40 @@ def test_step_timer_ring_bounded_and_aggregate():
     assert agg["phase_means_s"]["compute"] == pytest.approx(0.5)
 
 
+def test_step_timer_wall_mono_anchor():
+    """One wall<->mono anchor per incarnation: the wall clock is read
+    exactly once (at construction) and every "ts" the timer emits is
+    derived from the monotonic clock via that anchor, so an NTP step
+    mid-run moves nothing."""
+    mono = FakeClock()
+    wall = FakeClock()
+    wall.t = 50_000.0
+    reads = []
+
+    def stepped_wall():
+        reads.append(wall.t)
+        return wall()
+
+    t = StepTimer(ring_size=4, rank=0, clock=mono, wall=stepped_wall)
+    assert len(reads) == 1
+    mono.advance(2.0)
+    wall.t += 3600.0                 # NTP step: wall jumps an hour ahead
+    assert t.wall_now() == pytest.approx(50_002.0)
+    t.step_start(0)
+    mono.advance(0.5)
+    rec = t.step_end(0)
+    assert rec["ts"] == pytest.approx(50_002.0)
+    assert rec["dur"] == pytest.approx(0.5)
+    wall.t -= 7200.0                 # and back behind the anchor
+    t.step_start(1)
+    mono.advance(0.5)
+    rec2 = t.step_end(1)
+    # strictly monotonic "ts" progression despite both wall steps
+    assert rec2["ts"] == pytest.approx(50_002.5)
+    assert t.wall_now() == pytest.approx(50_003.0)
+    assert len(reads) == 1           # never re-read after construction
+
+
 def test_phase_is_noop_outside_session():
     # train loops use ray_tpu.telemetry.phase unconditionally; with no
     # current timer (telemetry off / outside a session) it must be free
